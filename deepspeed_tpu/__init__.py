@@ -1,0 +1,83 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capability surface of DeepSpeed (reference: kooyunmo/DeepSpeed; see SURVEY.md).
+
+Public API parity (SURVEY.md §2.1 "Public API"): ``initialize()``,
+``init_inference()``, ``init_distributed()``, ``add_config_arguments()``, the
+``comm`` and ``zero`` submodules, and ``DeepSpeedConfig`` — reimplemented over
+jax/XLA/pjit with a device mesh instead of torch/NCCL.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
+from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
+from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
+
+
+def initialize(args=None, model=None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, distributed_port=29500,
+               mpu=None, dist_init_required=None, collate_fn=None, config=None,
+               config_params=None, mesh=None, rng=None):
+    """Create a training engine (reference contract: SURVEY.md §3.2).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    ``model`` may be a flax ``nn.Module`` or any object exposing
+    ``init(rng, *inputs)`` / ``apply(params, *inputs)``.  See
+    ``deepspeed_tpu/runtime/engine.py`` for the engine design (functional
+    jitted train step under an imperative forward/backward/step façade).
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    cfg = config if config is not None else config_params
+    if cfg is None and args is not None and hasattr(args, "deepspeed_config"):
+        cfg = args.deepspeed_config
+    engine = DeepSpeedEngine(args=args, model=model, optimizer=optimizer,
+                             model_parameters=model_parameters, training_data=training_data,
+                             lr_scheduler=lr_scheduler, mpu=mpu,
+                             dist_init_required=dist_init_required, collate_fn=collate_fn,
+                             config=cfg, mesh=mesh, rng=rng)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Create an inference engine (reference: SURVEY.md §3.5)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    if config is None:
+        config = kwargs
+    elif kwargs:
+        config = {**(config if isinstance(config, dict) else {}), **kwargs}
+    if not isinstance(config, DeepSpeedInferenceConfig):
+        config = DeepSpeedInferenceConfig(**config)
+    return InferenceEngine(model, config)
+
+
+def init_distributed(dist_backend: str = "xla", **kwargs):
+    """Bootstrap multi-host + mesh (reference: ``deepspeed.init_distributed``)."""
+    return comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Add ``--deepspeed``/``--deepspeed_config`` CLI args (reference parity)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag, parity with reference)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the ds_config JSON file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="Local rank injected by the launcher")
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+
+    return argparse.SUPPRESS

@@ -1,0 +1,3 @@
+from deepspeed_tpu.accelerator.real_accelerator import get_accelerator, set_accelerator
+
+__all__ = ["get_accelerator", "set_accelerator"]

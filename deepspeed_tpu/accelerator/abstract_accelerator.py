@@ -1,0 +1,141 @@
+"""Accelerator abstraction.
+
+TPU-native analog of the reference's ``deepspeed/accelerator/abstract_accelerator.py``
+(SURVEY.md §2.1 "Accelerator abstraction"): the seam the north star says to
+swap — device management, memory stats, dtype support probes,
+``communication_backend_name()``, and op-builder lookup.  The reference ABC
+has ~90 methods because torch exposes streams/events/allocator knobs; under
+XLA many of those are meaningless (no user-visible streams — the compiler
+schedules; no caching allocator — buffers are XLA-managed), so those methods
+exist for API parity and are documented no-ops.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    _name: str = "abstract"
+    _communication_backend_name: str = "xla"
+
+    # -- device queries -----------------------------------------------------
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str: ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None) -> Any: ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int: ...
+
+    def current_device(self) -> int:
+        return 0
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    def set_device(self, device_index: int) -> None:  # no-op: XLA places buffers
+        pass
+
+    def is_available(self) -> bool:
+        return self.device_count() > 0
+
+    # -- synchronization ----------------------------------------------------
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        jax.device_get(jnp.zeros(()))
+
+    # Streams/events: XLA has no user streams; parity no-ops.
+    def Stream(self, *args, **kwargs):
+        return None
+
+    def stream(self, stream):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def current_stream(self, device_index: Optional[int] = None):
+        return None
+
+    def default_stream(self, device_index: Optional[int] = None):
+        return None
+
+    def Event(self, *args, **kwargs):
+        return None
+
+    # -- RNG ----------------------------------------------------------------
+    def manual_seed(self, seed: int) -> None:
+        self._seed = seed
+
+    def initial_seed(self) -> int:
+        return getattr(self, "_seed", 0)
+
+    # -- memory -------------------------------------------------------------
+    @abc.abstractmethod
+    def memory_allocated(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index: Optional[int] = None) -> int: ...
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    def empty_cache(self) -> None:  # XLA manages buffers; parity no-op
+        pass
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    def memory_stats(self, device_index: Optional[int] = None) -> dict:
+        return {
+            "allocated_bytes.all.current": self.memory_allocated(device_index),
+            "allocated_bytes.all.peak": self.max_memory_allocated(device_index),
+        }
+
+    # -- dtype support ------------------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+
+        out = [jnp.float32]
+        if self.is_bf16_supported():
+            out.append(jnp.bfloat16)
+        if self.is_fp16_supported():
+            out.append(jnp.float16)
+        return out
+
+    # -- misc ---------------------------------------------------------------
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def pin_memory(self, tensor, align_bytes: int = 1):
+        return tensor  # host numpy arrays are already directly DMA-able
+
+    def is_pinned(self, tensor) -> bool:
+        return True
+
+    def name(self) -> str:
+        return self._name
+
+    def create_op_builder(self, op_name: str):
+        from deepspeed_tpu.ops.op_builder import get_op_builder
+
+        builder = get_op_builder(op_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, op_name: str):
+        from deepspeed_tpu.ops.op_builder import get_op_builder
+
+        return get_op_builder(op_name)

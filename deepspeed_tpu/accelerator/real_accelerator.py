@@ -1,0 +1,45 @@
+"""Accelerator auto-detection (reference: ``deepspeed/accelerator/real_accelerator.py``).
+
+``get_accelerator()`` returns the process-wide accelerator, honoring the
+``DS_ACCELERATOR`` env override exactly like the reference (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+from deepspeed_tpu.utils.logging import logger
+
+_ACCELERATOR: Optional[DeepSpeedAccelerator] = None
+
+
+def _detect() -> DeepSpeedAccelerator:
+    override = os.environ.get("DS_ACCELERATOR")
+    if override:
+        if override == "cpu":
+            return CPU_Accelerator()
+        if override in ("tpu", "axon"):
+            return TPU_Accelerator(platform=override)
+        raise ValueError(f"DS_ACCELERATOR={override!r} not supported (tpu, cpu)")
+    import jax
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return CPU_Accelerator()
+    return TPU_Accelerator(platform=backend)
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        _ACCELERATOR = _detect()
+        logger.info("accelerator: %s (%d devices)", _ACCELERATOR.name(), _ACCELERATOR.device_count())
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = accel

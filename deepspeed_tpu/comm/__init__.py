@@ -1,0 +1,23 @@
+"""``deepspeed_tpu.comm`` — mesh-first communication layer (SURVEY.md §5.8)."""
+
+from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_reduce, all_to_all_single,
+                                     axis_index, barrier, broadcast, broadcast_object_list,
+                                     comms_logger, configure, get_local_rank, get_process_count,
+                                     get_rank, get_world_size, init_distributed, is_initialized,
+                                     log_summary, ppermute, reduce_scatter)
+from deepspeed_tpu.comm.mesh import (MESH_AXES, axis_size, batch_sharding, build_mesh,
+                                     data_axes, get_data_parallel_world_size,
+                                     get_expert_parallel_world_size, get_global_mesh,
+                                     get_model_parallel_world_size,
+                                     get_sequence_parallel_world_size, mesh_from_config,
+                                     replicated, set_global_mesh)
+
+__all__ = [
+    "ReduceOp", "all_gather", "all_reduce", "all_to_all_single", "axis_index", "barrier",
+    "broadcast", "broadcast_object_list", "comms_logger", "configure", "get_local_rank",
+    "get_process_count", "get_rank", "get_world_size", "init_distributed", "is_initialized",
+    "log_summary", "ppermute", "reduce_scatter", "MESH_AXES", "axis_size", "batch_sharding",
+    "build_mesh", "data_axes", "get_data_parallel_world_size", "get_expert_parallel_world_size",
+    "get_global_mesh", "get_model_parallel_world_size", "get_sequence_parallel_world_size",
+    "mesh_from_config", "replicated", "set_global_mesh",
+]

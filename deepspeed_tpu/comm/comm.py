@@ -1,0 +1,261 @@
+"""The ``deepspeed_tpu.comm`` façade.
+
+TPU-native analog of the reference's ``deepspeed/comm/comm.py`` +
+``deepspeed/comm/torch.py`` (SURVEY.md §2.1 "comm API", §5.8): the same
+module-level function surface (``init_distributed``, ``get_rank``,
+``get_world_size``, ``all_reduce``, ``all_gather``, ``reduce_scatter``,
+``all_to_all_single``, ``broadcast``, ``barrier``) but backed by XLA
+collectives over the device mesh instead of torch.distributed/NCCL.
+
+Two tiers, matching SURVEY.md §5.8's design note:
+
+1. **In-jit named-axis collectives** — ``psum``/``all_gather``/
+   ``psum_scatter``/``all_to_all``/``ppermute`` wrappers that take a mesh-axis
+   name.  These are what the runtime uses on the hot path (inside
+   ``jit``/``shard_map``); XLA schedules them onto ICI/DCN and overlaps them
+   with compute.  Each wrapper records trace-time metadata into the
+   ``CommsLogger`` (op, shape, bytes) — latency attribution comes from the
+   profiler, not eager timers, because there is no eager hot path to time.
+
+2. **Eager control-plane ops** — process-level broadcast/barrier built on
+   ``jax.experimental.multihost_utils`` for config agreement, checkpoint
+   coordination, etc.  These are NOT for gradients.
+
+Rank semantics on TPU: ``get_rank()`` is the JAX *process* index (one per
+host); ``get_world_size()`` is the global *device* count, which is what the
+batch triad and ZeRO partitioning math need (the reference's rank==GPU model
+maps to device, not process, on TPU).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from collections import defaultdict
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.comm.mesh import (MESH_AXES, build_mesh, get_global_mesh, mesh_from_config,
+                                     set_global_mesh)
+from deepspeed_tpu.utils.logging import logger
+
+_INITIALIZED = False
+
+ReduceOp = type("ReduceOp", (), {"SUM": "sum", "AVG": "avg", "MAX": "max", "MIN": "min", "PRODUCT": "prod"})
+
+
+class CommsLogger:
+    """Trace-time collective accounting (reference: ``@timed_op`` + log_summary).
+
+    Inside jit we cannot wall-clock individual collectives, so we record
+    (op, axis, shape, bytes) at trace time and leave latency to the XLA
+    profiler; ``log_summary()`` prints counts and volumes per op.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.bytes: Dict[str, int] = defaultdict(int)
+
+    def configure(self, enabled: bool = False, verbose: bool = False, **_: Any) -> None:
+        self.enabled = enabled
+        self.verbose = verbose
+
+    def record(self, op: str, axis: Any, x: Any) -> None:
+        if not self.enabled:
+            return
+        try:
+            nbytes = int(x.size) * x.dtype.itemsize
+        except Exception:
+            nbytes = 0
+        key = f"{op}@{axis}"
+        self.counts[key] += 1
+        self.bytes[key] += nbytes
+        if self.verbose:
+            logger.info("comm trace: %s shape=%s bytes=%d", key, getattr(x, "shape", None), nbytes)
+
+    def log_summary(self) -> str:
+        lines = ["Comms summary (trace-time counts; use jax.profiler for latency):"]
+        for key in sorted(self.counts):
+            lines.append(f"  {key}: count={self.counts[key]} bytes={self.bytes[key]:,}")
+        text = "\n".join(lines)
+        logger.info("%s", text)
+        return text
+
+    def reset(self) -> None:
+        self.counts.clear()
+        self.bytes.clear()
+
+
+comms_logger = CommsLogger()
+
+
+def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = False,
+                     distributed_port: int = 29500, verbose: bool = True,
+                     timeout: datetime.timedelta = datetime.timedelta(minutes=30),
+                     init_method: Optional[str] = None, dist_init_required: Optional[bool] = None,
+                     config: Optional[Any] = None, rank: int = -1, world_size: int = -1) -> None:
+    """Bootstrap multi-host JAX and the global mesh.
+
+    Reference parity: ``deepspeed.comm.init_distributed`` (SURVEY.md §3.2).
+    On a single host this is a cheap no-op apart from mesh construction; on a
+    TPU pod it calls ``jax.distributed.initialize`` (coordinator discovered
+    from TPU metadata or ``COORDINATOR_ADDRESS``/``MASTER_ADDR`` env, matching
+    the reference launcher's env contract).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    # IMPORTANT: decide from env only — any jax query (process_count etc.)
+    # would initialize the XLA backend and make jax.distributed.initialize
+    # raise.  jax auto-detects all args on TPU pods when passed None.
+    multi_host = (os.environ.get("COORDINATOR_ADDRESS") or
+                  (os.environ.get("MASTER_ADDR") and os.environ.get("WORLD_SIZE")))
+    already_up = jax._src.distributed.global_state.client is not None
+    if multi_host and not already_up:
+        coord = os.environ.get("COORDINATOR_ADDRESS") or \
+            f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', distributed_port)}"
+        nproc = int(os.environ["WORLD_SIZE"]) if "WORLD_SIZE" in os.environ else \
+            (world_size if world_size > 0 else None)
+        pid = int(os.environ["RANK"]) if "RANK" in os.environ else (rank if rank >= 0 else None)
+        logger.info("jax.distributed.initialize(coordinator=%s, num_processes=%s, process_id=%s)",
+                    coord, nproc, pid)
+        try:
+            jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
+                                       process_id=pid)
+        except RuntimeError as exc:
+            # Backend already initialized (e.g. tests touched jax first):
+            # surface loudly but keep single-process semantics usable.
+            logger.error("jax.distributed.initialize failed: %s", exc)
+    if config is not None and getattr(config, "mesh", None) is not None:
+        set_global_mesh(mesh_from_config(config.mesh))
+    _INITIALIZED = True
+    if verbose:
+        logger.info("init_distributed: backend=%s processes=%d devices=%d",
+                    dist_backend, jax.process_count(), jax.device_count())
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def get_rank(group: Any = None) -> int:
+    return jax.process_index()
+
+
+def get_local_rank() -> int:
+    return 0
+
+
+def get_world_size(group: Any = None) -> int:
+    return jax.device_count()
+
+
+def get_process_count() -> int:
+    return jax.process_count()
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: in-jit named-axis collectives (the hot path).
+# Use inside jit / shard_map bodies with a mesh axis name (or tuple of names).
+# ---------------------------------------------------------------------------
+
+def all_reduce(x, axis: Union[str, Sequence[str]] = ("dp", "fsdp"), op: str = "sum"):
+    """psum/pmax/pmin over a named mesh axis (reference: dist.all_reduce)."""
+    comms_logger.record("all_reduce", axis, x)
+    if op in ("sum", ReduceOp.SUM):
+        return lax.psum(x, axis)
+    if op in ("avg", ReduceOp.AVG):
+        return lax.pmean(x, axis)
+    if op in ("max", ReduceOp.MAX):
+        return lax.pmax(x, axis)
+    if op in ("min", ReduceOp.MIN):
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_gather(x, axis: Union[str, Sequence[str]], gather_dim: int = 0, tiled: bool = True):
+    """all_gather along a named axis (reference: all_gather_into_tensor)."""
+    comms_logger.record("all_gather", axis, x)
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: Union[str, Sequence[str]], scatter_dim: int = 0):
+    """psum_scatter (reference: reduce_scatter_tensor) — the ZeRO-2/3 grad op."""
+    comms_logger.record("reduce_scatter", axis, x)
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def all_to_all_single(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
+    """all_to_all (reference: all_to_all_single) — MoE dispatch / Ulysses."""
+    comms_logger.record("all_to_all", axis, x)
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
+
+
+def ppermute(x, axis: str, perm):
+    """Point-to-point ring shift (reference: send/recv pairs in pipe/p2p.py)."""
+    comms_logger.record("ppermute", axis, x)
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: eager control-plane ops (NOT for gradients).
+# ---------------------------------------------------------------------------
+
+def barrier(group: Any = None) -> None:
+    """Synchronize all processes (reference: dist.barrier)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+
+
+def broadcast(x, src: int = 0, group: Any = None):
+    """Broadcast a host value from process ``src`` to all processes."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(x, is_source=jax.process_index() == src)
+    return x
+
+
+def broadcast_object_list(objects, src: int = 0, group: Any = None):
+    if jax.process_count() > 1:
+        import pickle
+
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        is_source = jax.process_index() == src
+        payload = pickle.dumps(objects)
+        true_len = jnp.asarray(len(payload), dtype=jnp.int32)
+        n = int(multihost_utils.broadcast_one_to_all(true_len, is_source=is_source))
+        # Receivers must present a buffer of the SOURCE's length — their own
+        # payload may differ in size and is irrelevant.
+        if is_source:
+            buf = np.frombuffer(payload, dtype=np.uint8)
+        else:
+            buf = np.zeros(n, dtype=np.uint8)
+        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+        return pickle.loads(bytes(bytearray(out))[:n])
+    return objects
+
+
+def log_summary() -> str:
+    return comms_logger.log_summary()
+
+
+def configure(deepspeed_config=None, **kwargs) -> None:
+    if deepspeed_config is not None and getattr(deepspeed_config, "comms_logger", None):
+        c = deepspeed_config.comms_logger
+        comms_logger.configure(enabled=c.enabled, verbose=c.verbose)
+    elif kwargs:
+        comms_logger.configure(**kwargs)

@@ -1,0 +1,159 @@
+"""Device-mesh construction and axis algebra.
+
+TPU-native replacement for the reference's process-group machinery
+(``deepspeed/utils/groups.py`` + ``runtime/pipe/topology.py``, SURVEY.md §2.1
+"Process-group algebra", §5.8): instead of creating torch ProcessGroups per
+parallelism dimension, we build one ``jax.sharding.Mesh`` whose *named axes*
+are the parallelism dimensions.  Collectives then reference axis names inside
+``jit``/``shard_map`` and XLA lowers them onto ICI (intra-slice) or DCN
+(inter-slice) links.
+
+Axis meanings (mirroring the reference's DP/TP/PP/EP/SP groups):
+
+- ``pp``   pipeline stages. Outermost so a stage maps to a contiguous device
+           block (pipeline neighbors exchange over one link; across slices
+           this is the axis that rides DCN).
+- ``dp``   pure data parallelism (gradients all-reduced, nothing sharded).
+- ``fsdp`` the ZeRO axis: optimizer state (stage>=1), gradients (stage>=2) and
+           parameters (stage 3) are sharded over it.
+- ``ep``   expert parallelism for MoE all-to-all dispatch.
+- ``sp``   sequence parallelism (Ulysses all-to-all / ring attention).
+- ``tp``   tensor (model) parallelism. Innermost: TP collectives are on the
+           critical path of every matmul, so they get the fastest links.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import logger
+
+MESH_AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+def build_mesh(dp: int = 0, fsdp: int = 0, tp: int = 1, pp: int = 1, sp: int = 1,
+               ep: int = 1, devices: Optional[Sequence] = None,
+               axis_order: Optional[Sequence[str]] = None) -> Mesh:
+    """Build a Mesh over all (or the given) devices.
+
+    Axis sizes of 0 are inferred: ``fsdp`` absorbs the remaining device count;
+    if ``fsdp`` is explicitly set and ``dp`` is 0, ``dp`` absorbs it instead.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = {"tp": max(1, tp), "pp": max(1, pp), "sp": max(1, sp), "ep": max(1, ep)}
+    known = math.prod(fixed.values())
+    if n % known != 0:
+        raise ValueError(f"device count {n} not divisible by tp*pp*sp*ep={known}")
+    remainder = n // known
+    if dp and fsdp:
+        if dp * fsdp != remainder:
+            raise ValueError(f"dp({dp})*fsdp({fsdp}) != remaining devices {remainder}")
+    elif fsdp:
+        if remainder % fsdp != 0:
+            raise ValueError(f"fsdp={fsdp} does not divide remaining devices {remainder}")
+        dp = remainder // fsdp
+    else:
+        dp = dp or 1
+        if remainder % dp != 0:
+            raise ValueError(f"dp={dp} does not divide remaining devices {remainder}")
+        fsdp = remainder // dp
+    sizes: Dict[str, int] = {"pp": fixed["pp"], "dp": dp, "fsdp": fsdp,
+                             "ep": fixed["ep"], "sp": fixed["sp"], "tp": fixed["tp"]}
+    order: Tuple[str, ...] = tuple(axis_order) if axis_order else MESH_AXES
+    # Any axis missing from a custom order is appended with its configured size.
+    order = tuple(a for a in order if a in sizes) + tuple(a for a in MESH_AXES if a not in order)
+    shape = [sizes[a] for a in order]
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, order)
+    logger.info("built mesh %s over %d devices", dict(zip(order, shape)), n)
+    return mesh
+
+
+def mesh_from_config(mesh_cfg, devices: Optional[Sequence] = None) -> Mesh:
+    return build_mesh(dp=mesh_cfg.dp, fsdp=mesh_cfg.fsdp, tp=mesh_cfg.tp,
+                      pp=mesh_cfg.pp, sp=mesh_cfg.sp, ep=mesh_cfg.ep,
+                      devices=devices, axis_order=mesh_cfg.axis_order)
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh(create_default: bool = True) -> Optional[Mesh]:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None and create_default:
+        _GLOBAL_MESH = build_mesh()
+    return _GLOBAL_MESH
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape.get(axis, 1))
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes over which the global batch is split (dp × fsdp × ep is treated as
+    batch-parallel at the input; ep resharding happens at MoE layers)."""
+    return tuple(a for a in ("dp", "fsdp", "ep") if axis_size(mesh, a) > 1) or ("dp",)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [global_batch, ...] input batch."""
+    return NamedSharding(mesh, P(data_axes(mesh)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Reference-parity group queries (deepspeed/utils/groups.py equivalents).
+# On TPU a "group" is a mesh axis name (or tuple of names).
+# ---------------------------------------------------------------------------
+
+def get_data_parallel_group(mesh: Optional[Mesh] = None):
+    return ("dp", "fsdp")
+
+
+def get_model_parallel_group(mesh: Optional[Mesh] = None):
+    return ("tp",)
+
+
+def get_expert_parallel_group(mesh: Optional[Mesh] = None):
+    return ("ep",)
+
+
+def get_sequence_parallel_group(mesh: Optional[Mesh] = None):
+    return ("sp",)
+
+
+def get_pipeline_parallel_group(mesh: Optional[Mesh] = None):
+    return ("pp",)
+
+
+def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_global_mesh()
+    return axis_size(mesh, "dp") * axis_size(mesh, "fsdp")
+
+
+def get_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_global_mesh()
+    return axis_size(mesh, "tp")
+
+
+def get_expert_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_global_mesh()
+    return axis_size(mesh, "ep")
+
+
+def get_sequence_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_global_mesh()
+    return axis_size(mesh, "sp")

@@ -1,0 +1,65 @@
+"""Op-builder registry.
+
+TPU-native analog of the reference's ``op_builder/`` (SURVEY.md §2.1 "Op
+builder system").  On GPU the builders JIT-compile CUDA; here most "ops" are
+Pallas kernels that need no build step, so a builder reports availability and
+returns the op module.  Native host-side ops (cpu_adam C++, async AIO) do have
+a real build step via a Makefile-driven ``load()`` — see
+``deepspeed_tpu/ops/op_builder/native.py``.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, Optional, Type
+
+_REGISTRY: Dict[str, str] = {
+    # op name -> module providing it
+    "fused_adam": "deepspeed_tpu.ops.adam.fused_adam",
+    "cpu_adam": "deepspeed_tpu.ops.adam.cpu_adam",
+    "cpu_adagrad": "deepspeed_tpu.ops.adagrad.cpu_adagrad",
+    "cpu_lion": "deepspeed_tpu.ops.lion.cpu_lion",
+    "fused_lamb": "deepspeed_tpu.ops.lamb.fused_lamb",
+    "fused_lion": "deepspeed_tpu.ops.lion.fused_lion",
+    "transformer": "deepspeed_tpu.ops.transformer.transformer",
+    "transformer_inference": "deepspeed_tpu.ops.transformer.inference",
+    "quantizer": "deepspeed_tpu.ops.quantizer",
+    "async_io": "deepspeed_tpu.ops.aio",
+    "sparse_attn": "deepspeed_tpu.ops.sparse_attention",
+    "random_ltd": "deepspeed_tpu.ops.random_ltd",
+}
+
+
+class OpBuilder:
+    """Build/availability probe for one op (reference: ``OpBuilder.load()``)."""
+
+    def __init__(self, name: str, module: str):
+        self.name = name
+        self.module = module
+
+    def is_compatible(self) -> bool:
+        try:
+            importlib.import_module(self.module)
+            return True
+        except Exception:
+            return False
+
+    def load(self):
+        return importlib.import_module(self.module)
+
+    def builder_name(self) -> str:
+        return self.name
+
+
+def get_op_builder(op_name: str) -> Optional[Type]:
+    if op_name not in _REGISTRY:
+        return None
+    module = _REGISTRY[op_name]
+
+    def factory():
+        return OpBuilder(op_name, module)
+
+    return factory
+
+
+ALL_OPS = dict(_REGISTRY)

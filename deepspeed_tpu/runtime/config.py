@@ -1,0 +1,482 @@
+"""ds_config.json-compatible configuration system.
+
+TPU-native analog of the reference's ``deepspeed/runtime/config.py``
+(SURVEY.md §2.1 "Config system", §5.6): parses the single JSON config (path,
+dict, or base64-encoded JSON) into typed sub-configs, resolves the batch-size
+triad ``train_batch_size = micro_batch_per_gpu * gradient_accumulation_steps *
+world_size`` (any one of the three may be omitted), validates the result, and
+exposes every section the reference supports plus a TPU-only ``mesh``
+extension section describing the ICI/DCN device-mesh axes.
+
+"gpu" in key names (``train_micro_batch_size_per_gpu``) is kept verbatim for
+config compatibility; on TPU it means "per chip".
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any, ClassVar, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import AUTO, DeepSpeedConfigModel, get_scalar_param
+from deepspeed_tpu.utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# Section models
+# ---------------------------------------------------------------------------
+
+
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    auto_cast: bool = False
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class AMPConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    opt_level: str = "O1"
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "Adam"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    device: str = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: str = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    """``zero_optimization`` section (SURVEY.md §2.1 "ZeRO config").
+
+    On TPU the stages are sharding policies over the ``fsdp`` mesh axis
+    (SURVEY.md §7): stage 1 shards optimizer state, stage 2 additionally
+    reduce-scatters gradients, stage 3 shards parameters.  Bucket-size knobs
+    are accepted for compatibility and used as scheduling hints only — XLA/GSPMD
+    does the actual bucketing/overlap.
+    """
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    cpu_offload: Optional[bool] = None  # deprecated spelling
+    cpu_offload_params: Optional[bool] = None
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    DEPRECATED_FIELDS: ClassVar[Dict[str, str]] = {
+        "stage3_gather_fp16_weights_on_model_save": "stage3_gather_16bit_weights_on_model_save"}
+
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    memory_efficient_linear: bool = True
+
+    def model_post_init(self, ctx: Any) -> None:
+        super().model_post_init(ctx)
+        # cpu_offload is a structural migration (bool -> offload_optimizer
+        # section), not a rename, so it can't use DEPRECATED_FIELDS.
+        if self.cpu_offload and self.offload_optimizer is None:
+            object.__setattr__(self, "offload_optimizer",
+                               DeepSpeedZeroOffloadOptimizerConfig(device=OffloadDeviceEnum.cpu))
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class AIOConfig(DeepSpeedConfigModel):
+    block_size: int = 1_048_576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    async_save: bool = False
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    autotp_size: int = 1
+    tp_size: int = 1
+
+    def model_post_init(self, ctx: Any) -> None:
+        super().model_post_init(ctx)
+        if self.autotp_size > 1 and self.tp_size == 1:
+            object.__setattr__(self, "tp_size", self.autotp_size)
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU extension section (SURVEY.md §5.6 "add a mesh/tpu section").
+
+    Axis sizes for the device mesh.  Any axis left at 0 is inferred: ``fsdp``
+    absorbs whatever is left of the device count after the explicit axes.
+    Axis order is (dp, fsdp, tp, sp, ep-folded-into-dp/fsdp, pp outermost for
+    DCN) — see deepspeed_tpu/comm/mesh.py for the layout rationale.
+    """
+
+    dp: int = 0
+    fsdp: int = 0
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    axis_order: List[str] = Field(default_factory=lambda: ["pp", "dp", "fsdp", "ep", "sp", "tp"])
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    weight_quantization: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    arg_mappings: Dict[str, str] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Top-level config
+# ---------------------------------------------------------------------------
+
+
+def _load_config_dict(config: Union[str, Dict, None]) -> Dict:
+    if config is None:
+        return {}
+    if isinstance(config, dict):
+        return dict(config)
+    if isinstance(config, (str, os.PathLike)):
+        path = str(config)
+        if os.path.exists(path):
+            with open(path, "r") as fh:
+                return json.load(fh)
+        # The reference also accepts base64-encoded JSON (SURVEY.md §5.6,
+        # verified via accelerate's deepspeed plugin).
+        try:
+            decoded = base64.urlsafe_b64decode(path).decode("utf-8")
+            return json.loads(decoded)
+        except Exception:
+            pass
+        try:
+            return json.loads(path)
+        except Exception as exc:
+            raise ValueError(
+                f"Expected a path to a ds_config JSON file, a JSON string, or a dict; got {path!r}") from exc
+    raise TypeError(f"Unsupported config type: {type(config)}")
+
+
+class DeepSpeedConfig:
+    """Parsed, validated view of a ds_config.
+
+    Mirrors the reference's public attribute surface (``train_batch_size``,
+    ``train_micro_batch_size_per_gpu``, ``gradient_accumulation_steps``,
+    ``zero_config``, ``fp16_enabled``, ...) so code written against the
+    reference config object keeps working.
+    """
+
+    def __init__(self, config: Union[str, Dict, None], mpu=None, mesh_device=None,
+                 world_size: Optional[int] = None):
+        self._param_dict = _load_config_dict(config)
+        d = self._param_dict
+
+        if world_size is not None:
+            self.world_size = int(world_size)
+        elif mpu is not None and hasattr(mpu, "get_data_parallel_world_size"):
+            self.world_size = int(mpu.get_data_parallel_world_size())
+        else:
+            self.world_size = _default_world_size()
+
+        # -- batch triad ----------------------------------------------------
+        tbs = d.get("train_batch_size")
+        mbs = d.get("train_micro_batch_size_per_gpu")
+        gas = d.get("gradient_accumulation_steps")
+        tbs = None if tbs == AUTO else tbs
+        mbs = None if mbs == AUTO else mbs
+        gas = None if gas == AUTO else gas
+        (self.train_batch_size,
+         self.train_micro_batch_size_per_gpu,
+         self.gradient_accumulation_steps) = resolve_batch_triad(tbs, mbs, gas, self.world_size)
+
+        # -- scalar knobs ---------------------------------------------------
+        self.steps_per_print = _scalar(d, "steps_per_print", 10)
+        self.wall_clock_breakdown = _scalar(d, "wall_clock_breakdown", False)
+        self.dump_state = _scalar(d, "dump_state", False)
+        self.gradient_clipping = _scalar(d, "gradient_clipping", 0.0)
+        self.prescale_gradients = _scalar(d, "prescale_gradients", False)
+        self.gradient_predivide_factor = _scalar(d, "gradient_predivide_factor", 1.0)
+        self.sparse_gradients_enabled = _scalar(d, "sparse_gradients", False)
+        self.communication_data_type = _scalar(d, "communication_data_type", None)
+        self.zero_allow_untested_optimizer = _scalar(d, "zero_allow_untested_optimizer", False)
+        self.zero_force_ds_cpu_optimizer = _scalar(d, "zero_force_ds_cpu_optimizer", True)
+        self.memory_breakdown = _scalar(d, "memory_breakdown", False)
+        self.seed = _scalar(d, "seed", 42)
+        self.disable_allgather = _scalar(d, "disable_allgather", False)
+        self.train_steps = _scalar(d, "train_steps", None)
+
+        # -- sections -------------------------------------------------------
+        self.fp16 = FP16Config(**d.get("fp16", {}))
+        self.bf16 = BF16Config(**d.get("bf16", d.get("bfloat16", {})))
+        self.amp = AMPConfig(**d.get("amp", {}))
+        self.optimizer = OptimizerConfig(**d["optimizer"]) if "optimizer" in d else None
+        self.scheduler = SchedulerConfig(**d["scheduler"]) if "scheduler" in d else None
+        self.zero_config = DeepSpeedZeroConfig(**d.get("zero_optimization", {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **d.get("activation_checkpointing", {}))
+        self.aio = AIOConfig(**d.get("aio", {}))
+        self.flops_profiler = FlopsProfilerConfig(**d.get("flops_profiler", {}))
+        self.tensorboard = TensorBoardConfig(**d.get("tensorboard", {}))
+        self.wandb = WandbConfig(**d.get("wandb", {}))
+        self.csv_monitor = CSVConfig(**d.get("csv_monitor", {}))
+        self.comms_logger = CommsLoggerConfig(**d.get("comms_logger", {}))
+        self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
+        self.elasticity = ElasticityConfig(**d.get("elasticity", {}))
+        self.tensor_parallel = TensorParallelConfig(**d.get("tensor_parallel", {}))
+        self.mesh = MeshConfig(**d.get("mesh", d.get("tpu", {}).get("mesh", {}) if isinstance(d.get("tpu"), dict) else {}))
+        self.data_efficiency = DataEfficiencyConfig(**d.get("data_efficiency", {}))
+        self.compression_training = CompressionConfig(**d.get("compression_training", {}))
+        self.autotuning = AutotuningConfig(**d.get("autotuning", {}))
+        self.pipeline = d.get("pipeline", {})
+
+        self._validate()
+
+    # -- convenience predicates (reference API parity) ----------------------
+    @property
+    def fp16_enabled(self) -> bool:
+        return bool(self.fp16.enabled)
+
+    @property
+    def bfloat16_enabled(self) -> bool:
+        return bool(self.bf16.enabled)
+
+    @property
+    def loss_scale(self) -> float:
+        return self.fp16.loss_scale
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.fp16.dynamic_loss_scale
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    def dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def get(self, dotted_key: str, default: Any = None) -> Any:
+        return get_scalar_param(self._param_dict, dotted_key, default)
+
+    def _validate(self) -> None:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.zero_config.stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0-3, got {self.zero_config.stage}")
+        if self.train_batch_size <= 0:
+            raise ValueError("train_batch_size must be positive")
+        if self.gradient_clipping < 0:
+            raise ValueError("gradient_clipping must be >= 0")
+
+    def print_config(self) -> None:
+        logger.info("DeepSpeedConfig:")
+        logger.info(json.dumps(self._param_dict, indent=2, sort_keys=True, default=str))
+
+
+def _scalar(d: Dict, key: str, default: Any) -> Any:
+    v = d.get(key, default)
+    return default if v == AUTO else v
+
+
+def _default_world_size() -> int:
+    try:
+        import jax
+
+        return jax.device_count()
+    except Exception:  # pragma: no cover
+        return 1
+
+
+def resolve_batch_triad(train_batch_size: Optional[int],
+                        micro_batch_per_gpu: Optional[int],
+                        grad_accum_steps: Optional[int],
+                        world_size: int):
+    """Fill in any missing member of the batch triad.
+
+    Formula (reference contract, SURVEY.md §2.1 "Config system", restated in
+    the HF integration): ``train_batch_size = train_micro_batch_size_per_gpu *
+    gradient_accumulation_steps * world_size``.
+    """
+    tbs, mbs, gas = train_batch_size, micro_batch_per_gpu, grad_accum_steps
+    if tbs is not None and mbs is not None and gas is not None:
+        if tbs != mbs * gas * world_size:
+            raise ValueError(
+                f"Inconsistent batch config: train_batch_size={tbs} != "
+                f"micro_batch({mbs}) * grad_accum({gas}) * world_size({world_size})")
+        return tbs, mbs, gas
+    if tbs is None and mbs is not None and gas is not None:
+        return mbs * gas * world_size, mbs, gas
+    if mbs is None and tbs is not None and gas is not None:
+        if tbs % (gas * world_size) != 0:
+            raise ValueError(f"train_batch_size {tbs} not divisible by grad_accum*world {gas * world_size}")
+        return tbs, tbs // (gas * world_size), gas
+    if gas is None and tbs is not None and mbs is not None:
+        if tbs % (mbs * world_size) != 0:
+            raise ValueError(f"train_batch_size {tbs} not divisible by micro_batch*world {mbs * world_size}")
+        return tbs, mbs, tbs // (mbs * world_size)
+    if tbs is not None:
+        if tbs % world_size != 0:
+            raise ValueError(f"train_batch_size {tbs} not divisible by world_size {world_size}")
+        return tbs, tbs // world_size, 1
+    if mbs is not None:
+        return mbs * world_size, mbs, 1
+    if gas is not None:
+        return gas * world_size, 1, gas
+    # Nothing specified: micro-batch 1, no accumulation.
+    return world_size, 1, 1

@@ -1,0 +1,93 @@
+"""Config plumbing shared by all ds_config sections.
+
+TPU-native analog of the reference's ``deepspeed/runtime/config_utils.py``
+(SURVEY.md §2.1 "Config system"): a pydantic base model that
+
+- accepts the string ``"auto"`` for any leaf and resolves it to the field
+  default while recording which keys were auto (the engine may later overwrite
+  those with model-dependent values, mirroring the reference's
+  ``reduce_bucket_size = hidden**2`` style fills);
+- supports key deprecation/migration (old name → new name with a warning);
+- tolerates unknown keys with a warning instead of a hard error, so configs
+  written for the reference keep loading.
+"""
+
+from __future__ import annotations
+
+from typing import Any, ClassVar, Dict, List, Set
+
+from pydantic import BaseModel, ConfigDict, PrivateAttr, model_validator
+
+from deepspeed_tpu.utils.logging import logger
+
+AUTO = "auto"
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base class for every ds_config section model."""
+
+    model_config = ConfigDict(extra="allow", populate_by_name=True, validate_assignment=True,
+                              arbitrary_types_allowed=True, protected_namespaces=())
+
+    # Map of deprecated key -> new key, overridden by subclasses.
+    DEPRECATED_FIELDS: ClassVar[Dict[str, str]] = {}
+
+    # Recorded list of field names that were "auto" in the source config.
+    _auto_keys: List[str] = PrivateAttr(default_factory=list)
+
+    @model_validator(mode="before")
+    @classmethod
+    def _resolve_auto_and_deprecated(cls, values: Any) -> Any:
+        if not isinstance(values, dict):
+            return values
+        values = dict(values)
+        auto_keys: Set[str] = set()
+        # Deprecated-key migration.
+        deprecated = getattr(cls, "DEPRECATED_FIELDS", {}) or {}
+        for old, new in deprecated.items():
+            if old in values:
+                if new in values and values[new] != values[old]:
+                    raise ValueError(
+                        f"Config specifies both deprecated '{old}' and its replacement '{new}' with different values")
+                logger.warning("Config key '%s' is deprecated; use '%s'", old, new)
+                values.setdefault(new, values.pop(old))
+        # "auto" resolution: fall back to the field default, remember the key.
+        for name, field in cls.model_fields.items():
+            key = field.alias or name
+            candidates = [key, name]
+            for k in candidates:
+                if k in values and isinstance(values[k], str) and values[k] == AUTO:
+                    auto_keys.add(name)
+                    if field.default_factory is not None:
+                        values[k] = field.default_factory()
+                    else:
+                        values[k] = field.default
+        values["_ds_auto_keys"] = sorted(auto_keys)
+        return values
+
+    def model_post_init(self, __context: Any) -> None:
+        extra = getattr(self, "model_extra", None) or {}
+        auto = extra.pop("_ds_auto_keys", [])
+        self._auto_keys = list(auto)
+        known = set(type(self).model_fields)
+        for key in extra:
+            if key not in known and not key.startswith("_"):
+                logger.warning("%s: ignoring unknown config key '%s'", type(self).__name__, key)
+
+    def was_auto(self, field_name: str) -> bool:
+        return field_name in self._auto_keys
+
+    def fill_auto(self, field_name: str, value: Any) -> None:
+        """Overwrite a field that the user left as "auto" with a computed value."""
+        if self.was_auto(field_name):
+            object.__setattr__(self, field_name, value)
+
+
+def get_scalar_param(config_dict: Dict, name: str, default: Any) -> Any:
+    """Dotted-path config query, e.g. ``zero_optimization.stage``."""
+    node: Any = config_dict
+    for part in name.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return default
+        node = node[part]
+    return node

@@ -1,0 +1,4 @@
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = ["log_dist", "logger", "SynchronizedWallClockTimer", "ThroughputTimer"]

@@ -1,0 +1,76 @@
+"""Rank-aware logging for deepspeed_tpu.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (see
+SURVEY.md §2.1 "Utils: logging/timers"): a module-level ``logger`` plus
+``log_dist(message, ranks)`` that only emits on the requested process
+indices.  On TPU the "rank" is the JAX process index (one process per host,
+SPMD inside), not a per-device rank.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+        lg = logging.getLogger(name)
+        lg.setLevel(level)
+        lg.propagate = False
+        if not lg.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(logging.Formatter(LOG_FORMAT))
+            lg.addHandler(handler)
+        return lg
+
+
+logger = LoggerFactory.create_logger(
+    level=getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO)
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable in this env
+        return 0
+
+
+def should_log_on(ranks: Optional[Iterable[int]]) -> bool:
+    """True when the current process should emit for the given rank filter."""
+    if ranks is None:
+        return True
+    ranks = list(ranks)
+    if -1 in ranks:
+        return True
+    return _process_index() in ranks
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed process indices (default: all)."""
+    if should_log_on(ranks):
+        logger.log(level, "[rank %d] %s", _process_index(), message)
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)
+
+
+def get_log_level() -> int:
+    return logger.getEffectiveLevel()
+
+
+def set_log_level(level: int) -> None:
+    logger.setLevel(level)

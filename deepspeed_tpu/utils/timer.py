@@ -1,0 +1,156 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analog of the reference's ``deepspeed/utils/timer.py`` (SURVEY.md
+§5.1): named start/stop timers with optional device synchronization, and a
+``ThroughputTimer`` that reports samples/sec and an estimated TFLOPS.  On TPU
+"device sync" means blocking on the last dispatched computation
+(``jax.block_until_ready`` has no global variant, so we synchronize via
+``jax.effects_barrier`` when available, falling back to a device transfer).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _device_synchronize() -> None:
+    try:
+        import jax
+
+        # Cheap full-queue sync: transfer a token scalar off-device.
+        jax.device_get(jax.numpy.zeros(()))
+    except Exception:  # pragma: no cover
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str, synchronize: bool = False):
+        self.name = name
+        self.synchronize = synchronize
+        self._start: Optional[float] = None
+        self._elapsed = 0.0
+        self._records: List[float] = []
+        self.started = False
+
+    def start(self) -> None:
+        if self.started:
+            raise RuntimeError(f"timer {self.name} already started")
+        if self.synchronize:
+            _device_synchronize()
+        self._start = time.time()
+        self.started = True
+
+    def stop(self, record: bool = True) -> None:
+        if not self.started:
+            raise RuntimeError(f"timer {self.name} not started")
+        if self.synchronize:
+            _device_synchronize()
+        elapsed = time.time() - self._start
+        self._elapsed += elapsed
+        if record:
+            self._records.append(elapsed)
+        self.started = False
+
+    def reset(self) -> None:
+        self._elapsed = 0.0
+        self._records = []
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self._elapsed
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return sum(self._records) / len(self._records) if self._records else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Registry of named timers; mirrors the reference API shape."""
+
+    FORWARD = "forward"
+    BACKWARD = "backward"
+    STEP = "step"
+    BATCH = "batch"
+
+    def __init__(self, synchronize: bool = False):
+        self.timers: Dict[str, _Timer] = {}
+        self.synchronize = synchronize
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name, synchronize=self.synchronize)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: Optional[List[str]] = None, reset: bool = True, memory_breakdown: bool = False) -> str:
+        names = names if names is not None else list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0
+                parts.append(f"{name}: {ms:.2f}ms")
+        line = " | ".join(parts)
+        if line:
+            logger.info("time (ms) | %s", line)
+        return line
+
+    def means(self) -> Dict[str, float]:
+        return {name: t.mean() for name, t in self.timers.items()}
+
+
+class ThroughputTimer:
+    """Tracks samples/sec and estimated TFLOPS across steps.
+
+    ``flops_per_sample`` may be supplied (e.g. from the model's XLA cost
+    analysis — see deepspeed_tpu/profiling) to get a TFLOPS estimate.
+    """
+
+    def __init__(self, batch_size: int, start_step: int = 2, monitor_memory: bool = False,
+                 logging_fn=None, flops_per_sample: Optional[float] = None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.logging_fn = logging_fn or logger.info
+        self.flops_per_sample = flops_per_sample
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start_time: Optional[float] = None
+        self.started = False
+
+    def start(self) -> None:
+        self.started = True
+        self._start_time = time.time()
+
+    def stop(self, global_step: bool = True, report_speed: bool = False) -> None:
+        if not self.started:
+            return
+        self.started = False
+        duration = time.time() - self._start_time
+        if global_step:
+            self.global_step_count += 1
+            if self.global_step_count > self.start_step:
+                self.total_elapsed_time += duration
+                self.step_elapsed_time += duration
+        if report_speed and self.global_step_count % 10 == 0:
+            self.logging_fn(
+                f"step={self.global_step_count} samples/sec={self.avg_samples_per_sec():.2f}"
+            )
+
+    def avg_samples_per_sec(self) -> float:
+        steps = self.global_step_count - self.start_step
+        if steps <= 0 or self.total_elapsed_time == 0.0:
+            return 0.0
+        return steps * self.batch_size / self.total_elapsed_time
+
+    def avg_tflops(self) -> Optional[float]:
+        if self.flops_per_sample is None:
+            return None
+        return self.avg_samples_per_sec() * self.flops_per_sample / 1e12

@@ -1,0 +1,46 @@
+"""Test harness configuration.
+
+The reference tests distributed behavior by forking N processes on one box
+(SURVEY.md §4 ``DistributedTest``).  The TPU-native equivalent is simpler and
+stronger: a single process with N virtual XLA CPU devices, so every test runs
+the real SPMD code path (mesh + collectives) deterministically.  This must run
+before jax is imported anywhere.
+"""
+
+import os
+
+# Force-override: the session environment pins JAX_PLATFORMS to the TPU tunnel;
+# tests always run on the virtual CPU mesh (set DSTPU_TEST_ON_TPU=1 to opt out).
+if not os.environ.get("DSTPU_TEST_ON_TPU"):
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                               + os.environ.get("XLA_FLAGS", ""))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DS_ACCELERATOR"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+if not os.environ.get("DSTPU_TEST_ON_TPU"):
+    # jax may already be imported by the interpreter's sitecustomize (with
+    # JAX_PLATFORMS pinned to the TPU tunnel); the backend is not yet
+    # initialized at conftest time, so this still takes effect.
+    jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    from deepspeed_tpu.comm.mesh import build_mesh
+
+    return build_mesh(fsdp=8, devices=devices)
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)

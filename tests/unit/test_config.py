@@ -1,0 +1,110 @@
+"""Config-system tests (reference test model: tests/unit/runtime/test_ds_config*.py,
+SURVEY.md §4)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, resolve_batch_triad
+
+
+class TestBatchTriad:
+    def test_all_given_consistent(self):
+        assert resolve_batch_triad(32, 2, 2, 8) == (32, 2, 2)
+
+    def test_all_given_inconsistent(self):
+        with pytest.raises(ValueError):
+            resolve_batch_triad(33, 2, 2, 8)
+
+    def test_infer_train_batch(self):
+        assert resolve_batch_triad(None, 2, 2, 8) == (32, 2, 2)
+
+    def test_infer_micro_batch(self):
+        assert resolve_batch_triad(32, None, 2, 8) == (32, 2, 2)
+
+    def test_infer_grad_accum(self):
+        assert resolve_batch_triad(32, 2, None, 8) == (32, 2, 2)
+
+    def test_only_train_batch(self):
+        assert resolve_batch_triad(16, None, None, 8) == (16, 2, 1)
+
+    def test_nothing(self):
+        assert resolve_batch_triad(None, None, None, 8) == (8, 1, 1)
+
+    def test_indivisible(self):
+        with pytest.raises(ValueError):
+            resolve_batch_triad(30, None, 2, 8)
+
+
+class TestDeepSpeedConfig:
+    def test_dict_config(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 16,
+            "gradient_accumulation_steps": 1,
+            "fp16": {"enabled": True, "initial_scale_power": 8},
+            "zero_optimization": {"stage": 2, "overlap_comm": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        }, world_size=8)
+        assert cfg.train_batch_size == 16
+        assert cfg.train_micro_batch_size_per_gpu == 2
+        assert cfg.fp16_enabled and not cfg.bfloat16_enabled
+        assert cfg.fp16.initial_scale_power == 8
+        assert cfg.zero_config.stage == 2
+        assert cfg.optimizer.type == "AdamW"
+        assert cfg.optimizer.params["lr"] == 1e-3
+
+    def test_json_file(self, tmp_path):
+        p = tmp_path / "ds_config.json"
+        p.write_text(json.dumps({"train_micro_batch_size_per_gpu": 4, "bf16": {"enabled": True}}))
+        cfg = DeepSpeedConfig(str(p), world_size=2)
+        assert cfg.train_batch_size == 8
+        assert cfg.bfloat16_enabled
+
+    def test_base64_config(self):
+        import base64
+
+        blob = base64.urlsafe_b64encode(json.dumps({"train_batch_size": 4}).encode()).decode()
+        cfg = DeepSpeedConfig(blob, world_size=4)
+        assert cfg.train_batch_size == 4
+
+    def test_auto_values(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 8,
+            "gradient_clipping": "auto",
+            "zero_optimization": {"stage": 3, "reduce_bucket_size": "auto",
+                                   "stage3_prefetch_bucket_size": "auto"},
+        }, world_size=8)
+        assert cfg.gradient_clipping == 0.0
+        assert cfg.zero_config.reduce_bucket_size == 500_000_000
+        assert cfg.zero_config.was_auto("reduce_bucket_size")
+        cfg.zero_config.fill_auto("reduce_bucket_size", 1024)
+        assert cfg.zero_config.reduce_bucket_size == 1024
+
+    def test_fp16_bf16_conflict(self):
+        with pytest.raises(ValueError):
+            DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                             "bf16": {"enabled": True}}, world_size=8)
+
+    def test_deprecated_cpu_offload(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "zero_optimization": {"stage": 2, "cpu_offload": True}}, world_size=8)
+        assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+    def test_dotted_get(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {"stage": 1}}, world_size=8)
+        assert cfg.get("zero_optimization.stage") == 1
+        assert cfg.get("zero_optimization.missing", "d") == "d"
+
+    def test_mesh_section(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "mesh": {"tp": 2, "fsdp": 4}}, world_size=8)
+        assert cfg.mesh.tp == 2 and cfg.mesh.fsdp == 4
+
+    def test_scheduler_optimizer_sections(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 8,
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_min_lr": 0, "warmup_max_lr": 1e-3,
+                                     "warmup_num_steps": 100}},
+        }, world_size=8)
+        assert cfg.scheduler.type == "WarmupLR"
+        assert cfg.scheduler.params["warmup_num_steps"] == 100
