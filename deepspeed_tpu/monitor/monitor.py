@@ -1,0 +1,114 @@
+"""Monitoring backends.
+
+TPU-native analog of the reference's ``deepspeed/monitor/`` (SURVEY.md §2.1
+"Monitor"): ``MonitorMaster`` fans ``write_events([(name, value, step)])`` out
+to TensorBoard / W&B / CSV backends per config.  CSV is always available;
+TensorBoard and W&B engage only if their packages are importable (they are
+optional in this environment).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, Any, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: Sequence[Event]) -> None:  # pragma: no cover - ABC-ish
+        raise NotImplementedError
+
+
+class csvMonitor(Monitor):  # noqa: N801 - reference class name
+    def __init__(self, config):
+        super().__init__(config)
+        self._writers = {}
+        if self.enabled:
+            self.output_path = config.output_path or "./csv_monitor"
+            self.job_name = config.job_name
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 name.replace("/", "_") + ".csv")
+            is_new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if is_new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(config.output_path or "./tensorboard", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=path)
+            except Exception as exc:
+                logger.warning("tensorboard monitor disabled: %s", exc)
+                self.enabled = False
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled or self.summary_writer is None:
+            return
+        for name, value, step in events:
+            self.summary_writer.add_scalar(name, float(value), step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(team=config.team, project=config.project, group=config.group)
+                self._wandb = wandb
+            except Exception as exc:
+                logger.warning("wandb monitor disabled: %s", exc)
+                self.enabled = False
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self._wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled backends (reference: ``MonitorMaster``).  Only
+    process 0 writes, matching the reference's rank-0 gating."""
+
+    def __init__(self, ds_config):
+        self.monitors: List[Monitor] = []
+        import jax
+
+        if jax.process_index() == 0:
+            for cls, cfg in ((TensorBoardMonitor, ds_config.tensorboard),
+                             (WandbMonitor, ds_config.wandb),
+                             (csvMonitor, ds_config.csv_monitor)):
+                m = cls(cfg)
+                if m.enabled:
+                    self.monitors.append(m)
+        self.enabled = bool(self.monitors)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for m in self.monitors:
+            m.write_events(events)

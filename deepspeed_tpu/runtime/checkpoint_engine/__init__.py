@@ -1,0 +1,12 @@
+"""Pluggable checkpoint backends (reference:
+``deepspeed/runtime/checkpoint_engine/``, SURVEY.md §2.1 "Checkpoint engine").
+
+The default backend serializes the state pytree with flax msgpack (gathering
+sharded arrays to host); the sharded tensorstore/OCDBT backend for large
+models lives in ``deepspeed_tpu/checkpoint/`` (SURVEY.md §5.4 TPU note).
+"""
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (CheckpointEngine,
+                                                                       MsgpackCheckpointEngine)
+
+__all__ = ["CheckpointEngine", "MsgpackCheckpointEngine"]

@@ -1,0 +1,59 @@
+"""Checkpoint engine ABC + msgpack default backend."""
+
+from __future__ import annotations
+
+import abc
+import os
+from typing import Any
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class CheckpointEngine(abc.ABC):
+    """Save/load backend contract (reference: ``CheckpointEngine`` ABC)."""
+
+    def __init__(self, config_params: Any = None):
+        self.config_params = config_params
+
+    def create(self, tag: str) -> None:
+        logger.info("checkpoint: starting tag %s", tag)
+
+    @abc.abstractmethod
+    def save(self, state_dict: Any, path: str) -> None: ...
+
+    @abc.abstractmethod
+    def load(self, path: str, target: Any = None) -> Any: ...
+
+    def commit(self, tag: str) -> bool:
+        logger.info("checkpoint: committed tag %s", tag)
+        return True
+
+
+class MsgpackCheckpointEngine(CheckpointEngine):
+    """flax-msgpack serialization of a full pytree (single-file-per-process).
+
+    Sharded jax arrays are gathered to host on save; ``load`` returns numpy
+    leaves which the caller re-shards via device_put with the target
+    shardings (so a checkpoint saved under one ZeRO stage loads under any
+    other — the cross-stage load matrix of SURVEY.md §4).
+    """
+
+    def save(self, state_dict: Any, path: str) -> None:
+        from flax import serialization
+
+        data = serialization.to_bytes(jax.device_get(state_dict))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+
+    def load(self, path: str, target: Any = None) -> Any:
+        from flax import serialization
+
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if target is not None:
+            return serialization.from_bytes(target, data)
+        return serialization.msgpack_restore(data)

@@ -1,0 +1,113 @@
+"""Data loading onto the device mesh.
+
+TPU-native analog of the reference's ``deepspeed/runtime/dataloader.py``
+(SURVEY.md §2.1 "Dataloader"): ``DeepSpeedDataLoader`` yields *global*
+micro-batches placed on the mesh with the batch sharding (data axes split the
+leading dimension), plus ``RepeatingLoader``.  Where the reference wraps a
+torch ``DistributedSampler`` (each rank loads its slice), the TPU version
+builds one global batch per micro-step; under multi-process SPMD each process
+contributes its local slice via ``make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import batch_sharding, get_global_mesh
+
+
+def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Place a (possibly nested) host batch onto the mesh, splitting the
+    leading dim over the data axes."""
+    mesh = mesh or get_global_mesh()
+    sharding = batch_sharding(mesh)
+
+    def put(x):
+        x = np.asarray(x)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.host_local_array_to_global_array(x, mesh, sharding.spec)
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, batch)
+
+
+class DeepSpeedDataLoader:
+    """Batched iteration over an in-memory dataset or torch-style dataset.
+
+    ``dataset`` may be: a tuple/list of equal-length arrays (xs, ys, ...), a
+    sequence of per-sample pytrees, or an object with ``__len__``/``__getitem__``.
+    Yields micro-batches of ``batch_size`` samples (the GLOBAL micro-batch =
+    micro_batch_per_chip * data-parallel world), sharded onto the mesh.
+    """
+
+    def __init__(self, dataset: Any, batch_size: int, mesh: Optional[Mesh] = None,
+                 shuffle: bool = False, seed: int = 0, drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None, local_rank: int = 0,
+                 data_sampler: Any = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self._epoch = 0
+        self.data_sampler = data_sampler
+
+        if isinstance(dataset, (tuple, list)) and len(dataset) > 0 and hasattr(dataset[0], "shape"):
+            self._arrays = tuple(np.asarray(a) for a in dataset)
+            self._n = len(self._arrays[0])
+        else:
+            self._arrays = None
+            self._n = len(dataset)
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self._n // self.batch_size
+        return (self._n + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __iter__(self) -> Iterator[Any]:
+        idx = np.arange(self._n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(idx)
+        nb = len(self)
+        for b in range(nb):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            if self._arrays is not None:
+                batch = tuple(a[sel] for a in self._arrays)
+            else:
+                samples = [self.dataset[int(i)] for i in sel]
+                if self.collate_fn is not None:
+                    batch = self.collate_fn(samples)
+                else:
+                    batch = jax.tree.map(lambda *xs: np.stack(xs), *samples)
+            yield shard_batch(batch, self.mesh)
+        self._epoch += 1
+
+
+class RepeatingLoader:
+    """Endless wrapper (reference: ``RepeatingLoader``)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self._it = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._it = iter(self.loader)
+            return next(self._it)

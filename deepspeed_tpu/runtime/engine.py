@@ -1,0 +1,524 @@
+"""The DeepSpeed engine, TPU-native.
+
+Analog of the reference's ``deepspeed/runtime/engine.py`` (SURVEY.md §2.1
+"Engine", §3.2, §3.3) with a functional core: all training math lives in two
+jitted, donated, mesh-sharded functions —
+
+- ``_accum``: one micro-batch forward+backward; gradients (loss-scaled,
+  divided by gradient_accumulation_steps) are added into a persistent
+  accumulator whose sharding implements the ZeRO stage (reduce-scatter falls
+  out of GSPMD when the accumulator is sharded over ``fsdp``).
+- ``_apply``: the accumulation-boundary step — overflow check (fp16), unscale,
+  global-norm clip, optax update, loss-scale transition, skip-on-overflow via
+  select (the reference's eager "skip step" becomes a branchless where).
+
+The imperative reference API (``engine.forward`` / ``backward`` / ``step``,
+SURVEY.md §3.3) is preserved on top: ``forward`` runs the fused
+forward+backward micro-step (dispatch is async on TPU, so this costs nothing
+extra), ``backward`` is the recorded no-op that keeps user loops working, and
+``step`` applies the update at the accumulation boundary.
+
+ZeRO stages are placement policies (see runtime/zero/partition.py): the engine
+computes PartitionSpecs for params/optimizer/accumulator once, then relies on
+XLA/GSPMD for all-gathers, reduce-scatters, and comm/compute overlap — the
+TPU replacement for the reference's bucketed IPG reducer and trace-based
+prefetcher (SURVEY.md §3.3 TPU note).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm.mesh import batch_sharding, get_global_mesh, mesh_from_config
+from deepspeed_tpu.monitor.monitor import MonitorMaster
+from deepspeed_tpu.runtime import optimizer as opt_builder
+from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, shard_batch
+from deepspeed_tpu.runtime.fp16 import loss_scaler as scaler_lib
+from deepspeed_tpu.runtime.lr_schedules import LRSchedulerShim, get_lr_schedule
+from deepspeed_tpu.runtime.utils import (clip_grad_norm, global_norm, has_overflow,
+                                         tree_num_params)
+from deepspeed_tpu.runtime.zero.partition import (describe_partitioning, opt_state_pspecs,
+                                                  params_pspecs, shardings_from_pspecs)
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+
+class TrainState(NamedTuple):
+    """The complete, donated training state pytree."""
+
+    params: Any
+    opt_state: Any
+    grad_acc: Any
+    global_steps: jnp.ndarray  # i32: optimizer steps actually applied
+    scaler: scaler_lib.LossScaleState
+
+
+class DeepSpeedEngine:
+    def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
+                 training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,
+                 collate_fn=None, config=None, mesh=None, rng=None, loss_fn=None,
+                 param_pspecs=None):
+        if model is None and loss_fn is None:
+            raise ValueError("deepspeed_tpu.initialize requires a model (flax module or "
+                             "callable (params, batch, rng) -> loss)")
+        self.module = model
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+
+        self.config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config, mpu=mpu)
+        comm.init_distributed(dist_init_required=dist_init_required, config=self.config)
+        self.mesh = mesh or get_global_mesh()
+        comm.set_global_mesh(self.mesh)
+        comm.configure(deepspeed_config=self.config)
+
+        self.zero_stage = self.config.zero_config.stage
+        self.fp16_enabled = self.config.fp16_enabled
+        self.bfloat16_enabled = self.config.bfloat16_enabled
+        self.compute_dtype = self.config.dtype()
+        self.gradient_accumulation_steps = lambda: self.config.gradient_accumulation_steps
+        self.train_batch_size = lambda: self.config.train_batch_size
+        self.train_micro_batch_size_per_gpu = lambda: self.config.train_micro_batch_size_per_gpu
+
+        self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
+        self._loss_fn = loss_fn or self._make_loss_fn(model)
+        self._client_param_pspecs = param_pspecs  # tensor-parallel logical specs
+        self._micro_count = 0
+        self._boundary_override: Optional[bool] = None
+        self._last_loss = None
+        self._last_grad_norm = None
+        self._last_overflow = None
+        self.state: Optional[TrainState] = None
+        self._accum_fn = None
+        self._apply_fn = None
+        self._eval_fn = None
+        self.optimizer = None  # optax transformation, set in _build_optimizer
+        self._lr_schedule = None
+        self.lr_scheduler = None
+        self._build_optimizer()
+
+        self.checkpoint_engine = MsgpackCheckpointEngine(self.config.checkpoint_config)
+        self.monitor = MonitorMaster(self.config)
+        self.timers = SynchronizedWallClockTimer(synchronize=self.config.wall_clock_breakdown)
+        self.tput_timer = ThroughputTimer(batch_size=self.config.train_batch_size)
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
+        self._training = True
+
+        # Params supplied eagerly -> materialize state now; else lazy-init on
+        # the first batch (zero.Init-equivalent abstract init, SURVEY.md §7.4).
+        if model_parameters is not None:
+            self._init_state(model_parameters)
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def _make_loss_fn(self, model) -> Callable:
+        if hasattr(model, "apply"):  # flax module computing loss in __call__
+            def loss_fn(params, batch, rng):
+                kwargs = {"rngs": {"dropout": rng}}
+                if isinstance(batch, (tuple, list)):
+                    return model.apply(params, *batch, **kwargs)
+                if isinstance(batch, dict):
+                    return model.apply(params, **batch, **kwargs)
+                return model.apply(params, batch, **kwargs)
+
+            return loss_fn
+        if callable(model):
+            def loss_fn(params, batch, rng):
+                return model(params, batch)
+
+            return loss_fn
+        raise TypeError(f"Unsupported model type {type(model)}")
+
+    def _build_optimizer(self) -> None:
+        if self.config.scheduler is not None:
+            self._lr_schedule = get_lr_schedule(self.config.scheduler.type,
+                                                self.config.scheduler.params)
+        elif callable(self.client_lr_scheduler):
+            self._lr_schedule = self.client_lr_scheduler
+        if self.client_optimizer is not None:
+            self.optimizer = self.client_optimizer
+            if self.config.zero_allow_untested_optimizer:
+                log_dist("using client optimizer with ZeRO (zero_allow_untested_optimizer)",
+                         ranks=[0])
+        else:
+            self.optimizer = opt_builder.build_from_config(self.config, self._lr_schedule)
+        self.lr_scheduler = (LRSchedulerShim(self._lr_schedule)
+                             if self._lr_schedule is not None else None)
+
+    def _init_state(self, params: Any) -> None:
+        """Build shardings for the full state and compile the step functions."""
+        mesh = self.mesh
+        zcfg = self.config.zero_config
+        persist = zcfg.stage3_param_persistence_threshold if self.zero_stage == 3 else 0
+
+        self._param_specs = params_pspecs(params, mesh, shard=self.zero_stage == 3,
+                                          persistence_threshold=persist,
+                                          logical_specs=self._client_param_pspecs)
+        self._param_shardings = shardings_from_pspecs(self._param_specs, mesh)
+        opt_shapes = jax.eval_shape(self.optimizer.init, params)
+        self._opt_specs = opt_state_pspecs(opt_shapes, mesh, shard=self.zero_stage >= 1)
+        self._opt_shardings = shardings_from_pspecs(self._opt_specs, mesh)
+        # Gradient accumulator: sharded from stage 2 up (reduce-scatter), or
+        # like params under stage 3 (grads of sharded params are sharded).
+        acc_shard = self.zero_stage >= 2
+        self._acc_specs = params_pspecs(params, mesh, shard=acc_shard,
+                                        persistence_threshold=0 if acc_shard else persist,
+                                        logical_specs=self._client_param_pspecs)
+        self._acc_shardings = shardings_from_pspecs(self._acc_specs, mesh)
+        scalar_sh = NamedSharding(mesh, P())
+        self._state_shardings = TrainState(
+            params=self._param_shardings, opt_state=self._opt_shardings,
+            grad_acc=self._acc_shardings, global_steps=scalar_sh,
+            scaler=scaler_lib.LossScaleState(scalar_sh, scalar_sh, scalar_sh, scalar_sh))
+
+        # Materialize state on-device, already sharded (zero.Init semantics:
+        # nothing is ever resident unsharded).
+        params = jax.jit(lambda p: p, out_shardings=self._param_shardings)(params)
+        opt_state = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)(params)
+        grad_acc = jax.jit(
+            lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, self._acc_dtype(x.dtype)), p),
+            out_shardings=self._acc_shardings)(params)
+        self.state = TrainState(params=params, opt_state=opt_state, grad_acc=grad_acc,
+                                global_steps=jnp.zeros((), jnp.int32),
+                                scaler=scaler_lib.make_state(self.config.fp16))
+        self._compile_steps()
+        n = tree_num_params(params)
+        log_dist(f"engine ready: {n/1e6:.2f}M params, zero stage {self.zero_stage}, "
+                 f"dtype {self.compute_dtype.__name__}, mesh {dict(self.mesh.shape)}", ranks=[0])
+        if self.zero_stage == 3:
+            logger.info(describe_partitioning(params, self._param_specs))
+
+    def _acc_dtype(self, param_dtype):
+        return jnp.float32
+
+    def lazy_init_from_batch(self, batch: Any) -> None:
+        """zero.Init-equivalent: abstract-init then shard-on-create
+        (reference: ``deepspeed.zero.Init`` module-interception,
+        SURVEY.md §2.1 "zero.Init / partitioned params")."""
+        if self.state is not None:
+            return
+        if not hasattr(self.module, "init"):
+            raise ValueError("model has no .init(); pass model_parameters to initialize()")
+        self._rng, init_rng = jax.random.split(self._rng)
+
+        def init_fn(rng, b):
+            if isinstance(b, (tuple, list)):
+                return self.module.init(rng, *b)
+            if isinstance(b, dict):
+                return self.module.init(rng, **b)
+            return self.module.init(rng, b)
+
+        abstract = jax.eval_shape(init_fn, init_rng, batch)
+        zcfg = self.config.zero_config
+        persist = zcfg.stage3_param_persistence_threshold if self.zero_stage == 3 else 0
+        specs = params_pspecs(abstract, self.mesh, shard=self.zero_stage == 3,
+                              persistence_threshold=persist,
+                              logical_specs=self._client_param_pspecs)
+        shardings = shardings_from_pspecs(specs, self.mesh)
+        params = jax.jit(init_fn, out_shardings=shardings)(init_rng, batch)
+        self._init_state(params)
+
+    # ------------------------------------------------------------------
+    # jitted step functions
+    # ------------------------------------------------------------------
+    def _compile_steps(self) -> None:
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        compute_dtype = self.compute_dtype
+        fp16 = self.fp16_enabled
+        clip = cfg.gradient_clipping
+        loss_fn = self._loss_fn
+        fp16_cfg = cfg.fp16
+
+        def cast_params(p):
+            if compute_dtype == jnp.float32:
+                return p
+            return jax.tree.map(
+                lambda x: x.astype(compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+
+        def accum(state: TrainState, batch, rng):
+            scale = state.scaler.scale if fp16 else jnp.float32(1.0)
+
+            def scaled_loss_fn(params):
+                loss = loss_fn(cast_params(params), batch, rng)
+                return (loss.astype(jnp.float32) * scale) / gas, loss
+
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
+            new_acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype), state.grad_acc, grads)
+            return state._replace(grad_acc=new_acc), loss
+
+        def apply(state: TrainState):
+            scale = state.scaler.scale if fp16 else jnp.float32(1.0)
+            overflow = has_overflow(state.grad_acc) if fp16 else jnp.zeros((), bool)
+            grads = jax.tree.map(lambda g: g / scale, state.grad_acc)
+            if clip > 0:
+                grads, gnorm = clip_grad_norm(grads, clip)
+            else:
+                gnorm = global_norm(grads)
+            updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
+            import optax
+
+            new_params = optax.apply_updates(state.params, updates)
+            if fp16:
+                sel = lambda new, old: jax.tree.map(
+                    lambda a, b: jnp.where(overflow, b, a), new, old)
+                new_params = sel(new_params, state.params)
+                new_opt = sel(new_opt, state.opt_state)
+            new_scaler = scaler_lib.update(
+                state.scaler, overflow, dynamic=fp16 and fp16_cfg.dynamic_loss_scale,
+                loss_scale_window=fp16_cfg.loss_scale_window,
+                min_loss_scale=fp16_cfg.min_loss_scale, hysteresis=fp16_cfg.hysteresis)
+            zero_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
+            new_state = TrainState(
+                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
+                global_steps=state.global_steps + (1 - overflow.astype(jnp.int32)),
+                scaler=new_scaler)
+            return new_state, gnorm, overflow
+
+        def evaluate(params, batch, rng):
+            return loss_fn(cast_params(params), batch, rng)
+
+        sh = self._state_shardings
+        bs = batch_sharding(self.mesh)
+        self._accum_fn = jax.jit(accum, donate_argnums=(0,), in_shardings=(sh, None, None),
+                                 out_shardings=(sh, NamedSharding(self.mesh, P())))
+        self._apply_fn = jax.jit(apply, donate_argnums=(0,),
+                                 in_shardings=(sh,),
+                                 out_shardings=(sh, NamedSharding(self.mesh, P()),
+                                                NamedSharding(self.mesh, P())))
+        self._eval_fn = jax.jit(evaluate, in_shardings=(self._param_shardings, None, None),
+                                out_shardings=NamedSharding(self.mesh, P()))
+
+    # ------------------------------------------------------------------
+    # reference-parity imperative API (SURVEY.md §3.3)
+    # ------------------------------------------------------------------
+    def train(self, mode: bool = True):
+        self._training = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def __call__(self, batch):
+        return self.forward(batch)
+
+    def forward(self, batch):
+        """One micro-batch forward (+backward: gradients are produced in the
+        same XLA program and accumulated — see module docstring)."""
+        batch = shard_batch(batch, self.mesh)
+        if self.state is None:
+            self.lazy_init_from_batch(batch)
+        if not self._training:
+            self._rng, rng = jax.random.split(self._rng)
+            return self._eval_fn(self.state.params, batch, rng)
+        self.timers(SynchronizedWallClockTimer.FORWARD).start()
+        self._rng, rng = jax.random.split(self._rng)
+        self.state, loss = self._accum_fn(self.state, batch, rng)
+        self.timers(SynchronizedWallClockTimer.FORWARD).stop()
+        self._micro_count += 1
+        self._last_loss = loss
+        return loss
+
+    def backward(self, loss, retain_graph: bool = False):
+        """Reference-parity no-op: gradients were already computed and
+        accumulated by ``forward`` (fused fwd+bwd in one XLA program)."""
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        if self._boundary_override is not None:
+            return self._boundary_override
+        gas = self.config.gradient_accumulation_steps
+        return self._micro_count % gas == 0 and self._micro_count > 0
+
+    def set_gradient_accumulation_boundary(self, is_boundary: bool) -> None:
+        """Manual boundary control (reference API, used by HF Accelerate)."""
+        self._boundary_override = is_boundary
+
+    def step(self):
+        if not self.is_gradient_accumulation_boundary():
+            return
+        self.timers(SynchronizedWallClockTimer.STEP).start()
+        self.state, gnorm, overflow = self._apply_fn(self.state)
+        self.timers(SynchronizedWallClockTimer.STEP).stop()
+        self._last_grad_norm = gnorm
+        self._last_overflow = overflow
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._micro_count = 0
+        steps = self.global_steps
+        if steps and steps % self.config.steps_per_print == 0:
+            self._report(steps)
+
+    def train_batch(self, data_iter=None):
+        """Full global-batch step: gas micro-batches + boundary update
+        (reference: ``PipelineEngine.train_batch`` shape, here for the
+        non-pipeline engine as a convenience fast path)."""
+        if data_iter is None:
+            if self.training_dataloader is None:
+                raise ValueError("train_batch needs data_iter or training_data")
+            data_iter = iter(self.training_dataloader)
+        self.tput_timer.start()
+        gas = self.config.gradient_accumulation_steps
+        losses = []
+        for _ in range(gas):
+            losses.append(self.forward(next(data_iter)))
+        self.step()
+        self.tput_timer.stop()
+        return jnp.mean(jnp.stack(losses))
+
+    def eval_batch(self, data_iter):
+        was = self._training
+        self._training = False
+        try:
+            return self.forward(next(data_iter))
+        finally:
+            self._training = was
+
+    # ------------------------------------------------------------------
+    # introspection (reference API surface)
+    # ------------------------------------------------------------------
+    @property
+    def global_steps(self) -> int:
+        return int(self.state.global_steps) if self.state is not None else 0
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        return float(self._last_grad_norm) if self._last_grad_norm is not None else None
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.state.scaler.scale) if self.state is not None else 1.0
+
+    @property
+    def skipped_steps(self) -> int:
+        return int(self.state.scaler.skipped_steps) if self.state is not None else 0
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_last_lr()
+        if self.config.optimizer is not None:
+            return [self.config.optimizer.params.get("lr", 0.0)]
+        return [0.0]
+
+    def _report(self, steps: int) -> None:
+        lr = self.get_lr()[0]
+        loss = float(self._last_loss) if self._last_loss is not None else float("nan")
+        log_dist(f"step={steps} loss={loss:.4f} lr={lr:.3e} "
+                 f"loss_scale={self.loss_scale:.0f} "
+                 f"samples/sec={self.tput_timer.avg_samples_per_sec():.2f}", ranks=[0])
+        if self.monitor.enabled:
+            self.monitor.write_events([("Train/loss", loss, steps),
+                                       ("Train/lr", lr, steps),
+                                       ("Train/loss_scale", self.loss_scale, steps)])
+
+    def deepspeed_io(self, dataset, batch_size=None, **kwargs):
+        gas_batch = batch_size or self.config.train_micro_batch_size_per_gpu * \
+            comm.get_data_parallel_world_size(self.mesh)
+        return DeepSpeedDataLoader(dataset, batch_size=gas_batch, mesh=self.mesh,
+                                   collate_fn=self.collate_fn, **kwargs)
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference layout: SURVEY.md §5.4)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None, save_latest: bool = True):
+        if self.state is None:
+            raise RuntimeError("nothing to checkpoint: engine state not initialized")
+        tag = tag or f"global_step{self.global_steps}"
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        if comm.get_rank() == 0:
+            os.makedirs(ckpt_dir, exist_ok=True)
+        comm.barrier()
+        self.checkpoint_engine.create(str(tag))
+        if comm.get_rank() == 0:
+            self.checkpoint_engine.save(self.state.params,
+                                        os.path.join(ckpt_dir, "model_states.msgpack"))
+            self.checkpoint_engine.save(
+                {"opt_state": self.state.opt_state,
+                 "grad_acc": self.state.grad_acc,
+                 "global_steps": self.state.global_steps,
+                 "scaler": tuple(self.state.scaler)},
+                os.path.join(ckpt_dir, "optim_states.msgpack"))
+            meta = {"client_state": client_state or {},
+                    "micro_count": self._micro_count,
+                    "lr_scheduler": (self.lr_scheduler.state_dict()
+                                     if self.lr_scheduler else None),
+                    "zero_stage": self.zero_stage,
+                    "world_size": comm.get_world_size()}
+            with open(os.path.join(ckpt_dir, "client_state.json"), "w") as fh:
+                json.dump(meta, fh, default=str)
+            if save_latest:
+                with open(os.path.join(save_dir, "latest"), "w") as fh:
+                    fh.write(str(tag))
+        comm.barrier()
+        self.checkpoint_engine.commit(str(tag))
+        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_module_strict: bool = True, load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True,
+                        load_module_only: bool = False):
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                logger.warning("no 'latest' file in %s; cannot load", load_dir)
+                return None, {}
+            with open(latest) as fh:
+                tag = fh.read().strip()
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        if self.state is None:
+            raise RuntimeError("load_checkpoint requires initialized state "
+                               "(pass model_parameters or run one batch first)")
+        params_host = self.checkpoint_engine.load(
+            os.path.join(ckpt_dir, "model_states.msgpack"), target=jax.device_get(self.state.params))
+        params = jax.device_put(params_host, self._param_shardings)
+        new_state = self.state._replace(params=params)
+        meta = {}
+        meta_path = os.path.join(ckpt_dir, "client_state.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as fh:
+                meta = json.load(fh)
+        if not load_module_only and load_optimizer_states:
+            opt_host = self.checkpoint_engine.load(
+                os.path.join(ckpt_dir, "optim_states.msgpack"),
+                target={"opt_state": jax.device_get(self.state.opt_state),
+                        "grad_acc": jax.device_get(self.state.grad_acc),
+                        "global_steps": np.zeros((), np.int32),
+                        "scaler": tuple(np.asarray(x) for x in self.state.scaler)})
+            new_state = new_state._replace(
+                opt_state=jax.device_put(opt_host["opt_state"], self._opt_shardings),
+                grad_acc=jax.device_put(opt_host["grad_acc"], self._acc_shardings),
+                global_steps=jnp.asarray(opt_host["global_steps"], jnp.int32),
+                scaler=scaler_lib.LossScaleState(*[jnp.asarray(x) for x in opt_host["scaler"]]))
+        if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        self.state = new_state
+        log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir, meta.get("client_state", {})
+
+    def save_16bit_model(self, save_dir: str, save_filename: str = "model_states_16bit.msgpack"):
+        """Gather full (unsharded) compute-dtype weights and save on rank 0
+        (reference: ``stage3_gather_16bit_weights_on_model_save``)."""
+        os.makedirs(save_dir, exist_ok=True)
+        gathered = jax.device_get(self.state.params)
+        cast = jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(np.asarray(x).dtype, np.floating) else x, gathered)
+        if comm.get_rank() == 0:
+            self.checkpoint_engine.save(cast, os.path.join(save_dir, save_filename))
+        return os.path.join(save_dir, save_filename)

@@ -1,0 +1,79 @@
+"""Dynamic and static loss scaling, functional.
+
+TPU-native analog of the reference's ``deepspeed/runtime/fp16/loss_scaler.py``
+(SURVEY.md §2.1 "FP16 optimizers"): same semantics — scale the loss before
+backward, detect inf/nan in gradients, skip the step and halve the scale on
+overflow, double the scale after ``loss_scale_window`` clean steps, honor
+``hysteresis`` — but expressed as a pure state transition inside the jitted
+train step (the reference mutates a Python object between eager calls; here
+the skip is a ``jnp.where`` select on the update).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar, current loss scale
+    growth_tracker: jnp.ndarray  # i32, consecutive overflow-free steps
+    hysteresis_tracker: jnp.ndarray  # i32, remaining tolerated overflows before shrink
+    skipped_steps: jnp.ndarray   # i32, total skipped steps (reporting)
+
+
+def make_state(config) -> LossScaleState:
+    """Build initial scaler state from an FP16Config (static scale if
+    ``loss_scale`` nonzero, else dynamic starting at 2**initial_scale_power)."""
+    if config is not None and config.enabled:
+        init = config.loss_scale if config.loss_scale > 0 else float(2 ** config.initial_scale_power)
+        hyst = config.hysteresis
+    else:
+        init, hyst = 1.0, 1
+    return LossScaleState(scale=jnp.asarray(init, jnp.float32),
+                          growth_tracker=jnp.zeros((), jnp.int32),
+                          hysteresis_tracker=jnp.asarray(hyst, jnp.int32),
+                          skipped_steps=jnp.zeros((), jnp.int32))
+
+
+def update(state: LossScaleState, overflow: jnp.ndarray, *, dynamic: bool,
+           loss_scale_window: int, min_loss_scale: float, hysteresis: int,
+           consecutive_hysteresis: bool = False) -> LossScaleState:
+    """One scaler transition given this step's overflow flag."""
+    if not dynamic:
+        return state._replace(skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
+    ht = jnp.where(overflow, state.hysteresis_tracker - 1, state.hysteresis_tracker)
+    shrink = jnp.logical_and(overflow, ht <= 0)
+    new_scale = jnp.where(shrink, jnp.maximum(state.scale / 2.0, min_loss_scale), state.scale)
+    ht = jnp.where(shrink, jnp.asarray(hysteresis, jnp.int32), ht)
+    growth = jnp.where(overflow, 0, state.growth_tracker + 1)
+    grow = growth >= loss_scale_window
+    new_scale = jnp.where(grow, new_scale * 2.0, new_scale)
+    growth = jnp.where(grow, 0, growth)
+    if consecutive_hysteresis:
+        ht = jnp.where(jnp.logical_not(overflow), jnp.asarray(hysteresis, jnp.int32), ht)
+    return LossScaleState(scale=new_scale, growth_tracker=growth, hysteresis_tracker=ht,
+                          skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
+
+
+class DynamicLossScaler:
+    """Imperative shim for reference API parity (``cur_scale`` attribute)."""
+
+    def __init__(self, init_scale=2**16, scale_window=1000, min_scale=1.0, hysteresis=2):
+        self.state = LossScaleState(jnp.asarray(float(init_scale), jnp.float32),
+                                    jnp.zeros((), jnp.int32),
+                                    jnp.asarray(hysteresis, jnp.int32),
+                                    jnp.zeros((), jnp.int32))
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.hysteresis = hysteresis
+
+    @property
+    def cur_scale(self) -> float:
+        return float(self.state.scale)
+
+    def update_scale(self, overflow: bool) -> None:
+        self.state = update(self.state, jnp.asarray(overflow), dynamic=True,
+                            loss_scale_window=self.scale_window,
+                            min_loss_scale=self.min_scale, hysteresis=self.hysteresis)

@@ -1,0 +1,147 @@
+"""Learning-rate schedules.
+
+TPU-native analog of the reference's ``deepspeed/runtime/lr_schedules.py``
+(SURVEY.md §2.1 "LR schedules"): the same schedule types and config keys
+(``WarmupLR``, ``WarmupDecayLR``, ``WarmupCosineLR``, ``OneCycle``,
+``LRRangeTest``) but expressed as pure ``step -> lr`` functions compatible
+with optax's ``Schedule``, so they live inside the jitted train step instead
+of mutating optimizer param groups between steps.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]
+
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+ONE_CYCLE = "OneCycle"
+LR_RANGE_TEST = "LRRangeTest"
+
+VALID_LR_SCHEDULES = [WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR, ONE_CYCLE, LR_RANGE_TEST]
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log", **_: Any) -> Schedule:
+    """Warm up from min to max, then hold (reference ``WarmupLR``)."""
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            # log-spaced warmup, matching the reference's default
+            gamma = jnp.where(frac > 0, jnp.log(1.0 + frac * (math.e - 1.0)), 0.0)
+        else:
+            gamma = frac
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_: Any) -> Schedule:
+    """Warmup then linear decay to 0 (reference ``WarmupDecayLR``)."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    total = max(total_num_steps, warmup_num_steps + 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm = base(step)
+        decay = jnp.clip((total - step) / max(1.0, total - warmup_num_steps), 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr * decay)
+
+    return schedule
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_max_lr: float = 0.001, **_: Any) -> Schedule:
+    def schedule(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm_frac = jnp.clip(step / max(1, warmup_num_steps), 0.0, 1.0)
+        warm = (warmup_min_ratio + (1 - warmup_min_ratio) * warm_frac) * warmup_max_lr
+        progress = jnp.clip((step - warmup_num_steps) / max(1, total_num_steps - warmup_num_steps),
+                            0.0, 1.0)
+        cosine = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr * cosine)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float, cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None, decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0, cycle_momentum: bool = False, **_: Any) -> Schedule:
+    """Triangular one-cycle policy (reference ``OneCycle``)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    cycle_len = cycle_first_step_size + second
+
+    def schedule(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        in_cycle = jnp.minimum(step, cycle_len)
+        up = jnp.clip(in_cycle / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((in_cycle - cycle_first_step_size) / second, 0.0, 1.0)
+        tri = jnp.where(in_cycle < cycle_first_step_size,
+                        cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up,
+                        cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down)
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - cycle_len, 0.0) / decay_step_size
+            tri = tri * (1.0 / (1.0 + decay_lr_rate * decay_steps))
+        return tri
+
+    return schedule
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0, lr_range_test_staircase: bool = False,
+                  **_: Any) -> Schedule:
+    def schedule(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+_FACTORIES: Dict[str, Callable[..., Schedule]] = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+    ONE_CYCLE: one_cycle,
+    LR_RANGE_TEST: lr_range_test,
+}
+
+
+def get_lr_schedule(name: str, params: Dict[str, Any]) -> Schedule:
+    if name not in _FACTORIES:
+        raise ValueError(f"Unknown scheduler type {name!r}; valid: {VALID_LR_SCHEDULES}")
+    return _FACTORIES[name](**params)
+
+
+class LRSchedulerShim:
+    """Imperative facade over a functional schedule, for reference API parity
+    (``lr_scheduler.step()``, ``get_last_lr()``)."""
+
+    def __init__(self, schedule: Schedule, engine=None):
+        self.schedule = schedule
+        self._step = 0
+
+    def step(self, increment: int = 1) -> None:
+        self._step += increment
+
+    def get_last_lr(self):
+        return [float(self.schedule(self._step))]
+
+    def state_dict(self):
+        return {"step": self._step}
+
+    def load_state_dict(self, sd):
+        self._step = sd["step"]

@@ -1,0 +1,98 @@
+"""Runtime utilities.
+
+TPU-native analog of the reference's ``deepspeed/runtime/utils.py`` (SURVEY.md
+§2.1 "Runtime utils"): memory reporting, global-norm computation, overflow
+checking.  The cross-rank allreduce in the reference's ``clip_grad_norm_``
+disappears here — under jit with sharded grads, ``jnp`` reductions are global
+and GSPMD inserts the collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Log device-memory stats (reference: ``see_memory_usage``)."""
+    if not force:
+        return
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    alloc = acc.memory_allocated() / 2**30
+    peak = acc.max_memory_allocated() / 2**30
+    total = acc.total_memory() / 2**30
+    logger.info("%s | device mem: alloc %.2fGB peak %.2fGB total %.2fGB", message, alloc, peak, total)
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    """L2 norm over a pytree of arrays (global across shards under jit)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def clip_grad_norm(grads: Any, max_norm: float, norm: Optional[jnp.ndarray] = None):
+    """Clip a gradient pytree to ``max_norm`` by global L2 norm.
+
+    Returns (clipped_grads, pre_clip_norm).  Reference:
+    ``clip_grad_norm_`` with cross-rank allreduce (SURVEY.md §3.3).
+    """
+    norm = global_norm(grads) if norm is None else norm
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads), norm
+
+
+def has_overflow(grads: Any) -> jnp.ndarray:
+    """True if any gradient entry is non-finite (reference: ``CheckOverflow``).
+
+    Under jit the ``jnp.isfinite`` reduction is global across shards, which is
+    the reference's inf/nan allreduce collapsed into the XLA program.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), dtype=bool)
+    finite = jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves])
+    return jnp.logical_not(jnp.all(finite))
+
+
+def cast_tree(tree: Any, dtype) -> Any:
+    return jax.tree.map(lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                        tree)
+
+
+def tree_num_params(tree: Any) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_bytes(tree: Any) -> int:
+    return sum(int(x.size) * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
+
+
+class PartitionedTensor:
+    """Flatten-and-shard helper for pipeline activation exchange
+    (reference: ``PartitionedTensor`` in runtime/utils.py).  On TPU this is
+    only needed for host-side staging; in-program sharding uses NamedSharding.
+    """
+
+    def __init__(self, tensor: jnp.ndarray, num_parts: int):
+        self.orig_shape = tensor.shape
+        flat = tensor.reshape(-1)
+        pad = (-flat.size) % num_parts
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        self.parts = flat.reshape(num_parts, -1)
+        self.num_parts = num_parts
+
+    def full(self) -> jnp.ndarray:
+        flat = self.parts.reshape(-1)
+        n = 1
+        for d in self.orig_shape:
+            n *= d
+        return flat[:n].reshape(self.orig_shape)

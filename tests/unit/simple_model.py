@@ -1,0 +1,46 @@
+"""Tiny model fixtures (reference: ``tests/unit/simple_model.py``, SURVEY.md §4)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SimpleModel(nn.Module):
+    """MLP that computes its own loss, matching the engine contract
+    (forward returns scalar loss, as the reference's engine expects)."""
+
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x, y):
+        h = x
+        for _ in range(self.nlayers):
+            h = nn.Dense(self.hidden_dim)(h)
+            h = nn.relu(h)
+        out = nn.Dense(y.shape[-1] if y.ndim > 1 else 1)(h)
+        if y.ndim == 1:
+            y = y[:, None]
+        return jnp.mean((out - y) ** 2)
+
+
+class SimpleClassifier(nn.Module):
+    hidden_dim: int = 32
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, labels):
+        h = nn.Dense(self.hidden_dim)(x)
+        h = nn.relu(h)
+        logits = nn.Dense(self.num_classes)(h)
+        logp = nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def random_dataset(n=64, dim=8, out_dim=4, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(dim, out_dim))
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = (x @ w + 0.01 * rng.normal(size=(n, out_dim))).astype(np.float32)
+    return x, y
