@@ -1,0 +1,78 @@
+"""FusedAdam as an optax transformation backed by the Pallas kernel.
+
+Reference parity: ``deepspeed/ops/adam/fused_adam.py`` (SURVEY.md §2.1 "Ops:
+Adam family") — same knobs (``adam_w_mode``, betas, eps, weight_decay); the
+multi-tensor CUDA launch is replaced by per-leaf Pallas kernels that XLA
+compiles into one fused program (see ops/pallas/fused_adam.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.ops.pallas.fused_adam import fused_adam_update
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray
+    m: Any
+    v: Any
+
+
+def fused_adam(learning_rate: Union[float, Callable] = 1e-3, b1: float = 0.9,
+               b2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
+               adam_w_mode: bool = True, impl: Optional[str] = None) -> optax.GradientTransformation:
+    """optax transformation whose update IS the new params delta.
+
+    Note: unlike composed optax chains, the fused kernel computes new params
+    directly; the returned "updates" are ``new_params - params`` so it stays a
+    drop-in GradientTransformation.
+    """
+
+    def init(params):
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return FusedAdamState(count=jnp.zeros((), jnp.int32), m=zeros,
+                              v=jax.tree.map(jnp.copy, zeros))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            pn, mn, vn = fused_adam_update(p, g, m, v, count, lr=lr, beta1=b1, beta2=b2,
+                                           eps=eps, weight_decay=weight_decay,
+                                           adam_w_mode=adam_w_mode, impl=impl)
+            new_p.append(pn)
+            new_m.append(mn)
+            new_v.append(vn)
+        updates = jax.tree_util.tree_unflatten(
+            treedef, [pn - p for pn, p in zip(new_p, flat_p)])
+        return updates, FusedAdamState(count=count,
+                                       m=jax.tree_util.tree_unflatten(treedef, new_m),
+                                       v=jax.tree_util.tree_unflatten(treedef, new_v))
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedAdam:
+    """Class-style constructor for reference API parity
+    (``FusedAdam(params, lr=..., adam_w_mode=True)``)."""
+
+    def __new__(cls, params=None, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                eps=1e-8, adam_w_mode=True, weight_decay=0.0, amsgrad=False,
+                set_grad_none=True):
+        if amsgrad:
+            raise ValueError("FusedAdam does not support amsgrad (reference parity)")
+        return fused_adam(learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+                          weight_decay=weight_decay, adam_w_mode=adam_w_mode)

@@ -1,0 +1,49 @@
+"""Shared helpers for Pallas TPU kernels.
+
+TPU-native analog of the reference's ``csrc/includes/`` shared headers
+(SURVEY.md §2.2 "Common headers"): dispatch policy, tiling helpers, and the
+interpret-mode switch that lets every kernel run (and be parity-tested)
+on the CPU backend.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+# Resolution order for each op's implementation:
+#   "pallas"  - compiled Pallas kernel (TPU)
+#   "interpret" - Pallas kernel in interpreter mode (CPU tests)
+#   "xla"     - pure jnp reference (always available; XLA fuses well)
+_FORCE = os.environ.get("DSTPU_KERNEL_IMPL")  # override for debugging/benchmarks
+
+
+@functools.lru_cache(maxsize=None)
+def default_impl() -> str:
+    if _FORCE:
+        return _FORCE
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def resolve_impl(impl: str | None) -> str:
+    return impl if impl is not None else default_impl()
+
+
+def interpret_flag(impl: str) -> bool:
+    return impl == "interpret"
+
+
+def round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def pick_block(n: int, preferred: int, minimum: int = 128) -> int:
+    """Largest divisor-of-n block <= preferred, else n itself (small inputs)."""
+    if n <= preferred:
+        return n
+    for b in range(preferred, minimum - 1, -minimum):
+        if n % b == 0:
+            return b
+    return n
