@@ -1,0 +1,100 @@
+"""Headline benchmark: GPT-2 125M-class causal-LM training throughput on one
+chip (BASELINE.json configs[1] rung; north star = tokens/sec/chip, BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec", "vs_baseline": N}
+
+``vs_baseline`` is achieved MFU / 0.40 — the north-star target is matching
+A100 ZeRO-3 MFU (~40%) on the same workload class (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.models import causal_lm
+
+PEAK_FLOPS = {  # bf16 peak per chip
+    "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5": 459e12,
+    "tpu v4": 275e12, "tpu v6 lite": 918e12, "cpu": 1e12,
+}
+
+
+def peak_flops() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 197e12
+
+
+def main():
+    on_tpu = jax.default_backend() != "cpu"
+    mesh = build_mesh(devices=jax.devices()[:1])
+    set_global_mesh(mesh)
+
+    if on_tpu:
+        batch, seq, steps, warmup = 8, 1024, 30, 5
+        model = causal_lm("gpt2-small", mesh=mesh)
+    else:  # dev smoke path
+        batch, seq, steps, warmup = 2, 256, 3, 1
+        model = causal_lm("gpt2-small", mesh=mesh, num_layers=2, hidden_size=128,
+                          intermediate_size=512, num_heads=4, vocab_size=2048)
+    cfg = model.config
+
+    ds_config = {
+        "train_batch_size": batch,
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config, mesh=mesh)
+
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size)
+    batch_data = (tokens, tokens)
+
+    for _ in range(warmup):
+        engine.backward(engine.forward(batch_data))
+        engine.step()
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.backward(engine.forward(batch_data))
+        engine.step()
+    jax.block_until_ready(engine.state.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tps = steps * tokens_per_step / dt
+    n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
+    # fwd+bwd FLOPs/token: 6N matmul + 12*L*D*S attention (causal halves it).
+    flops_per_token = 6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq
+    mfu = tps * flops_per_token / peak_flops()
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {"mfu": round(mfu, 4), "params_m": round(n_params / 1e6, 2),
+                   "batch": batch, "seq": seq, "steps": steps,
+                   "step_ms": round(1e3 * dt / steps, 2),
+                   "backend": jax.default_backend(),
+                   "device": getattr(jax.devices()[0], "device_kind", "?")},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -120,7 +120,9 @@ def replicated(mesh: Mesh) -> NamedSharding:
 # ---------------------------------------------------------------------------
 
 def get_data_parallel_group(mesh: Optional[Mesh] = None):
-    return ("dp", "fsdp")
+    # Matches get_data_parallel_world_size: ep carries batch shards outside
+    # MoE layers, so a DP-group collective must span it too.
+    return ("dp", "fsdp", "ep")
 
 
 def get_model_parallel_group(mesh: Optional[Mesh] = None):
@@ -140,8 +142,11 @@ def get_pipeline_parallel_group(mesh: Optional[Mesh] = None):
 
 
 def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    """Number of batch shards: dp × fsdp × ep (all of data_axes — ep carries
+    batch at the input and reshards to experts only inside MoE layers)."""
     mesh = mesh or get_global_mesh()
-    return axis_size(mesh, "dp") * axis_size(mesh, "fsdp")
+    return (axis_size(mesh, "dp") * axis_size(mesh, "fsdp")
+            * axis_size(mesh, "ep"))
 
 
 def get_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:

@@ -1,0 +1,12 @@
+"""Built-in model families (Llama / GPT-2 / Mixtral), TPU-native.
+
+The reference wraps external torch models (SURVEY.md §2.1 module_inject
+policies); a jax framework ships its own functional implementations of the
+same architecture families instead.
+"""
+
+from deepspeed_tpu.models.config import ModelConfig, get_model_config
+from deepspeed_tpu.models.transformer import CausalLM, causal_lm, cross_entropy
+
+__all__ = ["ModelConfig", "get_model_config", "CausalLM", "causal_lm",
+           "cross_entropy"]

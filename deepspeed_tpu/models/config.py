@@ -1,0 +1,101 @@
+"""Model configurations for the built-in model families.
+
+The reference ships no model zoo of its own — it wraps user/HF torch modules
+(SURVEY.md §2.1 "Module injection / TP": policies for BERT, GPT2, GPT-Neo/J/
+NeoX, OPT, BLOOM, LLaMA, Megatron).  A TPU-native framework cannot wrap torch
+modules, so we ship functional jax implementations of the same architecture
+families instead; `ModelConfig` spans them with feature flags:
+
+- Llama family  : RMSNorm + RoPE + SwiGLU + GQA   (``llama`` presets)
+- GPT-2 family  : LayerNorm + learned positions + GELU (``gpt2`` presets)
+- Mixtral family: Llama backbone + top-k MoE MLP  (``mixtral`` presets)
+
+All presets follow the public architecture descriptions of those model
+families; sizes match the milestone configs in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None     # GQA; None -> == num_heads
+    head_dim: Optional[int] = None         # None -> hidden_size // num_heads
+    max_seq_len: int = 4096
+    norm: str = "rmsnorm"                  # "rmsnorm" (llama) | "layernorm" (gpt2)
+    norm_eps: float = 1e-5
+    activation: str = "silu"               # "silu" (swiglu) | "gelu"
+    glu: bool = True                       # gated MLP (llama) vs plain (gpt2)
+    position: str = "rope"                 # "rope" | "learned"
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = False
+    dropout: float = 0.0                   # residual dropout (needs a dropout rng)
+    # MoE (mixtral family); num_experts == 0 -> dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
+    # training-time knobs
+    remat: bool = True                     # activation checkpointing per layer
+    scan_layers: bool = True               # lax.scan over stacked layer params
+    z_loss: float = 0.0
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+
+_PRESETS = {
+    # GPT-2 family (BASELINE.json configs[1]: GPT-2 125M rung)
+    "gpt2-small": dict(vocab_size=50257, hidden_size=768, intermediate_size=3072,
+                       num_layers=12, num_heads=12, max_seq_len=1024,
+                       norm="layernorm", activation="gelu", glu=False,
+                       position="learned", tie_embeddings=True),
+    "gpt2-medium": dict(vocab_size=50257, hidden_size=1024, intermediate_size=4096,
+                        num_layers=24, num_heads=16, max_seq_len=1024,
+                        norm="layernorm", activation="gelu", glu=False,
+                        position="learned", tie_embeddings=True),
+    "gpt2-xl": dict(vocab_size=50257, hidden_size=1600, intermediate_size=6400,
+                    num_layers=48, num_heads=25, max_seq_len=1024,
+                    norm="layernorm", activation="gelu", glu=False,
+                    position="learned", tie_embeddings=True),
+    # Llama family (configs[2]/[4]: 8B on v5p-8, 70B on v5p-128)
+    "llama-tiny": dict(vocab_size=32000, hidden_size=256, intermediate_size=688,
+                       num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=2048),
+    "llama3-8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                      num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                      rope_theta=500000.0),
+    "llama3-70b": dict(vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+                       num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=8192,
+                       rope_theta=500000.0),
+    # Mixtral family (configs[3]: MoE expert-parallel rung)
+    "mixtral-tiny": dict(vocab_size=32000, hidden_size=256, intermediate_size=512,
+                         num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=2048,
+                         num_experts=8, num_experts_per_tok=2),
+    "mixtral-8x7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                         rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2),
+}
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    if name not in _PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; available: {sorted(_PRESETS)}")
+    kw = dict(_PRESETS[name])
+    kw.update(overrides)
+    return ModelConfig(**kw)

@@ -1,0 +1,112 @@
+"""Model building blocks wired to the Pallas kernel library.
+
+These are the TPU-native counterparts of the reference's fused module zoo
+(``deepspeed/ops/transformer/`` wrappers over ``csrc/transformer/*.cu``,
+SURVEY.md §2.2): norms and RoPE dispatch to the Pallas kernels in
+``deepspeed_tpu/ops/pallas`` (with jnp/XLA fallback off-TPU), attention runs
+the blockwise flash kernel under ``shard_map`` when the mesh layout allows it,
+and everything else is left to XLA fusion on purpose (the MXU gets the
+matmuls; elementwise epilogues fuse).
+
+Sharding model (GSPMD): weights carry logical tensor-parallel specs
+(Megatron-style column/row split over the ``tp`` axis — the analog of the
+reference's AutoTP LinearLayer/LinearAllreduce classification,
+``deepspeed/module_inject/auto_tp.py``); activations get
+``with_sharding_constraint`` pins at layer boundaries so XLA inserts the
+all-reduce after row-parallel matmuls exactly where the reference called
+``dist.all_reduce``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import axis_size, data_axes
+from deepspeed_tpu.ops.pallas import (apply_rotary_pos_emb, flash_attention,
+                                      layer_norm, mha_reference, rms_norm,
+                                      rope_angles)
+from deepspeed_tpu.ops.pallas.common import resolve_impl
+
+
+def constrain(x, mesh: Optional[Mesh], *spec):
+    """Pin activation sharding; no-op without a mesh.
+
+    Axis names absent from ``mesh`` are dropped, so the built-in models'
+    (dp/fsdp/tp/sp/ep) constraints degrade gracefully on custom meshes.
+    """
+    if mesh is None or mesh.empty:
+        return x
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*(keep(e) for e in spec))))
+
+
+def norm(x, params, kind: str, eps: float):
+    """Dispatch to the fused Pallas norm kernels (csrc layer_norm/rms_norm)."""
+    if kind == "rmsnorm":
+        return rms_norm(x, params["scale"], eps=eps)
+    return layer_norm(x, params["scale"], params["bias"], eps=eps)
+
+
+def activation_fn(name: str):
+    return {"silu": jax.nn.silu, "gelu": functools.partial(jax.nn.gelu, approximate=True),
+            "relu": jax.nn.relu}[name]
+
+
+def _repeat_kv(k, n_rep: int):
+    """GQA: expand [B, Hkv, S, D] -> [B, Hkv*n_rep, S, D]."""
+    if n_rep == 1:
+        return k
+    b, h, s, d = k.shape
+    return jnp.broadcast_to(k[:, :, None], (b, h, n_rep, s, d)).reshape(b, h * n_rep, s, d)
+
+
+def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
+                   impl: Optional[str] = None):
+    """Multi-head attention on [B, H, S, Dh] tensors.
+
+    On TPU with a compatible mesh layout the flash kernel runs inside
+    ``shard_map`` (batch over the data axes, heads over ``tp`` — the Ulysses
+    head-parallel layout, SURVEY.md §5.7); otherwise the jnp reference runs
+    under plain GSPMD, which still gives a fused, sharded attention.
+    """
+    impl = resolve_impl(impl)
+    if impl != "pallas" or mesh is None or mesh.empty:
+        return mha_reference(q, k, v, causal=causal)
+    b, h, s, d = q.shape
+    batch_ax = data_axes(mesh)
+    nb = 1
+    for a in batch_ax:
+        nb *= axis_size(mesh, a)
+    ntp = axis_size(mesh, "tp")
+    nsp = axis_size(mesh, "sp")
+    if nsp > 1 or b % nb != 0 or h % ntp != 0 or s % 128 != 0:
+        # sp-sharded sequence is handled by the ring/Ulysses paths in
+        # deepspeed_tpu/sequence; here fall back to the XLA reference.
+        return mha_reference(q, k, v, causal=causal)
+    spec = P(batch_ax, "tp", None, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _sharded(qq, kk, vv):
+        return flash_attention(qq, kk, vv, causal=causal)
+
+    return _sharded(q, k, v)
+
+
+def rope_cache(seq_len: int, head_dim: int, theta: float):
+    return rope_angles(jnp.arange(seq_len), head_dim, theta=theta)

@@ -1,0 +1,8 @@
+"""Mixture-of-Experts / expert parallelism (reference: ``deepspeed/moe/``)."""
+
+from deepspeed_tpu.moe.layer import MoE, split_params_into_moe_groups
+from deepspeed_tpu.moe.sharded_moe import (compute_capacity, moe_mlp,
+                                           topk_gating)
+
+__all__ = ["MoE", "split_params_into_moe_groups", "compute_capacity",
+           "moe_mlp", "topk_gating"]

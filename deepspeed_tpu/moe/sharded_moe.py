@@ -1,0 +1,121 @@
+"""Expert-parallel MoE: top-k gating + all-to-all dispatch/combine.
+
+TPU-native rebuild of the reference's ``deepspeed/moe/sharded_moe.py``
+(GShard-style ``top1gating``/``top2gating`` + ``MOELayer`` with ``_AllToAll``
+over the expert-parallel process group; SURVEY.md §2.1 "MoE / expert
+parallelism").  Differences forced by XLA's static shapes — and they are the
+same choices GShard itself made:
+
+- **Fixed expert capacity + masking** instead of dynamic token lists: every
+  expert processes exactly ``C = ceil(k·N/E · capacity_factor)`` token slots;
+  overflow tokens are dropped (their combine weight is zero, so they pass
+  through the residual connection untouched).
+- **Dispatch/combine as einsums** with a [N, E, C] one-hot tensor; the
+  reference's explicit ``all_to_all_single`` calls become GSPMD-inserted
+  all-to-alls when the [E, C, D] expert tensor is sharding-constrained onto
+  the ``ep`` mesh axis while tokens are sharded over the data axes.
+- Load-balancing aux loss (the reference's ``l_aux``): ``E · Σ_e mean_prob_e
+  · frac_tokens_e`` over the top-1 assignment.
+
+Expert weights are sharded over ``ep`` (expert parallelism) and optionally
+``tp`` (intra-expert tensor parallelism) via the model's logical specs; the
+expert-data-parallel hybrid (reference ``ep_size`` < world) falls out of the
+mesh factorization (ep axis size < dp·fsdp·ep extent).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.layers import activation_fn, constrain
+
+
+def compute_capacity(num_tokens: int, num_experts: int, k: int,
+                     capacity_factor: float, min_capacity: int = 4) -> int:
+    return max(min_capacity,
+               int(math.ceil(k * num_tokens / num_experts * capacity_factor)))
+
+
+def topk_gating(gates, k: int, capacity: int):
+    """GShard top-k gating with fixed capacity.
+
+    gates: [N, E] softmax router probabilities (fp32).
+    Returns (combine [N, E, C], dispatch [N, E, C] bool, aux_loss scalar).
+    Reference: ``top1gating``/``top2gating`` in deepspeed/moe/sharded_moe.py.
+    """
+    N, E = gates.shape
+    C = capacity
+    remaining = gates
+    location_base = jnp.zeros((E,), jnp.int32)
+    combine = jnp.zeros((N, E, C), jnp.float32)
+    kept_gate_sum = jnp.zeros((N,), jnp.float32)
+    aux = jnp.zeros((), jnp.float32)
+
+    for slot in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                      # [N]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)        # [N, E]
+        if slot == 0:
+            me = jnp.mean(gates, axis=0)                          # mean router prob
+            ce = jnp.mean(onehot, axis=0)                         # token fraction
+            aux = E * jnp.sum(me * ce)
+        # position of each token within its chosen expert's capacity buffer
+        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot + location_base[None]
+        pos = jnp.sum(pos_in_e * onehot, axis=-1).astype(jnp.int32)  # [N]
+        keep = (pos < C).astype(jnp.float32)
+        gate_val = jnp.sum(gates * onehot, axis=-1)               # [N]
+        pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C, dtype=jnp.float32)
+        combine = combine + ((gate_val * keep)[:, None, None]
+                             * onehot[:, :, None] * pos_oh[:, None, :])
+        kept_gate_sum = kept_gate_sum + gate_val * keep
+        location_base = location_base + jnp.sum(onehot, axis=0).astype(jnp.int32)
+        remaining = jnp.where(onehot > 0, -jnp.inf, remaining)
+
+    if k > 1:
+        # normalize combine weights over the kept top-k experts per token
+        # (Mixtral/top2gating convention); k=1 keeps the raw gate probability
+        # so the router still gets gradient from the task loss (top1gating).
+        combine = combine / jnp.maximum(kept_gate_sum, 1e-9)[:, None, None]
+    dispatch = combine > 0
+    return combine, dispatch, aux
+
+
+def moe_mlp(params, x, cfg, mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One MoE feed-forward block on [B, S, D] hidden states.
+
+    ``params``: {"gate_w" [D, E], "w_up" [E, D, F], ("w_gate" [E, D, F]),
+    "w_down" [E, F, D]} — the per-layer slice of the model's stacked MoE
+    weights.  Returns (output [B, S, D], aux_loss scalar).
+    """
+    B, S, D = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    N = B * S
+    xt = x.reshape(N, D)
+
+    logits = xt.astype(jnp.float32) @ params["gate_w"].astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    C = compute_capacity(N, E, k, cfg.moe_capacity_factor,
+                         getattr(cfg, "moe_min_capacity", 4))
+    combine, dispatch, aux = topk_gating(gates, k, C)
+
+    # dispatch: tokens (sharded over data axes) -> expert buffers (sharded
+    # over ep) — GSPMD inserts the all-to-all here (reference: _AllToAll).
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt)
+    expert_in = constrain(expert_in, mesh, "ep", None, None)
+
+    act = activation_fn(cfg.activation)
+    up = jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"].astype(x.dtype))
+    if cfg.glu:
+        gate = jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"].astype(x.dtype))
+        hidden = act(gate) * up
+    else:
+        hidden = act(up)
+    out = jnp.einsum("ecf,efd->ecd", hidden, params["w_down"].astype(x.dtype))
+    out = constrain(out, mesh, "ep", None, None)
+
+    # combine: expert buffers -> tokens (the return all-to-all)
+    y = jnp.einsum("ecd,nec->nd", out, combine.astype(x.dtype))
+    return y.reshape(B, S, D), aux

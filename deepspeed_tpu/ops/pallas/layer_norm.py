@@ -49,6 +49,13 @@ def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, *, eps):
 
 
 def _ln_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dg_ref, db_ref, *, eps):
+    # dg/db are a single (1, n) block shared across the (sequential) TPU grid:
+    # zero on the first step, accumulate in VMEM on every step.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
     x = x_ref[:].astype(jnp.float32)
     dy = dy_ref[:].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)
@@ -61,8 +68,8 @@ def _ln_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dg_ref, db_ref, *, eps):
     c1 = jnp.mean(wdy, axis=-1, keepdims=True)
     c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
     dx_ref[:] = ((wdy - c1 - xhat * c2) * rstd).astype(dx_ref.dtype)
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+    dg_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def _rms_fwd_kernel(x_ref, g_ref, y_ref, *, eps):
@@ -72,6 +79,10 @@ def _rms_fwd_kernel(x_ref, g_ref, y_ref, *, eps):
 
 
 def _rms_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dg_ref, *, eps):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+
     x = x_ref[:].astype(jnp.float32)
     dy = dy_ref[:].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)
@@ -80,7 +91,7 @@ def _rms_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dg_ref, *, eps):
     wdy = dy * g
     c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
     dx_ref[:] = ((wdy - xhat * c2) * rstd).astype(dx_ref.dtype)
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    dg_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
 
 
 # ---------------------------------------------------------------------------
@@ -154,14 +165,14 @@ def _layer_norm_bwd_vjp(eps, impl, res, dy):
                       pl.BlockSpec((1, n), lambda i: (0, 0)),
                       pl.BlockSpec((br, n), lambda i: (i, 0))],
             out_specs=[pl.BlockSpec((br, n), lambda i: (i, 0)),
-                       pl.BlockSpec((1, n), lambda i: (i, 0)),
-                       pl.BlockSpec((1, n), lambda i: (i, 0))],
+                       pl.BlockSpec((1, n), lambda i: (0, 0)),
+                       pl.BlockSpec((1, n), lambda i: (0, 0))],
             out_shape=[jax.ShapeDtypeStruct((rows, n), x.dtype),
-                       jax.ShapeDtypeStruct((grid, n), jnp.float32),
-                       jax.ShapeDtypeStruct((grid, n), jnp.float32)],
+                       jax.ShapeDtypeStruct((1, n), jnp.float32),
+                       jax.ShapeDtypeStruct((1, n), jnp.float32)],
             interpret=interpret_flag(impl),
         )(x2, gamma.reshape(1, n), dy2)
-        dg, db = dg_part.sum(0), db_part.sum(0)
+        dg, db = dg_part[0], db_part[0]
     return dx.reshape(orig), dg.astype(gamma.dtype), db.astype(gamma.dtype)
 
 
@@ -227,12 +238,12 @@ def _rms_norm_bwd_vjp(eps, impl, res, dy):
                       pl.BlockSpec((1, n), lambda i: (0, 0)),
                       pl.BlockSpec((br, n), lambda i: (i, 0))],
             out_specs=[pl.BlockSpec((br, n), lambda i: (i, 0)),
-                       pl.BlockSpec((1, n), lambda i: (i, 0))],
+                       pl.BlockSpec((1, n), lambda i: (0, 0))],
             out_shape=[jax.ShapeDtypeStruct((rows, n), x.dtype),
-                       jax.ShapeDtypeStruct((grid, n), jnp.float32)],
+                       jax.ShapeDtypeStruct((1, n), jnp.float32)],
             interpret=interpret_flag(impl),
         )(x2, gamma.reshape(1, n), dy2)
-        dg = dg_part.sum(0)
+        dg = dg_part[0]
     return dx.reshape(orig), dg.astype(gamma.dtype)
 
 

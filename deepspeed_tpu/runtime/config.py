@@ -317,12 +317,19 @@ class DeepSpeedConfig:
         self._param_dict = _load_config_dict(config)
         d = self._param_dict
 
+        # Mesh section is parsed first: the batch triad's "world size" is the
+        # *data-parallel* world (reference precedence: mpu's DP group,
+        # SURVEY.md §3.2) = devices / (tp*sp*pp); dp, fsdp and ep all carry
+        # batch shards (comm/mesh.py data_axes).
+        self.mesh = MeshConfig(**d.get("mesh", d.get("tpu", {}).get("mesh", {})
+                                       if isinstance(d.get("tpu"), dict) else {}))
         if world_size is not None:
             self.world_size = int(world_size)
         elif mpu is not None and hasattr(mpu, "get_data_parallel_world_size"):
             self.world_size = int(mpu.get_data_parallel_world_size())
         else:
-            self.world_size = _default_world_size()
+            denom = max(1, self.mesh.tp * self.mesh.sp * self.mesh.pp)
+            self.world_size = max(1, _default_world_size() // denom)
 
         # -- batch triad ----------------------------------------------------
         tbs = d.get("train_batch_size")
@@ -369,7 +376,6 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
         self.elasticity = ElasticityConfig(**d.get("elasticity", {}))
         self.tensor_parallel = TensorParallelConfig(**d.get("tensor_parallel", {}))
-        self.mesh = MeshConfig(**d.get("mesh", d.get("tpu", {}).get("mesh", {}) if isinstance(d.get("tpu"), dict) else {}))
         self.data_efficiency = DataEfficiencyConfig(**d.get("data_efficiency", {}))
         self.compression_training = CompressionConfig(**d.get("compression_training", {}))
         self.autotuning = AutotuningConfig(**d.get("autotuning", {}))

@@ -77,7 +77,17 @@ class DeepSpeedEngine:
         self.collate_fn = collate_fn
         self.mpu = mpu
 
-        self.config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config, mpu=mpu)
+        if isinstance(config, DeepSpeedConfig):
+            self.config = config
+        else:
+            # With an explicit mesh (and no mpu — the mpu's DP group keeps
+            # reference precedence), the batch triad's world size is the
+            # mesh's data-parallel extent (dp × fsdp × ep carry batch shards).
+            ws = None
+            ws_mesh = mesh if mesh is not None else get_global_mesh(create_default=False)
+            if ws_mesh is not None and mpu is None:
+                ws = comm.get_data_parallel_world_size(ws_mesh)
+            self.config = DeepSpeedConfig(config, mpu=mpu, world_size=ws)
         comm.init_distributed(dist_init_required=dist_init_required, config=self.config)
         self.mesh = mesh or get_global_mesh()
         comm.set_global_mesh(self.mesh)
@@ -93,6 +103,10 @@ class DeepSpeedEngine:
 
         self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         self._loss_fn = loss_fn or self._make_loss_fn(model)
+        if param_pspecs is None and hasattr(model, "logical_pspecs"):
+            # Built-in models publish their tensor/expert-parallel layout
+            # (the AutoTP-equivalent classification, SURVEY.md §2.1).
+            param_pspecs = model.logical_pspecs()
         self._client_param_pspecs = param_pspecs  # tensor-parallel logical specs
         self._micro_count = 0
         self._boundary_override: Optional[bool] = None

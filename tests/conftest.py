@@ -12,7 +12,12 @@ import os
 # Force-override: the session environment pins JAX_PLATFORMS to the TPU tunnel;
 # tests always run on the virtual CPU mesh (set DSTPU_TEST_ON_TPU=1 to opt out).
 if not os.environ.get("DSTPU_TEST_ON_TPU"):
+    # The concurrency-optimized scheduler can order two independent
+    # collectives differently across the in-process CPU "devices", deadlocking
+    # the rendezvous (observed with MoE's ep all-gathers + loss all-reduce).
+    # TPU executes collectives in one serialized stream, so this is test-only.
     os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                               "--xla_cpu_enable_concurrency_optimized_scheduler=false "
                                + os.environ.get("XLA_FLAGS", ""))
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["DS_ACCELERATOR"] = "cpu"

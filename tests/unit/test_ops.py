@@ -202,3 +202,38 @@ class TestFusedAdam:
         it = iter(RepeatingLoader(loader))
         losses = [float(engine.train_batch(it)) for _ in range(10)]
         assert losses[-1] < losses[0]
+
+
+def test_norm_backward_multiblock_grid():
+    """rows > 256 exercises the multi-step grid accumulation of dgamma/dbeta
+    (zero-on-first-step + VMEM '+=' across sequential grid steps)."""
+    import jax, numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.pallas import layer_norm, rms_norm
+
+    rng = jax.random.PRNGKey(7)
+    x = jax.random.normal(rng, (512, 128), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(8), (128,)) * 0.1 + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(9), (128,)) * 0.1
+
+    def loss_pallas(x, g, b):
+        return jnp.sum(layer_norm(x, g, b, 1e-5, "interpret") ** 2)
+
+    def loss_xla(x, g, b):
+        return jnp.sum(layer_norm(x, g, b, 1e-5, "xla") ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, g, b)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(x, g, b)
+    for a, e in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), rtol=2e-4, atol=2e-4)
+
+    def rms_pallas(x, g):
+        return jnp.sum(rms_norm(x, g, 1e-6, "interpret") ** 2)
+
+    def rms_xla(x, g):
+        return jnp.sum(rms_norm(x, g, 1e-6, "xla") ** 2)
+
+    gp = jax.grad(rms_pallas, argnums=(0, 1))(x, g)
+    gx = jax.grad(rms_xla, argnums=(0, 1))(x, g)
+    for a, e in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), rtol=2e-4, atol=2e-4)
