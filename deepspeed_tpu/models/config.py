@@ -44,6 +44,8 @@ class ModelConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
     # training-time knobs
+    sp_mode: str = "auto"                  # "auto" | "ulysses" | "ring" (sp>1)
+    pp_microbatches: int = 0               # pipeline microbatches (0 -> pp size)
     remat: bool = True                     # activation checkpointing per layer
     scan_layers: bool = True               # lax.scan over stacked layer params
     z_loss: float = 0.0

@@ -76,16 +76,20 @@ def _repeat_kv(k, n_rep: int):
 
 
 def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
-                   impl: Optional[str] = None):
+                   impl: Optional[str] = None, sp_mode: str = "auto"):
     """Multi-head attention on [B, H, S, Dh] tensors.
 
-    On TPU with a compatible mesh layout the flash kernel runs inside
-    ``shard_map`` (batch over the data axes, heads over ``tp`` — the Ulysses
-    head-parallel layout, SURVEY.md §5.7); otherwise the jnp reference runs
-    under plain GSPMD, which still gives a fused, sharded attention.
+    Dispatch (SURVEY.md §5.7):
+    - sp > 1 and heads divisible → **Ulysses**: all-to-all seq↔head reshard
+      around full-sequence attention (deepspeed_tpu/sequence/layer.py).
+    - sp > 1 otherwise (or ``sp_mode="ring"``) → **ring attention**: KV
+      rotation via ppermute, O(S/P) memory.
+    - sp == 1 on TPU with a compatible layout → flash kernel under shard_map
+      (batch over data axes, heads over ``tp``).
+    - anything else → jnp reference under plain GSPMD.
     """
     impl = resolve_impl(impl)
-    if impl != "pallas" or mesh is None or mesh.empty:
+    if mesh is None or mesh.empty:
         return mha_reference(q, k, v, causal=causal)
     b, h, s, d = q.shape
     batch_ax = data_axes(mesh)
@@ -94,9 +98,17 @@ def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
         nb *= axis_size(mesh, a)
     ntp = axis_size(mesh, "tp")
     nsp = axis_size(mesh, "sp")
-    if nsp > 1 or b % nb != 0 or h % ntp != 0 or s % 128 != 0:
-        # sp-sharded sequence is handled by the ring/Ulysses paths in
-        # deepspeed_tpu/sequence; here fall back to the XLA reference.
+    divisible = b % nb == 0 and h % ntp == 0
+    if nsp > 1 and divisible and s % nsp == 0:
+        from deepspeed_tpu.sequence.layer import ring_attention, ulysses_attention
+        local_heads = h // ntp
+        if sp_mode == "ring" or local_heads % nsp != 0:
+            return ring_attention(q, k, v, mesh, causal=causal)
+        inner = None
+        if impl == "pallas" and s % 128 == 0:
+            inner = functools.partial(flash_attention, causal=causal)
+        return ulysses_attention(q, k, v, mesh, attn_fn=inner, causal=causal)
+    if impl != "pallas" or nsp > 1 or not divisible or s % 128 != 0:
         return mha_reference(q, k, v, causal=causal)
     spec = P(batch_ax, "tp", None, None)
 

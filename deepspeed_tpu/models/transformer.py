@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deepspeed_tpu.comm.mesh import get_global_mesh
+from deepspeed_tpu.comm.mesh import axis_size, get_global_mesh
 from deepspeed_tpu.models.config import ModelConfig, get_model_config
 from deepspeed_tpu.models.layers import (activation_fn, attention_core, constrain,
                                          norm, _repeat_kv, rope_cache)
@@ -152,6 +152,11 @@ class CausalLM:
             specs["embed"]["pos"] = P(None, None)
         if not cfg.tie_embeddings:
             specs["lm_head"] = P(None, "tp")
+        mesh = self.mesh
+        if mesh is not None and not mesh.empty:
+            # pipeline parallelism: stage ownership = stacked-layer-dim shard
+            from deepspeed_tpu.runtime.pipe.spmd import pp_layer_pspecs
+            specs["layers"] = pp_layer_pspecs(specs["layers"], mesh)
         return specs
 
     # ------------------------------------------------------------------
@@ -173,8 +178,7 @@ class CausalLM:
             k = apply_rotary_pos_emb(k, cos, sin)
         k = _repeat_kv(k, H // Hkv)
         v = _repeat_kv(v, H // Hkv)
-        q = constrain(q, mesh, batch_ax, "tp", None, None)
-        o = attention_core(q, k, v, mesh, causal=True)
+        o = attention_core(q, k, v, mesh, causal=True, sp_mode=cfg.sp_mode)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
         o = (o @ lp["attn"]["wo"]).astype(x.dtype)
         if use_drop:
@@ -225,11 +229,28 @@ class CausalLM:
                                  use_drop=use_drop)
         if cfg.remat:
             body = jax.checkpoint(body, prevent_cse=False)
-        if cfg.scan_layers:
-            def scan_body(carry, xs):
-                lp, key = xs
-                y, aux = body(lp, carry, key)
-                return y, aux
+        pp = axis_size(mesh, "pp") if mesh is not None and not mesh.empty else 1
+
+        def scan_body(carry, xs):
+            lp, key = xs
+            y, aux = body(lp, carry, key)
+            return y, aux
+
+        if pp > 1:
+            if not cfg.scan_layers:
+                raise ValueError("pipeline parallelism requires scan_layers=True "
+                                 "(stacked layer params)")
+            from deepspeed_tpu.runtime.pipe.spmd import spmd_pipeline
+
+            def stage_fn(wl, xmb, keys_l, cos, sin):
+                y, auxes = jax.lax.scan(
+                    lambda c, xs: scan_body(c, xs), xmb, (wl, keys_l))
+                return y, jnp.sum(auxes)
+
+            x, aux_loss = spmd_pipeline(stage_fn, params["layers"], x, mesh,
+                                        num_microbatches=cfg.pp_microbatches,
+                                        broadcast_args=(cos, sin), scan_args=keys)
+        elif cfg.scan_layers:
             x, auxes = jax.lax.scan(scan_body, x, (params["layers"], keys))
             aux_loss = jnp.sum(auxes)
         else:

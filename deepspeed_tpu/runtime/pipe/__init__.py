@@ -1,0 +1,9 @@
+"""Pipeline parallelism (reference: ``deepspeed/runtime/pipe/``)."""
+
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+from deepspeed_tpu.runtime.pipe.spmd import pp_layer_pspecs, spmd_pipeline
+
+__all__ = ["PipelineEngine", "LayerSpec", "PipelineModule", "TiedLayerSpec",
+           "pp_layer_pspecs", "spmd_pipeline"]

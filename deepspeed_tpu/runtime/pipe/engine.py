@@ -1,0 +1,34 @@
+"""Pipeline engine (reference: ``deepspeed/runtime/pipe/engine.py``).
+
+The reference subclass replaces forward/backward with an instruction scheduler
+(SURVEY.md §3.4).  Here pipelining happens *inside* the jitted train step
+(runtime/pipe/spmd.py), so the engine surface is unchanged — this subclass
+only adds the pipeline-specific introspection the reference exposes and makes
+``train_batch``/``eval_batch`` the primary entry points.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.comm.mesh import axis_size
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.is_pipe_parallel = axis_size(self.mesh, "pp") > 1
+
+    @property
+    def num_stages(self) -> int:
+        return axis_size(self.mesh, "pp")
+
+    def stage_id(self) -> int:
+        # SPMD: every process drives all stages; stage placement is a mesh
+        # sharding, not a per-process role (reference: grid.get_stage_id()).
+        return 0
+
+    def is_first_stage(self) -> bool:
+        return True
+
+    def is_last_stage(self) -> bool:
+        return True

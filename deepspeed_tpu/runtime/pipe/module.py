@@ -1,0 +1,108 @@
+"""Reference-parity pipeline module API.
+
+Reference: ``deepspeed/runtime/pipe/module.py`` — ``PipelineModule(layers=
+[LayerSpec(...), ...], num_stages, partition_method)`` (SURVEY.md §2.1).  The
+functional TPU version keeps the LayerSpec construction surface but executes
+via the SPMD pipeline (runtime/pipe/spmd.py): layer params are stacked along a
+leading [L] dim and sharded over the ``pp`` mesh axis, so the reference's
+layer-to-stage partitioner becomes a sharding decision.
+
+Constraint inherited from the stacked representation: specs must build layers
+with identical param structure and activation shape (the transformer case).
+Heterogeneous stacks (embedding → blocks → head) follow the built-in models'
+pattern instead: keep the non-uniform ends outside the pipelined stack
+(models/transformer.py does exactly this).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.mesh import axis_size, get_global_mesh
+from deepspeed_tpu.runtime.pipe.spmd import spmd_pipeline
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference parity: holds class + args,
+    builds lazily so stages only materialize their own layers — here,
+    building is cheap and sharding handles placement)."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """Reference parity: layers sharing params across stages (e.g. embedding
+    reused as the LM head).  In the functional model, tied params are stored
+    once outside the stacked layer tree and passed to both call sites —
+    the tie is a pytree-sharing decision, not a gradient-allreduce protocol."""
+
+    def __init__(self, key: str, typename: Callable, *args, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+
+
+class PipelineModule:
+    """Uniform-layer pipeline container.
+
+    Each built layer must expose ``init(rng, x) -> params`` and
+    ``apply(params, x) -> y`` with identical param structure and activation
+    shapes.  Params are stacked per-leaf along a new leading [L] dim.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: Optional[int] = None,
+                 mesh=None, loss_fn: Optional[Callable] = None,
+                 partition_method: str = "uniform", num_microbatches: int = 0):
+        self.specs = list(layers)
+        self.mesh = mesh or get_global_mesh(create_default=False)
+        self.loss_fn = loss_fn
+        self.num_microbatches = num_microbatches
+        self._layers = [s.build() for s in self.specs]
+        pp = axis_size(self.mesh, "pp") if self.mesh is not None else 1
+        self.num_stages = num_stages or pp
+        if pp > 1 and len(self._layers) % pp != 0:
+            raise ValueError(f"{len(self._layers)} layers not divisible by pp={pp}")
+        if partition_method not in ("uniform", "parameters"):
+            raise ValueError(f"unknown partition_method {partition_method!r}")
+
+    def init(self, rng, x) -> Any:
+        rngs = jax.random.split(rng, len(self._layers))
+        per_layer = []
+        for layer, r in zip(self._layers, rngs):
+            p = layer.init(r, x)
+            x = jax.eval_shape(layer.apply, p, x)
+            x = jnp.zeros(x.shape, x.dtype)
+            per_layer.append(p)
+        first = jax.tree.structure(per_layer[0])
+        for i, p in enumerate(per_layer[1:], 1):
+            if jax.tree.structure(p) != first:
+                raise ValueError(
+                    f"layer {i} param structure differs from layer 0; the SPMD "
+                    "pipeline needs uniform layers (see module docstring)")
+        return jax.tree.map(lambda *ls: jnp.stack(ls), *per_layer)
+
+    def apply(self, params, x):
+        apply0 = self._layers[0].apply
+
+        def stage_fn(wl, xmb, _scan, *bcast):
+            def body(c, lp):
+                return apply0(lp, c), None
+            y, _ = jax.lax.scan(body, xmb, wl)
+            return y, jnp.zeros((), jnp.float32)
+
+        y, _aux = spmd_pipeline(stage_fn, params, x, self.mesh,
+                                num_microbatches=self.num_microbatches)
+        if self.loss_fn is not None:
+            return self.loss_fn(y)
+        return y
+
+    def __call__(self, params, x):
+        return self.apply(params, x)

@@ -1,0 +1,135 @@
+"""SPMD pipeline parallelism: microbatch pipelining inside one XLA program.
+
+TPU-native replacement for the reference's instruction-VM pipeline
+(``deepspeed/runtime/pipe/engine.py`` + ``schedule.py`` + ``p2p.py``,
+SURVEY.md §2.1, §3.4): instead of a Python scheduler issuing
+``SendActivation``/``RecvActivation`` P2P ops per rank, the whole schedule is
+one ``lax.scan`` under a ``shard_map`` that is *manual only over the ``pp``
+axis* — stage-to-stage transfers are ``ppermute`` (nearest-neighbor on the ICI
+torus), every other mesh axis (fsdp/tp/sp/ep/dp) stays under GSPMD inside the
+stage body, and autodiff through the scan replaces the 1F1B backward
+instructions (XLA schedules the pipelined backward).
+
+Schedule shape = GPipe fill-drain over ``T = M + pp - 1`` steps with M
+microbatches; the bubble fraction is ``(pp-1)/T``, identical to the
+reference's default ``TrainSchedule`` cost.  Stage ``s`` processes microbatch
+``m`` at step ``t = m + s``; invalid (bubble) steps compute on zeros and are
+masked out of outputs and aux losses, contributing zero gradient.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import axis_size
+
+
+def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
+                  mesh: Mesh, num_microbatches: int = 0,
+                  broadcast_args: Tuple = (), scan_args: Any = None,
+                  axis: str = "pp"):
+    """Run a stacked-layer function pipelined over the ``pp`` mesh axis.
+
+    - ``stage_fn(local_layer_params, x_mb, local_scan_args, *broadcast_args)
+      -> (y_mb, aux)``: consumes the local [L/pp, ...] slice of the stacked
+      layer params (scanning over it internally) and one microbatch.
+    - ``layer_params``: pytree with leading stacked layer dim [L, ...] on
+      every leaf; sliced into [L/pp, ...] per stage.
+    - ``x``: [B, ...] global batch; split into M microbatches along dim 0.
+    - ``scan_args``: optional pytree with leading [L] dim sliced like params
+      (e.g. per-layer dropout keys).
+    - ``broadcast_args``: replicated extras (e.g. RoPE cos/sin tables).
+
+    Returns (y [B, ...], aux_sum) with y replicated over ``pp``.
+    """
+    pp = axis_size(mesh, axis)
+    if pp == 1:
+        y, aux = stage_fn(layer_params, x, scan_args, *broadcast_args)
+        return y, aux
+    B = x.shape[0]
+    M = num_microbatches or pp
+    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    mb = B // M
+    T = M + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    # Replicated (P()) boundary tensors cross in fp32: the transpose of a
+    # replicated shard_map input is a psum over the manual axis, and bf16
+    # psum under partial-manual shard_map trips an XLA CPU check ("invalid
+    # binary instruction opcode copy", jax 0.9 / 2026-07); fp32 at the
+    # boundary is also exact for the activation cotangent accumulation.
+    x_dtype = x.dtype
+    b_dtypes = tuple(jnp.asarray(a).dtype for a in broadcast_args)
+    n_b = len(broadcast_args)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(axis), P(), P(axis)) + (P(),) * n_b,
+                       out_specs=(P(), P()),
+                       axis_names={axis}, check_vma=False)
+    def _pipelined(wl, xg32, sl, *bc32):
+        xg = xg32.astype(x_dtype)
+        broadcast_args = tuple(a.astype(dt) for a, dt in zip(bc32, b_dtypes))
+        stage = jax.lax.axis_index(axis)
+        xmb = xg.reshape((M, mb) + xg.shape[1:])
+
+        def step(carry, t):
+            buf, outs, aux_acc = carry
+            m_idx = t - stage
+            valid = (m_idx >= 0) & (m_idx < M)
+            inp = jnp.where(stage == 0, xmb[jnp.clip(t, 0, M - 1)], buf)
+            out, aux = stage_fn(wl, inp, sl, *broadcast_args)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            o_idx = t - (pp - 1)
+            is_out = (stage == pp - 1) & (o_idx >= 0)
+            outs = jax.lax.cond(
+                is_out, lambda o: o.at[jnp.maximum(o_idx, 0)].set(out),
+                lambda o: o, outs)
+            buf = jax.lax.ppermute(out, axis, perm)
+            return (buf, outs, aux_acc), None
+
+        buf0 = jnp.zeros((mb,) + xg.shape[1:], xg.dtype)
+        outs0 = jnp.zeros((M, mb) + xg.shape[1:], xg.dtype)
+        (b, outs, aux), _ = jax.lax.scan(step, (buf0, outs0, jnp.zeros((), jnp.float32)),
+                                         jnp.arange(T))
+        # Replicate the last stage's outputs / summed aux across pp.  The
+        # psum runs in fp32: besides exactness, bf16 psum under partial-manual
+        # shard_map trips an XLA CPU check ("invalid binary instruction
+        # opcode copy"), observed jax 0.9 / 2026-07.
+        outs = jax.lax.psum(
+            jnp.where(stage == pp - 1, outs.astype(jnp.float32), 0.0), axis)
+        # Mean over microbatches so aux losses match the unpipelined full-batch
+        # value (each stage contributes only its own layers; the psum over pp
+        # is the sum over layers, not a duplication).
+        aux = jax.lax.psum(aux, axis) / M
+        return outs.astype(xg.dtype).reshape(xg.shape), aux
+
+    if scan_args is None:
+        # shard_map needs a concrete argument; a [L]-length dummy slices fine
+        leaves = jax.tree.leaves(layer_params)
+        scan_args = jnp.zeros((leaves[0].shape[0],), jnp.uint32)
+    def boundary_cast(a):
+        a = jnp.asarray(a)
+        return a.astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+    return _pipelined(layer_params, boundary_cast(x), scan_args,
+                      *(boundary_cast(a) for a in broadcast_args))
+
+
+def pp_layer_pspecs(pspecs: Any, mesh: Mesh, axis: str = "pp") -> Any:
+    """Mark the stacked layer dim of every leaf spec with the ``pp`` axis
+    (storage placement matches pipeline stage ownership)."""
+    if axis_size(mesh, axis) == 1:
+        return pspecs
+
+    def mark(spec: P) -> P:
+        entries = list(spec) + [None] * max(0, 1 - len(spec))
+        if entries[0] is None:
+            entries[0] = axis
+        return P(*entries)
+
+    return jax.tree.map(mark, pspecs, is_leaf=lambda s: isinstance(s, P))

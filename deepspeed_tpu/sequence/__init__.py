@@ -1,0 +1,6 @@
+"""Sequence parallelism (reference: ``deepspeed/sequence/``) + ring attention."""
+
+from deepspeed_tpu.sequence.layer import (DistributedAttention, ring_attention,
+                                          ulysses_attention)
+
+__all__ = ["DistributedAttention", "ring_attention", "ulysses_attention"]

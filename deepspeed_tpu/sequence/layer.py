@@ -1,0 +1,166 @@
+"""Sequence parallelism: Ulysses all-to-all attention and ring attention.
+
+Reference: ``deepspeed/sequence/layer.py`` ``DistributedAttention`` (SURVEY.md
+§2.1, §5.7) — input sharded on the sequence dim across the SP group,
+all-to-all re-shards seq↔head around the core attention so each rank computes
+full-sequence attention for ``H/P`` heads.  Here that is a ``shard_map`` over
+the mesh's ``sp`` axis with ``jax.lax.all_to_all`` (which rides ICI directly).
+
+**Ring attention** (``ring_attention``) is the TPU-idiomatic extension beyond
+the reference's capability (SURVEY.md §5.7 plan): KV chunks rotate around the
+``sp`` axis via ``ppermute`` while each rank accumulates blockwise-softmax
+partial results for its resident Q chunk — memory O(S/P), comm overlapped
+with compute, no head-count divisibility requirement.  Implemented as a
+``lax.scan`` over ring steps (differentiable; the backward re-runs the ring).
+
+Both entry points take globally-shaped [B, H, S, D] arrays and shard
+internally, so they drop into any attention call site.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import axis_size, data_axes
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Ulysses
+# ---------------------------------------------------------------------------
+
+def ulysses_attention(q, k, v, mesh: Mesh, attn_fn: Optional[Callable] = None,
+                      causal: bool = True, axis: str = "sp"):
+    """All-to-all seq↔head reshard around full-sequence attention.
+
+    q: [B, H, S, D]; k/v: [B, Hkv, S, D] with Hkv == H (repeat GQA heads
+    before calling).  Requires H % sp == 0 and S % sp == 0.
+    """
+    if attn_fn is None:
+        from deepspeed_tpu.ops.pallas import mha_reference
+        attn_fn = functools.partial(mha_reference, causal=causal)
+    sp = axis_size(mesh, axis)
+    if sp == 1:
+        return attn_fn(q, k, v)
+    batch_ax = data_axes(mesh)
+    spec = P(batch_ax, "tp", axis, None)   # seq-sharded on entry/exit
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _inner(ql, kl, vl):
+        # [B, h, S/P, D] -> all-to-all -> [B, h/P, S, D]   (h = H/tp)
+        def scatter_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def gather_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        o = attn_fn(scatter_heads(ql), scatter_heads(kl), scatter_heads(vl))
+        return gather_heads(o)
+
+    return _inner(q, k, v)
+
+
+class DistributedAttention:
+    """Reference-parity wrapper (``deepspeed.sequence.layer.DistributedAttention``).
+
+    ``local_attention(q, k, v) -> out`` computes attention on full sequences;
+    this class re-shards seq↔head around it over the sequence-parallel axis.
+    scatter_idx/gather_idx are accepted for signature parity (the jax
+    implementation always scatters heads / gathers sequence).
+    """
+
+    def __init__(self, local_attention: Callable, mesh: Mesh,
+                 scatter_idx: int = 2, gather_idx: int = 0, axis: str = "sp"):
+        self.local_attn = local_attention
+        self.mesh = mesh
+        self.axis = axis
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        return ulysses_attention(query, key, value, self.mesh,
+                                 attn_fn=self.local_attn, axis=self.axis)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention
+# ---------------------------------------------------------------------------
+
+def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
+    """Blockwise attention partials for online-softmax accumulation.
+
+    q: [B, H, Sq, D]; k/v: [B, H, Sk, D].  Returns (m [B,H,Sq], l [B,H,Sq],
+    acc [B,H,Sq,D]) — fp32 running max / sum / weighted values.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows have m == NEG_INF and s - m == 0; zero them explicitly
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
+                   sm_scale: Optional[float] = None, axis: str = "sp"):
+    """Blockwise ring attention over the ``sp`` axis (ppermute KV rotation).
+
+    q/k/v: [B, H, S, D] globally; sharded on S internally.  Each ring step
+    attends the resident Q chunk to the visiting KV chunk and folds the
+    result into an online-softmax accumulator; KV then rotates to the next
+    neighbor.  O(S/P) memory per chip; comm is nearest-neighbor on the ICI
+    torus.
+    """
+    B, H, S, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    sp = axis_size(mesh, axis)
+    if sp == 1:
+        from deepspeed_tpu.ops.pallas import mha_reference
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    batch_ax = data_axes(mesh)
+    spec = P(batch_ax, "tp", axis, None)
+    chunk = S // sp
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _inner(ql, kl, vl):
+        my = jax.lax.axis_index(axis)
+        q_pos = my * chunk + jnp.arange(chunk)
+        m0 = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
+        l0 = jnp.zeros(ql.shape[:3], jnp.float32)
+        a0 = jnp.zeros(ql.shape, jnp.float32)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(carry, t):
+            kc, vc, m, l, acc = carry
+            # KV chunk visiting at step t started at rank (my - t) mod sp
+            src = jnp.mod(my - t, sp)
+            k_pos = src * chunk + jnp.arange(chunk)
+            bm, bl, bacc = _block_attend(ql, kc, vc, q_pos, k_pos, scale, causal)
+            mn = jnp.maximum(m, bm)
+            c_old = jnp.exp(m - mn)
+            c_new = jnp.exp(bm - mn)
+            l = l * c_old + bl * c_new
+            acc = acc * c_old[..., None] + bacc * c_new[..., None]
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return (kc, vc, mn, l, acc), None
+
+        (kc, vc, m, l, acc), _ = jax.lax.scan(
+            step, (kl, vl, m0, l0, a0), jnp.arange(sp))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(ql.dtype)
+
+    return _inner(q, k, v)

@@ -16,11 +16,9 @@ def test_topk_gating_properties(rng):
     C = compute_capacity(N, E, k, capacity_factor=1.25)
     combine, dispatch, aux = topk_gating(gates, k, C)
     assert combine.shape == (N, E, C)
-    # each expert receives at most C tokens
-    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
     assert (np.asarray(dispatch.sum(axis=2)) <= 1).all()  # one slot per (token, expert)
-    occupancy = np.asarray(dispatch).sum(axis=(0,)).max(axis=-1)
-    assert (np.asarray(dispatch.sum(axis=(0, 2))) <= C * np.ones(E)).all()
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert (per_expert <= C).all()  # capacity respected
     # kept tokens have combine weights normalized to ~1
     w = np.asarray(combine.sum(axis=(1, 2)))
     kept = np.asarray(dispatch.sum(axis=(1, 2))) == k  # tokens with all k slots kept

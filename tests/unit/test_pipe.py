@@ -1,0 +1,113 @@
+"""Pipeline-parallel tests (reference analog: tests/unit/runtime/pipe/,
+SURVEY.md §4): parity of the SPMD pipeline against sequential execution,
+and end-to-end training of the built-in model over a pp mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.runtime.pipe import (LayerSpec, PipelineModule, spmd_pipeline)
+
+
+class TanhLayer:
+    def __init__(self, dim):
+        self.dim = dim
+
+    def init(self, rng, x):
+        return {"w": jax.random.normal(rng, (self.dim, self.dim)) * 0.3}
+
+    def apply(self, params, x):
+        return jnp.tanh(x @ params["w"])
+
+
+def test_spmd_pipeline_matches_sequential(devices, rng):
+    mesh = build_mesh(fsdp=2, pp=4, devices=devices)
+    set_global_mesh(mesh)
+    L, D, B, M = 8, 16, 8, 4
+    w = jax.random.normal(rng, (L, D, D)) * 0.3
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+
+    def stage_fn(wl, xmb, _scan, *bcast):
+        def body(c, wi):
+            return jnp.tanh(c @ wi), None
+        y, _ = jax.lax.scan(body, xmb, wl)
+        return y, jnp.zeros((), jnp.float32)
+
+    def sequential(w, x):
+        for i in range(L):
+            x = jnp.tanh(x @ w[i])
+        return x
+
+    y, aux = jax.jit(lambda w, x: spmd_pipeline(stage_fn, w, x, mesh,
+                                                num_microbatches=M))(w, x)
+    ref = sequential(w, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    # gradients through the pipeline == sequential gradients
+    gp = jax.jit(jax.grad(lambda w: jnp.sum(
+        spmd_pipeline(stage_fn, w, x, mesh, num_microbatches=M)[0] ** 2)))(w)
+    gs = jax.jit(jax.grad(lambda w: jnp.sum(sequential(w, x) ** 2)))(w)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gs), rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_module_api(devices, rng):
+    mesh = build_mesh(fsdp=2, pp=4, devices=devices)
+    set_global_mesh(mesh)
+    D = 16
+    module = PipelineModule([LayerSpec(TanhLayer, D) for _ in range(8)], mesh=mesh)
+    x = jax.random.normal(rng, (8, D))
+    params = module.init(rng, x)
+    assert jax.tree.leaves(params)[0].shape[0] == 8  # stacked layer dim
+    y = jax.jit(module.apply)(params, x)
+    xs = x
+    for i in range(8):
+        xs = jnp.tanh(xs @ jax.tree.map(lambda a: a[i], params)["w"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(xs), rtol=1e-5, atol=1e-5)
+
+
+def test_model_trains_on_pp_mesh(devices, rng):
+    """Llama-family model end-to-end on pp=2 × fsdp=2 × tp=2."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    mesh = build_mesh(pp=2, fsdp=2, tp=2, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=4, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256)
+    ds_config = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+                 "zero_optimization": {"stage": 1},
+                 "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                 "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config, mesh=mesh)
+    toks = jax.random.randint(rng, (8, 64), 0, 256)
+    losses = []
+    for _ in range(4):
+        loss = engine.forward((toks, toks))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_pp_forward_matches_no_pp(devices, rng):
+    """Same params, same tokens: pipelined forward == unpipelined forward."""
+    from deepspeed_tpu.models import causal_lm
+
+    toks = jax.random.randint(rng, (4, 32), 0, 128)
+    kw = dict(num_layers=4, hidden_size=32, intermediate_size=64, num_heads=2,
+              num_kv_heads=2, vocab_size=128, remat=False)
+
+    mesh1 = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh1)
+    m1 = causal_lm("llama-tiny", mesh=mesh1, **kw)
+    params = m1.init(rng, toks)
+    ref = jax.jit(m1.apply)(params, toks)
+
+    mesh2 = build_mesh(pp=4, fsdp=2, devices=devices)
+    set_global_mesh(mesh2)
+    m2 = causal_lm("llama-tiny", mesh=mesh2, **kw)
+    out = jax.jit(m2.apply)(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)

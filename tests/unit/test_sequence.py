@@ -1,0 +1,98 @@
+"""Sequence-parallel tests: Ulysses + ring attention parity vs the dense
+reference (reference analog: unit tests for deepspeed/sequence, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.ops.pallas import mha_reference
+from deepspeed_tpu.sequence import (DistributedAttention, ring_attention,
+                                    ulysses_attention)
+
+
+@pytest.fixture()
+def qkv(rng):
+    B, H, S, D = 2, 4, 64, 16
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, H, S, D))
+    k = jax.random.normal(kk, (B, H, S, D))
+    v = jax.random.normal(kv, (B, H, S, D))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_parity(devices, qkv, causal):
+    mesh = build_mesh(dp=2, sp=4, devices=devices)
+    set_global_mesh(mesh)
+    q, k, v = qkv
+    ref = mha_reference(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_parity(devices, qkv, causal):
+    mesh = build_mesh(dp=2, sp=4, devices=devices)
+    set_global_mesh(mesh)
+    q, k, v = qkv
+    ref = mha_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_grad_parity(devices, qkv):
+    """The ring is a lax.scan — backward must match dense attention grads."""
+    mesh = build_mesh(sp=4, fsdp=2, devices=devices)
+    set_global_mesh(mesh)
+    q, k, v = qkv
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_distributed_attention_api(devices, qkv):
+    """Reference-parity class wrapper drives any local attention callable."""
+    mesh = build_mesh(dp=2, sp=4, devices=devices)
+    set_global_mesh(mesh)
+    q, k, v = qkv
+    import functools
+    dist_attn = DistributedAttention(
+        functools.partial(mha_reference, causal=True), mesh)
+    out = dist_attn(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("sp_mode", ["ulysses", "ring"])
+def test_model_trains_on_sp_mesh(devices, rng, sp_mode):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    mesh = build_mesh(fsdp=2, sp=4, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, sp_mode=sp_mode)
+    ds_config = {"train_batch_size": 4, "gradient_accumulation_steps": 1,
+                 "zero_optimization": {"stage": 2},
+                 "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                 "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config, mesh=mesh)
+    toks = jax.random.randint(rng, (4, 64), 0, 256)
+    losses = []
+    for _ in range(4):
+        loss = engine.forward((toks, toks))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
