@@ -1,0 +1,7 @@
+"""Universal checkpointing (reference: ``deepspeed/checkpoint/``)."""
+
+from deepspeed_tpu.checkpoint.universal import (DeepSpeedCheckpoint,
+                                                ds_to_universal,
+                                                load_universal_params)
+
+__all__ = ["DeepSpeedCheckpoint", "ds_to_universal", "load_universal_params"]

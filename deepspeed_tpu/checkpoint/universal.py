@@ -1,0 +1,122 @@
+"""Universal checkpoint: topology-independent per-parameter layout.
+
+Reference: ``deepspeed/checkpoint/`` — ``ds_to_universal.py`` converts a
+(DP/TP/PP)-sharded checkpoint into per-parameter canonical fragments that can
+be loaded at a different parallel topology (SURVEY.md §2.1, §5.4).
+
+The TPU-native checkpoint already stores logically-full arrays, so *any*
+checkpoint loads at any mesh (re-sharding is ``device_put`` with the new
+topology's shardings).  The universal format still earns its keep for:
+- per-parameter files → partial/streamed loading of huge models,
+- a stable, inspectable on-disk contract (name → .npy) for external tools,
+- stacked-layer splitting (the reference's per-layer files) so a checkpoint
+  from ``scan_layers`` models can initialize per-layer consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class DeepSpeedCheckpoint:
+    """Inspection API over a native checkpoint dir (reference class name).
+
+    The reference exposes tp/pp/dp degrees parsed from filename patterns; the
+    TPU format records them in ``client_state.json``.
+    """
+
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        self.dir = ckpt_dir
+        if tag is None:
+            with open(os.path.join(ckpt_dir, "latest")) as fh:
+                tag = fh.read().strip()
+        self.tag = str(tag)
+        self.path = os.path.join(ckpt_dir, self.tag)
+        meta_path = os.path.join(self.path, "client_state.json")
+        self.meta: Dict[str, Any] = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as fh:
+                self.meta = json.load(fh)
+
+    @property
+    def zero_stage(self) -> int:
+        return int(self.meta.get("zero_stage", 0))
+
+    @property
+    def world_size(self) -> int:
+        return int(self.meta.get("world_size", 1))
+
+    def load_params(self) -> Any:
+        from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+
+        return MsgpackCheckpointEngine().load(
+            os.path.join(self.path, "model_states.msgpack"))
+
+
+def ds_to_universal(input_dir: str, output_dir: str, tag: Optional[str] = None,
+                    split_layers: bool = False) -> str:
+    """Convert a native checkpoint to the universal per-parameter layout:
+
+    output_dir/
+      meta.json                     (source meta + param index)
+      params/<path with '/'→'.'>.npy
+    With ``split_layers=True``, stacked [L, ...] layer params are written as
+    one file per layer (<name>.layer<k>.npy), the reference's per-layer form.
+    """
+    from deepspeed_tpu.utils.tensor_fragment import _path_str
+
+    ckpt = DeepSpeedCheckpoint(input_dir, tag)
+    params = ckpt.load_params()
+    pdir = os.path.join(output_dir, "params")
+    os.makedirs(pdir, exist_ok=True)
+    index: Dict[str, Any] = {}
+    for pth, leaf in jax.tree_util.tree_leaves_with_path(params):
+        name = _path_str(pth)
+        fname = name.replace("/", ".")
+        arr = np.asarray(leaf)
+        if split_layers and name.startswith("layers/"):
+            for i in range(arr.shape[0]):
+                np.save(os.path.join(pdir, f"{fname}.layer{i}.npy"), arr[i])
+            index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                           "layers": int(arr.shape[0])}
+        else:
+            np.save(os.path.join(pdir, fname + ".npy"), arr)
+            index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+    with open(os.path.join(output_dir, "meta.json"), "w") as fh:
+        json.dump({"source": ckpt.meta, "tag": ckpt.tag, "format": "universal/1",
+                   "params": index}, fh, indent=1)
+    return output_dir
+
+
+def load_universal_params(universal_dir: str, target: Any) -> Any:
+    """Rebuild a param pytree (matching ``target``'s structure/shapes) from a
+    universal dir; loading at a different mesh/ZeRO stage is the caller's
+    ``device_put`` (reference: --universal-checkpoint load path)."""
+    from deepspeed_tpu.utils.tensor_fragment import _path_str
+
+    with open(os.path.join(universal_dir, "meta.json")) as fh:
+        meta = json.load(fh)
+    pdir = os.path.join(universal_dir, "params")
+
+    def load_leaf(pth, leaf):
+        name = _path_str(pth)
+        info = meta["params"].get(name)
+        if info is None:
+            raise KeyError(f"universal checkpoint missing param {name!r}")
+        if "layers" in info:
+            arr = np.stack([np.load(os.path.join(pdir, name.replace('/', '.') +
+                                                 f".layer{i}.npy"))
+                            for i in range(info["layers"])])
+        else:
+            arr = np.load(os.path.join(pdir, name.replace("/", ".") + ".npy"))
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(f"{name}: universal shape {arr.shape} != target "
+                             f"{tuple(leaf.shape)}")
+        return arr.astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(load_leaf, target)

@@ -1,0 +1,6 @@
+"""Inference engine (reference: ``deepspeed/inference/``)."""
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine"]

@@ -1,0 +1,48 @@
+"""Inference config (reference: ``deepspeed/inference/config.py``).
+
+Key schema parity (SURVEY.md §2.1 "Inference engine", §3.5):
+``dtype``, ``tensor_parallel.tp_size`` (also the legacy ``mp_size`` alias),
+``max_out_tokens``, ``replace_with_kernel_inject``, ``checkpoint``,
+``min_out_tokens``, ``max_tokens``.  On TPU the kernel-injection flag is
+honored trivially: the fused decode path (models/decoding.py) *is* the only
+path, so ``replace_with_kernel_inject`` is accepted and recorded but does not
+change behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class InferenceTPConfig(DeepSpeedConfigModel):
+    tp_size: int = 1
+    enabled: bool = True
+
+
+class InferenceCheckpointConfig(DeepSpeedConfigModel):
+    checkpoint_dir: Optional[str] = None
+    save_mp_checkpoint_path: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    dtype: str = "bfloat16"
+    tensor_parallel: Optional[InferenceTPConfig] = None
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_batch_size: int = 0              # 0 = derive from first call
+    replace_with_kernel_inject: bool = False
+    checkpoint: Optional[Any] = None
+    enable_cuda_graph: bool = False      # accepted for parity; XLA always "graphs"
+    seed: int = 0
+
+    def __init__(self, **kwargs):
+        # legacy alias: mp_size -> tensor_parallel.tp_size
+        mp = kwargs.pop("mp_size", None)
+        tp = kwargs.pop("tensor_parallel", None)
+        if isinstance(tp, dict):
+            tp = InferenceTPConfig(**tp)
+        if tp is None:
+            tp = InferenceTPConfig(tp_size=mp or 1)
+        super().__init__(tensor_parallel=tp, **kwargs)

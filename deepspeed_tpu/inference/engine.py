@@ -1,0 +1,173 @@
+"""Inference engine (reference: ``deepspeed/inference/engine.py``, SURVEY.md §3.5).
+
+``init_inference(model, config)`` → engine with ``generate``.  The reference's
+machinery maps onto TPU as:
+
+- kernel injection (``replace_with_kernel_inject``) → the fused decode path
+  is the only path (models/decoding.py); the flag is accepted for parity.
+- AutoTP sharding → the model's logical tp specs applied over a ``tp`` mesh
+  (the same column/row classification auto_tp.py derives by name analysis).
+- KV-cache workspace (``max_out_tokens``, inference_context.h arena) →
+  preallocated [L, B, Hkv, Smax, Dh] cache pytree, donated through the jitted
+  decode step so XLA updates it in place.
+- per-token fused decode loop → one compiled prefill program + one compiled
+  decode program reused for every token (static shapes, no retracing).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.comm.mesh import build_mesh, get_global_mesh, set_global_mesh
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
+                                           sample_token)
+from deepspeed_tpu.runtime.zero.partition import params_pspecs, shardings_from_pspecs
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class InferenceEngine:
+    def __init__(self, model, config: DeepSpeedInferenceConfig, params: Any = None,
+                 mesh=None):
+        self.module = model                      # reference attr name
+        self._config = config
+        tp = config.tensor_parallel.tp_size if config.tensor_parallel else 1
+        if mesh is None:
+            mesh = get_global_mesh(create_default=False)
+        if mesh is None or (tp > 1 and mesh.shape.get("tp", 1) != tp):
+            mesh = build_mesh(tp=tp)
+            set_global_mesh(mesh)
+        self.mesh = mesh
+        self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else (
+            jnp.float16 if config.dtype in ("float16", "fp16", "half") else jnp.float32)
+        self._params = None
+        self._cache = None
+        self._decode_fn = None
+        self._prefill_fns = {}
+        self._rng = jax.random.PRNGKey(config.seed)
+        self._forward_fn = None
+        if params is not None:
+            self.set_params(params)
+        elif getattr(config, "checkpoint", None):
+            self.load_checkpoint(config.checkpoint)
+
+    # ------------------------------------------------------------------
+    def set_params(self, params: Any) -> None:
+        """Shard params over the mesh per the model's logical tp specs
+        (AutoTP equivalent) and cast to the serving dtype."""
+        logical = (self.module.logical_pspecs()
+                   if hasattr(self.module, "logical_pspecs") else None)
+        specs = params_pspecs(params, self.mesh, shard=False, logical_specs=logical)
+        shardings = shardings_from_pspecs(specs, self.mesh)
+        cast = jax.tree.map(
+            lambda a: a.astype(self.dtype)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else jnp.asarray(a),
+            params)
+        self._params = jax.device_put(cast, shardings)
+        n = sum(x.size for x in jax.tree.leaves(self._params))
+        log_dist(f"inference engine ready: {n/1e6:.2f}M params, tp="
+                 f"{self.mesh.shape.get('tp', 1)}, dtype {self.dtype.__name__}", ranks=[0])
+
+    def load_checkpoint(self, path: str) -> None:
+        from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+        import os
+
+        engine = MsgpackCheckpointEngine()
+        f = path
+        if os.path.isdir(path):
+            latest = os.path.join(path, "latest")
+            if os.path.exists(latest):
+                with open(latest) as fh:
+                    f = os.path.join(path, fh.read().strip(), "model_states.msgpack")
+            else:
+                f = os.path.join(path, "model_states.msgpack")
+        self.set_params(engine.load(f))
+
+    # ------------------------------------------------------------------
+    def _ensure_compiled(self, batch: int, max_len: int):
+        cfg = self.module.config
+        if self._cache is None or self._cache["k"].shape[1] != batch or \
+                self._cache["k"].shape[3] < max_len:
+            self._cache = init_kv_cache(cfg, batch, max_len, dtype=self.dtype)
+        if self._decode_fn is None:
+            model = self.module
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def decode(params, cache, tokens, pos):
+                logits, cache = forward_with_cache(model, params, tokens, cache, pos)
+                return logits[:, -1], cache
+
+            self._decode_fn = decode
+
+    def _prefill(self, params, cache, tokens, pos):
+        # one compiled program per prompt length (left-padded buckets would
+        # collapse this further; lengths are usually few in serving)
+        s = tokens.shape[1]
+        if s not in self._prefill_fns:
+            model = self.module
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def prefill(params, cache, tokens, pos):
+                logits, cache = forward_with_cache(model, params, tokens, cache, pos)
+                return logits[:, -1], cache
+
+            self._prefill_fns[s] = prefill
+        return self._prefill_fns[s](params, cache, tokens, pos)
+
+    # ------------------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens: int = 128, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None, rng=None):
+        """Autoregressive generation; returns [B, S+max_new_tokens] ids
+        (right side may hold EOS padding once every row finished)."""
+        if self._params is None:
+            raise RuntimeError("no weights: pass params=, config.checkpoint, or set_params()")
+        tokens = jnp.asarray(input_ids)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        B, S = tokens.shape
+        max_len = min(self._config.max_out_tokens, S + max_new_tokens)
+        assert S < self._config.max_out_tokens, \
+            f"prompt {S} exceeds max_out_tokens {self._config.max_out_tokens}"
+        self._ensure_compiled(B, max_len)
+        cache = self._cache
+        self._cache = None  # donated below; invalidate the handle
+
+        logits, cache = self._prefill(self._params, cache, tokens, 0)
+        out = [tokens]
+        finished = jnp.zeros((B,), bool)
+        rng = rng if rng is not None else self._rng
+        pos = S
+        last = None
+        for _ in range(max_new_tokens):
+            rng, step_rng = jax.random.split(rng)
+            nxt = sample_token(logits, step_rng, temperature=temperature,
+                               top_k=top_k, top_p=top_p, do_sample=do_sample)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            out.append(nxt[:, None])
+            if pos >= max_len - 0 or (eos_token_id is not None and bool(finished.all())):
+                break
+            if pos >= cache["k"].shape[3]:
+                break
+            logits, cache = self._decode_fn(self._params, cache, nxt[:, None], pos)
+            pos += 1
+        self._rng = rng
+        self._cache = cache
+        return jnp.concatenate(out, axis=1)
+
+    def __call__(self, tokens):
+        """Plain forward (logits) — reference ``engine(inputs)`` parity."""
+        if self._forward_fn is None:
+            self._forward_fn = jax.jit(self.module.apply)
+        return self._forward_fn(self._params, jnp.asarray(tokens))
+
+    @property
+    def config(self):
+        return self._config

@@ -1,0 +1,139 @@
+"""KV-cache prefill/decode paths for the built-in models.
+
+TPU-native counterpart of the reference's inference kernel path
+(``csrc/transformer/inference/``: preallocated KV-cache workspace in
+``inference_context.h`` sized by ``max_out_tokens``, fused decode kernels;
+SURVEY.md §2.2, §3.5).  The cache is a functional pytree of static-shape
+[L, B, Hkv, Smax, Dh] buffers updated with ``dynamic_update_slice`` and
+donated across steps by the engine — the jax equivalent of the reference's
+global inference workspace arena.
+
+Decode attends the new queries against the full static cache under a position
+mask (data-dependent lengths would retrace; masking keeps one compiled step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.layers import activation_fn, constrain, norm, _repeat_kv
+from deepspeed_tpu.ops.pallas import apply_rotary_pos_emb, rope_angles
+
+NEG_INF = -1e30
+
+
+def init_kv_cache(cfg, batch: int, max_len: int, dtype=jnp.bfloat16) -> Dict[str, Any]:
+    L, Hkv, Dh = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    return {
+        "k": jnp.zeros((L, batch, Hkv, max_len, Dh), dtype),
+        "v": jnp.zeros((L, batch, Hkv, max_len, Dh), dtype),
+    }
+
+
+def _cached_attention(q, kcache, vcache, q_pos, scale):
+    """q: [B, H, s, Dh]; caches: [B, Hkv, Smax, Dh]; q_pos: [s] absolute
+    positions of the queries.  Masked attention over the whole static cache."""
+    B, H, s, Dh = q.shape
+    Hkv = kcache.shape[1]
+    k = _repeat_kv(kcache, H // Hkv)
+    v = _repeat_kv(vcache, H // Hkv)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    key_pos = jnp.arange(k.shape[-2])
+    mask = key_pos[None, :] <= q_pos[:, None]          # causal vs absolute pos
+    logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def forward_with_cache(model, params, tokens, cache, start_pos):
+    """Run the model over ``tokens`` [B, s] starting at absolute position
+    ``start_pos`` (scalar), reading/updating the KV cache.
+
+    Returns (logits [B, s, V], new_cache).  Used for both prefill (s = prompt
+    length, start_pos=0) and decode (s = 1).
+    """
+    cfg = model.config
+    mesh = model.mesh
+    batch_ax = ("dp", "fsdp", "ep")
+    B, s = tokens.shape
+    H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    x = jnp.take(params["embed"]["tok"], tokens, axis=0)
+    if cfg.position == "learned":
+        pos_idx = start_pos + jnp.arange(s)
+        x = x + jnp.take(params["embed"]["pos"], pos_idx, axis=0)[None]
+    x = x.astype(cache["k"].dtype)
+    x = constrain(x, mesh, batch_ax, None, None)
+    q_pos = start_pos + jnp.arange(s)
+
+    if cfg.position == "rope":
+        # angles for the whole cache window once; gather the query slice
+        cos_all, sin_all = rope_angles(jnp.arange(cache["k"].shape[-2]),
+                                       Dh, theta=cfg.rope_theta)
+        cos = jax.lax.dynamic_slice_in_dim(cos_all, start_pos, s).astype(x.dtype)
+        sin = jax.lax.dynamic_slice_in_dim(sin_all, start_pos, s).astype(x.dtype)
+    else:
+        cos = sin = jnp.zeros((), x.dtype)
+    scale = 1.0 / (Dh ** 0.5)
+
+    def layer_step(carry, xs):
+        h_in = carry
+        lp, kc, vc = xs
+        h = norm(h_in, lp["attn_norm"], cfg.norm, cfg.norm_eps)
+        q = (h @ lp["attn"]["wq"].astype(h.dtype)).reshape(B, s, H, Dh).transpose(0, 2, 1, 3)
+        k = (h @ lp["attn"]["wk"].astype(h.dtype)).reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
+        v = (h @ lp["attn"]["wv"].astype(h.dtype)).reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
+        if cfg.position == "rope":
+            q = apply_rotary_pos_emb(q, cos, sin)
+            k = apply_rotary_pos_emb(k, cos, sin)
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, start_pos, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, start_pos, 0))
+        o = _cached_attention(q, kc, vc, q_pos, scale)
+        o = o.transpose(0, 2, 1, 3).reshape(B, s, H * Dh)
+        h_in = h_in + (o @ lp["attn"]["wo"].astype(h.dtype))
+
+        h = norm(h_in, lp["mlp_norm"], cfg.norm, cfg.norm_eps)
+        if cfg.is_moe:
+            from deepspeed_tpu.moe.sharded_moe import moe_mlp
+            mlp_out, _ = moe_mlp(jax.tree.map(lambda a: a.astype(h.dtype), lp["mlp"]),
+                                 h, cfg, mesh)
+        else:
+            act = activation_fn(cfg.activation)
+            up = h @ lp["mlp"]["w_up"].astype(h.dtype)
+            gated = (act(h @ lp["mlp"]["w_gate"].astype(h.dtype)) * up
+                     if cfg.glu else act(up))
+            mlp_out = gated @ lp["mlp"]["w_down"].astype(h.dtype)
+        h_in = h_in + mlp_out
+        return h_in, (kc, vc)
+
+    x, (kc_new, vc_new) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    head = (params["embed"]["tok"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(x.dtype)
+    logits = (x @ head).astype(jnp.float32)
+    return logits, {"k": kc_new, "v": vc_new}
+
+
+def sample_token(logits, rng, temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, do_sample: bool = True):
+    """logits: [B, V] -> token ids [B] (greedy when do_sample=False)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)            # first idx past mass
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1)

@@ -43,16 +43,24 @@ def constrain(x, mesh: Optional[Mesh], *spec):
         return x
     names = set(mesh.axis_names)
 
-    def keep(entry):
+    def keep(entry, dim_size):
         if entry is None:
             return None
         if isinstance(entry, (tuple, list)):
             kept = tuple(a for a in entry if a in names)
-            return kept if kept else None
-        return entry if entry in names else None
+        else:
+            kept = (entry,) if entry in names else ()
+        # drop the whole entry if the dim doesn't divide across it (e.g.
+        # batch-1 serving on a multi-chip data mesh)
+        total = 1
+        for a in kept:
+            total *= axis_size(mesh, a)
+        if not kept or dim_size % total != 0:
+            return None
+        return kept if len(kept) > 1 else kept[0]
 
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(*(keep(e) for e in spec))))
+    entries = tuple(keep(e, d) for e, d in zip(spec, x.shape))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*entries)))
 
 
 def norm(x, params, kind: str, eps: float):

@@ -1,0 +1,47 @@
+"""Module injection / AutoTP (reference: ``deepspeed/module_inject/``).
+
+The reference walks a torch module tree, classifies Linears as column- or
+row-parallel by name analysis (``auto_tp.py``), and swaps fused kernels in
+(``replace_module.py``; SURVEY.md §2.1, §3.5).  In the TPU framework that
+classification is the model's ``logical_pspecs()`` (Megatron column/row specs
+over the ``tp`` mesh axis) and "kernel injection" is the default compiled
+path — so these entry points shard params instead of rewriting modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from deepspeed_tpu.comm.mesh import build_mesh, get_global_mesh, set_global_mesh
+from deepspeed_tpu.runtime.zero.partition import params_pspecs, shardings_from_pspecs
+
+
+def tp_model_init(model, tp_size: int = 1, dtype=None, params: Any = None, mesh=None):
+    """Training-time tensor parallelism (reference ``tp_model_init``,
+    used by HF for ``tensor_parallel.autotp_size``): returns (model, sharded
+    params) with the model's logical tp layout applied over a tp mesh."""
+    if mesh is None:
+        mesh = get_global_mesh(create_default=False)
+        if mesh is None or mesh.shape.get("tp", 1) != tp_size:
+            mesh = build_mesh(tp=tp_size)
+            set_global_mesh(mesh)
+    if params is None:
+        return model, None
+    logical = model.logical_pspecs() if hasattr(model, "logical_pspecs") else None
+    specs = params_pspecs(params, mesh, shard=False, logical_specs=logical)
+    sharded = jax.device_put(params, shardings_from_pspecs(specs, mesh))
+    if dtype is not None:
+        import jax.numpy as jnp
+
+        sharded = jax.tree.map(
+            lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            sharded)
+    return model, sharded
+
+
+def replace_module(model=None, **kwargs):
+    """Reference parity shim: kernel swapping is the compiled default on TPU;
+    returns the model unchanged."""
+    return model

@@ -1,0 +1,122 @@
+"""DeepSpeedCPUAdam: host optimizer step over offloaded fp32 states.
+
+Reference parity: ``deepspeed/ops/adam/cpu_adam.py`` (``DeepSpeedCPUAdam``
+with ``adamw_mode``; SURVEY.md §2.1) — the optimizer the engine swaps in when
+``zero_optimization.offload_optimizer.device == "cpu"``.  States live in host
+numpy; the C++ kernel (csrc/cpu_adam) does the math, sharded across a thread
+pool (the reference's OpenMP parallel-for).  Falls back to a pure-numpy step
+if the native build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_MIN_CHUNK = 1 << 16
+
+
+def _lib():
+    from deepspeed_tpu.ops.op_builder.native import CPUAdamBuilder
+
+    return CPUAdamBuilder().load()
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW over a list of host fp32 arrays (one 'param group')."""
+
+    def __init__(self, params: Optional[List[np.ndarray]] = None, lr: float = 1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 0.0,
+                 amsgrad: bool = False, adamw_mode: bool = True,
+                 fp32_optimizer_states: bool = True, num_threads: int = 0):
+        if amsgrad:
+            raise NotImplementedError("amsgrad not supported (reference parity)")
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self.state: Dict[int, Dict[str, np.ndarray]] = {}
+        self.params = [np.ascontiguousarray(p, dtype=np.float32) for p in (params or [])]
+        try:
+            self._native = _lib()
+        except Exception as e:  # pragma: no cover
+            logger.warning("cpu_adam native lib unavailable (%s); numpy fallback", e)
+            self._native = None
+        import os
+
+        self._pool = ThreadPoolExecutor(max_workers=num_threads or min(16, os.cpu_count() or 1))
+
+    def _ensure_state(self, i: int, p: np.ndarray):
+        if i not in self.state:
+            self.state[i] = {"exp_avg": np.zeros_like(p),
+                             "exp_avg_sq": np.zeros_like(p)}
+
+    def _native_step(self, p, g, m, v, step):
+        n = p.size
+        b1, b2 = self.betas
+        lib = self._native
+
+        def run(lo, hi):
+            lib.ds_adam_step(
+                ctypes.c_int64(hi - lo),
+                ctypes.c_void_p(p.ctypes.data + 4 * lo),
+                ctypes.c_void_p(g.ctypes.data + 4 * lo),
+                ctypes.c_void_p(m.ctypes.data + 4 * lo),
+                ctypes.c_void_p(v.ctypes.data + 4 * lo),
+                ctypes.c_int64(step), ctypes.c_float(self.lr), ctypes.c_float(b1),
+                ctypes.c_float(b2), ctypes.c_float(self.eps),
+                ctypes.c_float(self.weight_decay), ctypes.c_int(int(self.adamw_mode)))
+
+        workers = self._pool._max_workers
+        if n <= _MIN_CHUNK or workers == 1:
+            run(0, n)
+            return
+        chunk = (n + workers - 1) // workers
+        futs = [self._pool.submit(run, lo, min(lo + chunk, n))
+                for lo in range(0, n, chunk)]
+        for f in futs:
+            f.result()
+
+    def _numpy_step(self, p, g, m, v, step):
+        b1, b2 = self.betas
+        if self.adamw_mode:
+            p *= 1.0 - self.lr * self.weight_decay
+        elif self.weight_decay:
+            g = g + self.weight_decay * p
+        m *= b1
+        m += (1 - b1) * g
+        v *= b2
+        v += (1 - b2) * np.square(g)
+        bc1 = 1 - b1 ** step
+        bc2 = 1 - b2 ** step
+        p -= (self.lr / bc1) * m / (np.sqrt(v) / np.sqrt(bc2) + self.eps)
+
+    def step(self, grads: Optional[List[np.ndarray]] = None, lr: Optional[float] = None):
+        """In-place update of self.params given matching grads."""
+        if lr is not None:
+            self.lr = lr
+        if grads is None:
+            raise ValueError("pass grads=[...] matching params")
+        self.step_count += 1
+        for i, (p, g) in enumerate(zip(self.params, grads)):
+            self._ensure_state(i, p)
+            g = np.ascontiguousarray(g, dtype=np.float32).reshape(-1)
+            pf = p.reshape(-1)
+            st = self.state[i]
+            m, v = st["exp_avg"].reshape(-1), st["exp_avg_sq"].reshape(-1)
+            if self._native is not None:
+                self._native_step(pf, g, m, v, self.step_count)
+            else:
+                self._numpy_step(pf, g, m, v, self.step_count)
+        return self.params

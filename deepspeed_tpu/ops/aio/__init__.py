@@ -1,0 +1,63 @@
+"""Async file I/O (reference: ``deepspeed/ops/aio`` over ``csrc/aio/``).
+
+``AsyncIOBuilder().load()`` compiles/loads the C++ library (csrc/aio); the
+``aio_handle`` class mirrors the reference handle API: ``async_pread`` /
+``async_pwrite`` submit, ``wait()`` drains (returns error count, 0 = ok).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder.native import AsyncIOBuilder
+
+
+class aio_handle:
+    """Handle over the native thread-pool async IO engine."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 num_threads: int = 4, use_direct: bool = False):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.ds_aio_handle_new(
+            block_size, queue_depth, int(single_submit), int(overlap_events),
+            num_threads, int(use_direct))
+        if not self._h:
+            raise RuntimeError("failed to create aio handle")
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> None:
+        buffer = np.ascontiguousarray(buffer)
+        self._lib.ds_aio_pwrite_async(self._h, path.encode(),
+                                      buffer.ctypes.data_as(ctypes.c_void_p),
+                                      buffer.nbytes, offset)
+
+    def async_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> None:
+        assert buffer.flags["C_CONTIGUOUS"], "read target must be contiguous"
+        self._lib.ds_aio_pread_async(self._h, path.encode(),
+                                     buffer.ctypes.data_as(ctypes.c_void_p),
+                                     buffer.nbytes, offset)
+
+    def wait(self) -> int:
+        return int(self._lib.ds_aio_wait(self._h))
+
+    def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pwrite(buffer, path, offset)
+        return self.wait()
+
+    def sync_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pread(buffer, path, offset)
+        return self.wait()
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ds_aio_handle_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+__all__ = ["aio_handle", "AsyncIOBuilder"]

@@ -1,0 +1,116 @@
+"""Native (C++) op building: g++ → shared object → ctypes.
+
+TPU-native analog of the reference's JIT path in ``op_builder/builder.py``
+(SURVEY.md §2.1): where the reference shells out to nvcc via torch
+cpp_extension, we compile host-side C++ (csrc/) with g++ once per source
+change and bind via ctypes (no pybind11 in this image).  ``DS_BUILD_*``-style
+forcing is honored through ``DS_TPU_REBUILD_OPS=1``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+_BUILD_DIR = os.environ.get(
+    "DS_TPU_BUILD_DIR", os.path.join(_REPO_ROOT, "build", "ops"))
+_LOCK = threading.Lock()
+
+
+class NativeOpBuilder:
+    NAME: str = ""
+    SOURCES: List[str] = []          # relative to repo root
+    CXX_FLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-march=native",
+                 "-funroll-loops"]
+    LDFLAGS = ["-lpthread"]
+
+    _cache: dict = {}
+
+    def lib_path(self) -> str:
+        return os.path.join(_BUILD_DIR, f"lib_ds_{self.NAME}.so")
+
+    def _needs_build(self) -> bool:
+        out = self.lib_path()
+        if os.environ.get("DS_TPU_REBUILD_OPS"):
+            return True
+        if not os.path.exists(out):
+            return True
+        out_m = os.path.getmtime(out)
+        return any(os.path.getmtime(os.path.join(_REPO_ROOT, s)) > out_m
+                   for s in self.SOURCES)
+
+    def build(self) -> str:
+        with _LOCK:
+            if not self._needs_build():
+                return self.lib_path()
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            srcs = [os.path.join(_REPO_ROOT, s) for s in self.SOURCES]
+            out = self.lib_path()
+            cmd = ["g++", *self.CXX_FLAGS, *srcs, "-o", out + ".tmp", *self.LDFLAGS]
+            logger.info("building native op %s: %s", self.NAME, " ".join(cmd))
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError as e:  # pragma: no cover
+                raise RuntimeError(
+                    f"native build of {self.NAME} failed:\n{e.stderr}") from e
+            os.replace(out + ".tmp", out)
+            return out
+
+    def is_compatible(self) -> bool:
+        try:
+            self.load()
+            return True
+        except Exception as e:
+            logger.warning("native op %s unavailable: %s", self.NAME, e)
+            return False
+
+    def load(self) -> ctypes.CDLL:
+        key = self.NAME
+        if key not in NativeOpBuilder._cache:
+            NativeOpBuilder._cache[key] = ctypes.CDLL(self.build())
+        return NativeOpBuilder._cache[key]
+
+
+class CPUAdamBuilder(NativeOpBuilder):
+    NAME = "cpu_adam"
+    SOURCES = ["csrc/cpu_adam/cpu_adam.cpp"]
+
+    def load(self) -> ctypes.CDLL:
+        lib = super().load()
+        i64, f, i, p = ctypes.c_int64, ctypes.c_float, ctypes.c_int, ctypes.c_void_p
+        lib.ds_adam_step.argtypes = [i64, p, p, p, p, i64, f, f, f, f, f, i]
+        lib.ds_adam_step.restype = None
+        lib.ds_adam_step_bf16g.argtypes = [i64, p, p, p, p, p, i64, f, f, f, f, f, i]
+        lib.ds_adam_step_bf16g.restype = None
+        lib.ds_adagrad_step.argtypes = [i64, p, p, p, f, f, f]
+        lib.ds_adagrad_step.restype = None
+        lib.ds_lion_step.argtypes = [i64, p, p, p, f, f, f, f]
+        lib.ds_lion_step.restype = None
+        return lib
+
+
+class AsyncIOBuilder(NativeOpBuilder):
+    NAME = "aio"
+    SOURCES = ["csrc/aio/ds_aio.cpp"]
+
+    def load(self) -> ctypes.CDLL:
+        lib = super().load()
+        i64, i, p, cp = ctypes.c_int64, ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p
+        lib.ds_aio_handle_new.argtypes = [i, i, i, i, i, i]
+        lib.ds_aio_handle_new.restype = p
+        lib.ds_aio_handle_free.argtypes = [p]
+        lib.ds_aio_pread_async.argtypes = [p, cp, p, i64, i64]
+        lib.ds_aio_pwrite_async.argtypes = [p, cp, p, i64, i64]
+        lib.ds_aio_wait.argtypes = [p]
+        lib.ds_aio_wait.restype = i64
+        lib.ds_aio_read.argtypes = [p, cp, p, i64, i64]
+        lib.ds_aio_read.restype = i64
+        lib.ds_aio_write.argtypes = [p, cp, p, i64, i64]
+        lib.ds_aio_write.restype = i64
+        return lib

@@ -1,4 +1,13 @@
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.tensor_fragment import (list_param_paths,
+                                                 safe_get_full_fp32_param,
+                                                 safe_get_full_grad,
+                                                 safe_get_full_optimizer_state,
+                                                 safe_set_full_fp32_param,
+                                                 safe_set_full_optimizer_state)
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
-__all__ = ["log_dist", "logger", "SynchronizedWallClockTimer", "ThroughputTimer"]
+__all__ = ["log_dist", "logger", "SynchronizedWallClockTimer", "ThroughputTimer",
+           "safe_get_full_fp32_param", "safe_set_full_fp32_param",
+           "safe_get_full_optimizer_state", "safe_set_full_optimizer_state",
+           "safe_get_full_grad", "list_param_paths"]

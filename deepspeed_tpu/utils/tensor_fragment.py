@@ -1,0 +1,117 @@
+"""Cross-stage access to full params / optimizer states / gradients.
+
+Reference: ``deepspeed/utils/tensor_fragment.py`` (SURVEY.md §2.1) — the
+``safe_get_full_*`` / ``safe_set_full_*`` API that reads and writes logically
+full tensors regardless of how ZeRO partitioned them.  In the TPU framework
+"partitioned" means "sharded jax array", so *gather* is ``jax.device_get``
+(XLA assembles the shards) and *set* is ``jax.device_put`` back to the leaf's
+existing sharding — no fragment-offset bookkeeping exists to reproduce.
+
+Params are addressed by pytree path strings like ``"layers/attn/wq"``
+(the reference addresses torch parameter objects; a functional pytree has no
+stable object identity, so paths are the handle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def list_param_paths(tree: Any) -> List[str]:
+    return [_path_str(p) for p, _ in jax.tree_util.tree_leaves_with_path(tree)]
+
+
+def _find(tree: Any, name: str):
+    matches = [(pth, leaf) for pth, leaf in jax.tree_util.tree_leaves_with_path(tree)
+               if _path_str(pth) == name or _path_str(pth).endswith("/" + name)]
+    if not matches:
+        raise KeyError(f"no leaf matching {name!r}; known: {list_param_paths(tree)[:10]}...")
+    if len(matches) > 1:
+        raise KeyError(f"ambiguous name {name!r}: {[_path_str(p) for p, _ in matches]}")
+    return matches[0]
+
+
+def _replace_leaf(tree: Any, name: str, value) -> Any:
+    def swap(pth, leaf):
+        if _path_str(pth) == name or _path_str(pth).endswith("/" + name):
+            v = jnp.asarray(value, dtype=leaf.dtype)
+            if v.shape != leaf.shape:
+                raise ValueError(f"shape mismatch for {name}: {v.shape} vs {leaf.shape}")
+            if hasattr(leaf, "sharding"):
+                return jax.device_put(v, leaf.sharding)
+            return v
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(swap, tree)
+
+
+# -- params ----------------------------------------------------------------
+
+def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
+    """Gather the full fp32 master value of a (possibly sharded) param."""
+    _, leaf = _find(engine.state.params, name)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    engine.state = engine.state._replace(
+        params=_replace_leaf(engine.state.params, name, value))
+
+
+# -- optimizer state -------------------------------------------------------
+
+def safe_get_full_optimizer_state(engine, name: str, state_key: str) -> np.ndarray:
+    """state_key ∈ {"exp_avg", "exp_avg_sq"} (reference naming) or any optax
+    field name ("mu", "nu")."""
+    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+    field = alias.get(state_key, state_key)
+    for st in jax.tree_util.tree_leaves(
+            engine.state.opt_state, is_leaf=lambda x: hasattr(x, "_fields")):
+        if hasattr(st, "_fields") and field in st._fields:
+            sub = getattr(st, field)
+            _, leaf = _find(sub, name)
+            return np.asarray(jax.device_get(leaf), dtype=np.float32)
+    raise KeyError(f"optimizer state has no field {state_key!r}")
+
+
+def safe_set_full_optimizer_state(engine, name: str, state_key: str, value) -> None:
+    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+    field = alias.get(state_key, state_key)
+
+    def swap_state(st):
+        if hasattr(st, "_fields") and field in st._fields:
+            return st._replace(**{field: _replace_leaf(getattr(st, field), name, value)})
+        return st
+
+    new_opt = jax.tree_util.tree_map(
+        swap_state, engine.state.opt_state,
+        is_leaf=lambda x: hasattr(x, "_fields") and field in getattr(x, "_fields", ()))
+    engine.state = engine.state._replace(opt_state=new_opt)
+
+
+# -- gradients -------------------------------------------------------------
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """The accumulated gradient for a param (None before any forward)."""
+    if engine.state is None:
+        return None
+    _, leaf = _find(engine.state.grad_acc, name)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)

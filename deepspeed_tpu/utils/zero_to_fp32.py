@@ -1,0 +1,73 @@
+"""Consolidate a checkpoint into a single fp32 state dict.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` (SURVEY.md §2.1, §5.4) — the
+offline script shipped into checkpoint dirs that merges ``zero_pp_rank_*``
+optimizer-state shards into one fp32 ``state_dict``.  The TPU checkpoint
+layout stores logically-full arrays (sharding is a runtime placement, not a
+file layout), so consolidation = load + cast + flatten; the entry points and
+CLI semantics match the reference so downstream tooling ports unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _load_checkpoint_params(checkpoint_dir: str, tag: Optional[str] = None) -> Any:
+    from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as fh:
+                tag = fh.read().strip()
+        else:
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag=")
+    path = os.path.join(checkpoint_dir, str(tag), "model_states.msgpack")
+    return MsgpackCheckpointEngine().load(path)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Flat {"layers/attn/wq": fp32 ndarray, ...} state dict (reference
+    function name; the reference returns torch tensors keyed by module path)."""
+    from deepspeed_tpu.utils.tensor_fragment import _path_str
+
+    params = _load_checkpoint_params(checkpoint_dir, tag)
+    flat = {}
+    for pth, leaf in jax.tree_util.tree_leaves_with_path(params):
+        arr = np.asarray(leaf)
+        flat[_path_str(pth)] = arr.astype(np.float32) if np.issubdtype(
+            arr.dtype, np.floating) else arr
+    return flat
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str,
+                                               tag: Optional[str] = None) -> str:
+    """Write the consolidated fp32 state dict as an .npz (reference writes a
+    torch .bin; npz is the dependency-free equivalent here)."""
+    flat = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file if output_file.endswith(".npz") else output_file + ".npz",
+             **flat)
+    out = output_file if output_file.endswith(".npz") else output_file + ".npz"
+    print(f"saved consolidated fp32 state dict ({len(flat)} tensors) to {out}")
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("-t", "--tag", default=None)
+    args = p.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file,
+                                               args.tag)
+
+
+if __name__ == "__main__":
+    main()
