@@ -77,7 +77,15 @@ struct Handle {
                 ::close(fd);
             }
             if (failed) errors.fetch_add(1);
-            if (inflight.fetch_sub(1) == 1) done_cv.notify_all();
+            {
+                // The lock orders this decrement with ds_aio_wait's
+                // inflight==0 predicate check: without it the waiter can see
+                // inflight!=0, the worker then decrements to 0 and notifies
+                // before the waiter blocks, and the waiter sleeps forever
+                // (lost wakeup).
+                std::lock_guard<std::mutex> lk(mu);
+                if (inflight.fetch_sub(1) == 1) done_cv.notify_all();
+            }
         }
     }
 };

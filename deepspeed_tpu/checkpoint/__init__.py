@@ -2,6 +2,8 @@
 
 from deepspeed_tpu.checkpoint.universal import (DeepSpeedCheckpoint,
                                                 ds_to_universal,
+                                                load_universal_optim,
                                                 load_universal_params)
 
-__all__ = ["DeepSpeedCheckpoint", "ds_to_universal", "load_universal_params"]
+__all__ = ["DeepSpeedCheckpoint", "ds_to_universal", "load_universal_params",
+           "load_universal_optim"]

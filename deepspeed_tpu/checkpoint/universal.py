@@ -57,14 +57,29 @@ class DeepSpeedCheckpoint:
         return MsgpackCheckpointEngine().load(
             os.path.join(self.path, "model_states.msgpack"))
 
+    def load_optim(self) -> Optional[Any]:
+        """Optimizer-state dict (``opt_state`` + step bookkeeping) or None for
+        a params-only checkpoint."""
+        from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+
+        path = os.path.join(self.path, "optim_states.msgpack")
+        if not os.path.exists(path):
+            return None
+        return MsgpackCheckpointEngine().load(path)
+
 
 def ds_to_universal(input_dir: str, output_dir: str, tag: Optional[str] = None,
                     split_layers: bool = False) -> str:
     """Convert a native checkpoint to the universal per-parameter layout:
 
     output_dir/
-      meta.json                     (source meta + param index)
+      meta.json                     (source meta + param/optim index)
       params/<path with '/'→'.'>.npy
+      optim/<path with '/'→'.'>.npy  (exp_avg/exp_avg_sq/... leaves, so a
+                                      universal checkpoint can resume training
+                                      at a different topology, matching the
+                                      reference's fp32 master + optimizer
+                                      fragment export)
     With ``split_layers=True``, stacked [L, ...] layer params are written as
     one file per layer (<name>.layer<k>.npy), the reference's per-layer form.
     """
@@ -72,24 +87,31 @@ def ds_to_universal(input_dir: str, output_dir: str, tag: Optional[str] = None,
 
     ckpt = DeepSpeedCheckpoint(input_dir, tag)
     params = ckpt.load_params()
-    pdir = os.path.join(output_dir, "params")
-    os.makedirs(pdir, exist_ok=True)
-    index: Dict[str, Any] = {}
-    for pth, leaf in jax.tree_util.tree_leaves_with_path(params):
-        name = _path_str(pth)
-        fname = name.replace("/", ".")
-        arr = np.asarray(leaf)
-        if split_layers and name.startswith("layers/"):
-            for i in range(arr.shape[0]):
-                np.save(os.path.join(pdir, f"{fname}.layer{i}.npy"), arr[i])
-            index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
-                           "layers": int(arr.shape[0])}
-        else:
-            np.save(os.path.join(pdir, fname + ".npy"), arr)
-            index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+
+    def export_tree(tree, subdir: str) -> Dict[str, Any]:
+        out = os.path.join(output_dir, subdir)
+        os.makedirs(out, exist_ok=True)
+        index: Dict[str, Any] = {}
+        for pth, leaf in jax.tree_util.tree_leaves_with_path(tree):
+            name = _path_str(pth)
+            fname = name.replace("/", ".")
+            arr = np.asarray(leaf)
+            if split_layers and name.startswith("layers/") and arr.ndim > 0:
+                for i in range(arr.shape[0]):
+                    np.save(os.path.join(out, f"{fname}.layer{i}.npy"), arr[i])
+                index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                               "layers": int(arr.shape[0])}
+            else:
+                np.save(os.path.join(out, fname + ".npy"), arr)
+                index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+        return index
+
+    index = export_tree(params, "params")
+    optim = ckpt.load_optim()
+    optim_index = export_tree(optim, "optim") if optim is not None else None
     with open(os.path.join(output_dir, "meta.json"), "w") as fh:
         json.dump({"source": ckpt.meta, "tag": ckpt.tag, "format": "universal/1",
-                   "params": index}, fh, indent=1)
+                   "params": index, "optim": optim_index}, fh, indent=1)
     return output_dir
 
 
@@ -97,17 +119,29 @@ def load_universal_params(universal_dir: str, target: Any) -> Any:
     """Rebuild a param pytree (matching ``target``'s structure/shapes) from a
     universal dir; loading at a different mesh/ZeRO stage is the caller's
     ``device_put`` (reference: --universal-checkpoint load path)."""
+    return _load_universal_tree(universal_dir, target, "params")
+
+
+def load_universal_optim(universal_dir: str, target: Any) -> Any:
+    """Rebuild the optimizer-state tree exported by :func:`ds_to_universal`
+    (raises KeyError if the universal dir is params-only)."""
+    return _load_universal_tree(universal_dir, target, "optim")
+
+
+def _load_universal_tree(universal_dir: str, target: Any, section: str) -> Any:
     from deepspeed_tpu.utils.tensor_fragment import _path_str
 
     with open(os.path.join(universal_dir, "meta.json")) as fh:
         meta = json.load(fh)
-    pdir = os.path.join(universal_dir, "params")
+    if meta.get(section) is None:
+        raise KeyError(f"universal checkpoint has no {section!r} section")
+    pdir = os.path.join(universal_dir, section)
 
     def load_leaf(pth, leaf):
         name = _path_str(pth)
-        info = meta["params"].get(name)
+        info = meta[section].get(name)
         if info is None:
-            raise KeyError(f"universal checkpoint missing param {name!r}")
+            raise KeyError(f"universal checkpoint {section} section missing {name!r}")
         if "layers" in info:
             arr = np.stack([np.load(os.path.join(pdir, name.replace('/', '.') +
                                                  f".layer{i}.npy"))

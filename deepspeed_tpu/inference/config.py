@@ -30,8 +30,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     dtype: str = "bfloat16"
     tensor_parallel: Optional[InferenceTPConfig] = None
     max_out_tokens: int = 1024
-    min_out_tokens: int = 1
-    max_batch_size: int = 0              # 0 = derive from first call
+    min_out_tokens: int = 1              # enforced: generate() raises if the
+                                         # cache budget cannot cover it
+    max_batch_size: int = 0              # 0 = unlimited; else generate() raises
     replace_with_kernel_inject: bool = False
     checkpoint: Optional[Any] = None
     enable_cuda_graph: bool = False      # accepted for parity; XLA always "graphs"

@@ -132,8 +132,14 @@ class InferenceEngine:
             tokens = tokens[None]
         B, S = tokens.shape
         max_len = min(self._config.max_out_tokens, S + max_new_tokens)
-        assert S < self._config.max_out_tokens, \
-            f"prompt {S} exceeds max_out_tokens {self._config.max_out_tokens}"
+        if self._config.max_batch_size and B > self._config.max_batch_size:
+            raise ValueError(
+                f"batch {B} exceeds max_batch_size {self._config.max_batch_size}")
+        if S + max(1, self._config.min_out_tokens) > self._config.max_out_tokens:
+            raise ValueError(
+                f"cache budget max_out_tokens={self._config.max_out_tokens} cannot "
+                f"cover min_out_tokens={self._config.min_out_tokens} after a "
+                f"{S}-token prompt")
         self._ensure_compiled(B, max_len)
         cache = self._cache
         self._cache = None  # donated below; invalidate the handle

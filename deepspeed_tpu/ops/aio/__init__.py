@@ -27,21 +27,29 @@ class aio_handle:
             num_threads, int(use_direct))
         if not self._h:
             raise RuntimeError("failed to create aio handle")
+        # Buffers whose raw pointers are enqueued to worker threads; kept
+        # alive here until wait() so an ascontiguousarray temporary (or a
+        # caller buffer the caller drops) is not freed mid-I/O.
+        self._pending: list[np.ndarray] = []
 
     def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> None:
         buffer = np.ascontiguousarray(buffer)
+        self._pending.append(buffer)
         self._lib.ds_aio_pwrite_async(self._h, path.encode(),
                                       buffer.ctypes.data_as(ctypes.c_void_p),
                                       buffer.nbytes, offset)
 
     def async_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> None:
         assert buffer.flags["C_CONTIGUOUS"], "read target must be contiguous"
+        self._pending.append(buffer)
         self._lib.ds_aio_pread_async(self._h, path.encode(),
                                      buffer.ctypes.data_as(ctypes.c_void_p),
                                      buffer.nbytes, offset)
 
     def wait(self) -> int:
-        return int(self._lib.ds_aio_wait(self._h))
+        rc = int(self._lib.ds_aio_wait(self._h))
+        self._pending.clear()
+        return rc
 
     def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
         self.async_pwrite(buffer, path, offset)

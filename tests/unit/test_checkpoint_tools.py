@@ -82,6 +82,15 @@ def test_universal_checkpoint_roundtrip(devices, rng, tmp_path):
     for a, b in zip(jax.tree.leaves(rebuilt), jax.tree.leaves(target)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
+    # Optimizer states (exp_avg/exp_avg_sq/step) roundtrip too, so a universal
+    # checkpoint is a training-resume checkpoint, not params-only.
+    from deepspeed_tpu.checkpoint import load_universal_optim
+
+    opt_target = jax.device_get({"opt_state": engine.state.opt_state})
+    rebuilt_opt = load_universal_optim(udir, opt_target)
+    for a, b in zip(jax.tree.leaves(rebuilt_opt), jax.tree.leaves(opt_target)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
 
 def test_tensor_fragment_api(devices, rng):
     engine, _ = _make_engine(devices, rng, stage=3)

@@ -97,9 +97,6 @@ def test_tp_inference(devices, rng):
                       vocab_size=256, remat=False)
     toks = jax.random.randint(rng, (2, 8), 0, 256)
     params = model.init(rng, toks)
-    engine = deepspeed_tpu.init_inference(
-        model, config={"dtype": "float32", "tensor_parallel": {"tp_size": 2},
-                       "max_out_tokens": 32}, mesh=mesh) if False else None
     # init_inference signature parity: config kwargs path
     engine = deepspeed_tpu.init_inference(
         model, dtype="float32", tensor_parallel={"tp_size": 2}, max_out_tokens=32)
