@@ -41,8 +41,10 @@ def fused_adam(learning_rate: Union[float, Callable] = 1e-3, b1: float = 0.9,
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_adam requires params")
+        # Schedules are evaluated at the 0-based pre-increment count, matching
+        # optax.scale_by_schedule, so "torch_adam": true stays a drop-in swap.
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
         count = state.count + 1
-        lr = learning_rate(count) if callable(learning_rate) else learning_rate
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)

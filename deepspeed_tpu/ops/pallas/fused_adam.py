@@ -26,8 +26,8 @@ _LANE = 128
 _BLOCK = 64 * 1024  # elements per grid step
 
 
-def _adam_kernel(c1_ref, c2_ref, p_ref, g_ref, m_ref, v_ref,
-                 p_out, m_out, v_out, *, lr, beta1, beta2, eps, weight_decay,
+def _adam_kernel(c1_ref, c2_ref, lr_ref, p_ref, g_ref, m_ref, v_ref,
+                 p_out, m_out, v_out, *, beta1, beta2, eps, weight_decay,
                  adam_w_mode):
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
@@ -35,6 +35,7 @@ def _adam_kernel(c1_ref, c2_ref, p_ref, g_ref, m_ref, v_ref,
     v = v_ref[:]
     c1 = c1_ref[0]  # 1/(1-beta1^t)
     c2 = c2_ref[0]  # 1/(1-beta2^t)
+    lr = lr_ref[0]  # scalar-prefetch: may be schedule-driven (a traced value)
     if not adam_w_mode and weight_decay != 0.0:
         g = g + weight_decay * p  # L2 mode folds decay into the gradient
     m_new = beta1 * m + (1.0 - beta1) * g
@@ -89,16 +90,20 @@ def fused_adam_update(param, grad, m, v, step, *, lr: float, beta1: float = 0.9,
         block_rows //= 2
     block_rows = max(1, block_rows)
     grid = rows // block_rows
-    kernel = functools.partial(_adam_kernel, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+    kernel = functools.partial(_adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
                                weight_decay=weight_decay, adam_w_mode=adam_w_mode)
     c1a = jnp.asarray([c1], jnp.float32)
     c2a = jnp.asarray([c2], jnp.float32)
+    # lr rides in as a scalar-prefetch arg (not a closure constant) so a
+    # schedule-driven lr — a traced value inside the jitted train step —
+    # doesn't end up baked into the kernel body.
+    lra = jnp.asarray([lr], jnp.float32).reshape(1)
     # index_map receives (grid_idx, *scalar_prefetch_refs)
     bspec = pl.BlockSpec((block_rows, _LANE), lambda i, *_: (i, 0))
     p_new, m_new, v_new = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(grid,),
             in_specs=[bspec, bspec, bspec, bspec],
             out_specs=[bspec, bspec, bspec],
@@ -107,6 +112,6 @@ def fused_adam_update(param, grad, m, v, step, *, lr: float, beta1: float = 0.9,
                    jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
                    jax.ShapeDtypeStruct((rows, _LANE), jnp.float32)],
         interpret=interpret_flag(impl),
-    )(c1a, c2a, pf, gf, mf, vf)
+    )(c1a, c2a, lra, pf, gf, mf, vf)
     unflat = lambda x: x.reshape(-1)[:n].reshape(orig_shape)
     return unflat(p_new), unflat(m_new), unflat(v_new)

@@ -60,7 +60,19 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
                        type_name)
         name = LAMB_OPTIMIZER
 
-    if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
+    if name == FUSED_ADAM:
+        # The Pallas single-pass update kernel (ops/pallas/fused_adam.py);
+        # "torch_adam": true opts back into the plain optax path, mirroring
+        # the reference's escape hatch from the CUDA kernel.
+        if not p.get("torch_adam", False):
+            from deepspeed_tpu.ops.adam.fused_adam import fused_adam
+
+            return fused_adam(
+                learning_rate, weight_decay=wd,
+                adam_w_mode=p.get("adam_w_mode", p.get("adamw_mode", True)),
+                **_adam_args(p))
+        name = ADAM_OPTIMIZER
+    if name in (ADAM_OPTIMIZER, CPU_ADAM):
         # adam_w_mode (reference FusedAdam arg) selects decoupled weight decay.
         adam_w_mode = p.get("adam_w_mode", p.get("adamw_mode", True))
         if adam_w_mode:
@@ -82,7 +94,8 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
     if name == MUON:
         from deepspeed_tpu.ops.adam.muon import muon
 
-        return muon(learning_rate, weight_decay=wd, momentum=p.get("momentum", 0.95))
+        return muon(learning_rate, weight_decay=wd, momentum=p.get("momentum", 0.95),
+                    nesterov=p.get("nesterov", True), ns_steps=p.get("ns_steps", 5))
     raise ValueError(f"Unknown optimizer type {type_name!r}")
 
 

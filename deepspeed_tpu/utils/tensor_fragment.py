@@ -78,32 +78,48 @@ def safe_set_full_fp32_param(engine, name: str, value) -> None:
 
 # -- optimizer state -------------------------------------------------------
 
+# Reference state_key → candidate namedtuple fields across our optimizer
+# states: optax ScaleByAdamState uses mu/nu, the Pallas FusedAdamState m/v.
+_STATE_ALIASES = {"exp_avg": ("mu", "m"), "exp_avg_sq": ("nu", "v")}
+
+
+def _candidate_fields(state_key: str):
+    return _STATE_ALIASES.get(state_key, (state_key,))
+
+
 def safe_get_full_optimizer_state(engine, name: str, state_key: str) -> np.ndarray:
-    """state_key ∈ {"exp_avg", "exp_avg_sq"} (reference naming) or any optax
-    field name ("mu", "nu")."""
-    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
-    field = alias.get(state_key, state_key)
+    """state_key ∈ {"exp_avg", "exp_avg_sq"} (reference naming) or any concrete
+    field name ("mu"/"nu" for optax Adam, "m"/"v" for the fused kernel)."""
+    fields = _candidate_fields(state_key)
     for st in jax.tree_util.tree_leaves(
             engine.state.opt_state, is_leaf=lambda x: hasattr(x, "_fields")):
-        if hasattr(st, "_fields") and field in st._fields:
-            sub = getattr(st, field)
-            _, leaf = _find(sub, name)
-            return np.asarray(jax.device_get(leaf), dtype=np.float32)
+        for field in fields:
+            if hasattr(st, "_fields") and field in st._fields:
+                sub = getattr(st, field)
+                _, leaf = _find(sub, name)
+                return np.asarray(jax.device_get(leaf), dtype=np.float32)
     raise KeyError(f"optimizer state has no field {state_key!r}")
 
 
 def safe_set_full_optimizer_state(engine, name: str, state_key: str, value) -> None:
-    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
-    field = alias.get(state_key, state_key)
+    fields = _candidate_fields(state_key)
+    hit = []
 
     def swap_state(st):
-        if hasattr(st, "_fields") and field in st._fields:
-            return st._replace(**{field: _replace_leaf(getattr(st, field), name, value)})
+        if hasattr(st, "_fields"):
+            for field in fields:
+                if field in st._fields:
+                    hit.append(field)
+                    return st._replace(
+                        **{field: _replace_leaf(getattr(st, field), name, value)})
         return st
 
-    new_opt = jax.tree_util.tree_map(
-        swap_state, engine.state.opt_state,
-        is_leaf=lambda x: hasattr(x, "_fields") and field in getattr(x, "_fields", ()))
+    is_leaf = lambda x: hasattr(x, "_fields") and any(
+        f in getattr(x, "_fields", ()) for f in fields)
+    new_opt = jax.tree_util.tree_map(swap_state, engine.state.opt_state,
+                                     is_leaf=is_leaf)
+    if not hit:
+        raise KeyError(f"optimizer state has no field {state_key!r}")
     engine.state = engine.state._replace(opt_state=new_opt)
 
 
