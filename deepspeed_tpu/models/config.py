@@ -46,9 +46,18 @@ class ModelConfig:
     # training-time knobs
     sp_mode: str = "auto"                  # "auto" | "ulysses" | "ring" (sp>1)
     pp_microbatches: int = 0               # pipeline microbatches (0 -> pp size)
-    remat: bool = True                     # activation checkpointing per layer
+    # Activation checkpointing (ds_config "activation_checkpointing" section
+    # overrides these at engine init). None = off: recompute-in-backward costs
+    # ~1/3 extra FLOPs, so it must be opted into when the model doesn't fit,
+    # not paid by default. Large presets below turn it on.
+    remat: Optional[bool] = None
+    remat_policy: str = "full"             # "full" | "dots" (save matmul outputs)
     scan_layers: bool = True               # lax.scan over stacked layer params
     z_loss: float = 0.0
+    # Cross-entropy chunking (tokens per block; the [chunk, V] logits block is
+    # the only logits materialization). 0 = dense; None = auto (chunk when the
+    # full [B*S, V] fp32 logits would exceed ~2^28 elements).
+    ce_chunk: Optional[int] = None
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -75,23 +84,24 @@ _PRESETS = {
     "gpt2-xl": dict(vocab_size=50257, hidden_size=1600, intermediate_size=6400,
                     num_layers=48, num_heads=25, max_seq_len=1024,
                     norm="layernorm", activation="gelu", glu=False,
-                    position="learned", tie_embeddings=True),
+                    position="learned", tie_embeddings=True, remat=True),
     # Llama family (configs[2]/[4]: 8B on v5p-8, 70B on v5p-128)
     "llama-tiny": dict(vocab_size=32000, hidden_size=256, intermediate_size=688,
                        num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=2048),
     "llama3-8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
                       num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
-                      rope_theta=500000.0),
+                      rope_theta=500000.0, remat=True),
     "llama3-70b": dict(vocab_size=128256, hidden_size=8192, intermediate_size=28672,
                        num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=8192,
-                       rope_theta=500000.0),
+                       rope_theta=500000.0, remat=True),
     # Mixtral family (configs[3]: MoE expert-parallel rung)
     "mixtral-tiny": dict(vocab_size=32000, hidden_size=256, intermediate_size=512,
                          num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=2048,
                          num_experts=8, num_experts_per_tok=2),
     "mixtral-8x7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
                          num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
-                         rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2),
+                         rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2,
+                         remat=True),
 }
 
 

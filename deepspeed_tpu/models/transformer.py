@@ -228,7 +228,12 @@ class CausalLM:
         body = functools.partial(self._layer, cos=cos, sin=sin, batch_ax=batch_ax,
                                  use_drop=use_drop)
         if cfg.remat:
-            body = jax.checkpoint(body, prevent_cse=False)
+            # "dots" saves matmul outputs and recomputes only the cheap
+            # elementwise chain — a middle point between full remat (+1/3
+            # FLOPs) and no remat (full activation residency).
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots" else None)
+            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
         pp = axis_size(mesh, "pp") if mesh is not None and not mesh.empty else 1
 
         def scan_body(carry, xs):
@@ -263,17 +268,26 @@ class CausalLM:
         x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
         head = (params["embed"]["tok"].T if cfg.tie_embeddings
                 else params["lm_head"]).astype(x.dtype)
-        logits = x @ head
-        logits = constrain(logits, mesh, batch_ax, "sp", "tp")
         if labels is None:
-            return logits
+            logits = x @ head
+            return constrain(logits, mesh, batch_ax, "sp", "tp")
         # Next-token objective (HF CausalLM convention: shift inside when
         # labels == input_ids): logits[t] predicts labels[t+1].
-        shifted_logits = logits[:, :-1]
         shifted_labels = labels[:, 1:]
         shifted_mask = loss_mask[:, 1:] if loss_mask is not None else None
-        loss = cross_entropy(shifted_logits, shifted_labels, z_loss=cfg.z_loss,
-                             mask=shifted_mask)
+        B, S, _ = x.shape
+        chunk = cfg.ce_chunk
+        if chunk is None:  # auto: chunk when the fp32 logits would be >2^28 elts
+            chunk = 2048 if B * S * cfg.vocab_size > (1 << 28) else 0
+        if chunk:
+            loss = blockwise_cross_entropy(x[:, :-1], head, shifted_labels,
+                                           chunk=chunk, z_loss=cfg.z_loss,
+                                           mask=shifted_mask)
+        else:
+            logits = x[:, :-1] @ head
+            logits = constrain(logits, mesh, batch_ax, "sp", "tp")
+            loss = cross_entropy(logits, shifted_labels, z_loss=cfg.z_loss,
+                                 mask=shifted_mask)
         return loss + cfg.moe_aux_loss_coef * aux_loss if cfg.is_moe else loss
 
     # flax-style call-through so `model.apply(params, batch...)` also accepts
@@ -301,6 +315,58 @@ def cross_entropy(logits, labels, z_loss: float = 0.0, mask=None):
         valid = valid & (mask > 0)
     nll = jnp.where(valid, nll, 0.0)
     return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def blockwise_cross_entropy(x, head, labels, chunk: int, z_loss: float = 0.0,
+                            mask=None):
+    """LM loss without materializing the full [B, S, V] logits.
+
+    The reference's fused-softmax CUDA kernels attack the same bandwidth
+    problem from below (SURVEY.md §2.2 "Transformer training kernels"); on TPU
+    the winning shape is blockwise: scan over token chunks, each producing a
+    [chunk, V] logits block (one MXU matmul) reduced to per-token nll in fp32,
+    with ``jax.checkpoint`` so the backward pass recomputes the block instead
+    of saving it.  Peak logits memory drops from O(B·S·V) to O(chunk·V) while
+    the matmuls stay MXU-sized.
+    """
+    B, S, D = x.shape
+    N = B * S
+    xf = x.reshape(N, D)
+    lf = labels.reshape(N)
+    mf = None if mask is None else mask.reshape(N)
+    pad = (-N) % chunk
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, D), xf.dtype)])
+        lf = jnp.concatenate([lf, jnp.full((pad,), -100, lf.dtype)])
+        if mf is not None:
+            mf = jnp.concatenate([mf, jnp.zeros((pad,), mf.dtype)])
+    n_blocks = xf.shape[0] // chunk
+    xs = xf.reshape(n_blocks, chunk, D)
+    ls = lf.reshape(n_blocks, chunk)
+    ms = None if mf is None else mf.reshape(n_blocks, chunk)
+
+    @jax.checkpoint
+    def block(carry, args):
+        xc, lc = args[0], args[1]
+        mc = args[2] if len(args) > 2 else None
+        logits = (xc @ head).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, jnp.maximum(lc, 0)[:, None],
+                                   axis=-1).squeeze(-1)
+        nll = lse - gold
+        if z_loss:
+            nll = nll + z_loss * lse ** 2
+        valid = lc >= 0
+        if mc is not None:
+            valid = valid & (mc > 0)
+        tot, cnt = carry
+        return (tot + jnp.where(valid, nll, 0.0).sum(),
+                cnt + valid.sum()), None
+
+    xs_args = (xs, ls) if ms is None else (xs, ls, ms)
+    (tot, cnt), _ = jax.lax.scan(block, (jnp.zeros((), jnp.float32),
+                                         jnp.zeros((), jnp.int32)), xs_args)
+    return tot / jnp.maximum(cnt, 1)
 
 
 def causal_lm(preset: str, mesh: Optional[Mesh] = None, **overrides) -> CausalLM:

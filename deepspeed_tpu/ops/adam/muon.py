@@ -7,13 +7,17 @@ bf16-friendly precision; this is the TPU-idiomatic shape of the algorithm
 
 Matrix-shaped parameters ([m, n], and stacked [L, m, n] layer params via
 vmap) get the orthogonalized update; vectors/scalars (biases, norm scales)
-fall back to plain momentum SGD, matching the usual Muon deployment where
-non-matrix params use a different rule.
+AND embedding/lm-head tables fall back to plain momentum SGD, matching the
+usual Muon deployment where non-hidden-layer params use a different rule
+(orthogonalizing the embedding update distorts token-frequency-dependent
+magnitudes).  The exclusion is path-based (``exclude`` predicate; default
+matches "embed"/"head"/"tok" path components).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Union
+import re
+from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -53,10 +57,22 @@ class MuonState(NamedTuple):
     momentum: Any
 
 
+_DEFAULT_EXCLUDE = re.compile(r"embed|head|tok|wte|wpe", re.IGNORECASE)
+
+
+def _default_exclude(path: str) -> bool:
+    return bool(_DEFAULT_EXCLUDE.search(path))
+
+
 def muon(learning_rate: Union[float, Callable] = 2e-2, weight_decay: float = 0.0,
-         momentum: float = 0.95, nesterov: bool = True,
-         ns_steps: int = 5) -> optax.GradientTransformation:
-    """Muon as an optax GradientTransformation."""
+         momentum: float = 0.95, nesterov: bool = True, ns_steps: int = 5,
+         exclude: Optional[Callable[[str], bool]] = _default_exclude,
+         ) -> optax.GradientTransformation:
+    """Muon as an optax GradientTransformation.
+
+    ``exclude(path) -> True`` routes that parameter to plain momentum SGD
+    instead of the orthogonalized update (embeddings/heads by default).
+    """
 
     def init(params):
         return MuonState(
@@ -68,11 +84,11 @@ def muon(learning_rate: Union[float, Callable] = 2e-2, weight_decay: float = 0.0
         lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
         count = state.count + 1
 
-        def leaf(g, buf, p):
+        def leaf(g, buf, p, excluded):
             g32 = g.astype(jnp.float32)
             buf = momentum * buf + g32
             eff = g32 + momentum * buf if nesterov else buf
-            if eff.ndim in (2, 3):
+            if eff.ndim in (2, 3) and not excluded:
                 o = orthogonalize(eff, ns_steps)
                 # scale so update RMS matches Adam-style magnitudes across
                 # aspect ratios (public Muon scaling rule)
@@ -82,10 +98,13 @@ def muon(learning_rate: Union[float, Callable] = 2e-2, weight_decay: float = 0.0
             upd = -lr * (o + weight_decay * p.astype(jnp.float32))
             return upd.astype(p.dtype), buf
 
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_pp, treedef = jax.tree_util.tree_flatten_with_path(params)
+        paths = [jax.tree_util.keystr(kp) for kp, _ in flat_pp]
+        flat_p = [v for _, v in flat_pp]
         flat_g = treedef.flatten_up_to(grads)
         flat_b = treedef.flatten_up_to(state.momentum)
-        outs = [leaf(g, b, p) for g, b, p in zip(flat_g, flat_b, flat_p)]
+        outs = [leaf(g, b, p, exclude(path) if exclude else False)
+                for g, b, p, path in zip(flat_g, flat_b, flat_p, paths)]
         updates = jax.tree_util.tree_unflatten(treedef, [u for u, _ in outs])
         bufs = jax.tree_util.tree_unflatten(treedef, [b for _, b in outs])
         return updates, MuonState(count=count, momentum=bufs)

@@ -26,7 +26,11 @@ from jax.experimental import pallas as pl
 
 from deepspeed_tpu.ops.pallas.common import interpret_flag, pick_block, resolve_impl
 
-_BLOCK_ROWS = 256
+# 512-row tiles: fewer grid steps than 256 while the bwd kernel's blocks and
+# fp32 temporaries stay inside the 16MB scoped-VMEM budget even when fused
+# into a large training program (1024 rows compiles standalone but trips the
+# scoped limit inside the full step at n=768).
+_BLOCK_ROWS = 512
 
 
 def _rows_blocks(rows: int):

@@ -138,9 +138,16 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
 
 
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
-    partition_activations: bool = False
-    cpu_checkpointing: bool = False
-    contiguous_memory_optimization: bool = False
+    # TPU extension: master switch + remat policy. enabled=None leaves the
+    # model's own default; True/False forces per-layer jax.checkpoint on/off.
+    # The reference section has no master switch because torch checkpointing
+    # is invoked by model code; here the engine owns the transform.
+    enabled: Optional[bool] = None
+    policy: str = "full"                   # "full" | "dots" (save matmul outs)
+    # reference keys (SURVEY.md §2.1 "Activation checkpointing"):
+    partition_activations: bool = False    # activations are sharded by GSPMD
+    cpu_checkpointing: bool = False        # honored via jax host offload when set
+    contiguous_memory_optimization: bool = False  # XLA owns layout; accepted
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False

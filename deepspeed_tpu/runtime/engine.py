@@ -102,6 +102,7 @@ class DeepSpeedEngine:
         self.train_micro_batch_size_per_gpu = lambda: self.config.train_micro_batch_size_per_gpu
 
         self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
+        self._apply_activation_checkpointing_config(model)
         self._loss_fn = loss_fn or self._make_loss_fn(model)
         if param_pspecs is None and hasattr(model, "logical_pspecs"):
             # Built-in models publish their tensor/expert-parallel layout
@@ -109,6 +110,7 @@ class DeepSpeedEngine:
             param_pspecs = model.logical_pspecs()
         self._client_param_pspecs = param_pspecs  # tensor-parallel logical specs
         self._micro_count = 0
+        self._host_steps = 0
         self._boundary_override: Optional[bool] = None
         self._last_loss = None
         self._last_grad_norm = None
@@ -139,6 +141,28 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # construction helpers
     # ------------------------------------------------------------------
+    def _apply_activation_checkpointing_config(self, model) -> None:
+        """Push the ds_config ``activation_checkpointing`` section into the
+        model (reference: runtime/activation_checkpointing/checkpointing.py
+        ``configure()`` — there a global; here the engine owns the remat
+        transform applied in the model forward)."""
+        ac = self.config.activation_checkpointing
+        mcfg = getattr(model, "config", None)
+        if mcfg is None or not hasattr(mcfg, "remat"):
+            return
+        section_active = (ac.enabled is not None or ac.partition_activations
+                          or ac.cpu_checkpointing)
+        if ac.enabled is not None:
+            mcfg.remat = ac.enabled
+        elif section_active:
+            # reference configs enable the subsystem via these knobs
+            mcfg.remat = True
+        # Only take over the policy when the config section is actually in
+        # play; otherwise a model built with remat_policy="dots" would be
+        # silently reset to the section's default.
+        if section_active and hasattr(mcfg, "remat_policy"):
+            mcfg.remat_policy = ac.policy
+
     def _make_loss_fn(self, model) -> Callable:
         if hasattr(model, "apply"):  # flax module computing loss in __call__
             def loss_fn(params, batch, rng):
@@ -374,9 +398,13 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._micro_count = 0
-        steps = self.global_steps
-        if steps and steps % self.config.steps_per_print == 0:
-            self._report(steps)
+        # Host-side mirror of state.global_steps: reading the device scalar
+        # here would synchronize every step (it ignores fp16 overflow skips,
+        # which only matters for print cadence; checkpoint tags still read
+        # the authoritative device count).
+        self._host_steps += 1
+        if self._host_steps % self.config.steps_per_print == 0:
+            self._report(self.global_steps)
 
     def train_batch(self, data_iter=None):
         """Full global-batch step: gas micro-batches + boundary update
@@ -519,6 +547,7 @@ class DeepSpeedEngine:
                 grad_acc=jax.device_put(opt_host["grad_acc"], self._acc_shardings),
                 global_steps=jnp.asarray(opt_host["global_steps"], jnp.int32),
                 scaler=scaler_lib.LossScaleState(*[jnp.asarray(x) for x in opt_host["scaler"]]))
+            self._host_steps = int(opt_host["global_steps"])
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.state = new_state
