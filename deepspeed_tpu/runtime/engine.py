@@ -97,6 +97,26 @@ class DeepSpeedEngine:
         self.fp16_enabled = self.config.fp16_enabled
         self.bfloat16_enabled = self.config.bfloat16_enabled
         self.compute_dtype = self.config.dtype()
+
+        # ZeRO-Offload / ZeRO-Infinity (SURVEY.md §2.1 rows "NVMe swap",
+        # "ZeRO stage 1+2" cpu_offload): optimizer states live on host RAM or
+        # NVMe; the device holds compute-dtype params + grad accumulator only.
+        off_cfg = self.config.zero_config.offload_optimizer
+        self._offload_device = off_cfg.device if off_cfg is not None else "none"
+        self._offload = self._offload_device in ("cpu", "nvme")
+        self._offload_opt = None
+        if self._offload:
+            log_dist(f"ZeRO-Offload: optimizer states -> {self._offload_device}"
+                     + (f" ({off_cfg.nvme_path})" if self._offload_device == "nvme"
+                        else ""), ranks=[0])
+        p_off = self.config.zero_config.offload_param
+        if p_off is not None and p_off.device in ("cpu", "nvme"):
+            # fp32 masters are host-resident whenever offload_optimizer is on;
+            # per-layer streaming of compute params is not implemented yet.
+            logger.warning(
+                "offload_param.device=%s: fp32 master params are host-resident "
+                "(device keeps one compute-dtype copy); per-layer param "
+                "streaming is not implemented", p_off.device)
         self.gradient_accumulation_steps = lambda: self.config.gradient_accumulation_steps
         self.train_batch_size = lambda: self.config.train_batch_size
         self.train_micro_batch_size_per_gpu = lambda: self.config.train_micro_batch_size_per_gpu
@@ -187,7 +207,20 @@ class DeepSpeedEngine:
                                                 self.config.scheduler.params)
         elif callable(self.client_lr_scheduler):
             self._lr_schedule = self.client_lr_scheduler
-        if self.client_optimizer is not None:
+        if self._offload:
+            # The reference swaps in DeepSpeedCPUAdam when offload is active
+            # (SURVEY.md §3.2 _configure_optimizer); the device-side
+            # transformation is identity — all update math runs on host.
+            import optax
+
+            opt_type = (self.config.optimizer.type if self.config.optimizer
+                        else "AdamW").lower()
+            if "adam" not in opt_type:
+                logger.warning("offload_optimizer supports the Adam family; "
+                               "%s config will be stepped by DeepSpeedCPUAdam",
+                               opt_type)
+            self.optimizer = optax.identity()
+        elif self.client_optimizer is not None:
             self.optimizer = self.client_optimizer
             if self.config.zero_allow_untested_optimizer:
                 log_dist("using client optimizer with ZeRO (zero_allow_untested_optimizer)",
@@ -225,7 +258,21 @@ class DeepSpeedEngine:
 
         # Materialize state on-device, already sharded (zero.Init semantics:
         # nothing is ever resident unsharded).
-        params = jax.jit(lambda p: p, out_shardings=self._param_shardings)(params)
+        if self._offload:
+            # Host takes the fp32 masters; the device keeps ONE compute-dtype
+            # copy (bf16 halves resident param bytes, and no fp32
+            # master/moments ever touch HBM — the ZeRO-Offload contract).
+            self._build_offload_optimizer(params)
+            cdtype = self.compute_dtype
+
+            def to_compute(p):
+                return jax.tree.map(
+                    lambda x: x.astype(cdtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+
+            params = jax.jit(to_compute, out_shardings=self._param_shardings)(params)
+        else:
+            params = jax.jit(lambda p: p, out_shardings=self._param_shardings)(params)
         opt_state = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)(params)
         grad_acc = jax.jit(
             lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, self._acc_dtype(x.dtype)), p),
@@ -242,6 +289,21 @@ class DeepSpeedEngine:
 
     def _acc_dtype(self, param_dtype):
         return jnp.float32
+
+    def _build_offload_optimizer(self, params) -> None:
+        from deepspeed_tpu.runtime.zero.offload import OffloadedOptimizer
+
+        p = dict(self.config.optimizer.params) if self.config.optimizer else {}
+        betas = tuple(p.get("betas", (0.9, 0.999)))
+        off = self.config.zero_config.offload_optimizer
+        self._offload_opt = OffloadedOptimizer(
+            jax.device_get(params),
+            backend=self._offload_device,
+            lr=p.get("lr", 1e-3), betas=betas, eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay", 0.0),
+            adamw_mode=p.get("adam_w_mode", p.get("adamw_mode", True)),
+            swap_dir=off.nvme_path, aio_config=self.config.aio,
+            pipeline=True)
 
     def lazy_init_from_batch(self, batch: Any) -> None:
         """zero.Init-equivalent: abstract-init then shard-on-create
@@ -331,14 +393,42 @@ class DeepSpeedEngine:
         def evaluate(params, batch, rng):
             return loss_fn(cast_params(params), batch, rng)
 
+        def offload_prep(state: TrainState):
+            """Device half of the offload step: unscale + clip; grads leave
+            the device once, already final."""
+            scale = state.scaler.scale if fp16 else jnp.float32(1.0)
+            overflow = has_overflow(state.grad_acc) if fp16 else jnp.zeros((), bool)
+            grads = jax.tree.map(lambda g: g / scale, state.grad_acc)
+            if clip > 0:
+                grads, gnorm = clip_grad_norm(grads, clip)
+            else:
+                gnorm = global_norm(grads)
+            return grads, gnorm, overflow
+
+        def offload_commit(state: TrainState, overflow):
+            new_scaler = scaler_lib.update(
+                state.scaler, overflow, dynamic=fp16 and fp16_cfg.dynamic_loss_scale,
+                loss_scale_window=fp16_cfg.loss_scale_window,
+                min_loss_scale=fp16_cfg.min_loss_scale, hysteresis=fp16_cfg.hysteresis)
+            return (jax.tree.map(jnp.zeros_like, state.grad_acc),
+                    state.global_steps + (1 - overflow.astype(jnp.int32)),
+                    new_scaler)
+
         sh = self._state_shardings
         bs = batch_sharding(self.mesh)
         self._accum_fn = jax.jit(accum, donate_argnums=(0,), in_shardings=(sh, None, None),
                                  out_shardings=(sh, NamedSharding(self.mesh, P())))
-        self._apply_fn = jax.jit(apply, donate_argnums=(0,),
-                                 in_shardings=(sh,),
-                                 out_shardings=(sh, NamedSharding(self.mesh, P()),
-                                                NamedSharding(self.mesh, P())))
+        if self._offload:
+            self._offload_prep_fn = jax.jit(offload_prep, in_shardings=(sh,))
+            self._offload_commit_fn = jax.jit(
+                offload_commit, in_shardings=(sh, None),
+                out_shardings=(sh.grad_acc, NamedSharding(self.mesh, P()), sh.scaler))
+            self._apply_fn = None
+        else:
+            self._apply_fn = jax.jit(apply, donate_argnums=(0,),
+                                     in_shardings=(sh,),
+                                     out_shardings=(sh, NamedSharding(self.mesh, P()),
+                                                    NamedSharding(self.mesh, P())))
         self._eval_fn = jax.jit(evaluate, in_shardings=(self._param_shardings, None, None),
                                 out_shardings=NamedSharding(self.mesh, P()))
 
@@ -391,7 +481,10 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(SynchronizedWallClockTimer.STEP).start()
-        self.state, gnorm, overflow = self._apply_fn(self.state)
+        if self._offload:
+            gnorm, overflow = self._step_offload()
+        else:
+            self.state, gnorm, overflow = self._apply_fn(self.state)
         self.timers(SynchronizedWallClockTimer.STEP).stop()
         self._last_grad_norm = gnorm
         self._last_overflow = overflow
@@ -405,6 +498,34 @@ class DeepSpeedEngine:
         self._host_steps += 1
         if self._host_steps % self.config.steps_per_print == 0:
             self._report(self.global_steps)
+
+    def _step_offload(self):
+        """Optimizer step with host-resident states (ZeRO-Offload path):
+        device prep (unscale/clip) -> grads to host -> DeepSpeedCPUAdam ->
+        updated compute-dtype params back to device."""
+        import ml_dtypes
+
+        state = self.state
+        grads, gnorm, overflow = self._offload_prep_fn(state)
+        # The host optimizer step forces a sync anyway; reading the overflow
+        # flag here costs nothing extra (reference offload is host-synced too).
+        skipped = self.fp16_enabled and bool(overflow)
+        if not skipped:
+            grads_flat = [np.asarray(g) for g in
+                          jax.tree_util.tree_leaves(jax.device_get(grads))]
+            lr = self.get_lr()[0]
+            self._offload_opt.step([g.reshape(-1) for g in grads_flat], lr=lr)
+            np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
+                        jnp.float16: np.float16}.get(self.compute_dtype, np.float32)
+            master = self._offload_opt.master_tree()
+            compute = jax.tree.map(lambda a: a.astype(np_dtype), master)
+            new_params = jax.device_put(compute, self._param_shardings)
+        else:
+            new_params = state.params
+        zero_acc, steps, scaler = self._offload_commit_fn(state, overflow)
+        self.state = state._replace(params=new_params, grad_acc=zero_acc,
+                                    global_steps=steps, scaler=scaler)
+        return gnorm, overflow
 
     def train_batch(self, data_iter=None):
         """Full global-batch step: gas micro-batches + boundary update
@@ -489,12 +610,15 @@ class DeepSpeedEngine:
         if comm.get_rank() == 0:
             self.checkpoint_engine.save(self.state.params,
                                         os.path.join(ckpt_dir, "model_states.msgpack"))
+            optim_payload = {"opt_state": self.state.opt_state,
+                             "grad_acc": self.state.grad_acc,
+                             "global_steps": self.state.global_steps,
+                             "scaler": tuple(self.state.scaler)}
+            if self._offload:
+                # host-resident fp32 master + moments (cpu or nvme tier)
+                optim_payload["offload"] = self._offload_opt.state_dict()
             self.checkpoint_engine.save(
-                {"opt_state": self.state.opt_state,
-                 "grad_acc": self.state.grad_acc,
-                 "global_steps": self.state.global_steps,
-                 "scaler": tuple(self.state.scaler)},
-                os.path.join(ckpt_dir, "optim_states.msgpack"))
+                optim_payload, os.path.join(ckpt_dir, "optim_states.msgpack"))
             meta = {"client_state": client_state or {},
                     "micro_count": self._micro_count,
                     "lr_scheduler": (self.lr_scheduler.state_dict()
@@ -536,12 +660,16 @@ class DeepSpeedEngine:
             with open(meta_path) as fh:
                 meta = json.load(fh)
         if not load_module_only and load_optimizer_states:
+            target = {"opt_state": jax.device_get(self.state.opt_state),
+                      "grad_acc": jax.device_get(self.state.grad_acc),
+                      "global_steps": np.zeros((), np.int32),
+                      "scaler": tuple(np.asarray(x) for x in self.state.scaler)}
+            if self._offload:
+                target["offload"] = self._offload_opt.state_dict()
             opt_host = self.checkpoint_engine.load(
-                os.path.join(ckpt_dir, "optim_states.msgpack"),
-                target={"opt_state": jax.device_get(self.state.opt_state),
-                        "grad_acc": jax.device_get(self.state.grad_acc),
-                        "global_steps": np.zeros((), np.int32),
-                        "scaler": tuple(np.asarray(x) for x in self.state.scaler)})
+                os.path.join(ckpt_dir, "optim_states.msgpack"), target=target)
+            if self._offload and "offload" in opt_host:
+                self._offload_opt.load_state_dict(opt_host["offload"])
             new_state = new_state._replace(
                 opt_state=jax.device_put(opt_host["opt_state"], self._opt_shardings),
                 grad_acc=jax.device_put(opt_host["grad_acc"], self._acc_shardings),

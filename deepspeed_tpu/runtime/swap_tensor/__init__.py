@@ -1,0 +1,9 @@
+"""NVMe tensor swapping (ZeRO-Infinity).
+
+Reference: ``deepspeed/runtime/swap_tensor/`` — ``partitioned_optimizer_swapper``
++ ``pipelined_optimizer_swapper`` over the aio op (SURVEY.md §2.1 "NVMe swap").
+"""
+
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import OptimizerStateSwapper
+
+__all__ = ["OptimizerStateSwapper"]

@@ -1,0 +1,109 @@
+"""Pipelined NVMe swapper for optimizer states.
+
+Reference roles covered (SURVEY.md §2.1 "NVMe swap (ZeRO-Infinity)"):
+- ``partitioned_optimizer_swapper.py``: one state file per parameter,
+  [master, m, v] fp32 concatenated, O_DIRECT-capable via the aio library.
+- ``pipelined_optimizer_swapper.py``: read-ahead of parameter ``i+1`` while
+  ``i`` is being stepped, and asynchronous write-back, overlapping NVMe I/O
+  with the host optimizer compute.
+
+A small rotating pool of host buffers bounds memory: with ``n_buffers=3``
+one buffer is being stepped, one holds the in-flight read-ahead, and one may
+still be draining a write.  Reads and writes run on separate aio handles so
+waiting for the pending read does not also drain write-backs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import aio_handle
+
+
+class OptimizerStateSwapper:
+    STATES = 3  # master, exp_avg, exp_avg_sq
+
+    def __init__(self, swap_dir: str, sizes: List[int], aio_config=None,
+                 n_buffers: int = 3):
+        os.makedirs(swap_dir, exist_ok=True)
+        self.dir = swap_dir
+        self.sizes = sizes
+        kw = {}
+        if aio_config is not None:
+            kw = dict(block_size=aio_config.block_size,
+                      queue_depth=aio_config.queue_depth,
+                      num_threads=aio_config.thread_count,
+                      single_submit=aio_config.single_submit,
+                      overlap_events=aio_config.overlap_events)
+        self._read_h = aio_handle(**kw)
+        self._write_h = aio_handle(**kw)
+        max_elems = max(sizes) * self.STATES if sizes else 0
+        self._buffers = [np.empty(max_elems, np.float32) for _ in range(n_buffers)]
+        self._buf_of: Dict[int, int] = {}   # leaf index -> buffer slot
+        self._pending_read: Optional[int] = None
+        self._writes_since_drain = 0
+
+    def _path(self, i: int) -> str:
+        return os.path.join(self.dir, f"state_{i}.bin")
+
+    def _nbytes(self, i: int) -> int:
+        return self.sizes[i] * self.STATES * 4
+
+    def _claim_slot(self, i: int) -> int:
+        slot = i % len(self._buffers)
+        # The slot may still back an in-flight write from a previous leaf;
+        # drain writes before reuse (cheap: at most every n_buffers leaves).
+        if self._writes_since_drain:
+            self._write_h.wait()
+            self._writes_since_drain = 0
+        self._buf_of[i] = slot
+        return slot
+
+    # -- init / sync paths --------------------------------------------------
+    def initialize(self, i: int, master_flat: np.ndarray) -> None:
+        """Create the state file: master = given, moments = 0."""
+        buf = np.concatenate([master_flat.astype(np.float32),
+                              np.zeros(2 * self.sizes[i], np.float32)])
+        rc = self._write_h.sync_pwrite(buf, self._path(i))
+        assert rc == 0, f"nvme write failed for leaf {i}"
+
+    def read_sync(self, i: int) -> np.ndarray:
+        buf = np.empty(self.sizes[i] * self.STATES, np.float32)
+        rc = self._read_h.sync_pread(buf, self._path(i))
+        assert rc == 0, f"nvme read failed for leaf {i}"
+        return buf
+
+    def write_sync(self, i: int, buf: np.ndarray) -> None:
+        rc = self._write_h.sync_pwrite(
+            np.ascontiguousarray(buf[:self.sizes[i] * self.STATES]), self._path(i))
+        assert rc == 0, f"nvme write failed for leaf {i}"
+
+    # -- pipelined path ------------------------------------------------------
+    def prefetch(self, i: int) -> None:
+        """Submit the async read for leaf i (at most one in flight)."""
+        assert self._pending_read is None, "one read-ahead at a time"
+        slot = self._claim_slot(i)
+        view = self._buffers[slot][:self.sizes[i] * self.STATES]
+        self._read_h.async_pread(view, self._path(i))
+        self._pending_read = i
+
+    def wait_fetch(self, i: int) -> np.ndarray:
+        assert self._pending_read == i, f"leaf {i} was not prefetched"
+        rc = self._read_h.wait()
+        assert rc == 0, f"nvme read failed for leaf {i}"
+        self._pending_read = None
+        slot = self._buf_of[i]
+        return self._buffers[slot][:self.sizes[i] * self.STATES]
+
+    def writeback(self, i: int, buf: np.ndarray) -> None:
+        """Async write-back of a stepped buffer (drained lazily)."""
+        self._write_h.async_pwrite(buf[:self.sizes[i] * self.STATES], self._path(i))
+        self._writes_since_drain += 1
+
+    def drain(self) -> None:
+        rc = self._write_h.wait()
+        self._writes_since_drain = 0
+        assert rc == 0, "nvme write-back failed"

@@ -1,0 +1,171 @@
+"""ZeRO-Offload / ZeRO-Infinity: host-resident optimizer states.
+
+Reference: ``deepspeed/runtime/zero/stage_1_and_2.py`` (cpu_offload) +
+``deepspeed/runtime/swap_tensor/*`` (SURVEY.md §2.1 "NVMe swap", §7.6).
+
+Design (TPU-native): the device keeps only compute-dtype (bf16) params and
+the gradient accumulator; the fp32 master params and Adam moments live on the
+host (``device: cpu``) or on NVMe behind the aio library (``device: nvme``).
+The optimizer-boundary step is:
+
+  device grads --(one transfer)--> host
+  DeepSpeedCPUAdam (csrc/cpu_adam, threaded C++) steps master/m/v in place
+  updated master --cast--> compute dtype --(one transfer)--> device params
+
+For NVMe, per-parameter state files are streamed through a small pinned
+buffer pool with read-ahead: while parameter ``i`` is being stepped, the
+read for ``i+1`` is in flight on the aio handle (the reference's
+``pipelined_optimizer_swapper`` role).
+
+The host step is synchronous with respect to the train loop by nature (the
+reference's is too); grad-accumulation amortizes it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.utils.logging import logger
+
+
+def _flatten_with_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [jax.tree_util.keystr(kp) for kp, _ in flat]
+    leaves = [v for _, v in flat]
+    return paths, leaves, treedef
+
+
+class OffloadedOptimizer:
+    """fp32 master + Adam moments on host RAM or NVMe; steps via cpu_adam.
+
+    ``backend`` ∈ {"cpu", "nvme"}.  For "nvme", ``swap_dir`` holds one state
+    file per parameter ([master, m, v] fp32 concatenated) and reads are
+    pipelined one parameter ahead through the aio handle.
+    """
+
+    def __init__(self, params_host: Any, *, backend: str = "cpu",
+                 lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 swap_dir: Optional[str] = None, aio_config=None,
+                 pipeline: bool = True):
+        assert backend in ("cpu", "nvme"), backend
+        self.backend = backend
+        self.adam = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
+                                     weight_decay=weight_decay,
+                                     adamw_mode=adamw_mode)
+        self.step_count = 0
+        self.pipeline = pipeline
+        paths, leaves, treedef = _flatten_with_paths(params_host)
+        self._paths = paths
+        self._treedef = treedef
+        self._shapes = [np.asarray(l).shape for l in leaves]
+        self._sizes = [int(np.asarray(l).size) for l in leaves]
+
+        if backend == "cpu":
+            # explicit copy: device_get hands back read-only buffers, and the
+            # C++ step writes through raw pointers
+            self._master: List[np.ndarray] = [
+                np.array(l, dtype=np.float32, copy=True).reshape(-1)
+                for l in leaves]
+            self._m = [np.zeros_like(p) for p in self._master]
+            self._v = [np.zeros_like(p) for p in self._master]
+            self._swapper = None
+        else:
+            from deepspeed_tpu.runtime.swap_tensor import OptimizerStateSwapper
+
+            assert swap_dir, "nvme offload requires offload_optimizer.nvme_path"
+            self._swapper = OptimizerStateSwapper(swap_dir, self._sizes,
+                                                  aio_config=aio_config)
+            for i, l in enumerate(leaves):
+                self._swapper.initialize(
+                    i, np.ascontiguousarray(np.asarray(l), np.float32).reshape(-1))
+            self._master = self._m = self._v = None
+        logger.info("offloaded optimizer: %d tensors, %.1fM elements, backend=%s",
+                    len(leaves), sum(self._sizes) / 1e6, backend)
+
+    # ------------------------------------------------------------------
+    def step(self, grads_host: List[np.ndarray], lr: Optional[float] = None
+             ) -> List[np.ndarray]:
+        """One Adam step over all leaves (grads as flat fp32 host arrays, in
+        tree-leaf order).  Returns the updated fp32 masters (flat views)."""
+        if lr is not None:
+            self.adam.lr = lr
+        self.step_count += 1
+        n = len(self._sizes)
+        if self.backend == "cpu":
+            for i in range(n):
+                g = np.ascontiguousarray(grads_host[i], np.float32).reshape(-1)
+                self.adam._native_step(self._master[i], g, self._m[i], self._v[i],
+                                       self.step_count) if self.adam._native is not None \
+                    else self.adam._numpy_step(self._master[i], g, self._m[i],
+                                               self._v[i], self.step_count)
+            return self._master
+
+        # NVMe: stream [master, m, v] per leaf with one-ahead read pipelining.
+        out: List[np.ndarray] = []
+        sw = self._swapper
+        sw.prefetch(0)
+        for i in range(n):
+            buf = sw.wait_fetch(i)
+            if self.pipeline and i + 1 < n:
+                sw.prefetch(i + 1)
+            sz = self._sizes[i]
+            master, m, v = buf[:sz], buf[sz:2 * sz], buf[2 * sz:3 * sz]
+            g = np.ascontiguousarray(grads_host[i], np.float32).reshape(-1)
+            if self.adam._native is not None:
+                self.adam._native_step(master, g, m, v, self.step_count)
+            else:
+                self.adam._numpy_step(master, g, m, v, self.step_count)
+            out.append(master.copy())  # buffer is recycled; masters returned
+            sw.writeback(i, buf)
+        sw.drain()
+        return out
+
+    # ------------------------------------------------------------------
+    def masters(self) -> List[np.ndarray]:
+        """Current fp32 masters (reads from NVMe for the nvme backend)."""
+        if self.backend == "cpu":
+            return self._master
+        out = []
+        for i in range(len(self._sizes)):
+            buf = self._swapper.read_sync(i)
+            out.append(buf[:self._sizes[i]].copy())
+        return out
+
+    def state_dict(self) -> Dict[str, Any]:
+        masters, ms, vs = [], [], []
+        for i in range(len(self._sizes)):
+            if self.backend == "cpu":
+                masters.append(self._master[i]); ms.append(self._m[i]); vs.append(self._v[i])
+            else:
+                buf = self._swapper.read_sync(i)
+                sz = self._sizes[i]
+                masters.append(buf[:sz].copy()); ms.append(buf[sz:2*sz].copy())
+                vs.append(buf[2*sz:3*sz].copy())
+        return {"master": masters, "exp_avg": ms, "exp_avg_sq": vs,
+                "step_count": np.asarray(self.step_count, np.int64)}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.step_count = int(sd["step_count"])
+        for i in range(len(self._sizes)):
+            master = np.ascontiguousarray(sd["master"][i], np.float32).reshape(-1)
+            m = np.ascontiguousarray(sd["exp_avg"][i], np.float32).reshape(-1)
+            v = np.ascontiguousarray(sd["exp_avg_sq"][i], np.float32).reshape(-1)
+            if self.backend == "cpu":
+                self._master[i][:] = master
+                self._m[i][:] = m
+                self._v[i][:] = v
+            else:
+                buf = np.concatenate([master, m, v])
+                self._swapper.write_sync(i, buf)
+
+    def master_tree(self) -> Any:
+        """fp32 masters reassembled into the param pytree (host)."""
+        masters = self.masters()
+        leaves = [m.reshape(s) for m, s in zip(masters, self._shapes)]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
