@@ -110,6 +110,7 @@ def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = False
     global _INITIALIZED
     if _INITIALIZED:
         return
+    _discover_scheduler_env(auto_mpi_discovery)
     # IMPORTANT: decide from env only — any jax query (process_count etc.)
     # would initialize the XLA backend and make jax.distributed.initialize
     # raise.  jax auto-detects all args on TPU pods when passed None.
@@ -124,6 +125,14 @@ def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = False
         pid = int(os.environ["RANK"]) if "RANK" in os.environ else (rank if rank >= 0 else None)
         logger.info("jax.distributed.initialize(coordinator=%s, num_processes=%s, process_id=%s)",
                     coord, nproc, pid)
+        if (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+                or os.environ.get("DS_ACCELERATOR") == "cpu"):
+            # Multi-process CPU "pods" (dev clusters, the launcher e2e test)
+            # need a cross-process collectives impl; harmless if unsupported.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
         try:
             jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
                                        process_id=pid)
@@ -139,6 +148,30 @@ def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = False
                     dist_backend, jax.process_count(), jax.device_count())
 
 
+def _discover_scheduler_env(auto_mpi_discovery: bool = True) -> None:
+    """Map mpirun/srun rank env onto the RANK/WORLD_SIZE contract the
+    launcher agent exports (reference: ``auto_mpi_discovery`` /
+    mpi_discovery in comm.py — here env-only, no mpi4py import).
+
+    Gated on ``auto_mpi_discovery`` or the ``DS_AUTO_MPI_DISCOVERY`` marker
+    the mpirun/srun runners export — an unrelated process running inside a
+    scheduler allocation must not be dragged into a phantom world.
+    """
+    if not (auto_mpi_discovery or os.environ.get("DS_AUTO_MPI_DISCOVERY")):
+        return
+    if "RANK" in os.environ and "WORLD_SIZE" in os.environ:
+        return
+    for rank_key, size_key in (("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+                               ("PMI_RANK", "PMI_SIZE"),
+                               ("SLURM_PROCID", "SLURM_NTASKS")):
+        if rank_key in os.environ and size_key in os.environ:
+            os.environ.setdefault("RANK", os.environ[rank_key])
+            os.environ.setdefault("WORLD_SIZE", os.environ[size_key])
+            logger.info("discovered scheduler env: RANK=%s WORLD_SIZE=%s (from %s)",
+                        os.environ["RANK"], os.environ["WORLD_SIZE"], rank_key)
+            return
+
+
 def is_initialized() -> bool:
     return _INITIALIZED
 
@@ -148,7 +181,9 @@ def get_rank(group: Any = None) -> int:
 
 
 def get_local_rank() -> int:
-    return 0
+    # LOCAL_RANK is exported by the launcher agent (launcher/launch.py); on
+    # TPU pods the platform runs one process per host, so 0 is correct there.
+    return int(os.environ.get("LOCAL_RANK", 0))
 
 
 def get_world_size(group: Any = None) -> int:

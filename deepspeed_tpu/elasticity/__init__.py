@@ -1,0 +1,6 @@
+"""Elastic training (reference: ``deepspeed/elasticity/``, SURVEY.md §5.3)."""
+
+from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
+    ElasticityConfig, ElasticityConfigError, ElasticityError,
+    ElasticityIncompatibleWorldSize, compute_elastic_config, get_best_candidates,
+    get_valid_gpus)

@@ -1,0 +1,155 @@
+"""Elastic training config math.
+
+Reference: ``deepspeed/elasticity/elasticity.py`` (SURVEY.md §2.1
+"Elasticity", §5.3): *schedule-time* elasticity — given an acceptable maximum
+global batch size and a set of candidate micro-batch sizes, compute a final
+global batch size and the set of device counts at which training can resume
+with that batch size kept invariant (so a restart at a different scale is
+numerically consistent).  Recovery itself is restart-from-checkpoint at the
+new mesh shape (universal checkpoint, SURVEY.md §5.4); this module only does
+the host-side math.
+
+On TPU the "gpu count" is the device count of the mesh's data-parallel
+extent (dp × fsdp × ep).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` config section (reference schema)."""
+
+    def __init__(self, param_dict: Dict):
+        self.enabled = param_dict.get("enabled", False)
+        if "max_train_batch_size" not in param_dict and self.enabled:
+            raise ElasticityConfigError("elasticity requires max_train_batch_size")
+        self.max_acceptable_batch_size = param_dict.get("max_train_batch_size", 0)
+        self.micro_batches = param_dict.get("micro_batch_sizes", [2, 4, 6])
+        if not isinstance(self.micro_batches, list) or not all(
+                isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got {self.micro_batches}")
+        self.min_gpus = param_dict.get("min_gpus", 1)
+        self.max_gpus = param_dict.get("max_gpus", 10_000)
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"invalid gpu range [{self.min_gpus}, {self.max_gpus}]")
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = param_dict.get("version", LATEST_ELASTICITY_VERSION)
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """Device counts g such that batch_size = micro * accum * g exactly for
+    some micro in ``micro_batches`` (accum any positive int)."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        max_gpus = batch_size // micro
+        for g in range(min_valid_gpus, min(max_valid_gpus, max_gpus) + 1):
+            if (batch_size // micro) % g == 0:
+                valid.add(g)
+    return sorted(valid)
+
+
+def get_best_candidates(max_acceptable_batch_size: int, micro_batches: List[int],
+                        min_gpus: int, max_gpus: int, prefer_larger: bool
+                        ) -> Tuple[int, List[int]]:
+    """Pick the batch size <= max that admits the most device counts
+    (tie-break: larger/smaller batch per ``prefer_larger``)."""
+    base = _lcm_list(micro_batches)
+    candidates = list(range(base, max_acceptable_batch_size + 1, base))
+    if not candidates:
+        raise ElasticityConfigError(
+            f"max_train_batch_size {max_acceptable_batch_size} is smaller than "
+            f"the lcm of micro_batch_sizes {micro_batches} ({base})")
+    best_batch, best_gpus = 0, []
+    for b in candidates:
+        gpus = get_valid_gpus(b, micro_batches, min_gpus, max_gpus)
+        better = (len(gpus) > len(best_gpus)
+                  or (len(gpus) == len(best_gpus) and len(gpus) > 0 and prefer_larger))
+        if better:
+            best_batch, best_gpus = b, gpus
+    if not best_gpus:
+        raise ElasticityConfigError(
+            f"no valid device counts in [{min_gpus}, {max_gpus}] for "
+            f"micro_batch_sizes {micro_batches} and max batch "
+            f"{max_acceptable_batch_size}")
+    return best_batch, best_gpus
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Main entry (reference signature): returns
+    ``(final_batch_size, valid_gpus[, micro_batch])`` and — when
+    ``world_size`` > 0 — validates that world_size is one of the valid counts
+    and picks the micro-batch/grad-accum split for it."""
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError("no elasticity section in config")
+    elastic = ElasticityConfig(ds_config["elasticity"])
+    if float(elastic.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"unsupported elasticity version {elastic.version} "
+            f"(latest {LATEST_ELASTICITY_VERSION})")
+    final_batch_size, valid_gpus = get_best_candidates(
+        elastic.max_acceptable_batch_size, elastic.micro_batches,
+        elastic.min_gpus, elastic.max_gpus, elastic.prefer_larger_batch_size)
+    logger.info("elasticity: final global batch %d, valid device counts %s",
+                final_batch_size, valid_gpus)
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in the elastic set {valid_gpus} "
+                f"for batch {final_batch_size}")
+        micro = _best_micro_batch(final_batch_size, elastic.micro_batches,
+                                  world_size, elastic.prefer_larger_batch_size)
+        if return_microbatch:
+            return final_batch_size, valid_gpus, micro
+    if return_microbatch:
+        micro = _best_micro_batch(final_batch_size, elastic.micro_batches,
+                                  valid_gpus[-1], elastic.prefer_larger_batch_size)
+        return final_batch_size, valid_gpus, micro
+    return final_batch_size, valid_gpus
+
+
+def _best_micro_batch(batch: int, micro_batches: List[int], world_size: int,
+                      prefer_larger: bool) -> int:
+    fitting = [m for m in micro_batches
+               if batch % m == 0 and (batch // m) % world_size == 0]
+    if not fitting:
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro batch in {micro_batches} divides batch {batch} at "
+            f"world size {world_size}")
+    return max(fitting) if prefer_larger else min(fitting)
+
+
+def _lcm_list(xs: List[int]) -> int:
+    from math import gcd
+
+    out = 1
+    for x in xs:
+        out = out * x // gcd(out, x)
+    return out

@@ -1,0 +1,88 @@
+"""``ds_report`` — environment / op-build compatibility report.
+
+Reference: ``deepspeed/env_report.py`` (SURVEY.md §2.1 "env report"): prints
+framework versions, device inventory, and the native/Pallas op build matrix so
+users can see at a glance what is installed, compatible, and built.
+
+Run as ``python -m deepspeed_tpu.env_report``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import platform
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+DOTS = "." * 2
+
+
+def _try_version(mod_name: str):
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def op_report():
+    """Native (C++) + Pallas op availability matrix."""
+    rows = []
+    try:
+        from deepspeed_tpu.ops.op_builder.native import available_ops
+        rows.extend(available_ops())
+    except Exception as exc:  # report must never crash
+        rows.append(("op_builder", False, f"error: {exc}"))
+    # Pallas kernels: importable == available (TPU lowering is checked at call
+    # time; interpret mode covers CPU).
+    for name, mod in (("pallas.flash_attention", "deepspeed_tpu.ops.pallas.flash_attention"),
+                      ("pallas.layer_norm", "deepspeed_tpu.ops.pallas.layer_norm"),
+                      ("pallas.fused_adam", "deepspeed_tpu.ops.pallas.fused_adam"),
+                      ("pallas.softmax", "deepspeed_tpu.ops.pallas.softmax"),
+                      ("pallas.rope", "deepspeed_tpu.ops.pallas.rope")):
+        try:
+            importlib.import_module(mod)
+            rows.append((name, True, "importable"))
+        except Exception as exc:
+            rows.append((name, False, str(exc)))
+    return rows
+
+
+def main() -> int:
+    print("-" * 70)
+    print("deepspeed_tpu C++/Pallas op report")
+    print("-" * 70)
+    for name, ok, note in op_report():
+        status = GREEN_OK if ok else RED_NO
+        print(f"{name:<28} {DOTS} {status} {DOTS} {note}")
+
+    print("-" * 70)
+    print("General environment:")
+    print(f"  python ................ {sys.version.split()[0]} ({platform.platform()})")
+    import deepspeed_tpu
+
+    print(f"  deepspeed_tpu ......... {deepspeed_tpu.__version__}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        v = _try_version(mod)
+        print(f"  {mod:<21} {'.' * 1} {v if v else 'not installed'}")
+    print(f"  DS_ACCELERATOR ........ {os.environ.get('DS_ACCELERATOR', '(auto)')}")
+    print(f"  JAX_PLATFORMS ......... {os.environ.get('JAX_PLATFORMS', '(auto)')}")
+
+    # Device inventory last: touching jax initializes the backend.
+    try:
+        import jax
+
+        devs = jax.devices()
+        print(f"  backend ............... {jax.default_backend()}")
+        print(f"  devices ............... {len(devs)} x "
+              f"{getattr(devs[0], 'device_kind', '?')}")
+        print(f"  process count ......... {jax.process_count()}")
+    except Exception as exc:
+        print(f"  devices ............... unavailable ({exc})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

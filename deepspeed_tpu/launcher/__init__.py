@@ -1,0 +1,8 @@
+"""Launcher stack: ``deepspeed`` CLI, per-host agent, multinode transports.
+
+Reference: ``deepspeed/launcher/`` (SURVEY.md §2.1 rows "Launcher CLI",
+"Node launcher", "Multinode runners"; §3.1 call stack).
+"""
+
+from deepspeed_tpu.launcher.runner import (fetch_hostfile, main,  # noqa: F401
+                                           parse_args, parse_inclusion_exclusion)

@@ -1,0 +1,128 @@
+"""Per-host launcher agent.
+
+TPU-native analog of the reference's ``deepspeed/launcher/launch.py``
+(SURVEY.md §2.1 "Node launcher", §3.1): spawns one subprocess per local slot,
+exports the env contract ``comm.init_distributed`` consumes —
+``COORDINATOR_ADDRESS`` (host:port), ``RANK`` (global process id),
+``LOCAL_RANK``, ``WORLD_SIZE`` (total process count) — and supervises the
+children: any child dying propagates SIGTERM to the rest and the agent exits
+with the failing child's code (fail-fast, SURVEY.md §5.3).
+
+On a real TPU pod each process drives its host's chips and jax derives device
+counts itself; WORLD_SIZE here is the *process* world, matching
+``jax.distributed.initialize(num_processes=...)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import OrderedDict
+from typing import List
+
+from deepspeed_tpu.utils.logging import logger
+
+PROCESS_POLL_INTERVAL_S = 0.25
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(prog="deepspeed_tpu.launcher.launch")
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64-encoded {host: [slot ids]} dict")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--no_local_rank", action="store_true")
+    parser.add_argument("--enable_each_rank_log", type=str, default=None)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(encoded: str) -> "OrderedDict[str, List[int]]":
+    return OrderedDict(json.loads(base64.urlsafe_b64decode(encoded.encode())))
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info)
+    if not (0 <= args.node_rank < len(hosts)):
+        raise ValueError(f"node_rank {args.node_rank} out of range for {hosts}")
+    local_slots = world_info[hosts[args.node_rank]]
+    global_rank_offset = sum(len(world_info[h]) for h in hosts[: args.node_rank])
+    world_size = sum(len(s) for s in world_info.values())
+
+    log_dir = args.enable_each_rank_log
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    children: List[subprocess.Popen] = []
+
+    def terminate_all(sig=signal.SIGTERM):
+        for p in children:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except ProcessLookupError:
+                    pass
+
+    def handle_signal(signum, frame):
+        logger.info("launch agent received signal %d; terminating children", signum)
+        terminate_all()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, handle_signal)
+    signal.signal(signal.SIGINT, handle_signal)
+
+    for local_rank, _slot in enumerate(local_slots):
+        global_rank = global_rank_offset + local_rank
+        env = dict(os.environ)
+        env["COORDINATOR_ADDRESS"] = f"{args.master_addr}:{args.master_port}"
+        env["MASTER_ADDR"] = args.master_addr
+        env["MASTER_PORT"] = str(args.master_port)
+        env["RANK"] = str(global_rank)
+        env["LOCAL_RANK"] = str(local_rank)
+        env["WORLD_SIZE"] = str(world_size)
+        env["DS_NODE_RANK"] = str(args.node_rank)
+        env["DS_LOCAL_PROCESS_COUNT"] = str(len(local_slots))
+        cmd = [sys.executable, "-u", args.user_script]
+        if not args.no_local_rank:
+            cmd.append(f"--local_rank={local_rank}")
+        cmd.extend(args.user_args)
+        stdout = stderr = None
+        if log_dir:
+            stdout = open(os.path.join(log_dir, f"rank{global_rank}.out"), "w")
+            stderr = open(os.path.join(log_dir, f"rank{global_rank}.err"), "w")
+        logger.info("launching rank %d (local %d): %s", global_rank, local_rank,
+                    " ".join(cmd))
+        children.append(subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr))
+
+    # Supervise: fail-fast on the first non-zero exit (reference semantics).
+    rc = 0
+    alive = set(range(len(children)))
+    while alive:
+        time.sleep(PROCESS_POLL_INTERVAL_S)
+        for i in sorted(alive):
+            code = children[i].poll()
+            if code is None:
+                continue
+            alive.discard(i)
+            if code != 0:
+                logger.error("rank %d exited with code %d; terminating remaining "
+                             "ranks", global_rank_offset + i, code)
+                terminate_all()
+                for j in sorted(alive):
+                    children[j].wait()
+                return code
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""Multi-node transports for the launcher CLI.
+
+Reference: ``deepspeed/launcher/multinode_runner.py`` (SURVEY.md §2.1
+"Multinode runners") — each runner converts (hostfile resources, agent
+command) into remote launch processes.  The ssh/pdsh runners start the
+per-host agent (``launch.py``) with the right ``--node_rank``; mpirun/srun
+delegate process placement to the scheduler and launch the user script
+directly (ranks discovered from the scheduler env by
+``comm.init_distributed``).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+from typing import Callable, Dict, List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class MultiNodeRunner:
+    name = "base"
+
+    def __init__(self, args, exports: Dict[str, str]):
+        self.args = args
+        self.exports = exports
+
+    def backend_exists(self) -> bool:
+        return True
+
+    def export_cmd(self) -> List[str]:
+        out = []
+        for k, v in sorted(self.exports.items()):
+            out.append(f"export {k}={shlex.quote(v)};")
+        return out
+
+    def launch(self, active_resources, build_launch_command: Callable
+               ) -> List[subprocess.Popen]:
+        raise NotImplementedError
+
+
+class SSHRunner(MultiNodeRunner):
+    """One ssh session per host running the launch agent (default transport;
+    the reference's PDSH runner without the pdsh dependency)."""
+
+    name = "ssh"
+
+    def backend_exists(self) -> bool:
+        return _which("ssh")
+
+    def launch(self, active_resources, build_launch_command):
+        procs = []
+        for node_rank, host in enumerate(active_resources):
+            agent_cmd = build_launch_command(self.args, active_resources, node_rank)
+            remote = " ".join(self.export_cmd()
+                              + [f"cd {shlex.quote(os.getcwd())};"]
+                              + [shlex.quote(c) for c in agent_cmd])
+            if host in ("localhost", "127.0.0.1"):
+                cmd = ["bash", "-c", remote]
+            else:
+                cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+            logger.info("ssh launch [%s]: %s", host, remote)
+            procs.append(subprocess.Popen(cmd))
+        return procs
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fan-out (reference default).  Runs the agent on every host in one
+    pdsh invocation; node_rank is derived on each host from %n."""
+
+    name = "pdsh"
+
+    def backend_exists(self) -> bool:
+        return _which("pdsh")
+
+    def launch(self, active_resources, build_launch_command):
+        hosts = ",".join(active_resources)
+        env = {**os.environ, "PDSH_RCMD_TYPE": "ssh"}
+        procs = []
+        for node_rank, host in enumerate(active_resources):
+            agent_cmd = build_launch_command(self.args, active_resources, node_rank)
+            remote = " ".join(self.export_cmd()
+                              + [f"cd {shlex.quote(os.getcwd())};"]
+                              + [shlex.quote(c) for c in agent_cmd])
+            cmd = ["pdsh", "-S", "-w", host] + (
+                shlex.split(self.args.launcher_args) if self.args.launcher_args else []
+            ) + [remote]
+            logger.info("pdsh launch [%s]", host)
+            procs.append(subprocess.Popen(cmd, env=env))
+        _ = hosts
+        return procs
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun placement: one rank per slot; ranks read OMPI_COMM_WORLD_RANK /
+    OMPI_COMM_WORLD_SIZE (honored by ``comm.init_distributed``)."""
+
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        return _which("mpirun")
+
+    def launch(self, active_resources, build_launch_command):
+        total = sum(len(s) for s in active_resources.values())
+        hostlist = ",".join(f"{h}:{len(s)}" for h, s in active_resources.items())
+        cmd = ["mpirun", "-n", str(total), "--host", hostlist,
+               "--allow-run-as-root"]
+        for k, v in sorted(self.exports.items()):
+            cmd += ["-x", f"{k}={v}"]
+        cmd += ["-x", f"MASTER_ADDR={self.args.master_addr}",
+                "-x", f"MASTER_PORT={self.args.master_port}",
+                "-x", "DS_AUTO_MPI_DISCOVERY=1"]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        cmd += [sys.executable, "-u", self.args.user_script] + self.args.user_args
+        logger.info("mpirun launch: %s", " ".join(cmd))
+        return [subprocess.Popen(cmd)]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun placement: ranks read SLURM_PROCID / SLURM_NTASKS."""
+
+    name = "slurm"
+
+    def backend_exists(self) -> bool:
+        return _which("srun")
+
+    def launch(self, active_resources, build_launch_command):
+        total = sum(len(s) for s in active_resources.values())
+        cmd = ["srun", "-n", str(total)]
+        if self.args.include:
+            cmd += ["--include", self.args.include]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        env = {**os.environ, **self.exports,
+               "MASTER_ADDR": self.args.master_addr,
+               "MASTER_PORT": str(self.args.master_port),
+               "DS_AUTO_MPI_DISCOVERY": "1"}
+        cmd += [sys.executable, "-u", self.args.user_script] + self.args.user_args
+        logger.info("srun launch: %s", " ".join(cmd))
+        return [subprocess.Popen(cmd, env=env)]
+
+
+class IMPIRunner(OpenMPIRunner):
+    name = "impi"
+
+    def backend_exists(self) -> bool:
+        return _which("mpiexec")
+
+
+_RUNNERS = {r.name: r for r in
+            (SSHRunner, PDSHRunner, OpenMPIRunner, SlurmRunner, IMPIRunner)}
+
+
+def get_runner(name: str, args, exports: Dict[str, str]) -> MultiNodeRunner:
+    cls = _RUNNERS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown launcher {name!r}; choices: {sorted(_RUNNERS)}")
+    runner = cls(args, exports)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {name!r} not found on PATH")
+    return runner
+
+
+def _which(prog: str) -> bool:
+    from shutil import which
+
+    return which(prog) is not None

@@ -1,0 +1,240 @@
+"""``deepspeed`` CLI — cluster entry point.
+
+TPU-native analog of the reference's ``deepspeed/launcher/runner.py``
+(SURVEY.md §2.1 "Launcher CLI", §3.1): same UX — hostfile with ``slots=N``
+syntax, ``--include``/``--exclude`` resource filters, ``--num_nodes``/
+``--num_procs`` limits — but the per-process env contract it produces is the
+one ``deepspeed_tpu.comm.init_distributed`` consumes
+(``COORDINATOR_ADDRESS``/``RANK``/``WORLD_SIZE``), feeding
+``jax.distributed.initialize`` instead of a torch ProcessGroup.
+
+Single node: spawns the per-host agent (``launch.py``) directly.  Multi node:
+builds one agent command per host and dispatches via a multinode runner
+(ssh/pdsh/mpirun/srun — ``multinode_runner.py``).  On real TPU pods the usual
+path is one process per host launched by the platform (GKE/queued resources),
+where jax self-discovers the coordinator; this CLI covers the
+reference-parity manual path and CPU/dev clusters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ("PYTHON", "PATH", "LD_LIBRARY", "JAX_", "XLA_", "TPU_", "DS_",
+               "LIBTPU_", "HF_", "NCCL_")  # prefixes forwarded to remote hosts
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        prog="deepspeed",
+        description="deepspeed_tpu distributed launcher (reference-parity CLI)")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile with lines '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='Resources to include, e.g. "host1@host2:0,2"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help='Resources to exclude, e.g. "host1:1"')
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Limit the run to the first N nodes")
+    parser.add_argument("--num_gpus", "--num_procs", dest="num_procs", type=int,
+                        default=-1, help="Processes per node (reference: GPUs)")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="Coordinator address (default: first host / localhost)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "openmpi", "slurm", "impi"],
+                        help="Multi-node transport")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="Extra flags for the multi-node transport")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="Treat the run as multi-node even for one host")
+    parser.add_argument("--no_local_rank", action="store_true",
+                        help="Do not append --local_rank to the user script")
+    parser.add_argument("--save_pid", action="store_true",
+                        help="Write a PID file for this launcher")
+    parser.add_argument("--enable_each_rank_log", type=str, default=None,
+                        help="Directory for per-rank stdout/stderr logs")
+    parser.add_argument("user_script", type=str, help="User training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> "OrderedDict[str, int]":
+    """Parse '<hostname> slots=<n>' lines (reference hostfile format)."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    if not os.path.isfile(hostfile_path):
+        return resources
+    with open(hostfile_path) as fh:
+        for raw in fh:
+            line = raw.split("#")[0].strip()
+            if not line:
+                continue
+            try:
+                host, slots = line.split()
+                key, count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(f"expected 'slots=<n>', got {slots!r}")
+                resources[host] = int(count)
+            except ValueError as exc:
+                raise ValueError(f"Hostfile ({hostfile_path}) has a malformed "
+                                 f"line: {raw!r}") from exc
+    return resources
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """Parse 'host1@host2:0,2' → {host1: None, host2: [0, 2]} (None = all)."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in filter(None, spec.split("@")):
+        if ":" in part:
+            host, slot_str = part.split(":")
+            out[host.strip()] = sorted(int(s) for s in slot_str.split(",") if s)
+        else:
+            out[part.strip()] = None
+    return out
+
+
+def parse_inclusion_exclusion(resource_pool: "OrderedDict[str, int]",
+                              inclusion: str, exclusion: str
+                              ) -> "OrderedDict[str, List[int]]":
+    """Apply --include/--exclude to the hostfile pool → {host: [slot ids]}.
+
+    Reference semantics: include and exclude are mutually exclusive; a filter
+    naming a host without slots means the whole host.
+    """
+    if inclusion and exclusion:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    active: "OrderedDict[str, List[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in resource_pool.items())
+    if inclusion:
+        filt = _parse_filter(inclusion)
+        picked: "OrderedDict[str, List[int]]" = OrderedDict()
+        for host, slots in filt.items():
+            if host not in active:
+                raise ValueError(f"--include host {host} not in hostfile")
+            use = active[host] if slots is None else slots
+            bad = set(use) - set(active[host])
+            if bad:
+                raise ValueError(f"--include slots {sorted(bad)} not available on {host}")
+            picked[host] = sorted(use)
+        return picked
+    if exclusion:
+        filt = _parse_filter(exclusion)
+        for host, slots in filt.items():
+            if host not in active:
+                raise ValueError(f"--exclude host {host} not in hostfile")
+            if slots is None:
+                del active[host]
+            else:
+                remaining = [s for s in active[host] if s not in set(slots)]
+                if remaining:
+                    active[host] = remaining
+                else:
+                    del active[host]
+    return active
+
+
+def encode_world_info(active_resources: "OrderedDict[str, List[int]]") -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(active_resources).encode()).decode()
+
+
+def _load_persistent_env(path: str = DEEPSPEED_ENVIRONMENT_NAME) -> Dict[str, str]:
+    """Read KEY=VALUE lines from .deepspeed_env (reference env passthrough)."""
+    env: Dict[str, str] = {}
+    for base in (os.getcwd(), os.path.expanduser("~")):
+        p = os.path.join(base, path)
+        if os.path.isfile(p):
+            with open(p) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line and not line.startswith("#") and "=" in line:
+                        k, v = line.split("=", 1)
+                        env[k] = v
+            break
+    return env
+
+
+def exported_env() -> Dict[str, str]:
+    """Env vars forwarded to launched processes: allow-listed prefixes +
+    .deepspeed_env contents."""
+    env = {k: v for k, v in os.environ.items()
+           if any(k.startswith(p) for p in EXPORT_ENVS)}
+    env.update(_load_persistent_env())
+    return env
+
+
+def build_launch_command(args, active_resources: "OrderedDict[str, List[int]]",
+                         node_rank: int = 0) -> List[str]:
+    """Per-host agent command (launch.py) for a given node rank."""
+    world_info = encode_world_info(active_resources)
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           f"--world_info={world_info}",
+           f"--node_rank={node_rank}",
+           f"--master_addr={args.master_addr}",
+           f"--master_port={args.master_port}"]
+    if args.no_local_rank:
+        cmd.append("--no_local_rank")
+    if args.enable_each_rank_log:
+        cmd.append(f"--enable_each_rank_log={args.enable_each_rank_log}")
+    cmd.append(args.user_script)
+    cmd.extend(args.user_args)
+    return cmd
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+    if not resource_pool:
+        # No hostfile: single-node run with the local processor count.
+        nproc = args.num_procs if args.num_procs > 0 else 1
+        resource_pool = OrderedDict([("localhost", nproc)])
+    active = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[: args.num_nodes])
+    if args.num_procs > 0:
+        active = OrderedDict((h, s[: args.num_procs]) for h, s in active.items())
+    if not active:
+        raise ValueError("no resources left after applying filters")
+    if not args.master_addr:
+        first = next(iter(active))
+        args.master_addr = "127.0.0.1" if first in ("localhost", "127.0.0.1") else first
+    logger.info("launcher: %d node(s), world size %d, coordinator %s:%d",
+                len(active), sum(len(s) for s in active.values()),
+                args.master_addr, args.master_port)
+
+    multi_node = args.force_multi or len(active) > 1
+    if args.save_pid:
+        with open(f"/tmp/ds_launcher.{os.getpid()}.pid", "w") as fh:
+            fh.write(str(os.getpid()))
+    if not multi_node:
+        cmd = build_launch_command(args, active, node_rank=0)
+        logger.info("cmd = %s", " ".join(shlex.quote(c) for c in cmd))
+        env = {**os.environ, **exported_env()}
+        result = subprocess.run(cmd, env=env)
+        return result.returncode
+
+    from deepspeed_tpu.launcher.multinode_runner import get_runner
+
+    runner = get_runner(args.launcher, args, exported_env())
+    procs = runner.launch(active, build_launch_command)
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

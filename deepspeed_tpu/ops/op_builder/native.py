@@ -95,6 +95,23 @@ class CPUAdamBuilder(NativeOpBuilder):
         return lib
 
 
+def available_ops():
+    """(name, compatible, note) rows for every native builder — the data
+    behind ``ds_report`` (reference: op compatibility matrix in
+    env_report.py)."""
+    rows = []
+    for cls in (CPUAdamBuilder, AsyncIOBuilder):
+        b = cls()
+        built = os.path.exists(b.lib_path())
+        try:
+            ok = b.is_compatible()
+            note = ("prebuilt" if built else "jit-built") if ok else "build failed"
+        except Exception as exc:  # pragma: no cover
+            ok, note = False, str(exc)
+        rows.append((f"native.{cls.NAME}", ok, note))
+    return rows
+
+
 class AsyncIOBuilder(NativeOpBuilder):
     NAME = "aio"
     SOURCES = ["csrc/aio/ds_aio.cpp"]

@@ -213,6 +213,12 @@ class DeepSpeedEngine:
             # transformation is identity — all update math runs on host.
             import optax
 
+            if self.client_optimizer is not None:
+                logger.warning(
+                    "offload_optimizer is enabled: the supplied client "
+                    "optimizer (%s) is ignored; states will be stepped by "
+                    "DeepSpeedCPUAdam on the host",
+                    type(self.client_optimizer).__name__)
             opt_type = (self.config.optimizer.type if self.config.optimizer
                         else "AdamW").lower()
             if "adam" not in opt_type:
@@ -514,10 +520,12 @@ class DeepSpeedEngine:
             grads_flat = [np.asarray(g) for g in
                           jax.tree_util.tree_leaves(jax.device_get(grads))]
             lr = self.get_lr()[0]
-            self._offload_opt.step([g.reshape(-1) for g in grads_flat], lr=lr)
+            masters = self._offload_opt.step([g.reshape(-1) for g in grads_flat], lr=lr)
             np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
                         jnp.float16: np.float16}.get(self.compute_dtype, np.float32)
-            master = self._offload_opt.master_tree()
+            # step() already returns the updated masters; rebuilding the tree
+            # from them avoids a second full read of every NVMe state file.
+            master = self._offload_opt.tree_from_masters(masters)
             compute = jax.tree.map(lambda a: a.astype(np_dtype), master)
             new_params = jax.device_put(compute, self._param_shardings)
         else:

@@ -166,6 +166,10 @@ class OffloadedOptimizer:
 
     def master_tree(self) -> Any:
         """fp32 masters reassembled into the param pytree (host)."""
-        masters = self.masters()
-        leaves = [m.reshape(s) for m, s in zip(masters, self._shapes)]
+        return self.tree_from_masters(self.masters())
+
+    def tree_from_masters(self, masters: List[np.ndarray]) -> Any:
+        """Reassemble flat master arrays (e.g. the list ``step`` returns) into
+        the param pytree without re-reading state from the backing store."""
+        leaves = [np.asarray(m).reshape(s) for m, s in zip(masters, self._shapes)]
         return jax.tree_util.tree_unflatten(self._treedef, leaves)

@@ -1,0 +1,77 @@
+"""Elasticity tests (reference: tests/unit/elasticity/, SURVEY.md §5.3)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config, get_valid_gpus)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_gpus": 1,
+        "max_gpus": 10000,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_valid_gpus_basic():
+    # batch 24, micro 2 -> accum*g divides 12; micro 4 -> 6; micro 6 -> 4
+    gpus = get_valid_gpus(24, [2, 4, 6], 1, 100)
+    assert 1 in gpus and 2 in gpus and 12 in gpus
+    assert all(24 % g == 0 or any(24 % (m * g) == 0 for m in (2, 4, 6))
+               for g in gpus)
+
+
+def test_compute_elastic_config():
+    final_batch, valid_gpus = compute_elastic_config(BASE)
+    assert final_batch <= 2000
+    assert len(valid_gpus) > 1
+    # batch invariance: every valid gpu count evenly factors the batch
+    for g in valid_gpus:
+        assert any(final_batch % (m * g) == 0
+                   for m in BASE["elasticity"]["micro_batch_sizes"])
+
+
+def test_world_size_validation():
+    final_batch, valid_gpus, micro = compute_elastic_config(
+        BASE, world_size=valid_world(), return_microbatch=True)
+    assert micro in BASE["elasticity"]["micro_batch_sizes"]
+    assert final_batch % (micro * valid_world()) == 0
+
+
+def valid_world():
+    _, valid_gpus = compute_elastic_config(BASE)
+    return valid_gpus[0]
+
+
+def test_incompatible_world_size():
+    cfg = {"elasticity": dict(BASE["elasticity"], micro_batch_sizes=[8],
+                              max_train_batch_size=64)}
+    _, valid = compute_elastic_config(cfg)
+    bad = max(valid) + 1
+    while bad in valid:
+        bad += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=bad)
+
+
+def test_missing_section():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({})
+
+
+def test_bad_version():
+    cfg = {"elasticity": dict(BASE["elasticity"], version=9.9)}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)
+
+
+def test_bad_micro_batches():
+    cfg = {"elasticity": dict(BASE["elasticity"], micro_batch_sizes=[0, -2])}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)

@@ -5,7 +5,7 @@ where MFU is lost (transformer stack vs cross-entropy head vs attention
 kernel), and sweeps the knobs VERDICT r2 flagged: CE chunk size, vocab
 padding, micro-batch, attention impl.
 
-Usage: python tools/perf_sweep.py [--steps 8] [--part all|pieces|sweep]
+Usage: python tools/perf_sweep.py [--steps 8] [--part all|pieces|sweep|remat]
 """
 
 from __future__ import annotations
@@ -44,7 +44,7 @@ def model_flops_per_token(cfg, n_params, seq):
 
 
 def run_variant(name, micro=16, seq=1024, vocab=50257, ce_chunk=None, steps=8,
-                impl=None, remat=None):
+                impl=None, remat=None, remat_policy=None):
     mesh = build_mesh(devices=jax.devices()[:1])
     set_global_mesh(mesh)
     over = dict(vocab_size=vocab)
@@ -52,6 +52,8 @@ def run_variant(name, micro=16, seq=1024, vocab=50257, ce_chunk=None, steps=8,
         over["ce_chunk"] = ce_chunk
     if remat is not None:
         over["remat"] = remat
+    if remat_policy is not None:
+        over["remat_policy"] = remat_policy
     model = causal_lm("gpt2-small", mesh=mesh, **over)
     cfg = model.config
     rng = jax.random.PRNGKey(0)
@@ -142,10 +144,56 @@ def run_pieces(micro=16, seq=1024, vocab=50257, steps=8):
     print(f"  stack (12L)   dt={dt1*1e3:7.2f}ms eff~={stack_flops/dt1/PEAK:.3f}")
 
 
+def run_kernels(steps=16):
+    """Microbench the Pallas kernels vs MXU/HBM ideals (bench shapes)."""
+    import numpy as np
+
+    B, H, S, Dh, D = 16, 12, 1024, 64, 768
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (B, H, S, Dh), jnp.bfloat16)
+    k = jax.random.normal(rng, (B, H, S, Dh), jnp.bfloat16)
+    v = jax.random.normal(rng, (B, H, S, Dh), jnp.bfloat16)
+
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    fwd = lambda q, k, v: flash_attention(q, k, v, causal=True).sum()
+    dt = bench_fn(fwd, (q, k, v), steps=steps)
+    flops = 2 * B * H * S * S * Dh * 2 / 2  # qk + av, causal-halved
+    print(f"flash fwd      dt={dt*1e3:7.2f}ms eff={flops/dt/PEAK:.3f}")
+    g = jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True)
+                 .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+    dt = bench_fn(g, (q, k, v), steps=steps)
+    print(f"flash fwd+bwd  dt={dt*1e3:7.2f}ms eff={3.5*flops/dt/PEAK:.3f}")
+
+    from deepspeed_tpu.ops.pallas.layer_norm import layer_norm
+
+    x = jax.random.normal(rng, (B * S, D), jnp.bfloat16)
+    w = jnp.ones((D,), jnp.float32)
+    b = jnp.zeros((D,), jnp.float32)
+    dt = bench_fn(lambda x: layer_norm(x, w, b).sum(), (x,), steps=steps)
+    gb = 2 * x.size * 2 / 1e9  # read+write bf16
+    print(f"layernorm fwd  dt={dt*1e3:7.2f}ms bw={gb/dt:.0f}GB/s")
+    gln = jax.grad(lambda x: layer_norm(x, w, b).astype(jnp.float32).sum())
+    dt = bench_fn(gln, (x,), steps=steps)
+    print(f"layernorm bwd  dt={dt*1e3:7.2f}ms bw={2*gb/dt:.0f}GB/s")
+
+    # plain matmul at layer shapes for the MXU ceiling
+    a = jax.random.normal(rng, (B * S, D), jnp.bfloat16)
+    w1 = jax.random.normal(rng, (D, 4 * D), jnp.bfloat16)
+    dt = bench_fn(lambda a, w1: (a @ w1).sum(), (a, w1), steps=steps)
+    mf = 2 * B * S * D * 4 * D
+    print(f"matmul 768x3072 fwd dt={dt*1e3:7.2f}ms eff={mf/dt/PEAK:.3f}")
+    gmm = jax.grad(lambda a, w1: (a @ w1).astype(jnp.float32).sum(), argnums=(0, 1))
+    dt = bench_fn(gmm, (a, w1), steps=steps)
+    print(f"matmul 768x3072 f+b dt={dt*1e3:7.2f}ms eff={3*mf/dt/PEAK:.3f}")
+    _ = np
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=8)
-    ap.add_argument("--part", default="all")
+    ap.add_argument("--part", default="all",
+                    choices=("all", "pieces", "sweep", "remat", "kernels"))
     args = ap.parse_args()
     if args.part in ("all", "pieces"):
         print("== pieces (vocab 50257) ==")
@@ -159,9 +207,18 @@ def main():
         run_variant("v=50304 chunk=4096 m=16", vocab=50304, ce_chunk=4096, steps=args.steps)
         run_variant("v=50304 chunk=8192 m=16", vocab=50304, ce_chunk=8192, steps=args.steps)
         run_variant("v=50304 dense-ce m=16", vocab=50304, ce_chunk=0, steps=args.steps)
-        run_variant("v=50304 chunk=auto m=32", vocab=50304, micro=32, steps=args.steps)
         run_variant("v=50304 chunk=auto m=8", vocab=50304, micro=8, steps=args.steps)
-        run_variant("v=50257 xla-attn m=16", impl="xla", steps=args.steps)
+    if args.part in ("all", "kernels"):
+        print("== kernels ==")
+        run_kernels()
+    if args.part in ("all", "remat"):
+        run_variant("v=50304 remat=off m=16", vocab=50304, remat=False, steps=args.steps)
+        run_variant("v=50304 remat=off dense-ce m=16", vocab=50304, remat=False,
+                    ce_chunk=0, steps=args.steps)
+        run_variant("v=50304 remat=dots m=16", vocab=50304, remat=True,
+                    remat_policy="dots", steps=args.steps)
+        run_variant("v=50304 remat=off m=24", vocab=50304, remat=False, micro=24,
+                    steps=args.steps)
 
 
 if __name__ == "__main__":
