@@ -52,20 +52,28 @@ class DeepSpeedCheckpoint:
         return int(self.meta.get("world_size", 1))
 
     def load_params(self) -> Any:
-        from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
-
-        return MsgpackCheckpointEngine().load(
-            os.path.join(self.path, "model_states.msgpack"))
+        return self._load_payload("model_states")
 
     def load_optim(self) -> Optional[Any]:
         """Optimizer-state dict (``opt_state`` + step bookkeeping) or None for
         a params-only checkpoint."""
-        from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+        return self._load_payload("optim_states", optional=True)
 
-        path = os.path.join(self.path, "optim_states.msgpack")
-        if not os.path.exists(path):
-            return None
-        return MsgpackCheckpointEngine().load(path)
+    def _load_payload(self, name: str, optional: bool = False):
+        from deepspeed_tpu.runtime.checkpoint_engine import (MsgpackCheckpointEngine,
+                                                             ShardedCheckpointEngine,
+                                                             is_sharded_checkpoint)
+        from deepspeed_tpu.runtime.checkpoint_engine.sharded import nest_keystrs
+
+        sharded = os.path.join(self.path, name)
+        if is_sharded_checkpoint(sharded):
+            return nest_keystrs(ShardedCheckpointEngine().load(sharded))
+        legacy = os.path.join(self.path, name + ".msgpack")
+        if not os.path.exists(legacy):
+            if optional:
+                return None
+            raise FileNotFoundError(f"no {name} payload in {self.path}")
+        return MsgpackCheckpointEngine().load(legacy)
 
 
 def ds_to_universal(input_dir: str, output_dir: str, tag: Optional[str] = None,

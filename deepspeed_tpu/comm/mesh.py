@@ -105,8 +105,12 @@ def data_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(a for a in ("dp", "fsdp", "ep") if axis_size(mesh, a) > 1) or ("dp",)
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for a [global_batch, ...] input batch."""
+def batch_sharding(mesh: Mesh, stacked: bool = False) -> NamedSharding:
+    """Sharding for a [global_batch, ...] input batch (``stacked=True``:
+    [grad_accum, micro_batch, ...] — the accumulation axis is a scan axis,
+    only the micro dim is split over the data axes)."""
+    if stacked:
+        return NamedSharding(mesh, P(None, data_axes(mesh)))
     return NamedSharding(mesh, P(data_axes(mesh)))
 
 

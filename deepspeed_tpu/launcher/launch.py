@@ -53,6 +53,21 @@ def main(args=None) -> int:
     args = parse_args(args)
     world_info = decode_world_info(args.world_info)
     hosts = list(world_info)
+    if args.node_rank < 0:
+        # pdsh mode: every host runs the same command line; resolve our rank
+        # from the local hostname against the world_info mapping
+        import socket
+
+        hostname = socket.gethostname()
+        candidates = [i for i, h in enumerate(hosts)
+                      if h == hostname or h == hostname.split(".")[0]
+                      or hostname.startswith(h)]
+        if not candidates:
+            raise ValueError(f"cannot resolve node_rank: hostname {hostname!r} "
+                             f"not in world_info hosts {hosts}")
+        args.node_rank = candidates[0]
+        logger.info("resolved node_rank=%d from hostname %s", args.node_rank,
+                    hostname)
     if not (0 <= args.node_rank < len(hosts)):
         raise ValueError(f"node_rank {args.node_rank} out of range for {hosts}")
     local_slots = world_info[hosts[args.node_rank]]

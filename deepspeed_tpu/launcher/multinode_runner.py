@@ -67,8 +67,10 @@ class SSHRunner(MultiNodeRunner):
 
 
 class PDSHRunner(MultiNodeRunner):
-    """pdsh fan-out (reference default).  Runs the agent on every host in one
-    pdsh invocation; node_rank is derived on each host from %n."""
+    """pdsh fan-out (reference default): ONE pdsh invocation covering every
+    host.  The agent command uses ``--node_rank=-1``, which makes
+    ``launch.py`` resolve its own rank from the local hostname against the
+    world_info mapping (every host runs the identical command line)."""
 
     name = "pdsh"
 
@@ -76,21 +78,16 @@ class PDSHRunner(MultiNodeRunner):
         return _which("pdsh")
 
     def launch(self, active_resources, build_launch_command):
-        hosts = ",".join(active_resources)
         env = {**os.environ, "PDSH_RCMD_TYPE": "ssh"}
-        procs = []
-        for node_rank, host in enumerate(active_resources):
-            agent_cmd = build_launch_command(self.args, active_resources, node_rank)
-            remote = " ".join(self.export_cmd()
-                              + [f"cd {shlex.quote(os.getcwd())};"]
-                              + [shlex.quote(c) for c in agent_cmd])
-            cmd = ["pdsh", "-S", "-w", host] + (
-                shlex.split(self.args.launcher_args) if self.args.launcher_args else []
-            ) + [remote]
-            logger.info("pdsh launch [%s]", host)
-            procs.append(subprocess.Popen(cmd, env=env))
-        _ = hosts
-        return procs
+        agent_cmd = build_launch_command(self.args, active_resources, node_rank=-1)
+        remote = " ".join(self.export_cmd()
+                          + [f"cd {shlex.quote(os.getcwd())};"]
+                          + [shlex.quote(c) for c in agent_cmd])
+        cmd = ["pdsh", "-S", "-w", ",".join(active_resources)] + (
+            shlex.split(self.args.launcher_args) if self.args.launcher_args else []
+        ) + [remote]
+        logger.info("pdsh launch: %s", " ".join(cmd[:5]))
+        return [subprocess.Popen(cmd, env=env)]
 
 
 class OpenMPIRunner(MultiNodeRunner):
@@ -102,10 +99,12 @@ class OpenMPIRunner(MultiNodeRunner):
     def backend_exists(self) -> bool:
         return _which("mpirun")
 
+    MPI_BIN = "mpirun"
+
     def launch(self, active_resources, build_launch_command):
         total = sum(len(s) for s in active_resources.values())
         hostlist = ",".join(f"{h}:{len(s)}" for h, s in active_resources.items())
-        cmd = ["mpirun", "-n", str(total), "--host", hostlist,
+        cmd = [self.MPI_BIN, "-n", str(total), "--host", hostlist,
                "--allow-run-as-root"]
         for k, v in sorted(self.exports.items()):
             cmd += ["-x", f"{k}={v}"]
@@ -129,9 +128,9 @@ class SlurmRunner(MultiNodeRunner):
 
     def launch(self, active_resources, build_launch_command):
         total = sum(len(s) for s in active_resources.values())
-        cmd = ["srun", "-n", str(total)]
-        if self.args.include:
-            cmd += ["--include", self.args.include]
+        # the include/exclude filters were already applied to
+        # active_resources; srun gets the resulting nodelist
+        cmd = ["srun", "-n", str(total), "-w", ",".join(active_resources)]
         if self.args.launcher_args:
             cmd += shlex.split(self.args.launcher_args)
         env = {**os.environ, **self.exports,
@@ -145,6 +144,7 @@ class SlurmRunner(MultiNodeRunner):
 
 class IMPIRunner(OpenMPIRunner):
     name = "impi"
+    MPI_BIN = "mpiexec"
 
     def backend_exists(self) -> bool:
         return _which("mpiexec")

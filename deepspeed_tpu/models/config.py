@@ -51,7 +51,12 @@ class ModelConfig:
     # ~1/3 extra FLOPs, so it must be opted into when the model doesn't fit,
     # not paid by default. Large presets below turn it on.
     remat: Optional[bool] = None
-    remat_policy: str = "full"             # "full" | "dots" (save matmul outputs)
+    remat_policy: str = "full"  # "full" | "dots" | "mlp_only" | "mlp_dots"
+    # ZeRO-Infinity parameter tiering (engine sets this from ds_config
+    # offload_param): params live in host memory; the forward streams each
+    # scanned layer's weights to the device on demand, so device-resident
+    # param bytes are O(one layer), not O(model).
+    param_offload: bool = False
     scan_layers: bool = True               # lax.scan over stacked layer params
     z_loss: float = 0.0
     # Cross-entropy chunking (tokens per block; the [chunk, V] logits block is

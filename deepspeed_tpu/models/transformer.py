@@ -55,6 +55,12 @@ class CausalLM:
     def mesh(self) -> Optional[Mesh]:
         return self._mesh if self._mesh is not None else get_global_mesh(create_default=False)
 
+    def set_param_offload_specs(self, specs) -> None:
+        """Engine hook: runtime PartitionSpecs for the param tree, needed so
+        the per-layer host->device streaming moves carry explicit shardings
+        (ZeRO-Infinity param tiering)."""
+        self._offload_specs = specs
+
     # ------------------------------------------------------------------
     # parameters
     # ------------------------------------------------------------------
@@ -162,13 +168,11 @@ class CausalLM:
     # ------------------------------------------------------------------
     # forward
     # ------------------------------------------------------------------
-    def _layer(self, lp, x, key, cos, sin, batch_ax, use_drop):
+    def _attn_block(self, lp, x, k_attn, cos, sin, batch_ax, use_drop):
         cfg = self.config
         mesh = self.mesh
         B, S, D = x.shape
         H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-        k_attn, k_mlp = (jax.random.split(key) if use_drop else (None, None))
-
         h = norm(x, lp["attn_norm"], cfg.norm, cfg.norm_eps)
         q = (h @ lp["attn"]["wq"]).reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
         k = (h @ lp["attn"]["wk"]).reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
@@ -184,8 +188,11 @@ class CausalLM:
         if use_drop:
             o = _dropout(o, k_attn, cfg.dropout)
         x = x + o
-        x = constrain(x, mesh, batch_ax, "sp", None)
+        return constrain(x, mesh, batch_ax, "sp", None)
 
+    def _mlp_block(self, lp, x, k_mlp, batch_ax, use_drop):
+        cfg = self.config
+        mesh = self.mesh
         h = norm(x, lp["mlp_norm"], cfg.norm, cfg.norm_eps)
         if cfg.is_moe:
             from deepspeed_tpu.moe.sharded_moe import moe_mlp
@@ -200,13 +207,47 @@ class CausalLM:
         if use_drop:
             mlp_out = _dropout(mlp_out, k_mlp, cfg.dropout)
         x = x + mlp_out
-        x = constrain(x, mesh, batch_ax, "sp", None)
-        return x, aux
+        return constrain(x, mesh, batch_ax, "sp", None), aux
+
+    def _layer(self, lp, x, key, cos, sin, batch_ax, use_drop):
+        k_attn, k_mlp = (jax.random.split(key) if use_drop else (None, None))
+        x = self._attn_block(lp, x, k_attn, cos, sin, batch_ax, use_drop)
+        return self._mlp_block(lp, x, k_mlp, batch_ax, use_drop)
 
     def apply(self, params, tokens, labels=None, rngs=None, loss_mask=None):
         cfg = self.config
         mesh = self.mesh
         batch_ax = ("dp", "fsdp", "ep")
+        if cfg.param_offload:
+            # ZeRO-Infinity param tiering: non-layer params come over once
+            # here; scanned layer weights stream per-layer inside the scan
+            # body (bounded device window; XLA's latency-hiding scheduler
+            # overlaps the copies with the previous layer's compute).  The
+            # engine injects the runtime PartitionSpecs (set_param_offload_specs)
+            # because the SPMD partitioner requires memory-space moves to
+            # carry explicit shardings on multi-device meshes.
+            specs = getattr(self, "_offload_specs", None)
+
+            def to_dev(t, spec_t):
+                def put(a, s):
+                    if s is None or mesh is None or mesh.empty:
+                        return jax.device_put(a, jax.memory.Space.Device)
+                    from jax.sharding import NamedSharding
+                    return jax.device_put(
+                        a, NamedSharding(mesh, s, memory_kind="device"))
+                if spec_t is None:
+                    return jax.tree.map(lambda a: put(a, None), t)
+                return jax.tree.map(put, t, spec_t)
+
+            self._offload_to_dev = to_dev
+            params = {**params,
+                      "embed": to_dev(params["embed"],
+                                      specs["embed"] if specs else None),
+                      "final_norm": to_dev(params["final_norm"],
+                                           specs["final_norm"] if specs else None)}
+            if "lm_head" in params:
+                params["lm_head"] = to_dev(params["lm_head"],
+                                           specs["lm_head"] if specs else None)
         tokens = constrain(tokens, mesh, batch_ax, "sp")
         x = jnp.take(params["embed"]["tok"], tokens, axis=0)
         if cfg.position == "learned":
@@ -230,14 +271,43 @@ class CausalLM:
         if cfg.remat:
             # "dots" saves matmul outputs and recomputes only the cheap
             # elementwise chain — a middle point between full remat (+1/3
-            # FLOPs) and no remat (full activation residency).
-            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                      if cfg.remat_policy == "dots" else None)
-            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+            # FLOPs) and no remat (full activation residency).  Measured on
+            # v5e: also saving the flash-attention output does NOT pay — the
+            # custom_vjp still recomputes its forward for the lse residual,
+            # so the extra residency only adds memory pressure.
+            # "mlp_only" leaves the attention sub-block out of the remat
+            # region entirely (its residuals persist; the flash kernel never
+            # re-runs) and fully remats the MLP half — the fastest policy on
+            # v5e when activations fit.
+            if cfg.remat_policy in ("mlp_only", "mlp_dots"):
+                mlp_policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                              if cfg.remat_policy == "mlp_dots" else None)
+
+                def body(lp, x, key, _self=self):
+                    k_attn, k_mlp = (jax.random.split(key) if use_drop
+                                     else (None, None))
+                    x = _self._attn_block(lp, x, k_attn, cos, sin, batch_ax,
+                                          use_drop)
+                    mlp = jax.checkpoint(
+                        functools.partial(_self._mlp_block, batch_ax=batch_ax,
+                                          use_drop=use_drop),
+                        prevent_cse=False, policy=mlp_policy)
+                    return mlp(lp, x, k_mlp)
+            else:
+                policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                          if cfg.remat_policy == "dots" else None)
+                body = jax.checkpoint(body, prevent_cse=False, policy=policy)
         pp = axis_size(mesh, "pp") if mesh is not None and not mesh.empty else 1
+
+        if cfg.param_offload:
+            specs = getattr(self, "_offload_specs", None)
+            layer_specs = (jax.tree.map(lambda s: P(*tuple(s)[1:]),
+                                        specs["layers"]) if specs else None)
 
         def scan_body(carry, xs):
             lp, key = xs
+            if cfg.param_offload:  # stream this layer's weights to device
+                lp = self._offload_to_dev(lp, layer_specs)
             y, aux = body(lp, carry, key)
             return y, aux
 
@@ -262,6 +332,12 @@ class CausalLM:
             aux_loss = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
                 lp = jax.tree.map(lambda a: a[i], params["layers"])
+                if cfg.param_offload:
+                    lspecs = (jax.tree.map(lambda s: P(*tuple(s)[1:]),
+                                           getattr(self, "_offload_specs",
+                                                   {}).get("layers"))
+                              if getattr(self, "_offload_specs", None) else None)
+                    lp = self._offload_to_dev(lp, lspecs)
                 x, aux = body(lp, x, keys[i])
                 aux_loss = aux_loss + aux
 

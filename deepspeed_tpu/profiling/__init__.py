@@ -1,0 +1,4 @@
+"""Profiling subsystem (reference: ``deepspeed/profiling/``, SURVEY.md §5.1):
+the FLOPS profiler built on XLA cost analysis lives in ``flops_profiler``."""
+
+from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler, get_model_profile  # noqa: F401

@@ -1,0 +1,2 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (  # noqa: F401
+    FlopsProfiler, get_model_profile)

@@ -1,12 +1,16 @@
 """Pluggable checkpoint backends (reference:
 ``deepspeed/runtime/checkpoint_engine/``, SURVEY.md §2.1 "Checkpoint engine").
 
-The default backend serializes the state pytree with flax msgpack (gathering
-sharded arrays to host); the sharded tensorstore/OCDBT backend for large
-models lives in ``deepspeed_tpu/checkpoint/`` (SURVEY.md §5.4 TPU note).
+``ShardedCheckpointEngine`` is the default: per-process shard files + JSON
+index, streamed writes, resharding reads (the multi-host-safe
+tensorstore/OCDBT shape of SURVEY.md §5.4).  ``MsgpackCheckpointEngine``
+remains for small single-file payloads (inference exports, tools).
 """
 
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (CheckpointEngine,
                                                                        MsgpackCheckpointEngine)
+from deepspeed_tpu.runtime.checkpoint_engine.sharded import (ShardedCheckpointEngine,
+                                                             is_sharded_checkpoint)
 
-__all__ = ["CheckpointEngine", "MsgpackCheckpointEngine"]
+__all__ = ["CheckpointEngine", "MsgpackCheckpointEngine",
+           "ShardedCheckpointEngine", "is_sharded_checkpoint"]

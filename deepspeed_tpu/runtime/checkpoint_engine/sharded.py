@@ -1,0 +1,256 @@
+"""Sharded, multi-host-safe checkpoint engine.
+
+Reference: ``deepspeed/runtime/checkpoint_engine/`` + the per-rank
+``*_zero_pp_rank_*`` shard files of the reference layout (SURVEY.md §5.4).
+The TPU-native design is the tensorstore/OCDBT shape the survey prescribes,
+dependency-free:
+
+- **Each process writes only its addressable shards** — no full gather, ever.
+  A leaf's bytes land in the writing process's ``shard_p{N}.bin``; replica
+  deduplication keeps exactly one copy of every global element
+  (``replica_id == 0``).
+- **A JSON index per process** (``index_p{N}.json``) records, per pytree
+  leaf: global shape, dtype, and the chunks (global slice -> file, offset,
+  nbytes).  The checkpoint is the union of all (bin, index) pairs.
+- **Streaming**: shards are copied device->host and written one at a time;
+  peak host memory is O(largest shard), not O(model).
+- **Resharding load**: ``load`` assembles any requested slice from the
+  recorded chunks, so a checkpoint saved on one mesh/ZeRO stage loads on any
+  other (``jax.make_array_from_callback`` with the target sharding — each
+  device reads only the byte ranges it needs).
+
+Scalars/ints and non-jax leaves are written by process 0 only (they are
+replicated by construction).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.utils.logging import logger
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _keystr(kp) -> str:
+    return jax.tree_util.keystr(kp)
+
+
+def _norm_index(idx, shape) -> List[List[int]]:
+    """Slice tuple -> [[start, stop], ...] with Nones resolved."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+class ShardedCheckpointEngine(CheckpointEngine):
+    """Per-process shard files + JSON index; resharding reads."""
+
+    def __init__(self, config_params: Any = None):
+        super().__init__(config_params)
+        self.max_bytes_in_flight = 0  # peak single host buffer, for tests
+
+    # ------------------------------------------------------------------
+    # save
+    # ------------------------------------------------------------------
+    def save(self, state_dict: Any, path: str) -> None:
+        proc = jax.process_index()
+        os.makedirs(path, exist_ok=True)
+        flat = jax.tree_util.tree_flatten_with_path(state_dict)[0]
+        index: Dict[str, Any] = {}
+        bin_name = f"shard_p{proc}.bin"
+        bin_path = os.path.join(path, bin_name)
+        offset = 0
+        with open(bin_path + ".tmp", "wb") as fh:
+            for kp, leaf in flat:
+                key = _keystr(kp)
+                chunks = []
+                if isinstance(leaf, jax.Array):
+                    shape = tuple(leaf.shape)
+                    dtype = str(np.dtype(leaf.dtype))
+                    for shard in leaf.addressable_shards:
+                        if shard.replica_id != 0:
+                            continue
+                        data = np.asarray(shard.data)  # ONE shard on host
+                        self.max_bytes_in_flight = max(self.max_bytes_in_flight,
+                                                       data.nbytes)
+                        fh.write(data.tobytes())
+                        chunks.append({"index": _norm_index(shard.index, shape),
+                                       "file": bin_name, "offset": offset,
+                                       "nbytes": int(data.nbytes)})
+                        offset += data.nbytes
+                else:
+                    arr = np.asarray(leaf)
+                    shape, dtype = tuple(arr.shape), str(arr.dtype)
+                    if proc == 0:  # replicated host value: one writer
+                        self.max_bytes_in_flight = max(self.max_bytes_in_flight,
+                                                       arr.nbytes)
+                        fh.write(np.ascontiguousarray(arr).tobytes())
+                        chunks.append({"index": [[0, d] for d in shape],
+                                       "file": bin_name, "offset": offset,
+                                       "nbytes": int(arr.nbytes)})
+                        offset += arr.nbytes
+                index[key] = {"shape": list(shape), "dtype": dtype,
+                              "chunks": chunks}
+        os.replace(bin_path + ".tmp", bin_path)
+        idx_path = os.path.join(path, f"index_p{proc}.json")
+        with open(idx_path + ".tmp", "w") as fh:
+            json.dump(index, fh)
+        os.replace(idx_path + ".tmp", idx_path)
+
+    # ------------------------------------------------------------------
+    # load
+    # ------------------------------------------------------------------
+    @staticmethod
+    def read_index(path: str) -> Dict[str, Any]:
+        """Union of all per-process indexes (chunk lists concatenate)."""
+        merged: Dict[str, Any] = {}
+        names = sorted(n for n in os.listdir(path)
+                       if n.startswith("index_p") and n.endswith(".json"))
+        if not names:
+            raise FileNotFoundError(f"no index_p*.json in {path}")
+        for name in names:
+            with open(os.path.join(path, name)) as fh:
+                part = json.load(fh)
+            for key, meta in part.items():
+                if key in merged:
+                    merged[key]["chunks"].extend(meta["chunks"])
+                else:
+                    merged[key] = meta
+        return merged
+
+    @staticmethod
+    def _read_region(path: str, meta: Dict[str, Any], region: List[List[int]]
+                     ) -> np.ndarray:
+        """Assemble one global region from the stored chunks (reads only
+        intersecting byte ranges via memmap)."""
+        dtype = _np_dtype(meta["dtype"])
+        shape = tuple(b - a for a, b in region)
+        out = np.zeros(shape, dtype)
+        covered = 0
+        for ch in meta["chunks"]:
+            cidx = ch["index"]
+            inter = [(max(a0, b0), min(a1, b1))
+                     for (a0, a1), (b0, b1) in zip(cidx, region)]
+            if any(lo >= hi for lo, hi in inter):
+                continue
+            cshape = tuple(b - a for a, b in cidx)
+            mm = np.memmap(os.path.join(path, ch["file"]), dtype=dtype,
+                           mode="r", offset=ch["offset"],
+                           shape=cshape if cshape else (1,))
+            src = tuple(slice(lo - a, hi - a)
+                        for (lo, hi), (a, _) in zip(inter, cidx))
+            dst = tuple(slice(lo - b, hi - b)
+                        for (lo, hi), (b, _) in zip(inter, region))
+            if cshape:
+                out[dst] = mm[src]
+            else:
+                out = mm[0].copy().reshape(())
+            covered += int(np.prod([hi - lo for lo, hi in inter])) if cshape else 1
+        want = int(np.prod(shape)) if shape else 1
+        if covered < want:
+            raise ValueError(f"checkpoint region under-covered: have {covered} "
+                             f"of {want} elements (missing shard files?)")
+        return out
+
+    def load(self, path: str, target: Any = None, shardings: Any = None) -> Any:
+        """Load a sharded checkpoint directory.
+
+        - ``shardings`` (pytree of NamedSharding, same structure as saved):
+          builds jax Arrays where each device reads only its slice.
+        - ``target`` (pytree of array-likes): returns numpy leaves shaped
+          like target (full assembly), preserving target structure.
+        - neither: flat {keystr: ndarray} dict.
+        """
+        index = self.read_index(path)
+
+        def full(key, meta):
+            region = [[0, d] for d in meta["shape"]]
+            return self._read_region(path, meta, region)
+
+        if shardings is not None:
+            flat, treedef = jax.tree_util.tree_flatten_with_path(shardings)
+            leaves = []
+            for kp, sh in flat:
+                key = _keystr(kp)
+                if key not in index:
+                    raise KeyError(f"checkpoint {path} missing leaf {key}")
+                meta = index[key]
+                shape = tuple(meta["shape"])
+                dtype = _np_dtype(meta["dtype"])
+
+                def cb(idx, meta=meta, shape=shape, dtype=dtype):
+                    region = _norm_index(idx, shape)
+                    out = self._read_region(path, meta, region)
+                    # NB: ascontiguousarray would promote 0-d to (1,)
+                    return out if out.flags["C_CONTIGUOUS"] else np.ascontiguousarray(out)
+
+                leaves.append(jax.make_array_from_callback(
+                    shape, sh, cb, dtype=dtype))
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        if target is not None:
+            flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+            leaves = []
+            for kp, tgt in flat:
+                key = _keystr(kp)
+                if key not in index:
+                    raise KeyError(f"checkpoint {path} missing leaf {key}")
+                arr = full(key, index[key])
+                leaves.append(arr)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        return {key: full(key, meta) for key, meta in index.items()}
+
+
+def is_sharded_checkpoint(path: str) -> bool:
+    return os.path.isdir(path) and any(
+        n.startswith("index_p") for n in os.listdir(path))
+
+
+_KEY_SEG = __import__("re").compile(
+    r"\[<flat index (\d+)>\]|\[(?:'([^']*)'|(\d+))\]|\.([A-Za-z_]\w*)")
+
+
+def nest_keystrs(flat: Dict[str, Any]) -> Dict[Any, Any]:
+    """{"['a'][0].count": v} -> {"a": {0: {"count": v}}}.
+
+    Handles every jax keystr segment form: DictKey ``['k']``, SequenceKey
+    ``[0]``, GetAttrKey ``.name`` (namedtuples in optimizer states), and
+    FlattenedIndexKey ``[<flat index 0>]``.  Tools (zero_to_fp32, universal
+    checkpoint) use this to re-nest the flat index keys into a pytree-shaped
+    dict without knowing the original treedef."""
+    out: Dict[Any, Any] = {}
+    for key, val in flat.items():
+        segs: List[Any] = []
+        for m in _KEY_SEG.finditer(key):
+            flat_idx, dkey, seq_idx, attr = m.groups()
+            if flat_idx is not None:
+                segs.append(int(flat_idx))
+            elif dkey is not None:
+                segs.append(dkey)
+            elif seq_idx is not None:
+                segs.append(int(seq_idx))
+            else:
+                segs.append(attr)
+        if not segs:
+            segs = [key]
+        cur = out
+        for s in segs[:-1]:
+            cur = cur.setdefault(s, {})
+        cur[segs[-1]] = val
+    return out

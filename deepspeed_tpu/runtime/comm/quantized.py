@@ -1,0 +1,168 @@
+"""Quantized / compressed collectives over named mesh axes.
+
+Reference: ``deepspeed/runtime/comm/nccl.py`` (cupy sign-compressed
+allreduce with error feedback for the 1-bit optimizers) and the ZeRO++
+quantized collectives (``quantized_gradients``/qgZ all-to-all; SURVEY.md
+§2.1 rows 26-27, PAPERS.md EQuARX).  TPU-native design: the compression
+math is jnp (VPU-friendly bit packing), the transport is XLA collectives
+(``all_to_all``/``all_gather``) over a named axis inside ``shard_map`` —
+ICI carries int8/uint8 payloads instead of bf16/fp32.
+
+All functions are *in-manual-region* primitives: call them inside a
+``shard_map`` body with the axis name.  Comm volume is recorded through the
+``comm`` façade so CommsLogger can assert the reduction.
+
+- ``block_quantize`` / ``block_dequantize``: per-block absmax int8.
+- ``quantized_all_gather``: int8 payload + fp32 scales, dequantize after.
+- ``quantized_reduce_scatter``: qgZ shape — quantize once, all_to_all the
+  int8 blocks, dequantize + reduce locally in fp32 (one quantization error
+  per element, not log(P)).
+- ``compressed_allreduce``: 1-bit sign compression with error feedback,
+  the exact two-phase (worker -> server -> worker) scheme of the
+  reference's NcclBackend.compressed_allreduce, signs bit-packed 8/byte.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.comm import comm as comm_api
+
+DEFAULT_BLOCK = 256
+
+
+def _pad_to(x: jnp.ndarray, multiple: int) -> Tuple[jnp.ndarray, int]:
+    n = x.size
+    pad = (-n) % multiple
+    flat = x.reshape(-1)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
+    return flat, pad
+
+
+def block_quantize(x, block: int = DEFAULT_BLOCK):
+    """Per-block symmetric absmax int8 quantization.
+
+    Returns (q int8 [nblocks, block], scale fp32 [nblocks, 1], pad).
+    """
+    flat, pad = _pad_to(x.astype(jnp.float32), block)
+    blocks = flat.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+    return q, scale, pad
+
+
+def block_dequantize(q, scale, pad: int, shape, dtype=jnp.float32):
+    out = (q.astype(jnp.float32) * scale).reshape(-1)
+    if pad:
+        out = out[: out.size - pad]
+    return out.reshape(shape).astype(dtype)
+
+
+def pack_signs(x) -> jnp.ndarray:
+    """fp tensor -> uint8 bitmap (1 bit/element, 8 elements/byte).
+    Sign convention: bit=1 for x >= 0."""
+    flat, _ = _pad_to(x, 8)
+    bits = (flat.reshape(-1, 8) >= 0).astype(jnp.uint8)
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    return (bits * weights).sum(axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed, n: int) -> jnp.ndarray:
+    """uint8 bitmap -> {-1, +1} fp32 of length n."""
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    bits = (packed[:, None] & weights) > 0
+    signs = jnp.where(bits, 1.0, -1.0).reshape(-1)[:n]
+    return signs.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# in-shard_map collectives
+# ---------------------------------------------------------------------------
+
+def quantized_all_gather(x, axis: str, block: int = DEFAULT_BLOCK):
+    """All-gather with int8 payload: each rank contributes its (quantized)
+    local x; result is the dequantized concatenation along dim 0."""
+    q, scale, pad = block_quantize(x, block)
+    comm_api.comms_logger.record("q_all_gather", axis, q)
+    qg = lax.all_gather(q, axis, axis=0, tiled=False)       # [P, nb, block]
+    sg = lax.all_gather(scale, axis, axis=0, tiled=False)   # [P, nb, 1]
+    P = qg.shape[0]
+    parts = (qg.astype(jnp.float32) * sg).reshape(P, -1)
+    if pad:
+        parts = parts[:, : parts.shape[1] - pad]
+    return parts.reshape((P * x.shape[0],) + x.shape[1:]).astype(x.dtype)
+
+
+def quantized_reduce_scatter(x, axis: str, block: int = DEFAULT_BLOCK):
+    """Reduce-scatter with int8 transport (qgZ shape): quantize the local
+    tensor once, all_to_all the int8 shards, dequantize and sum in fp32.
+
+    ``x``: full local tensor, leading dim divisible by the axis size.
+    Returns this rank's reduced shard (x.shape[0] // P leading dim).
+    """
+    import functools as _ft
+    import numpy as _np
+
+    P = lax.axis_size(axis)
+    shard = x.shape[0] // P
+    shard_elems = shard * int(_np.prod(x.shape[1:])) if x.ndim > 1 else shard
+    xs = x.reshape(P, shard_elems)
+    # quantize each destination shard separately so blocks never span shard
+    # boundaries and scales travel with their blocks
+    q, scale, _ = jax.vmap(_ft.partial(block_quantize, block=block))(xs)
+    comm_api.comms_logger.record("q_reduce_scatter", axis, q)
+    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    st = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
+    parts = (qt.astype(jnp.float32) * st).sum(axis=0)       # [nb, block]
+    flat = parts.reshape(-1)[:shard_elems]
+    return flat.reshape((shard,) + x.shape[1:]).astype(x.dtype)
+
+
+def compressed_allreduce(x, error, server_error, axis: str):
+    """1-bit sign-compressed allreduce with two-level error feedback
+    (reference: NcclBackend.compressed_allreduce).
+
+    x: local fp tensor; error/server_error: this rank's feedback buffers
+    (same shape as x / x.size//P).  Returns (averaged tensor, new_error,
+    new_server_error).  Transport: uint8 bitmaps (1 bit/element) + one fp32
+    scale per rank-chunk, via all_to_all + all_gather.
+    """
+    P = lax.axis_size(axis)
+    shape = x.shape
+    n = x.size
+    chunk = -(-n // P)  # ceil; pad so chunks are equal
+    compensated = x.astype(jnp.float32) + error.astype(jnp.float32)
+    flat, _ = _pad_to(compensated, P * 8)
+    chunk = flat.size // P
+    # worker compression: per-chunk L1 scale * sign
+    chunks = flat.reshape(P, chunk)
+    scale_w = jnp.mean(jnp.abs(chunks), axis=-1, keepdims=True)      # [P, 1]
+    signs_w = jnp.where(chunks >= 0, 1.0, -1.0)
+    new_error = (flat - (scale_w * signs_w).reshape(-1))[:n].reshape(shape)
+    packed = jax.vmap(pack_signs)(chunks)                            # [P, chunk//8]
+    comm_api.comms_logger.record("compressed_allreduce", axis, packed)
+    # exchange: rank r receives chunk r from every rank
+    recv = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                               # [P, chunk//8]
+    recv_scale = lax.all_to_all(scale_w, axis, split_axis=0, concat_axis=0,
+                                tiled=False)                         # [P, 1]
+    decoded = jax.vmap(lambda p: unpack_signs(p, chunk))(recv)       # [P, chunk]
+    avg = (decoded * recv_scale).mean(axis=0)                        # [chunk]
+    # server compression of the averaged chunk, with server error feedback
+    avg_comp = avg + server_error.astype(jnp.float32)
+    scale_s = jnp.mean(jnp.abs(avg_comp))
+    signs_s = jnp.where(avg_comp >= 0, 1.0, -1.0)
+    new_server_error = avg_comp - scale_s * signs_s
+    packed_s = pack_signs(avg_comp)[None]                            # [1, chunk//8]
+    comm_api.comms_logger.record("compressed_allgather", axis, packed_s)
+    gathered = lax.all_gather(packed_s[0], axis, axis=0, tiled=False)  # [P, chunk//8]
+    gathered_scale = lax.all_gather(scale_s, axis, axis=0)           # [P]
+    out = (jax.vmap(lambda p: unpack_signs(p, chunk))(gathered)
+           * gathered_scale[:, None]).reshape(-1)[:n]
+    return out.reshape(shape).astype(x.dtype), new_error, new_server_error

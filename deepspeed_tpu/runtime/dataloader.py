@@ -20,13 +20,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deepspeed_tpu.comm.mesh import batch_sharding, get_global_mesh
 
 
-def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
+def shard_batch(batch: Any, mesh: Optional[Mesh] = None, stacked: bool = False) -> Any:
     """Place a (possibly nested) host batch onto the mesh, splitting the
-    leading dim over the data axes."""
+    leading dim over the data axes (``stacked=True``: leaves carry a
+    [gas, micro, ...] accumulation axis first; the micro dim is split)."""
     mesh = mesh or get_global_mesh()
-    sharding = batch_sharding(mesh)
+    sharding = batch_sharding(mesh, stacked=stacked)
 
     def put(x):
+        if isinstance(x, jax.Array) and jax.process_count() == 1:
+            # already on device: resharding device-to-device, no host hop
+            return jax.device_put(x, sharding)
         x = np.asarray(x)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils

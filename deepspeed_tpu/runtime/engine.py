@@ -40,7 +40,8 @@ from deepspeed_tpu import comm
 from deepspeed_tpu.comm.mesh import batch_sharding, get_global_mesh, mesh_from_config
 from deepspeed_tpu.monitor.monitor import MonitorMaster
 from deepspeed_tpu.runtime import optimizer as opt_builder
-from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine import (MsgpackCheckpointEngine,
+                                                     ShardedCheckpointEngine)
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, shard_batch
 from deepspeed_tpu.runtime.fp16 import loss_scaler as scaler_lib
@@ -110,19 +111,67 @@ class DeepSpeedEngine:
                      + (f" ({off_cfg.nvme_path})" if self._offload_device == "nvme"
                         else ""), ranks=[0])
         p_off = self.config.zero_config.offload_param
-        if p_off is not None and p_off.device in ("cpu", "nvme"):
-            # fp32 masters are host-resident whenever offload_optimizer is on;
-            # per-layer streaming of compute params is not implemented yet.
-            logger.warning(
-                "offload_param.device=%s: fp32 master params are host-resident "
-                "(device keeps one compute-dtype copy); per-layer param "
-                "streaming is not implemented", p_off.device)
+        self._param_offload = p_off is not None and p_off.device in ("cpu", "nvme")
+        if self._param_offload:
+            # ZeRO-Infinity parameter tiering: compute-dtype params live in
+            # pinned host memory; the model streams each scanned layer to the
+            # device on demand (bounded window).  Implies host-resident
+            # optimizer states (reference: offload_param requires
+            # offload_optimizer in practice).
+            if not self._offload:
+                self._offload = True
+                self._offload_device = p_off.device
+            if self.config.fp16_enabled:
+                raise ValueError("offload_param does not support fp16 loss "
+                                 "scaling; use bf16 (TPU-native) instead")
+            # NOTE: validated end-to-end on the CPU mesh and in small
+            # real-TPU programs; the remote-tunnel TPU runtime in this
+            # environment intermittently faults on programs with many
+            # concurrent pinned-host DMA streams (runtime bug, reproduced
+            # with minimal non-framework programs too) — on direct-attached
+            # TPU VMs the standard memories API path below is the supported
+            # configuration.
+            log_dist(f"ZeRO-Infinity: params tiered to {p_off.device} "
+                     "(per-layer device streaming)", ranks=[0])
+        # 1-bit optimizers (reference: fp16/onebit/): need per-worker local
+        # gradients, so the engine runs accum/apply under full-manual
+        # shard_map over the data axes.  Like the reference, incompatible
+        # with ZeRO >= 2, fp16 loss scaling, and model parallelism.
+        _opt_name = (self.config.optimizer.type.lower().replace("_", "").replace("-", "")
+                     if self.config.optimizer else "")
+        self._onebit = (_opt_name in ("onebitadam", "zerooneadam", "onebitlamb")
+                        and not self._offload)
+        if self._onebit:
+            if self.zero_stage >= 2:
+                raise ValueError("1-bit optimizers do not support ZeRO stage >= 2 "
+                                 "(reference constraint)")
+            if self.fp16_enabled:
+                raise ValueError("1-bit optimizers require bf16/fp32 (no fp16 "
+                                 "loss scaling)")
+            bad = [a for a in ("tp", "sp", "pp") if self.mesh.shape.get(a, 1) > 1]
+            if bad:
+                raise ValueError(f"1-bit optimizers do not support model "
+                                 f"parallelism (axes {bad} > 1)")
+            if _opt_name == "zerooneadam":
+                logger.warning("ZeroOneAdam: approximated with the 1-bit Adam "
+                               "schedule (local-step variant not implemented)")
+            log_dist(f"1-bit optimizer active: {self.config.optimizer.type} "
+                     f"(compressed momentum exchange after freeze_step)", ranks=[0])
         self.gradient_accumulation_steps = lambda: self.config.gradient_accumulation_steps
         self.train_batch_size = lambda: self.config.train_batch_size
         self.train_micro_batch_size_per_gpu = lambda: self.config.train_micro_batch_size_per_gpu
 
         self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         self._apply_activation_checkpointing_config(model)
+        if self._param_offload:
+            mcfg = getattr(model, "config", None)
+            if mcfg is not None and hasattr(mcfg, "param_offload"):
+                mcfg.param_offload = True
+            else:
+                logger.warning(
+                    "offload_param: model %s does not expose a param_offload "
+                    "hook; params stay host-resident but the model will not "
+                    "stream them per-layer", type(model).__name__)
         self._loss_fn = loss_fn or self._make_loss_fn(model)
         if param_pspecs is None and hasattr(model, "logical_pspecs"):
             # Built-in models publish their tensor/expert-parallel layout
@@ -144,8 +193,15 @@ class DeepSpeedEngine:
         self.lr_scheduler = None
         self._build_optimizer()
 
-        self.checkpoint_engine = MsgpackCheckpointEngine(self.config.checkpoint_config)
+        self.checkpoint_engine = ShardedCheckpointEngine(self.config.checkpoint_config)
         self.monitor = MonitorMaster(self.config)
+        self.flops_profiler = None
+        self._profile_probes = {}
+        if self.config.flops_profiler.enabled:
+            from deepspeed_tpu.profiling import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(ds_engine=self)
+            self.flops_profiler.start_profile()
         self.timers = SynchronizedWallClockTimer(synchronize=self.config.wall_clock_breakdown)
         self.tput_timer = ThroughputTimer(batch_size=self.config.train_batch_size)
         self.training_dataloader = None
@@ -207,6 +263,17 @@ class DeepSpeedEngine:
                                                 self.config.scheduler.params)
         elif callable(self.client_lr_scheduler):
             self._lr_schedule = self.client_lr_scheduler
+        if self._onebit:
+            from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_from_config
+
+            waxes = ("dp", "fsdp", "ep")
+            world = int(np.prod([self.mesh.shape.get(a, 1) for a in waxes]))
+            self.optimizer = onebit_from_config(
+                self.config.optimizer.type, dict(self.config.optimizer.params),
+                world=world, axis_names=waxes)
+            self.lr_scheduler = (LRSchedulerShim(self._lr_schedule)
+                                 if self._lr_schedule is not None else None)
+            return
         if self._offload:
             # The reference swaps in DeepSpeedCPUAdam when offload is active
             # (SURVEY.md §3.2 _configure_optimizer); the device-side
@@ -246,16 +313,41 @@ class DeepSpeedEngine:
                                           persistence_threshold=persist,
                                           logical_specs=self._client_param_pspecs)
         self._param_shardings = shardings_from_pspecs(self._param_specs, mesh)
-        opt_shapes = jax.eval_shape(self.optimizer.init, params)
-        self._opt_specs = opt_state_pspecs(opt_shapes, mesh, shard=self.zero_stage >= 1)
+        if self._onebit:
+            self._opt_specs = self._onebit_opt_specs(params)
+        else:
+            opt_shapes = jax.eval_shape(self.optimizer.init, params)
+            self._opt_specs = opt_state_pspecs(opt_shapes, mesh, shard=self.zero_stage >= 1)
         self._opt_shardings = shardings_from_pspecs(self._opt_specs, mesh)
         # Gradient accumulator: sharded from stage 2 up (reduce-scatter), or
         # like params under stage 3 (grads of sharded params are sharded).
         acc_shard = self.zero_stage >= 2
-        self._acc_specs = params_pspecs(params, mesh, shard=acc_shard,
-                                        persistence_threshold=0 if acc_shard else persist,
-                                        logical_specs=self._client_param_pspecs)
+        if self._onebit:
+            # per-worker local grad accumulators, stacked on a leading [W] axis
+            waxes = ("dp", "fsdp", "ep")
+            self._acc_specs = jax.tree.map(
+                lambda p: P(waxes, *([None] * getattr(p, "ndim", 0))), params)
+        else:
+            self._acc_specs = params_pspecs(params, mesh, shard=acc_shard,
+                                            persistence_threshold=0 if acc_shard else persist,
+                                            logical_specs=self._client_param_pspecs)
         self._acc_shardings = shardings_from_pspecs(self._acc_specs, mesh)
+        if self._param_offload:
+            if hasattr(self.module, "set_param_offload_specs"):
+                self.module.set_param_offload_specs(self._param_specs)
+            # params live in pinned host memory (streamed per-layer by the
+            # model); gradients exit the program on device (XLA's SPMD
+            # partitioner cannot yet emit host-placed outputs on multi-device
+            # meshes) and are copied straight into numpy accumulators — the
+            # only transient device-resident [model]-sized buffer is the grad
+            # output at the program boundary.
+            self._param_dev_shardings = self._param_shardings
+            self._param_shardings = jax.tree.map(
+                lambda s: NamedSharding(s.mesh, s.spec, memory_kind="pinned_host"),
+                self._param_shardings)
+            self._acc_specs = ()
+            self._acc_shardings = ()
+            self._host_grad_acc = None
         scalar_sh = NamedSharding(mesh, P())
         self._state_shardings = TrainState(
             params=self._param_shardings, opt_state=self._opt_shardings,
@@ -276,13 +368,30 @@ class DeepSpeedEngine:
                     lambda x: x.astype(cdtype)
                     if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
 
-            params = jax.jit(to_compute, out_shardings=self._param_shardings)(params)
+            if self._param_offload:
+                # cast on device, then hop to pinned host outside jit (the
+                # SPMD partitioner rejects host-placed jit outputs on
+                # multi-device meshes)
+                params = jax.jit(to_compute,
+                                 out_shardings=self._param_dev_shardings)(params)
+                params = jax.device_put(params, self._param_shardings)
+            else:
+                params = jax.jit(to_compute, out_shardings=self._param_shardings)(params)
         else:
             params = jax.jit(lambda p: p, out_shardings=self._param_shardings)(params)
         opt_state = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)(params)
-        grad_acc = jax.jit(
-            lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, self._acc_dtype(x.dtype)), p),
-            out_shardings=self._acc_shardings)(params)
+        if self._param_offload:
+            grad_acc = ()
+        elif self._onebit:
+            W = self.optimizer.world
+            grad_acc = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.zeros((W,) + x.shape, jnp.float32), p),
+                out_shardings=self._acc_shardings)(params)
+        else:
+            grad_acc = jax.jit(
+                lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, self._acc_dtype(x.dtype)), p),
+                out_shardings=self._acc_shardings)(params)
         self.state = TrainState(params=params, opt_state=opt_state, grad_acc=grad_acc,
                                 global_steps=jnp.zeros((), jnp.int32),
                                 scaler=scaler_lib.make_state(self.config.fp16))
@@ -295,6 +404,19 @@ class DeepSpeedEngine:
 
     def _acc_dtype(self, param_dtype):
         return jnp.float32
+
+    def _onebit_opt_specs(self, params):
+        """PartitionSpecs for OneBitState: moments/count replicated; the
+        error-feedback buffers carry a leading per-worker axis."""
+        from deepspeed_tpu.runtime.fp16.onebit.adam import OneBitState
+
+        waxes = ("dp", "fsdp", "ep")
+        rep = jax.tree.map(lambda p: P(), params)
+        stacked = jax.tree.map(
+            lambda p: P(waxes, *([None] * getattr(p, "ndim", 0))), params)
+        serr = jax.tree.map(lambda p: P(waxes, None), params)
+        return OneBitState(exp_avg=rep, exp_avg_sq=jax.tree.map(lambda p: P(), params),
+                           error=stacked, server_error=serr, count=P())
 
     def _build_offload_optimizer(self, params) -> None:
         from deepspeed_tpu.runtime.zero.offload import OffloadedOptimizer
@@ -420,10 +542,58 @@ class DeepSpeedEngine:
                     state.global_steps + (1 - overflow.astype(jnp.int32)),
                     new_scaler)
 
+        def fused(state: TrainState, batches, rng):
+            """Full optimizer step in ONE XLA program: scan the gas
+            micro-batches (grad accumulation), then apply the update.  One
+            host dispatch instead of gas+1 — the dispatch latency matters on
+            remote-device transports, and a single program lets XLA overlap
+            the update's collectives with the last microbatch's compute."""
+            rngs = jax.random.split(rng, gas)
+
+            def micro(st, xs):
+                b, r = xs
+                st, loss = accum(st, b, r)
+                return st, loss
+
+            state, losses = jax.lax.scan(micro, state, (batches, rngs))
+            state, gnorm, overflow = apply(state)
+            return state, losses.mean(), gnorm, overflow
+
         sh = self._state_shardings
         bs = batch_sharding(self.mesh)
+        scalar = NamedSharding(self.mesh, P())
+        self._fused_fn = None
+        if self._param_offload:
+            # Params in pinned host memory; grads land host-resident with the
+            # same layout (no device [model]-sized buffers).  Accumulation
+            # happens in numpy; the host optimizer consumes it directly.
+            def fwdbwd(params, batch, rng):
+                def f(p):
+                    return loss_fn(cast_params(p), batch, rng).astype(jnp.float32) / gas
+
+                loss, grads = jax.value_and_grad(f)(params)
+                return loss * gas, grads
+
+            # No explicit in/out shardings: params arrive committed to pinned
+            # host; grads/loss default to device.  Forcing placements here
+            # makes jax emit sharding-less annotate_device_placement custom
+            # calls that the SPMD partitioner rejects on multi-device meshes.
+            self._pofwdbwd_fn = jax.jit(fwdbwd)
+            self._accum_fn = None
+            self._apply_fn = None
+            self._eval_fn = jax.jit(evaluate)
+            return
+        if self._onebit:
+            self._compile_onebit_steps(loss_fn, cast_params, gas)
+            self._eval_fn = jax.jit(evaluate, in_shardings=(self._param_shardings, None, None),
+                                    out_shardings=scalar)
+            return
         self._accum_fn = jax.jit(accum, donate_argnums=(0,), in_shardings=(sh, None, None),
                                  out_shardings=(sh, NamedSharding(self.mesh, P())))
+        if not self._offload:
+            self._fused_fn = jax.jit(
+                fused, donate_argnums=(0,), in_shardings=(sh, None, None),
+                out_shardings=(sh, scalar, scalar, scalar))
         if self._offload:
             self._offload_prep_fn = jax.jit(offload_prep, in_shardings=(sh,))
             self._offload_commit_fn = jax.jit(
@@ -437,6 +607,66 @@ class DeepSpeedEngine:
                                                     NamedSharding(self.mesh, P())))
         self._eval_fn = jax.jit(evaluate, in_shardings=(self._param_shardings, None, None),
                                 out_shardings=NamedSharding(self.mesh, P()))
+
+    def _compile_onebit_steps(self, loss_fn, cast_params, gas) -> None:
+        """Accum/apply under full-manual shard_map over the data axes: each
+        worker keeps LOCAL gradients (no implicit psum), which is what the
+        1-bit compression algorithm is defined over (reference:
+        fp16/onebit/adam.py + runtime/comm/nccl.py)."""
+        import functools
+
+        mesh = self.mesh
+        waxes = ("dp", "fsdp", "ep")
+        onebit = self.optimizer
+        lr_schedule = self._lr_schedule
+        base_lr = (self.config.optimizer.params.get("lr", 1e-3)
+                   if self.config.optimizer else 1e-3)
+        if self.config.gradient_clipping:
+            logger.warning("gradient_clipping is ignored by the 1-bit "
+                           "optimizer path (clipping local grads would break "
+                           "error feedback; reference behavior)")
+        state_specs = TrainState(
+            params=jax.tree.map(lambda s: s.spec, self._param_shardings),
+            opt_state=self._opt_specs,
+            grad_acc=self._acc_specs,
+            global_steps=P(),
+            scaler=scaler_lib.LossScaleState(P(), P(), P(), P()))
+        bspec = P(waxes)
+
+        def accum_local(state: TrainState, batch, rng):
+            def f(p):
+                return loss_fn(cast_params(p), batch, rng).astype(jnp.float32) / gas
+
+            loss, grads = jax.value_and_grad(f)(state.params)
+            new_acc = jax.tree.map(lambda a, g: a + g[None].astype(a.dtype),
+                                   state.grad_acc, grads)
+            return (state._replace(grad_acc=new_acc),
+                    jax.lax.pmean(loss * gas, waxes))
+
+        def apply_local(state: TrainState):
+            g_local = jax.tree.map(lambda a: a[0], state.grad_acc)
+            lr = lr_schedule(state.opt_state.count) if lr_schedule else base_lr
+            new_params, new_opt = onebit.update_local(
+                g_local, state.opt_state, state.params, lr=lr)
+            zero_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
+            new_state = state._replace(params=new_params, opt_state=new_opt,
+                                       grad_acc=zero_acc,
+                                       global_steps=state.global_steps + 1)
+            # grad-norm reporting: norm of the averaged local grads
+            gnorm = global_norm(jax.tree.map(
+                lambda g: jax.lax.pmean(g.astype(jnp.float32), waxes), g_local))
+            return new_state, gnorm, jnp.zeros((), bool)
+
+        sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+        self._accum_fn = jax.jit(
+            sm(accum_local, in_specs=(state_specs, bspec, P()),
+               out_specs=(state_specs, P())),
+            donate_argnums=(0,))
+        self._apply_fn = jax.jit(
+            sm(apply_local, in_specs=(state_specs,),
+               out_specs=(state_specs, P(), P())),
+            donate_argnums=(0,))
+        self._fused_fn = None
 
     # ------------------------------------------------------------------
     # reference-parity imperative API (SURVEY.md §3.3)
@@ -462,11 +692,30 @@ class DeepSpeedEngine:
             return self._eval_fn(self.state.params, batch, rng)
         self.timers(SynchronizedWallClockTimer.FORWARD).start()
         self._rng, rng = jax.random.split(self._rng)
-        self.state, loss = self._accum_fn(self.state, batch, rng)
+        if self._param_offload:
+            loss, grads = self._pofwdbwd_fn(self.state.params, batch, rng)
+            self._accum_host_grads(grads)
+            if self.flops_profiler is not None:
+                self._profile_probes["fwdbwd"] = (
+                    self._pofwdbwd_fn, (self.state.params, batch, rng))
+        else:
+            if self.flops_profiler is not None:
+                self._profile_probes["accum"] = (self._accum_fn,
+                                                 (self.state, batch, rng))
+            self.state, loss = self._accum_fn(self.state, batch, rng)
         self.timers(SynchronizedWallClockTimer.FORWARD).stop()
         self._micro_count += 1
         self._last_loss = loss
         return loss
+
+    def _accum_host_grads(self, grads) -> None:
+        """Accumulate host-resident micro-batch grads into fp32 numpy buffers
+        (ZeRO-Offload semantics: the accumulator never touches the device)."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        if self._host_grad_acc is None:
+            self._host_grad_acc = [np.zeros(l.shape, np.float32) for l in leaves]
+        for buf, leaf in zip(self._host_grad_acc, leaves):
+            buf += np.asarray(leaf, dtype=np.float32)
 
     def backward(self, loss, retain_graph: bool = False):
         """Reference-parity no-op: gradients were already computed and
@@ -487,7 +736,9 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(SynchronizedWallClockTimer.STEP).start()
-        if self._offload:
+        if self._param_offload:
+            gnorm, overflow = self._step_param_offload()
+        elif self._offload:
             gnorm, overflow = self._step_offload()
         else:
             self.state, gnorm, overflow = self._apply_fn(self.state)
@@ -504,6 +755,50 @@ class DeepSpeedEngine:
         self._host_steps += 1
         if self._host_steps % self.config.steps_per_print == 0:
             self._report(self.global_steps)
+        self._maybe_emit_flops_profile()
+
+    def _maybe_emit_flops_profile(self) -> None:
+        if (self.flops_profiler is None
+                or self._host_steps != self.config.flops_profiler.profile_step):
+            return
+        if self._apply_fn is not None and self.state is not None:
+            self._profile_probes.setdefault("apply", (self._apply_fn, (self.state,)))
+        for name, (fn, args) in self._profile_probes.items():
+            self.flops_profiler.collect(name, fn, *args)
+        fp = self.config.flops_profiler
+        self.flops_profiler.print_model_profile(
+            profile_step=fp.profile_step, module_depth=fp.module_depth,
+            top_modules=fp.top_modules, detailed=fp.detailed)
+
+    def _step_param_offload(self):
+        """ZeRO-Infinity step: grads already accumulated on host; clip, step
+        the host optimizer, cast masters to compute dtype, and re-place the
+        params in pinned host memory for the next streamed forward."""
+        import ml_dtypes
+
+        acc = self._host_grad_acc
+        if acc is None:
+            raise RuntimeError("step() before any forward() in offload_param mode")
+        gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
+                                  for g in acc)))
+        clip = self.config.gradient_clipping
+        if clip and clip > 0 and gnorm > clip:
+            scale = clip / (gnorm + 1e-6)
+            for g in acc:
+                g *= scale
+        lr = self.get_lr()[0]
+        masters = self._offload_opt.step([g.reshape(-1) for g in acc], lr=lr)
+        np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
+                    jnp.float16: np.float16}.get(self.compute_dtype, np.float32)
+        master = self._offload_opt.tree_from_masters(masters)
+        compute = jax.tree.map(lambda a: a.astype(np_dtype), master)
+        new_params = jax.device_put(compute, self._param_shardings)
+        self.state = self.state._replace(
+            params=new_params, global_steps=self.state.global_steps + 1)
+        for g in acc:
+            g[:] = 0.0
+        self._last_grad_norm = gnorm
+        return gnorm, False
 
     def _step_offload(self):
         """Optimizer step with host-resident states (ZeRO-Offload path):
@@ -535,22 +830,82 @@ class DeepSpeedEngine:
                                     global_steps=steps, scaler=scaler)
         return gnorm, overflow
 
+    def train_step(self, batch):
+        """One full optimizer step from a stacked batch in a single dispatch.
+
+        ``batch`` leaves carry a leading ``[gas, micro, ...]`` axis (or
+        ``[gas*micro, ...]``, reshaped here).  Falls back to the
+        accum-loop + step path when offload is active (the host optimizer
+        step cannot live inside the XLA program)."""
+        gas = self.config.gradient_accumulation_steps
+
+        tbs = self.config.train_batch_size
+
+        def stack(x):
+            if not (isinstance(x, jax.Array) and getattr(x, "ndim", 0)):
+                x = np.asarray(x)
+            if not x.ndim:
+                return x
+            # Disambiguate stacked [gas, micro, ...] from flat [batch, ...]
+            # even when gas == batch (micro == 1): the stacked form's second
+            # dim is the micro size.
+            already = (x.shape[0] == gas
+                       and (x.shape[0] != tbs
+                            or (x.ndim > 1 and x.shape[1] == tbs // gas)))
+            if already:
+                return x
+            if x.shape[0] % gas:
+                raise ValueError(f"batch leading dim {x.shape[0]} not "
+                                 f"divisible by gradient_accumulation_steps={gas}")
+            return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
+        stacked = jax.tree.map(stack, batch)
+        if self.state is None:
+            first = jax.tree.map(lambda x: x[0], stacked)
+            self.lazy_init_from_batch(shard_batch(first, self.mesh))
+        if self._fused_fn is None:  # offload path: host step between programs
+            for i in range(gas):
+                self.forward(jax.tree.map(lambda x: x[i], stacked))
+            loss = self._last_loss
+            self.step()
+            return loss
+        stacked = shard_batch(stacked, self.mesh, stacked=True)
+        self._rng, rng = jax.random.split(self._rng)
+        if self.flops_profiler is not None:
+            self._profile_probes["train_step"] = (self._fused_fn,
+                                                  (self.state, stacked, rng))
+        self.timers(SynchronizedWallClockTimer.STEP).start()
+        self.state, loss, gnorm, overflow = self._fused_fn(self.state, stacked, rng)
+        self.timers(SynchronizedWallClockTimer.STEP).stop()
+        self._last_loss = loss
+        self._last_grad_norm = gnorm
+        self._last_overflow = overflow
+        self._micro_count = 0
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._host_steps += 1
+        if self._host_steps % self.config.steps_per_print == 0:
+            self._report(self.global_steps)
+        self._maybe_emit_flops_profile()
+        return loss
+
     def train_batch(self, data_iter=None):
         """Full global-batch step: gas micro-batches + boundary update
-        (reference: ``PipelineEngine.train_batch`` shape, here for the
-        non-pipeline engine as a convenience fast path)."""
+        (reference: ``PipelineEngine.train_batch`` shape).  Pulls the gas
+        micro-batches eagerly and runs them through the fused single-dispatch
+        ``train_step``."""
         if data_iter is None:
             if self.training_dataloader is None:
                 raise ValueError("train_batch needs data_iter or training_data")
             data_iter = iter(self.training_dataloader)
         self.tput_timer.start()
         gas = self.config.gradient_accumulation_steps
-        losses = []
-        for _ in range(gas):
-            losses.append(self.forward(next(data_iter)))
-        self.step()
+        micros = [next(data_iter) for _ in range(gas)]
+        stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                               *micros)
+        loss = self.train_step(stacked)
         self.tput_timer.stop()
-        return jnp.mean(jnp.stack(losses))
+        return loss
 
     def eval_batch(self, data_iter):
         was = self._training
@@ -607,26 +962,29 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
+        """Sharded, multi-host-safe save: every process writes only its
+        addressable shards (no full gather — reference layout role of
+        ``*_zero_pp_rank_*`` files, SURVEY.md §5.4; TPU plan = sharded index
+        layout via ShardedCheckpointEngine)."""
         if self.state is None:
             raise RuntimeError("nothing to checkpoint: engine state not initialized")
         tag = tag or f"global_step{self.global_steps}"
         ckpt_dir = os.path.join(save_dir, str(tag))
-        if comm.get_rank() == 0:
-            os.makedirs(ckpt_dir, exist_ok=True)
+        os.makedirs(ckpt_dir, exist_ok=True)
         comm.barrier()
         self.checkpoint_engine.create(str(tag))
+        self.checkpoint_engine.save(self.state.params,
+                                    os.path.join(ckpt_dir, "model_states"))
+        optim_payload = {"opt_state": self.state.opt_state,
+                         "grad_acc": self.state.grad_acc,
+                         "global_steps": self.state.global_steps,
+                         "scaler": tuple(self.state.scaler)}
+        self.checkpoint_engine.save(optim_payload,
+                                    os.path.join(ckpt_dir, "optim_states"))
+        if self._offload and comm.get_rank() == 0:
+            # host-resident fp32 master + moments, streamed one leaf at a time
+            self._offload_opt.write_state(os.path.join(ckpt_dir, "offload_states"))
         if comm.get_rank() == 0:
-            self.checkpoint_engine.save(self.state.params,
-                                        os.path.join(ckpt_dir, "model_states.msgpack"))
-            optim_payload = {"opt_state": self.state.opt_state,
-                             "grad_acc": self.state.grad_acc,
-                             "global_steps": self.state.global_steps,
-                             "scaler": tuple(self.state.scaler)}
-            if self._offload:
-                # host-resident fp32 master + moments (cpu or nvme tier)
-                optim_payload["offload"] = self._offload_opt.state_dict()
-            self.checkpoint_engine.save(
-                optim_payload, os.path.join(ckpt_dir, "optim_states.msgpack"))
             meta = {"client_state": client_state or {},
                     "micro_count": self._micro_count,
                     "lr_scheduler": (self.lr_scheduler.state_dict()
@@ -658,10 +1016,59 @@ class DeepSpeedEngine:
         if self.state is None:
             raise RuntimeError("load_checkpoint requires initialized state "
                                "(pass model_parameters or run one batch first)")
-        params_host = self.checkpoint_engine.load(
-            os.path.join(ckpt_dir, "model_states.msgpack"), target=jax.device_get(self.state.params))
-        params = jax.device_put(params_host, self._param_shardings)
+        from deepspeed_tpu.runtime.checkpoint_engine import is_sharded_checkpoint
+
+        if not is_sharded_checkpoint(os.path.join(ckpt_dir, "model_states")):
+            return self._load_legacy_checkpoint(ckpt_dir, load_optimizer_states,
+                                                load_lr_scheduler_states,
+                                                load_module_only)
+        # Resharding load: each device reads only the byte ranges backing its
+        # slice of the target sharding — a checkpoint saved at any ZeRO
+        # stage/mesh loads at any other without a host-side full gather.
+        params = self.checkpoint_engine.load(
+            os.path.join(ckpt_dir, "model_states"),
+            shardings=self._param_shardings)
+        params = self._cast_like(params, self.state.params)
         new_state = self.state._replace(params=params)
+        meta = {}
+        meta_path = os.path.join(ckpt_dir, "client_state.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as fh:
+                meta = json.load(fh)
+        if not load_module_only and load_optimizer_states:
+            scalar_sh = NamedSharding(self.mesh, P())
+            opt_shardings = {"opt_state": self._opt_shardings,
+                             "grad_acc": self._acc_shardings,
+                             "global_steps": scalar_sh,
+                             "scaler": tuple([scalar_sh] * len(self.state.scaler))}
+            opt = self.checkpoint_engine.load(
+                os.path.join(ckpt_dir, "optim_states"), shardings=opt_shardings)
+            offload_dir = os.path.join(ckpt_dir, "offload_states")
+            if self._offload and os.path.isdir(offload_dir):
+                self._offload_opt.read_state(offload_dir)
+            new_state = new_state._replace(
+                opt_state=self._cast_like(opt["opt_state"], self.state.opt_state),
+                grad_acc=self._cast_like(opt["grad_acc"], self.state.grad_acc),
+                global_steps=jnp.asarray(opt["global_steps"], jnp.int32),
+                scaler=scaler_lib.LossScaleState(*[jnp.asarray(x) for x in opt["scaler"]]))
+            self._host_steps = int(jax.device_get(opt["global_steps"]))
+        if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        self.state = new_state
+        log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir, meta.get("client_state", {})
+
+    def _load_legacy_checkpoint(self, ckpt_dir: str, load_optimizer_states: bool,
+                                load_lr_scheduler_states: bool,
+                                load_module_only: bool):
+        """Read the pre-sharded single-file msgpack layout (checkpoints saved
+        by earlier releases remain resumable)."""
+        legacy = MsgpackCheckpointEngine()
+        params_host = legacy.load(
+            os.path.join(ckpt_dir, "model_states.msgpack"),
+            target=jax.device_get(self.state.params))
+        new_state = self.state._replace(
+            params=jax.device_put(params_host, self._param_shardings))
         meta = {}
         meta_path = os.path.join(ckpt_dir, "client_state.json")
         if os.path.exists(meta_path):
@@ -674,7 +1081,7 @@ class DeepSpeedEngine:
                       "scaler": tuple(np.asarray(x) for x in self.state.scaler)}
             if self._offload:
                 target["offload"] = self._offload_opt.state_dict()
-            opt_host = self.checkpoint_engine.load(
+            opt_host = legacy.load(
                 os.path.join(ckpt_dir, "optim_states.msgpack"), target=target)
             if self._offload and "offload" in opt_host:
                 self._offload_opt.load_state_dict(opt_host["offload"])
@@ -682,22 +1089,40 @@ class DeepSpeedEngine:
                 opt_state=jax.device_put(opt_host["opt_state"], self._opt_shardings),
                 grad_acc=jax.device_put(opt_host["grad_acc"], self._acc_shardings),
                 global_steps=jnp.asarray(opt_host["global_steps"], jnp.int32),
-                scaler=scaler_lib.LossScaleState(*[jnp.asarray(x) for x in opt_host["scaler"]]))
+                scaler=scaler_lib.LossScaleState(
+                    *[jnp.asarray(x) for x in opt_host["scaler"]]))
             self._host_steps = int(opt_host["global_steps"])
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.state = new_state
-        log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
+        log_dist(f"loaded legacy checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})
 
-    def save_16bit_model(self, save_dir: str, save_filename: str = "model_states_16bit.msgpack"):
-        """Gather full (unsharded) compute-dtype weights and save on rank 0
-        (reference: ``stage3_gather_16bit_weights_on_model_save``)."""
+    def _cast_like(self, tree, like):
+        """Cast loaded leaves to the live state's dtypes (cheap jitted map;
+        checkpoints may hold a different precision than the running config)."""
+        def cast(a, b):
+            return a.astype(b.dtype) if a.dtype != b.dtype else a
+
+        return jax.tree.map(cast, tree, like)
+
+    def save_16bit_model(self, save_dir: str, save_filename: str = "model_states_16bit"):
+        """Save compute-dtype weights (reference:
+        ``stage3_gather_16bit_weights_on_model_save``) — sharded layout, cast
+        on device, written shard-streamed: no rank-0 full gather."""
         os.makedirs(save_dir, exist_ok=True)
-        gathered = jax.device_get(self.state.params)
-        cast = jax.tree.map(
-            lambda x: x.astype(self.compute_dtype)
-            if jnp.issubdtype(np.asarray(x).dtype, np.floating) else x, gathered)
-        if comm.get_rank() == 0:
-            self.checkpoint_engine.save(cast, os.path.join(save_dir, save_filename))
-        return os.path.join(save_dir, save_filename)
+        cdtype = self.compute_dtype
+        # In param_offload mode the live shardings are pinned_host — cast with
+        # device outputs (the partitioner rejects host-placed jit outputs on
+        # multi-device meshes); the sharded writer streams either way.
+        out_sh = (self._param_dev_shardings if self._param_offload
+                  else self._param_shardings)
+        cast = jax.jit(
+            lambda p: jax.tree.map(
+                lambda x: x.astype(cdtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p),
+            out_shardings=out_sh)(self.state.params)
+        out = os.path.join(save_dir, save_filename)
+        self.checkpoint_engine.save(cast, out)
+        comm.barrier()
+        return out

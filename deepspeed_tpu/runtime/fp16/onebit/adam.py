@@ -1,0 +1,153 @@
+"""1-bit Adam / 1-bit LAMB: error-feedback compressed-communication optimizers.
+
+Reference: ``deepspeed/runtime/fp16/onebit/{adam,lamb}.py`` + the cupy
+``compressed_allreduce`` backend (SURVEY.md §2.1 rows 14, 27).  Algorithm:
+
+- **Warmup stage** (``step < freeze_step``): standard dense Adam — gradients
+  are averaged across data-parallel workers (pmean), both moments update.
+- **Compression stage**: the variance ``v`` freezes; each worker folds its
+  *local* gradient into its momentum copy, the momentum is exchanged with
+  1-bit sign compression + two-level error feedback
+  (``runtime/comm/quantized.compressed_allreduce``), and the averaged
+  momentum drives the update.  Comm volume drops ~16-32x (1 bit/element
+  over ICI instead of 16/32).
+
+TPU-native shape: these are *per-rank local* update functions meant to run
+inside a ``shard_map`` manual region over the data-parallel mesh axes — the
+engine wires them in (``DeepSpeedEngine`` onebit path) because 1-bit
+semantics need per-worker local gradients, which only exist under manual
+partitioning.  Like the reference, ZeRO stages >= 2 and model parallelism
+are not supported with 1-bit optimizers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.runtime.comm.quantized import compressed_allreduce
+
+
+class OneBitState(NamedTuple):
+    """Optimizer state pytree.  ``error``/``server_error`` carry a leading
+    [world] axis (each worker's slice is its local feedback buffer)."""
+
+    exp_avg: Any          # momentum, replicated
+    exp_avg_sq: Any       # variance (frozen after warmup), replicated
+    error: Any            # worker error feedback, [W, ...] stacked
+    server_error: Any     # server error feedback, [W, chunk] stacked
+    count: jnp.ndarray    # i32 step counter, replicated
+
+
+def _chunk_size(n: int, world: int) -> int:
+    padded = -(-n // (world * 8)) * (world * 8)
+    return padded // world
+
+
+class OneBitAdam:
+    """Config-driven 1-bit Adam/LAMB update (local functions; see module
+    docstring for the shard_map contract)."""
+
+    def __init__(self, world: int, axis_names: Sequence[str], lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, freeze_step: int = 100,
+                 lamb: bool = False):
+        self.world = world
+        self.axis_names = tuple(axis_names)
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.lamb = lamb
+
+    # -- state ----------------------------------------------------------
+    def init(self, params: Any) -> OneBitState:
+        W = self.world
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return OneBitState(
+            exp_avg=jax.tree.map(zeros, params),
+            exp_avg_sq=jax.tree.map(zeros, params),
+            error=jax.tree.map(lambda p: jnp.zeros((W,) + p.shape, jnp.float32),
+                               params),
+            server_error=jax.tree.map(
+                lambda p: jnp.zeros((W, _chunk_size(p.size, W)), jnp.float32),
+                params),
+            count=jnp.zeros((), jnp.int32))
+
+    # -- local (in-shard_map) update ------------------------------------
+    def update_local(self, grads_local: Any, state: OneBitState, params: Any,
+                     lr=None):
+        """One optimizer step from THIS worker's local gradients.
+
+        All leaves of ``error``/``server_error`` arrive as this worker's
+        [1, ...] slices.  Returns (new_params, new_state).
+        """
+        lr = self.lr if lr is None else lr
+        count = state.count + 1
+        warm = count <= self.freeze_step
+
+        def leaf_update(g_local, m, v, err, serr, p):
+            g_local = g_local.astype(jnp.float32)
+            g_avg = lax.pmean(g_local, self.axis_names)
+
+            def warm_branch(_):
+                m_new = self.b1 * m + (1 - self.b1) * g_avg
+                v_new = self.b2 * v + (1 - self.b2) * g_avg * g_avg
+                # bias correction only in warmup (matches dense Adam exactly)
+                c = count.astype(jnp.float32)
+                m_hat = m_new / (1 - self.b1 ** c)
+                v_hat = v_new / (1 - self.b2 ** c)
+                upd = m_hat / (jnp.sqrt(v_hat) + self.eps)
+                return m_new, v_new, err[0], serr[0], upd
+
+            def frozen_branch(_):
+                m_w = self.b1 * m + (1 - self.b1) * g_local  # LOCAL fold
+                m_new, e_new, s_new = compressed_allreduce(
+                    m_w, err[0], serr[0], self.axis_names)
+                upd = m_new / (jnp.sqrt(v) + self.eps)
+                return m_new, v, e_new, s_new, upd
+
+            m_new, v_new, e_new, s_new, upd = lax.cond(
+                warm, warm_branch, frozen_branch, operand=None)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p.astype(jnp.float32)
+            if self.lamb:
+                w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+                u_norm = jnp.linalg.norm(upd)
+                trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                                  w_norm / u_norm, 1.0)
+                upd = trust * upd
+            p_new = (p.astype(jnp.float32) - lr * upd).astype(p.dtype)
+            return p_new, m_new, v_new, e_new[None], s_new[None]
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads_local)
+        flat_m = jax.tree_util.tree_leaves(state.exp_avg)
+        flat_v = jax.tree_util.tree_leaves(state.exp_avg_sq)
+        flat_e = jax.tree_util.tree_leaves(state.error)
+        flat_s = jax.tree_util.tree_leaves(state.server_error)
+        outs = [leaf_update(g, m, v, e, s, p) for g, m, v, e, s, p in
+                zip(flat_g, flat_m, flat_v, flat_e, flat_s, flat_p)]
+        unflat = lambda i: jax.tree_util.tree_unflatten(treedef,
+                                                        [o[i] for o in outs])
+        new_state = OneBitState(exp_avg=unflat(1), exp_avg_sq=unflat(2),
+                                error=unflat(3), server_error=unflat(4),
+                                count=count)
+        return unflat(0), new_state
+
+
+def onebit_from_config(opt_type: str, params: Dict[str, Any], world: int,
+                       axis_names: Sequence[str]) -> OneBitAdam:
+    name = opt_type.lower().replace("_", "").replace("-", "")
+    betas = tuple(params.get("betas", (0.9, 0.999)))
+    return OneBitAdam(
+        world=world, axis_names=axis_names,
+        lr=params.get("lr", 1e-3), betas=betas, eps=params.get("eps", 1e-8),
+        weight_decay=params.get("weight_decay", 0.0),
+        freeze_step=params.get("freeze_step", 100),
+        lamb=(name == "onebitlamb"))

@@ -19,7 +19,10 @@ import numpy as np
 
 
 def _load_checkpoint_params(checkpoint_dir: str, tag: Optional[str] = None) -> Any:
-    from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+    from deepspeed_tpu.runtime.checkpoint_engine import (MsgpackCheckpointEngine,
+                                                         ShardedCheckpointEngine,
+                                                         is_sharded_checkpoint)
+    from deepspeed_tpu.runtime.checkpoint_engine.sharded import nest_keystrs
 
     if tag is None:
         latest = os.path.join(checkpoint_dir, "latest")
@@ -28,6 +31,9 @@ def _load_checkpoint_params(checkpoint_dir: str, tag: Optional[str] = None) -> A
                 tag = fh.read().strip()
         else:
             raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag=")
+    sharded = os.path.join(checkpoint_dir, str(tag), "model_states")
+    if is_sharded_checkpoint(sharded):
+        return nest_keystrs(ShardedCheckpointEngine().load(sharded))
     path = os.path.join(checkpoint_dir, str(tag), "model_states.msgpack")
     return MsgpackCheckpointEngine().load(path)
 

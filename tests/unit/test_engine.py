@@ -77,6 +77,48 @@ class TestTrainLoop:
         for a, b in zip(flat1, flat2):
             np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
 
+    def test_fused_train_step_matches_loop(self):
+        """One-dispatch train_step (scan over microbatches + update in one
+        XLA program) must produce the same params as the forward/step loop."""
+        x, y = random_dataset(n=16)
+        world = 8
+        gas, micro = 2, 1
+        cfg = {"train_micro_batch_size_per_gpu": micro,
+               "gradient_accumulation_steps": gas,
+               "optimizer": {"type": "SGD", "params": {"lr": 0.1}}}
+        outs = {}
+        for mode in ("loop", "fused"):
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=SimpleModel(hidden_dim=16), config=cfg,
+                rng=jax.random.PRNGKey(7))
+            per_micro = micro * world
+            if mode == "loop":
+                for i in range(gas):
+                    engine.forward((x[i * per_micro:(i + 1) * per_micro],
+                                    y[i * per_micro:(i + 1) * per_micro]))
+                engine.step()
+            else:
+                stacked = (x[: gas * per_micro].reshape(gas, per_micro, -1),
+                           y[: gas * per_micro].reshape(gas, per_micro, -1))
+                engine.train_step(stacked)
+                assert engine.global_steps == 1
+            outs[mode] = jax.device_get(engine.state.params)
+        for a, b in zip(jax.tree_util.tree_leaves(outs["loop"]),
+                        jax.tree_util.tree_leaves(outs["fused"])):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+    def test_train_step_flat_batch_reshape(self):
+        """train_step accepts [gas*micro, ...] leaves and restacks them."""
+        x, y = random_dataset(n=16)
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "SGD", "params": {"lr": 0.1}}}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16), config=cfg, rng=jax.random.PRNGKey(7))
+        loss = engine.train_step((x, y))
+        assert np.isfinite(float(loss))
+        assert engine.global_steps == 1
+
     def test_bf16(self):
         cfg = {**BASE, "bf16": {"enabled": True}}
         engine, loader = make_engine(cfg)
@@ -157,7 +199,13 @@ class TestCheckpoint:
         engine, loader = make_engine(cfg)
         engine.train_batch(iter(loader))
         p = engine.save_16bit_model(str(tmp_path))
-        assert p and (tmp_path / "model_states_16bit.msgpack").exists()
+        from deepspeed_tpu.runtime.checkpoint_engine import (ShardedCheckpointEngine,
+                                                             is_sharded_checkpoint)
+        assert p and is_sharded_checkpoint(str(tmp_path / "model_states_16bit"))
+        flat = ShardedCheckpointEngine().load(p)
+        # every non-integer leaf must have been cast to the compute dtype
+        assert all(str(a.dtype) == "bfloat16" for a in flat.values()
+                   if not np.issubdtype(np.asarray(a).dtype, np.integer))
 
 
 class TestFP16:

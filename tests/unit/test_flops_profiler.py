@@ -1,0 +1,50 @@
+"""FLOPS profiler tests (reference: tests/unit/profiling/, SURVEY.md §5.1)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile
+from tests.unit.simple_model import SimpleModel, random_dataset
+
+
+def test_get_model_profile_matmul():
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 256), jnp.float32)
+    flops, macs, n_params = get_model_profile(lambda a, b: a @ b, (a, b))
+    want = 2 * 64 * 128 * 256
+    # XLA cost analysis counts the dot exactly
+    assert flops == 0 or abs(flops - want) / want < 0.1, (flops, want)
+    assert n_params == a.size + b.size
+
+
+def test_engine_profile_printed():
+    x, y = random_dataset(n=16)
+    cfg = {"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "flops_profiler": {"enabled": True, "profile_step": 2}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg, rng=jax.random.PRNGKey(0))
+    assert engine.flops_profiler is not None
+    for i in range(3):
+        loss = engine.forward((x[:8], y[:8]))
+        engine.backward(loss)
+        engine.step()
+    # the engine printed at profile_step 2 (through the logger); the collected
+    # cost data persists — re-render and assert on the content
+    assert engine.flops_profiler._cost, "cost analyses should be collected"
+    text = engine.flops_profiler.print_model_profile(profile_step=2)
+    assert "Flops Profiler" in text
+    assert "flops per train step" in text
+    assert engine.flops_profiler.get_total_params() > 0
+    assert engine.flops_profiler.get_total_flops() > 0
+
+
+def test_profiler_api_shapes():
+    p = FlopsProfiler()
+    p.start_profile()
+    assert p.get_total_flops() == 0.0
+    assert isinstance(p.get_total_duration(), float)
+    p.end_profile()

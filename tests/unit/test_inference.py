@@ -103,3 +103,51 @@ def test_tp_inference(devices, rng):
     engine.set_params(params)
     out = engine.generate(toks, max_new_tokens=4)
     assert out.shape == (2, 12)
+
+
+def test_generate_bucketed_prefill_matches_exact(tiny_model, rng):
+    """A prompt whose length is not a bucket size (5 -> bucket 16) must
+    produce the same greedy continuation as manual exact-length decode."""
+    toks = jax.random.randint(rng, (2, 5), 0, 256)
+    params = tiny_model.init(rng, toks)
+    engine = deepspeed_tpu.init_inference(
+        tiny_model, config={"dtype": "float32", "max_out_tokens": 64})
+    engine.set_params(params)
+    out = engine.generate(toks, max_new_tokens=6, do_sample=False)
+
+    # manual: exact-length prefill + greedy decode
+    cache = init_kv_cache(tiny_model.config, 2, 64, dtype=jnp.float32)
+    logits, cache = forward_with_cache(tiny_model, engine._params, toks, cache, 0)
+    cur = jnp.argmax(logits[:, -1], axis=-1)
+    want = [cur]
+    pos = 5
+    for _ in range(5):
+        logits, cache = forward_with_cache(tiny_model, engine._params,
+                                           cur[:, None], cache, pos)
+        cur = jnp.argmax(logits[:, -1], axis=-1)
+        want.append(cur)
+        pos += 1
+    np.testing.assert_array_equal(np.asarray(out[:, 5:]),
+                                  np.asarray(jnp.stack(want, axis=1)))
+
+
+def test_generate_single_dispatch(tiny_model, rng, monkeypatch):
+    """The whole decode loop must be ONE compiled call — count dispatches."""
+    toks = jax.random.randint(rng, (1, 8), 0, 256)
+    params = tiny_model.init(rng, toks)
+    engine = deepspeed_tpu.init_inference(
+        tiny_model, config={"dtype": "float32", "max_out_tokens": 64})
+    engine.set_params(params)
+    engine.generate(toks, max_new_tokens=4)  # warm the compile caches
+
+    calls = {"n": 0}
+    settings_key = next(iter(engine._gen_fns))
+    real = engine._gen_fns[settings_key]
+
+    def counted(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    engine._gen_fns[settings_key] = counted
+    engine.generate(toks, max_new_tokens=4)  # same settings -> same program
+    assert calls["n"] == 1, "decode loop should be a single jitted call"

@@ -1,0 +1,89 @@
+"""1-bit Adam/LAMB engine tests (VERDICT r2 item 6 done-criteria):
+convergence parity vs dense Adam on the 8-device mesh + comm volume
+reduction via CommsLogger.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm as comm_api
+from tests.unit.simple_model import SimpleModel, random_dataset
+
+
+def _train(opt_type, steps=12, freeze_step=100, lr=5e-2, **opt_params):
+    x, y = random_dataset(n=64)
+    cfg = {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 1,
+           "comms_logger": {"enabled": comm_api.comms_logger.enabled},
+           "optimizer": {"type": opt_type,
+                         "params": {"lr": lr, "freeze_step": freeze_step,
+                                    **opt_params}}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg, rng=jax.random.PRNGKey(11))
+    losses = []
+    for i in range(steps):
+        lo = i * 16 % 48
+        loss = engine.forward((x[lo:lo + 16], y[lo:lo + 16]))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses, engine
+
+
+def test_warmup_matches_dense_adam():
+    """With freeze_step > steps the 1-bit path is exactly dense Adam."""
+    dense, _ = _train("Adam", steps=8, adam_w_mode=False)
+    onebit, _ = _train("OneBitAdam", steps=8, freeze_step=100)
+    np.testing.assert_allclose(dense, onebit, rtol=2e-4, atol=2e-5)
+
+
+def test_compression_stage_converges():
+    """After freeze_step the compressed exchange still trains the model.
+
+    Like the reference, 1-bit Adam needs enough warmup that the frozen
+    variance is meaningful, and a gentler lr in the compression stage (the
+    sign-compressed momentum behaves like signSGD per coordinate)."""
+    # eps floors the frozen-variance denominator: sign-compressed momentum is
+    # dense, so coordinates with ~zero variance would otherwise blow up
+    # (inherent to the algorithm; the reference exposes eps the same way)
+    losses, engine = _train("OneBitAdam", steps=30, freeze_step=15, lr=1e-3,
+                            eps=1e-3)
+    assert engine.global_steps == 30
+    assert np.isfinite(losses).all(), losses
+    assert min(losses[15:]) < losses[0], losses
+
+
+def test_onebit_lamb_trains():
+    losses, _ = _train("OneBitLamb", steps=16, freeze_step=8, lr=5e-3, eps=1e-3)
+    assert min(losses[8:]) < losses[0], losses
+    assert np.isfinite(losses[-1]), losses
+
+
+def test_comm_volume_reduced():
+    comm_api.comms_logger.configure(enabled=True)
+    comm_api.comms_logger.reset()
+    _train("OneBitAdam", steps=6, freeze_step=2, lr=1e-3)
+    recs = comm_api.comms_logger.bytes
+    comp = sum(v for k, v in recs.items() if "compressed" in k)
+    assert comp > 0, recs
+    # payload per exchanged element must be ~1 bit, not 16:
+    # 4 compressed steps x n_params elements -> bytes ~ steps * n / 8 (x2 legs)
+    n_params = sum(p.size for p in [np.zeros((8, 16)), np.zeros((16,)),
+                                    np.zeros((16, 4)), np.zeros((4,))])
+    dense_equiv = 4 * n_params * 2  # bf16 bytes for the same exchanges
+    assert comp < dense_equiv / 2, (comp, dense_equiv)
+    comm_api.comms_logger.configure(enabled=False)
+    comm_api.comms_logger.reset()
+
+
+def test_rejects_zero2_and_fp16():
+    cfg_base = {"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-2}}}
+    with pytest.raises(ValueError, match="ZeRO"):
+        deepspeed_tpu.initialize(model=SimpleModel(16),
+                                 config={**cfg_base, "zero_optimization": {"stage": 2}})
+    with pytest.raises(ValueError, match="fp16|bf16"):
+        deepspeed_tpu.initialize(model=SimpleModel(16),
+                                 config={**cfg_base, "fp16": {"enabled": True}})

@@ -1,0 +1,124 @@
+"""Quantized/compressed collective tests (VERDICT r2 item 6).
+
+Correctness vs dense equivalents on the 8-device mesh + comm-volume
+accounting through CommsLogger.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import comm as comm_api
+from deepspeed_tpu.comm.mesh import build_mesh
+from deepspeed_tpu.runtime.comm.quantized import (block_dequantize, block_quantize,
+                                                  compressed_allreduce, pack_signs,
+                                                  quantized_all_gather,
+                                                  quantized_reduce_scatter,
+                                                  unpack_signs)
+
+
+@pytest.fixture()
+def dp_mesh(devices):
+    return build_mesh(dp=8, devices=devices)
+
+
+def test_block_quantize_roundtrip(rng):
+    x = jax.random.normal(rng, (1000,)) * 3.0
+    q, s, pad = block_quantize(x, block=256)
+    out = block_dequantize(q, s, pad, x.shape)
+    assert np.abs(np.asarray(out - x)).max() <= np.abs(np.asarray(x)).max() / 127 + 1e-6
+
+
+def test_sign_pack_roundtrip(rng):
+    x = jax.random.normal(rng, (77,))
+    packed = pack_signs(x)
+    assert packed.dtype == jnp.uint8 and packed.size == 10  # ceil(77/8)
+    signs = unpack_signs(packed, 77)
+    np.testing.assert_array_equal(np.asarray(signs),
+                                  np.where(np.asarray(x) >= 0, 1.0, -1.0))
+
+
+def test_quantized_all_gather_matches_dense(dp_mesh, rng):
+    x = jax.random.normal(rng, (16, 32))
+
+    def body(xl):
+        return quantized_all_gather(xl, "dp")
+
+    out = jax.jit(jax.shard_map(body, mesh=dp_mesh, in_specs=P("dp"),
+                                out_specs=P("dp"), check_vma=False))(x)
+    # each rank's gathered copy equals the full tensor within quant error
+    np.testing.assert_allclose(np.asarray(out[:16]), np.asarray(x),
+                               atol=float(jnp.abs(x).max()) / 127 + 1e-6)
+
+
+def test_quantized_reduce_scatter_matches_dense(dp_mesh, rng):
+    x = jax.random.normal(rng, (8, 64))  # per-rank contribution
+
+    def body(xl):
+        # xl: [1, 64] local slice; build a full local tensor so every rank
+        # contributes to every shard
+        full = jnp.tile(xl, (8, 1))
+        return quantized_reduce_scatter(full, "dp")
+
+    out = jax.jit(jax.shard_map(body, mesh=dp_mesh, in_specs=P("dp"),
+                                out_specs=P("dp"), check_vma=False))(x)
+    want = np.asarray(x).sum(axis=0)  # every shard = sum over ranks
+    got = np.asarray(out)
+    for r in range(8):
+        np.testing.assert_allclose(got[r], want, atol=8 * 0.05 + np.abs(want).max() / 30,
+                                   rtol=0.1)
+
+
+def test_compressed_allreduce_error_feedback_converges(dp_mesh, rng):
+    """Error feedback makes repeated compressed allreduce track the dense
+    mean: accumulated output over steps approaches accumulated dense mean."""
+    xs = jax.random.normal(rng, (8, 128))
+    dense_mean = np.asarray(xs).mean(axis=0)
+
+    def body(xl):
+        x = xl[0]
+        err = jnp.zeros_like(x)
+        serr = jnp.zeros((x.size // 8,), jnp.float32)
+
+        def step(carry, _):
+            err, serr, acc = carry
+            out, err, serr = compressed_allreduce(x, err, serr, "dp")
+            return (err, serr, acc + out), None
+
+        (_, _, acc), _ = jax.lax.scan(step, (err, serr, jnp.zeros_like(x)),
+                                      None, length=12)
+        return (acc / 12)[None]
+
+    out = jax.jit(jax.shard_map(body, mesh=dp_mesh, in_specs=P("dp"),
+                                out_specs=P("dp"), check_vma=False))(xs)
+    got = np.asarray(out[0])
+    # the time-average converges to the dense mean (EF property)
+    assert np.abs(got - dense_mean).mean() < 0.15 * np.abs(dense_mean).mean() + 0.05
+
+
+def test_comm_volume_reduction(dp_mesh, rng):
+    """Compressed payload bytes must be ~1/4 of the bf16 dense volume."""
+    comm_api.comms_logger.configure(enabled=True)
+    comm_api.comms_logger.reset()
+    x = jax.random.normal(rng, (8, 4096))
+
+    def body(xl):
+        x = xl[0]
+        err = jnp.zeros_like(x)
+        serr = jnp.zeros((x.size // 8,), jnp.float32)
+        out, _, _ = compressed_allreduce(x, err, serr, "dp")
+        return out[None]
+
+    jax.jit(jax.shard_map(body, mesh=dp_mesh, in_specs=P("dp"),
+                          out_specs=P("dp"), check_vma=False))(x)
+    recs = comm_api.comms_logger.bytes
+    comp_bytes = sum(v for k, v in recs.items() if "compressed" in k)
+    dense_bytes = 4096 * 2  # one bf16 allreduce payload per rank
+    assert 0 < comp_bytes < dense_bytes / 4, (comp_bytes, dense_bytes)
+    comm_api.comms_logger.configure(enabled=False)
+    comm_api.comms_logger.reset()

@@ -1,0 +1,132 @@
+"""Sharded checkpoint engine tests (VERDICT r2 item 2).
+
+Every process writes only its addressable shards; loads reshard to any
+target sharding; peak host memory stays O(shard), not O(model).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpoint_engine import (ShardedCheckpointEngine,
+                                                     is_sharded_checkpoint)
+from deepspeed_tpu.runtime.checkpoint_engine.sharded import nest_keystrs
+from tests.unit.simple_model import SimpleModel, random_dataset
+
+
+def test_roundtrip_resharded(tmp_path, mesh8):
+    """Save under fsdp sharding, load replicated AND load fsdp-sharded."""
+    eng = ShardedCheckpointEngine()
+    sh = NamedSharding(mesh8, P("fsdp"))
+    rep = NamedSharding(mesh8, P())
+    tree = {"w": jax.device_put(jnp.arange(64.0).reshape(16, 4), sh),
+            "b": jax.device_put(jnp.arange(8.0), sh),
+            "step": jnp.asarray(7, jnp.int32)}
+    path = str(tmp_path / "ckpt")
+    eng.save(tree, path)
+    assert is_sharded_checkpoint(path)
+
+    # replicated load
+    out = eng.load(path, shardings={"w": rep, "b": rep, "step": rep})
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.asarray(tree["b"]))
+    assert int(out["step"]) == 7
+
+    # sharded load on a different axis layout
+    sh2 = NamedSharding(mesh8, P(None, None))
+    out2 = eng.load(path, shardings={"w": NamedSharding(mesh8, P("fsdp", None)),
+                                     "b": sh2.with_spec(P(None)) if hasattr(sh2, "with_spec")
+                                     else NamedSharding(mesh8, P(None)),
+                                     "step": rep})
+    np.testing.assert_array_equal(np.asarray(out2["w"]), np.asarray(tree["w"]))
+
+
+def test_streaming_peak_host_bytes(tmp_path, mesh8):
+    """Peak host buffer during save must be one shard, not the whole model."""
+    eng = ShardedCheckpointEngine()
+    sh = NamedSharding(mesh8, P("fsdp"))
+    big = jax.device_put(jnp.zeros((1024, 128), jnp.float32), sh)  # 512 KiB
+    eng.save({"big": big}, str(tmp_path / "c"))
+    model_bytes = big.size * big.dtype.itemsize
+    assert eng.max_bytes_in_flight <= model_bytes // 8 + 1024, \
+        (eng.max_bytes_in_flight, model_bytes)
+
+
+def test_flat_dict_load_and_nest(tmp_path, mesh8):
+    eng = ShardedCheckpointEngine()
+    tree = {"a": {"b": jnp.ones((4, 4)), "c": jnp.zeros((2,))}}
+    eng.save(tree, str(tmp_path / "c"))
+    flat = eng.load(str(tmp_path / "c"))
+    nested = nest_keystrs(flat)
+    np.testing.assert_array_equal(nested["a"]["b"], np.ones((4, 4)))
+    np.testing.assert_array_equal(nested["a"]["c"], np.zeros((2,)))
+
+
+def test_bf16_dtype_roundtrip(tmp_path, mesh8):
+    eng = ShardedCheckpointEngine()
+    tree = {"w": jnp.full((8, 8), 1.5, jnp.bfloat16)}
+    eng.save(tree, str(tmp_path / "c"))
+    out = eng.load(str(tmp_path / "c"))
+    arr = out["['w']"]
+    assert str(arr.dtype) == "bfloat16"
+    np.testing.assert_array_equal(np.asarray(arr, np.float32), 1.5)
+
+
+def test_missing_leaf_raises(tmp_path, mesh8):
+    eng = ShardedCheckpointEngine()
+    eng.save({"w": jnp.ones((2,))}, str(tmp_path / "c"))
+    rep = NamedSharding(mesh8, P())
+    with pytest.raises(KeyError):
+        eng.load(str(tmp_path / "c"), shardings={"nope": rep})
+
+
+def _make_engine(stage, tmp=None):
+    cfg = {"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": stage}}
+    x, y = random_dataset(n=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg, rng=jax.random.PRNGKey(3))
+    return engine, (x, y)
+
+
+def test_engine_checkpoint_no_full_gather(tmp_path):
+    """Engine save writes the sharded layout and never gathers the model;
+    a zero-3 save loads back into a zero-0 engine (cross-stage reshard)."""
+    engine, (x, y) = _make_engine(stage=3)
+    engine.forward((x[:8], y[:8]))
+    engine.step()
+    ckpt = engine.save_checkpoint(str(tmp_path), tag="t1")
+    assert is_sharded_checkpoint(os.path.join(ckpt, "model_states"))
+    assert is_sharded_checkpoint(os.path.join(ckpt, "optim_states"))
+    # peak host buffer bounded by largest shard (params sharded over fsdp=8)
+    n_bytes = sum(l.size * l.dtype.itemsize
+                  for l in jax.tree.leaves(engine.state.params))
+    assert engine.checkpoint_engine.max_bytes_in_flight < n_bytes, \
+        "save should stream shards, not materialize the model"
+    saved = jax.device_get(engine.state.params)
+
+    other, _ = _make_engine(stage=0)
+    other.forward((x[:8], y[:8]))
+    other.step()
+    other.load_checkpoint(str(tmp_path), tag="t1")
+    for a, b in zip(jax.tree.leaves(saved), jax.tree.leaves(jax.device_get(other.state.params))):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_save_16bit_model_sharded(tmp_path):
+    engine, (x, y) = _make_engine(stage=1)
+    engine.forward((x[:8], y[:8]))
+    engine.step()
+    out = engine.save_16bit_model(str(tmp_path))
+    assert is_sharded_checkpoint(out)
+    eng = ShardedCheckpointEngine()
+    flat = eng.load(out)
+    assert len(flat) == len(jax.tree.leaves(engine.state.params))

@@ -144,10 +144,29 @@ def run_pieces(micro=16, seq=1024, vocab=50257, steps=8):
     print(f"  stack (12L)   dt={dt1*1e3:7.2f}ms eff~={stack_flops/dt1/PEAK:.3f}")
 
 
-def run_kernels(steps=16):
-    """Microbench the Pallas kernels vs MXU/HBM ideals (bench shapes)."""
-    import numpy as np
+def _timed_op(fn, args, flops=0.0, gbytes=0.0, name="", reps=24, steps=4):
+    """Time ``fn`` with REPS serialized applications inside ONE program so
+    the ~9ms remote-dispatch latency amortizes away.  Serialization: the
+    carry scales the first arg, creating a data dependency XLA can't CSE."""
+    def many(*a):
+        def body(c, _):
+            out = fn(a[0] * (1 + c * 1e-20), *a[1:])
+            return jnp.asarray(out, jnp.float32).mean(), None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
+        return c
 
+    dt = bench_fn(many, args, steps=steps) / reps
+    bits = []
+    if flops:
+        bits.append(f"eff={flops/dt/PEAK:.3f}")
+    if gbytes:
+        bits.append(f"bw={gbytes/dt:.0f}GB/s")
+    print(f"{name:24s} dt={dt*1e3:7.3f}ms {' '.join(bits)}")
+    return dt
+
+
+def run_kernels(steps=4):
+    """Microbench the Pallas kernels vs MXU/HBM ideals (bench shapes)."""
     B, H, S, Dh, D = 16, 12, 1024, 64, 768
     rng = jax.random.PRNGKey(0)
     q = jax.random.normal(rng, (B, H, S, Dh), jnp.bfloat16)
@@ -156,37 +175,49 @@ def run_kernels(steps=16):
 
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-    fwd = lambda q, k, v: flash_attention(q, k, v, causal=True).sum()
-    dt = bench_fn(fwd, (q, k, v), steps=steps)
     flops = 2 * B * H * S * S * Dh * 2 / 2  # qk + av, causal-halved
-    print(f"flash fwd      dt={dt*1e3:7.2f}ms eff={flops/dt/PEAK:.3f}")
+    _timed_op(lambda q, k, v: flash_attention(q, k, v, causal=True),
+              (q, k, v), flops=flops, name="flash fwd", steps=steps)
     g = jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True)
                  .astype(jnp.float32).sum(), argnums=(0, 1, 2))
-    dt = bench_fn(g, (q, k, v), steps=steps)
-    print(f"flash fwd+bwd  dt={dt*1e3:7.2f}ms eff={3.5*flops/dt/PEAK:.3f}")
+    _timed_op(lambda q, k, v: g(q, k, v)[0], (q, k, v), flops=3.5 * flops,
+              name="flash fwd+bwd", steps=steps)
 
     from deepspeed_tpu.ops.pallas.layer_norm import layer_norm
 
     x = jax.random.normal(rng, (B * S, D), jnp.bfloat16)
     w = jnp.ones((D,), jnp.float32)
     b = jnp.zeros((D,), jnp.float32)
-    dt = bench_fn(lambda x: layer_norm(x, w, b).sum(), (x,), steps=steps)
     gb = 2 * x.size * 2 / 1e9  # read+write bf16
-    print(f"layernorm fwd  dt={dt*1e3:7.2f}ms bw={gb/dt:.0f}GB/s")
+    _timed_op(lambda x: layer_norm(x, w, b), (x,), gbytes=gb,
+              name="layernorm fwd", steps=steps)
     gln = jax.grad(lambda x: layer_norm(x, w, b).astype(jnp.float32).sum())
-    dt = bench_fn(gln, (x,), steps=steps)
-    print(f"layernorm bwd  dt={dt*1e3:7.2f}ms bw={2*gb/dt:.0f}GB/s")
+    _timed_op(gln, (x,), gbytes=2 * gb, name="layernorm bwd", steps=steps)
 
-    # plain matmul at layer shapes for the MXU ceiling
+    # plain matmuls at layer shapes for the MXU ceiling
     a = jax.random.normal(rng, (B * S, D), jnp.bfloat16)
     w1 = jax.random.normal(rng, (D, 4 * D), jnp.bfloat16)
-    dt = bench_fn(lambda a, w1: (a @ w1).sum(), (a, w1), steps=steps)
     mf = 2 * B * S * D * 4 * D
-    print(f"matmul 768x3072 fwd dt={dt*1e3:7.2f}ms eff={mf/dt/PEAK:.3f}")
-    gmm = jax.grad(lambda a, w1: (a @ w1).astype(jnp.float32).sum(), argnums=(0, 1))
-    dt = bench_fn(gmm, (a, w1), steps=steps)
-    print(f"matmul 768x3072 f+b dt={dt*1e3:7.2f}ms eff={3*mf/dt/PEAK:.3f}")
-    _ = np
+    _timed_op(lambda a, w1: a @ w1, (a, w1), flops=mf,
+              name="matmul 768x3072 fwd", steps=steps)
+    gmm = jax.grad(lambda a, w1: (a @ w1).astype(jnp.float32).sum(),
+                   argnums=(0, 1))
+    _timed_op(lambda a, w1: gmm(a, w1)[0], (a, w1), flops=3 * mf,
+              name="matmul 768x3072 f+b", steps=steps)
+    w2 = jax.random.normal(rng, (D, D), jnp.bfloat16)
+    _timed_op(lambda a, w2: a @ w2, (a, w2), flops=2 * B * S * D * D,
+              name="matmul 768x768 fwd", steps=steps)
+
+    # attention via plain XLA (chunk-free, bf16) for kernel comparison
+    def xla_attn(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (Dh ** 0.5)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e9)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(jnp.bfloat16)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    _timed_op(xla_attn, (q[:2], k[:2], v[:2]), flops=flops / 8,
+              name="xla attn fwd (B=2)", steps=steps)
 
 
 def main():
