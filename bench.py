@@ -16,6 +16,7 @@ measured fixed fetch round-trip is subtracted.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -43,6 +44,82 @@ def peak_flops() -> float:
 def sync(x) -> None:
     """Barrier that provably waits: fetch a scalar derived from x."""
     float(jax.tree.leaves(x)[0].sum())
+
+
+def bench_8b_rung(budget_s: float = 600.0):
+    """Llama-3-8B single-chip rung (BASELINE configs[2] / VERDICT r3 item 1).
+
+    8B bf16 params (16.1GB) exceed the 15.75GB v5e HBM, so this exercises
+    the ZeRO-Infinity param-streaming path: compute-dtype weights live in
+    pinned host memory and each scanned layer streams through a bounded
+    device window.  Measured: fwd+bwd tokens/sec per chip.  The full
+    CPU-Adam step is not timed on this runner — fp32 master+moments for 8B
+    are 96GB, exceeding this host's free RAM+disk — which is recorded in
+    the emitted status rather than silently skipped.
+    """
+    import numpy as np
+    import ml_dtypes
+    from jax.sharding import NamedSharding
+
+    t_start = time.perf_counter()
+    try:
+        from deepspeed_tpu.models import causal_lm
+        from deepspeed_tpu.runtime.zero.partition import (params_pspecs,
+                                                          shardings_from_pspecs)
+
+        mesh = build_mesh(devices=jax.devices()[:1])
+        model = causal_lm("llama3-8b", mesh=mesh, remat=True)
+        model.config.param_offload = True
+        cfg = model.config
+        micro, seq = 1, 1024
+
+        # init on HOST, leaf by leaf (a device init would need 32GB fp32)
+        rng = np.random.default_rng(0)
+        abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 8), jnp.int32))
+        def host_init(s):
+            scale = 0.02 if len(s.shape) <= 2 else s.shape[-1] ** -0.5
+            arr = (rng.standard_normal(s.shape, dtype=np.float32) * scale)
+            return arr.astype(ml_dtypes.bfloat16)
+        params_host = jax.tree.map(host_init, abstract)
+        n_params = sum(int(x.size) for x in jax.tree.leaves(params_host))
+
+        specs = params_pspecs(params_host, mesh, shard=False)
+        model.set_param_offload_specs(specs)
+        host_sh = jax.tree.map(
+            lambda s: NamedSharding(s.mesh, s.spec, memory_kind="pinned_host"),
+            shardings_from_pspecs(specs, mesh))
+        params = jax.device_put(params_host, host_sh)
+        del params_host
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (micro, seq), 0,
+                                    cfg.vocab_size)
+
+        def loss_of(p):
+            return model.apply(p, tokens, labels=tokens).astype(jnp.float32)
+
+        fwdbwd = jax.jit(jax.value_and_grad(loss_of))
+        loss, grads = fwdbwd(params)       # compile + first step
+        sync((loss,))
+        steps = 2
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, grads = fwdbwd(params)
+        sync((loss,))
+        dt = (time.perf_counter() - t0) / steps
+        tps = micro * seq / dt
+        fpt = 6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq
+        return {"status": "ok", "tokens_per_sec_fwd_bwd": round(tps, 2),
+                "params_b": round(n_params / 1e9, 3),
+                "micro_batch": micro, "seq": seq,
+                "step_ms": round(dt * 1e3, 1),
+                "mfu_fwd_bwd": round(tps * fpt / peak_flops(), 4),
+                "note": ("params host-tiered (16GB bf16 > 15.75GB HBM), "
+                         "streamed per-layer; optimizer step not timed: 96GB "
+                         "fp32 Adam states exceed this runner's RAM+disk")}
+    except Exception as exc:  # the 125M headline must still be emitted
+        return {"status": f"failed: {type(exc).__name__}",
+                "error": str(exc)[:200],
+                "elapsed_s": round(time.perf_counter() - t_start, 1)}
 
 
 def main():
@@ -111,6 +188,27 @@ def main():
     # separately in detail for comparison.
     dt = time.perf_counter() - t0
 
+    # The 8B rung is opt-in (DSTPU_BENCH_8B=1): on this runner the 16GB
+    # host-tiered param tree must travel through the remote-device relay,
+    # which takes tens of minutes before the first step — far past any
+    # bench budget.  The default emits the measured capability status; the
+    # param-streaming mechanism itself is exercised by tests/unit/
+    # test_param_offload.py on the CPU mesh and by small real-TPU programs.
+    if on_tpu and os.environ.get("DSTPU_BENCH_8B") == "1":
+        rung_8b = bench_8b_rung()
+    elif on_tpu:
+        rung_8b = {"status": "skipped: host->device staging of the 16GB "
+                             "param tier exceeds the bench budget through "
+                             "the remote-device relay on this runner",
+                   "mechanism": "ZeRO-Infinity param streaming (pinned-host "
+                                "params, per-layer device window) — "
+                                "tested on the virtual mesh; set "
+                                "DSTPU_BENCH_8B=1 to run the full rung",
+                   "params_b": 8.03, "hbm_needed_gb": 16.1,
+                   "hbm_present_gb": 15.75}
+    else:
+        rung_8b = None
+
     tokens_per_step = batch * seq
     tps = steps * tokens_per_step / dt
     n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
@@ -130,7 +228,8 @@ def main():
                    "flops_model": "6N + 6*L*D*S per token (dense causal; "
                                   "remat recompute not counted)",
                    "backend": jax.default_backend(),
-                   "device": getattr(jax.devices()[0], "device_kind", "?")},
+                   "device": getattr(jax.devices()[0], "device_kind", "?"),
+                   **({"llama3_8b": rung_8b} if rung_8b else {})},
     }))
 
 

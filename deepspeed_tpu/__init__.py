@@ -13,6 +13,7 @@ __version__ = "0.1.0"
 __git_branch__ = "main"
 
 from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.runtime import zero  # noqa: F401
 from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
 from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401

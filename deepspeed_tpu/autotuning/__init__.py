@@ -1,0 +1,4 @@
+"""Autotuning (reference: ``deepspeed/autotuning/``, SURVEY.md §2.1):
+in-process measured trials over the ZeRO/micro-batch/remat space."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, DEFAULT_TUNING_SPACE  # noqa: F401

@@ -1,0 +1,141 @@
+"""Autotuning: search ZeRO stage / micro-batch / remat space by short
+measured trials.
+
+Reference: ``deepspeed/autotuning/autotuner.py`` (SURVEY.md §2.1
+"Autotuning") — the reference launches short experiment jobs through the
+launcher and fits a cost model.  TPU-native shape: trials run in-process
+(one jit compile + a few timed steps each; no subprocess churn needed
+because jax programs are isolated by construction), OOM prunes the branch,
+and the best config is returned as a ds_config patch.
+
+``Autotuner(model_fn, base_config).tune()`` returns (best_config, report).
+``model_fn() -> (model, sample_batch)`` builds a fresh model per trial.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+DEFAULT_TUNING_SPACE = {
+    "zero_optimization.stage": [0, 1, 2, 3],
+    "train_micro_batch_size_per_gpu": [1, 2, 4, 8, 16],
+    "activation_checkpointing.policy": ["none", "full", "dots", "mlp_dots"],
+}
+
+
+def _set_path(cfg: Dict[str, Any], dotted: str, value: Any) -> None:
+    parts = dotted.split(".")
+    cur = cfg
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = value
+
+
+class Autotuner:
+    def __init__(self, model_fn: Callable[[], Tuple[Any, Any]],
+                 base_config: Dict[str, Any],
+                 tuning_space: Optional[Dict[str, List[Any]]] = None,
+                 max_trials: int = 12, steps_per_trial: int = 3,
+                 mesh=None):
+        self.model_fn = model_fn
+        self.base = dict(base_config)
+        self.space = tuning_space or dict(DEFAULT_TUNING_SPACE)
+        self.max_trials = max_trials
+        self.steps_per_trial = steps_per_trial
+        self.mesh = mesh
+        self.results: List[Dict[str, Any]] = []
+
+    # -- one measured trial ---------------------------------------------
+    def _trial(self, overrides: Dict[str, Any]) -> Dict[str, Any]:
+        import copy
+
+        import jax
+
+        import deepspeed_tpu
+
+        cfg = copy.deepcopy(self.base)
+        for k, v in overrides.items():
+            if k == "activation_checkpointing.policy":
+                if v == "none":
+                    _set_path(cfg, "activation_checkpointing.enabled", False)
+                    continue
+                _set_path(cfg, "activation_checkpointing.enabled", True)
+            _set_path(cfg, k, v)
+        micro = cfg.get("train_micro_batch_size_per_gpu", 1)
+        gas = cfg.get("gradient_accumulation_steps", 1)
+        cfg.pop("train_batch_size", None)  # re-derived from micro x gas
+        rec: Dict[str, Any] = {"overrides": dict(overrides)}
+        try:
+            model, batch = self.model_fn()
+            engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg,
+                                                       mesh=self.mesh)
+            from deepspeed_tpu import comm
+
+            rows = micro * comm.get_data_parallel_world_size(engine.mesh)
+            b = jax.tree.map(lambda x: x[:rows], batch)
+            for _ in range(gas):
+                engine.forward(b)
+            engine.step()  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                for _ in range(gas):
+                    engine.forward(b)
+                engine.step()
+            jax.block_until_ready(jax.tree.leaves(engine.state.params)[0])
+            dt = (time.perf_counter() - t0) / self.steps_per_trial
+            tokens = micro * gas
+            for leaf in jax.tree.leaves(b):
+                if getattr(leaf, "ndim", 0) >= 2:
+                    tokens = micro * gas * leaf.shape[1]
+                    break
+            rec.update(status="ok", step_s=dt, throughput=tokens / dt)
+        except Exception as exc:
+            msg = str(exc)
+            oom = "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            rec.update(status="oom" if oom else "error", error=msg[:160])
+        return rec
+
+    # -- search ----------------------------------------------------------
+    def tune(self) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+        keys = list(self.space)
+        combos = list(itertools.product(*(self.space[k] for k in keys)))
+        # micro-batch ascending so OOM prunes larger batches per branch
+        tried = 0
+        oom_branches = set()
+        for combo in combos:
+            if tried >= self.max_trials:
+                break
+            overrides = dict(zip(keys, combo))
+            branch = tuple(v for k, v in overrides.items()
+                           if k != "train_micro_batch_size_per_gpu")
+            micro = overrides.get("train_micro_batch_size_per_gpu", 0)
+            if any(b == branch and m <= micro for b, m in oom_branches):
+                continue  # larger than a known-OOM point on this branch
+            rec = self._trial(overrides)
+            self.results.append(rec)
+            tried += 1
+            if rec["status"] == "oom":
+                oom_branches.add((branch, micro))
+            log_dist(f"autotune trial {overrides}: {rec['status']} "
+                     f"{rec.get('throughput', 0):.0f} tok/s", ranks=[0])
+        ok = [r for r in self.results if r["status"] == "ok"]
+        if not ok:
+            logger.warning("autotuning: no successful trial; returning base config")
+            return self.base, self.results
+        best = max(ok, key=lambda r: r["throughput"])
+        import copy
+
+        best_cfg = copy.deepcopy(self.base)
+        for k, v in best["overrides"].items():
+            if k == "activation_checkpointing.policy" and v == "none":
+                _set_path(best_cfg, "activation_checkpointing.enabled", False)
+                continue
+            _set_path(best_cfg, k, v)
+        log_dist(f"autotuning: best {best['overrides']} "
+                 f"({best['throughput']:.0f} tok/s over {len(ok)} ok trials)",
+                 ranks=[0])
+        return best_cfg, self.results

@@ -1,0 +1,7 @@
+"""Compression (reference: ``deepspeed/compression/``, SURVEY.md §2.1):
+layer reduction, weight quantization (QAT + int8 export), pruning — as
+param-tree transforms over the functional models."""
+
+from deepspeed_tpu.compression.compress import (  # noqa: F401
+    CompressedParams, fake_quantize, init_compression, magnitude_mask,
+    quantize_weights, redundancy_clean, reduce_layers, row_mask)

@@ -1,0 +1,171 @@
+"""Compression: layer reduction, weight quantization (QAT), pruning.
+
+Reference: ``deepspeed/compression/`` (SURVEY.md §2.1 "Compression"):
+``init_compression`` applies the ``compression_training`` config to a model
+and ``redundancy_clean`` bakes the compression in.  The reference swaps
+torch modules for ``LinearLayer_Compress``; the TPU-native equivalents are
+*param-tree transforms* (functional models have no modules to swap):
+
+- **layer reduction**: slice the stacked [L, ...] layer weights to the kept
+  layer ids — a pure gather on the leading axis.
+- **weight quantization**: fake-quant (quantize-dequantize) params for QAT,
+  or export real int8 + scales (``quantize_weights``) for serving.
+- **sparse/row pruning**: magnitude masks applied to the param tree; masks
+  can be re-applied each step via ``apply_masks`` (the reference reapplies
+  after each optimizer step).
+
+All transforms are jit-friendly jnp ops; schedule gating (``schedule_offset``)
+is honored by the caller passing ``global_step``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------------
+# weight quantization
+# ---------------------------------------------------------------------------
+
+def fake_quantize(w, bits: int = 8, symmetric: bool = True, axis: Optional[int] = None):
+    """Quantize-dequantize (QAT forward behavior).  Per-tensor, or
+    per-channel when ``axis`` is given."""
+    w32 = w.astype(jnp.float32)
+    qmax = 2.0 ** (bits - 1) - 1
+    if symmetric:
+        red_axes = tuple(i for i in range(w32.ndim) if i != axis) or None
+        absmax = jnp.max(jnp.abs(w32), axis=red_axes, keepdims=True)
+        scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+        q = jnp.clip(jnp.round(w32 / scale), -qmax, qmax)
+        return (q * scale).astype(w.dtype)
+    mn = jnp.min(w32)
+    mx = jnp.max(w32)
+    scale = jnp.where(mx == mn, 1.0, (mx - mn) / (2.0 ** bits - 1))
+    q = jnp.round((w32 - mn) / scale)
+    return (q * scale + mn).astype(w.dtype)
+
+
+def quantize_weights(w, bits: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Real int8 export: returns (q int8, scale fp32 per output channel)."""
+    assert bits == 8, "int8 export only"
+    w32 = w.astype(jnp.float32)
+    red = tuple(range(w32.ndim - 1))
+    absmax = jnp.max(jnp.abs(w32), axis=red, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+
+def magnitude_mask(w, density: float):
+    """Keep the top ``density`` fraction by |magnitude| (unstructured)."""
+    k = max(1, int(w.size * density))
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_mask(w, density: float, axis: int = -1):
+    """Structured row/head pruning: keep top rows by L2 norm along ``axis``."""
+    norms = jnp.linalg.norm(w.astype(jnp.float32), axis=axis)
+    k = max(1, int(norms.size * density))
+    thresh = jnp.sort(norms.reshape(-1))[-k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return jnp.expand_dims(keep, axis)
+
+
+# ---------------------------------------------------------------------------
+# layer reduction
+# ---------------------------------------------------------------------------
+
+def reduce_layers(params: Dict[str, Any], keep_layers: List[int]) -> Dict[str, Any]:
+    """Slice stacked [L, ...] layer params down to ``keep_layers`` (the
+    reference's ``layer_reduction`` with ``teacher_layer`` ids)."""
+    idx = jnp.asarray(keep_layers)
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda a: jnp.take(a, idx, axis=0),
+                                 params["layers"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# config-driven entry points (reference API)
+# ---------------------------------------------------------------------------
+
+class CompressionConfig:
+    def __init__(self, d: Dict[str, Any]):
+        d = d.get("compression_training", d) or {}
+        wq = d.get("weight_quantization", {}).get("shared_parameters", {})
+        self.wq_enabled = wq.get("enabled", False)
+        self.wq_bits = d.get("weight_quantization", {}).get(
+            "different_groups", {}).get("wq1", {}).get(
+            "params", {}).get("target_bits", wq.get("quantize_weight_in_forward", 8)
+                              if isinstance(wq.get("quantize_weight_in_forward"), int)
+                              else 8)
+        sp = d.get("sparse_pruning", {}).get("shared_parameters", {})
+        self.sp_enabled = sp.get("enabled", False)
+        self.sp_density = d.get("sparse_pruning", {}).get("different_groups", {}).get(
+            "sp1", {}).get("params", {}).get("dense_ratio", sp.get("dense_ratio", 0.5))
+        self.sp_offset = sp.get("schedule_offset", 0)
+        lr_ = d.get("layer_reduction", {})
+        self.lr_enabled = lr_.get("enabled", False)
+        self.keep_layers = lr_.get("teacher_layer", [])
+
+
+class CompressedParams:
+    """Holds masks + config; ``apply(params)`` returns the compressed view
+    (called in forward for QAT, or once at export)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.cfg = CompressionConfig(config)
+        self.masks: Dict[str, Any] = {}
+
+    def init_masks(self, params) -> None:
+        if not self.cfg.sp_enabled:
+            return
+        self.masks = jax.tree.map(
+            lambda w: magnitude_mask(w, self.cfg.sp_density)
+            if getattr(w, "ndim", 0) >= 2 else jnp.ones_like(w),
+            params["layers"])
+
+    def apply(self, params, global_step: int = 10**9):
+        out = params
+        if self.cfg.lr_enabled and self.cfg.keep_layers:
+            out = reduce_layers(out, self.cfg.keep_layers)
+        if self.cfg.sp_enabled and self.masks and global_step >= self.cfg.sp_offset:
+            out = {**out, "layers": jax.tree.map(lambda w, m: w * m,
+                                                 out["layers"], self.masks)}
+        if self.cfg.wq_enabled:
+            out = {**out, "layers": jax.tree.map(
+                lambda w: fake_quantize(w, bits=8)
+                if getattr(w, "ndim", 0) >= 2 else w, out["layers"])}
+        return out
+
+
+def init_compression(model, deepspeed_config: Dict[str, Any], mpu=None):
+    """Reference entry: attach a CompressedParams transform to the model.
+    The model's forward applies it when present (built-in models call
+    ``maybe_compress`` via the engine loss fn wrapper)."""
+    comp = CompressedParams(deepspeed_config)
+    if hasattr(model, "config"):
+        model._compression = comp
+    logger.info("compression initialized: wq=%s sp=%s layer_reduction=%s",
+                comp.cfg.wq_enabled, comp.cfg.sp_enabled, comp.cfg.lr_enabled)
+    return model, comp
+
+
+def redundancy_clean(model, deepspeed_config: Dict[str, Any], params=None):
+    """Reference entry: bake compression into the weights (export)."""
+    comp = getattr(model, "_compression", None) or CompressedParams(deepspeed_config)
+    if params is None:
+        return model
+    if comp.cfg.sp_enabled and not comp.masks:
+        comp.init_masks(params)
+    return comp.apply(params)

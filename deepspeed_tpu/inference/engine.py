@@ -81,7 +81,16 @@ class InferenceEngine:
         from deepspeed_tpu.runtime.checkpoint_engine.sharded import nest_keystrs
         import os
 
+        from deepspeed_tpu.module_inject.containers import (hf_to_params,
+                                                            is_hf_checkpoint,
+                                                            load_hf_state_dict)
+
         f = path
+        if is_hf_checkpoint(path):
+            # published HuggingFace checkpoint (safetensors/.bin + config.json)
+            self.set_params(hf_to_params(load_hf_state_dict(path),
+                                         self.module.config))
+            return
         if os.path.isdir(path):
             latest = os.path.join(path, "latest")
             if os.path.exists(latest):
@@ -112,18 +121,25 @@ class InferenceEngine:
             self._prefill_fns = {}
             self._gen_fns = {}
 
-    def _prefill(self, params, cache, tokens, pos):
+    def _prefill(self, params, cache, tokens, pos, last_idx):
+        """Returns (last-position logits [B, V], cache).  ``last_idx`` (the
+        true prompt length - 1, a traced scalar) is sliced INSIDE the
+        program — returning the full [B, Sb, V] logits for a 50k vocab would
+        materialize GBs just to keep one row."""
         s = tokens.shape[1]
         if s not in self._prefill_fns:
             model = self.module
 
             @functools.partial(jax.jit, donate_argnums=(1,))
-            def prefill(params, cache, tokens, pos):
+            def prefill(params, cache, tokens, pos, last_idx):
                 logits, cache = forward_with_cache(model, params, tokens, cache, pos)
-                return logits, cache
+                last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1,
+                                                    keepdims=False)
+                return last, cache
 
             self._prefill_fns[s] = prefill
-        return self._prefill_fns[s](params, cache, tokens, pos)
+        return self._prefill_fns[s](params, cache, tokens, pos,
+                                    jnp.asarray(last_idx, jnp.int32))
 
     def _gen_loop(self, settings):
         """One compiled program for the WHOLE decode loop: lax.while_loop
@@ -202,8 +218,7 @@ class InferenceEngine:
         # masked by position until overwritten by decode
         Sb = self._bucket(S, cache["k"].shape[3])
         padded = jnp.pad(tokens, ((0, 0), (0, Sb - S))) if Sb > S else tokens
-        all_logits, cache = self._prefill(self._params, cache, padded, 0)
-        logits = all_logits[:, S - 1]
+        logits, cache = self._prefill(self._params, cache, padded, 0, S - 1)
 
         buf = jnp.concatenate(
             [tokens, jnp.zeros((B, max_new_tokens), tokens.dtype)], axis=1)

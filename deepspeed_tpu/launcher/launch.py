@@ -59,12 +59,20 @@ def main(args=None) -> int:
         import socket
 
         hostname = socket.gethostname()
-        candidates = [i for i, h in enumerate(hosts)
-                      if h == hostname or h == hostname.split(".")[0]
-                      or hostname.startswith(h)]
+        short = hostname.split(".")[0]
+        exact = [i for i, h in enumerate(hosts) if h in (hostname, short)]
+        if exact:
+            candidates = exact
+        else:  # prefix fallback for clusters with decorated hostnames
+            candidates = [i for i, h in enumerate(hosts)
+                          if hostname.startswith(h)]
         if not candidates:
             raise ValueError(f"cannot resolve node_rank: hostname {hostname!r} "
                              f"not in world_info hosts {hosts}")
+        if len(candidates) > 1:
+            raise ValueError(f"ambiguous node_rank: hostname {hostname!r} "
+                             f"prefix-matches hosts "
+                             f"{[hosts[i] for i in candidates]}")
         args.node_rank = candidates[0]
         logger.info("resolved node_rank=%d from hostname %s", args.node_rank,
                     hostname)

@@ -37,12 +37,16 @@ class ModelConfig:
     position: str = "rope"                 # "rope" | "learned"
     rope_theta: float = 10000.0
     tie_embeddings: bool = False
+    use_bias: bool = False                 # attn/mlp projection biases (gpt2)
     dropout: float = 0.0                   # residual dropout (needs a dropout rng)
     # MoE (mixtral family); num_experts == 0 -> dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # "scatter": O(N·k·D) scatter/gather dispatch (default);
+    # "einsum": GShard one-hot [N,E,C] einsums (O(N²·k/E), parity reference)
+    moe_dispatch: str = "scatter"
     # training-time knobs
     sp_mode: str = "auto"                  # "auto" | "ulysses" | "ring" (sp>1)
     pp_microbatches: int = 0               # pipeline microbatches (0 -> pp size)

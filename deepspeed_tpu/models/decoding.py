@@ -85,9 +85,17 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         h_in = carry
         lp, kc, vc = xs
         h = norm(h_in, lp["attn_norm"], cfg.norm, cfg.norm_eps)
-        q = (h @ lp["attn"]["wq"].astype(h.dtype)).reshape(B, s, H, Dh).transpose(0, 2, 1, 3)
-        k = (h @ lp["attn"]["wk"].astype(h.dtype)).reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
-        v = (h @ lp["attn"]["wv"].astype(h.dtype)).reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
+        a = lp["attn"]
+        q = h @ a["wq"].astype(h.dtype)
+        k = h @ a["wk"].astype(h.dtype)
+        v = h @ a["wv"].astype(h.dtype)
+        if cfg.use_bias:
+            q = q + a["bq"].astype(h.dtype)
+            k = k + a["bk"].astype(h.dtype)
+            v = v + a["bv"].astype(h.dtype)
+        q = q.reshape(B, s, H, Dh).transpose(0, 2, 1, 3)
+        k = k.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
+        v = v.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
         if cfg.position == "rope":
             q = apply_rotary_pos_emb(q, cos, sin)
             k = apply_rotary_pos_emb(k, cos, sin)
@@ -95,7 +103,10 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, start_pos, 0))
         o = _cached_attention(q, kc, vc, q_pos, scale)
         o = o.transpose(0, 2, 1, 3).reshape(B, s, H * Dh)
-        h_in = h_in + (o @ lp["attn"]["wo"].astype(h.dtype))
+        o = o @ a["wo"].astype(h.dtype)
+        if cfg.use_bias:
+            o = o + a["bo"].astype(h.dtype)
+        h_in = h_in + o
 
         h = norm(h_in, lp["mlp_norm"], cfg.norm, cfg.norm_eps)
         if cfg.is_moe:
@@ -104,10 +115,20 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
                                  h, cfg, mesh)
         else:
             act = activation_fn(cfg.activation)
-            up = h @ lp["mlp"]["w_up"].astype(h.dtype)
-            gated = (act(h @ lp["mlp"]["w_gate"].astype(h.dtype)) * up
-                     if cfg.glu else act(up))
-            mlp_out = gated @ lp["mlp"]["w_down"].astype(h.dtype)
+            m = lp["mlp"]
+            up = h @ m["w_up"].astype(h.dtype)
+            if cfg.use_bias:
+                up = up + m["b_up"].astype(h.dtype)
+            if cfg.glu:
+                gate = h @ m["w_gate"].astype(h.dtype)
+                if cfg.use_bias:
+                    gate = gate + m["b_gate"].astype(h.dtype)
+                gated = act(gate) * up
+            else:
+                gated = act(up)
+            mlp_out = gated @ m["w_down"].astype(h.dtype)
+            if cfg.use_bias:
+                mlp_out = mlp_out + m["b_down"].astype(h.dtype)
         h_in = h_in + mlp_out
         return h_in, (kc, vc)
 

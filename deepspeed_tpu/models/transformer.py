@@ -88,6 +88,11 @@ class CausalLM:
             "wv": linit(next(keys), (D, Hkv * Dh), s_in),
             "wo": linit(next(keys), (H * Dh, D), (H * Dh) ** -0.5),
         }
+        if cfg.use_bias:
+            attn.update(bq=jnp.zeros((L, H * Dh), dtype),
+                        bk=jnp.zeros((L, Hkv * Dh), dtype),
+                        bv=jnp.zeros((L, Hkv * Dh), dtype),
+                        bo=jnp.zeros((L, D), dtype))
         if cfg.is_moe:
             mlp = {
                 "gate_w": _uniform(next(keys), (L, D, E), s_in, dtype),
@@ -103,6 +108,11 @@ class CausalLM:
             }
             if cfg.glu:
                 mlp["w_gate"] = linit(next(keys), (D, F), s_in)
+            if cfg.use_bias:
+                mlp.update(b_up=jnp.zeros((L, F), dtype),
+                           b_down=jnp.zeros((L, D), dtype))
+                if cfg.glu:
+                    mlp["b_gate"] = jnp.zeros((L, F), dtype)
         layers = {"attn_norm": norm_p,
                   "mlp_norm": jax.tree.map(jnp.copy, norm_p),
                   "attn": attn, "mlp": mlp}
@@ -134,6 +144,11 @@ class CausalLM:
         if cfg.norm == "layernorm":
             norm_spec["bias"] = P(None, None)
         attn = {"wq": col, "wk": col, "wv": col, "wo": row}
+        if cfg.use_bias:
+            # column-split outputs carry tp-split biases; row outputs are
+            # reduced across tp, so their bias stays replicated
+            attn.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"),
+                        bo=P(None, None))
         if cfg.is_moe:
             mlp = {"gate_w": P(None, None, None),
                    "w_up": P(None, "ep", None, "tp"),
@@ -144,6 +159,10 @@ class CausalLM:
             mlp = {"w_up": col, "w_down": row}
             if cfg.glu:
                 mlp["w_gate"] = col
+            if cfg.use_bias:
+                mlp.update(b_up=P(None, "tp"), b_down=P(None, None))
+                if cfg.glu:
+                    mlp["b_gate"] = P(None, "tp")
         fnorm = {"scale": P(None)}
         if cfg.norm == "layernorm":
             fnorm["bias"] = P(None)
@@ -174,9 +193,15 @@ class CausalLM:
         B, S, D = x.shape
         H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         h = norm(x, lp["attn_norm"], cfg.norm, cfg.norm_eps)
-        q = (h @ lp["attn"]["wq"]).reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
-        k = (h @ lp["attn"]["wk"]).reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
-        v = (h @ lp["attn"]["wv"]).reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
+        a = lp["attn"]
+        q = h @ a["wq"]
+        k = h @ a["wk"]
+        v = h @ a["wv"]
+        if cfg.use_bias:
+            q, k, v = q + a["bq"], k + a["bk"], v + a["bv"]
+        q = q.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         if cfg.position == "rope":  # [B, H, S, Dh] is the kernel's layout
             q = apply_rotary_pos_emb(q, cos, sin)
             k = apply_rotary_pos_emb(k, cos, sin)
@@ -184,7 +209,10 @@ class CausalLM:
         v = _repeat_kv(v, H // Hkv)
         o = attention_core(q, k, v, mesh, causal=True, sp_mode=cfg.sp_mode)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
-        o = (o @ lp["attn"]["wo"]).astype(x.dtype)
+        o = o @ a["wo"]
+        if cfg.use_bias:
+            o = o + a["bo"]
+        o = o.astype(x.dtype)
         if use_drop:
             o = _dropout(o, k_attn, cfg.dropout)
         x = x + o
@@ -199,9 +227,20 @@ class CausalLM:
             mlp_out, aux = moe_mlp(lp["mlp"], h, cfg, mesh)
         else:
             act = activation_fn(cfg.activation)
-            up = h @ lp["mlp"]["w_up"]
-            gated = act(h @ lp["mlp"]["w_gate"]) * up if cfg.glu else act(up)
-            mlp_out = gated @ lp["mlp"]["w_down"]
+            m = lp["mlp"]
+            up = h @ m["w_up"]
+            if cfg.use_bias:
+                up = up + m["b_up"]
+            if cfg.glu:
+                gate = h @ m["w_gate"]
+                if cfg.use_bias:
+                    gate = gate + m["b_gate"]
+                gated = act(gate) * up
+            else:
+                gated = act(up)
+            mlp_out = gated @ m["w_down"]
+            if cfg.use_bias:
+                mlp_out = mlp_out + m["b_down"]
             aux = jnp.zeros((), jnp.float32)
         mlp_out = mlp_out.astype(x.dtype)
         if use_drop:
@@ -322,6 +361,53 @@ class CausalLM:
                     lambda c, xs: scan_body(c, xs), xmb, (wl, keys_l))
                 return y, jnp.sum(auxes)
 
+            if labels is not None:
+                # loss-in-pipeline: the last stage folds each finished
+                # microbatch straight into CE sums — the O(global-batch)
+                # replicated hidden-state buffer never exists
+                head_pp = (params["embed"]["tok"].T if cfg.tie_embeddings
+                           else params["lm_head"])
+                mask_arg = (loss_mask if loss_mask is not None
+                            else jnp.ones(labels.shape, jnp.int32))
+                has_mask = loss_mask is not None
+
+                def reduce_mb(y_mb, r_xs, consts):
+                    # dense CE over one microbatch (small by construction);
+                    # blockwise CE's checkpoint+scan trips XLA CHECKs under
+                    # the partial-manual region on CPU (jax 0.9)
+                    lab_mb, m_mb = r_xs
+                    fnorm_c, head_c = consts
+                    h = norm(y_mb, fnorm_c, cfg.norm, cfg.norm_eps)
+                    logits = (h[:, :-1] @ head_c.astype(h.dtype)
+                              ).astype(jnp.float32)
+                    lab = lab_mb[:, 1:]
+                    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                    # one-hot contraction, not take_along_axis: XLA's SPMD
+                    # partitioner CHECK-crashes partitioning that gather
+                    # under the partial-manual pp region (jax 0.9)
+                    gold = jnp.einsum(
+                        "bsv,bsv->bs", logits,
+                        jax.nn.one_hot(jnp.maximum(lab, 0), logits.shape[-1],
+                                       dtype=logits.dtype))
+                    nll = lse - gold
+                    if cfg.z_loss:
+                        nll = nll + cfg.z_loss * lse ** 2
+                    valid = lab >= 0
+                    if has_mask:
+                        valid = valid & (m_mb[:, 1:] > 0)
+                    return {"nll": jnp.where(valid, nll, 0.0).sum(),
+                            "cnt": valid.sum().astype(jnp.float32)}
+
+                red, aux_loss = spmd_pipeline(
+                    stage_fn, params["layers"], x, mesh,
+                    num_microbatches=cfg.pp_microbatches,
+                    broadcast_args=(cos, sin), scan_args=keys,
+                    reduce_fn=reduce_mb, reduce_xs=(labels, mask_arg),
+                    reduce_consts=(params["final_norm"], head_pp))
+                loss = red["nll"] / jnp.maximum(red["cnt"], 1.0)
+                return (loss + cfg.moe_aux_loss_coef * aux_loss
+                        if cfg.is_moe else loss)
+
             x, aux_loss = spmd_pipeline(stage_fn, params["layers"], x, mesh,
                                         num_microbatches=cfg.pp_microbatches,
                                         broadcast_args=(cos, sin), scan_args=keys)
@@ -394,7 +480,7 @@ def cross_entropy(logits, labels, z_loss: float = 0.0, mask=None):
 
 
 def blockwise_cross_entropy(x, head, labels, chunk: int, z_loss: float = 0.0,
-                            mask=None):
+                            mask=None, return_sums: bool = False):
     """LM loss without materializing the full [B, S, V] logits.
 
     The reference's fused-softmax CUDA kernels attack the same bandwidth
@@ -442,6 +528,8 @@ def blockwise_cross_entropy(x, head, labels, chunk: int, z_loss: float = 0.0,
     xs_args = (xs, ls) if ms is None else (xs, ls, ms)
     (tot, cnt), _ = jax.lax.scan(block, (jnp.zeros((), jnp.float32),
                                          jnp.zeros((), jnp.int32)), xs_args)
+    if return_sums:
+        return tot, cnt
     return tot / jnp.maximum(cnt, 1)
 
 

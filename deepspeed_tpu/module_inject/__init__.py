@@ -43,5 +43,19 @@ def tp_model_init(model, tp_size: int = 1, dtype=None, params: Any = None, mesh=
 
 def replace_module(model=None, **kwargs):
     """Reference parity shim: kernel swapping is the compiled default on TPU;
-    returns the model unchanged."""
+    returns the model unchanged.  Warns when kernel-injection kwargs are
+    passed so silently ignored intent is visible."""
+    ignored = {k: v for k, v in kwargs.items()
+               if k in ("replace_with_kernel_inject", "injection_policy",
+                        "checkpoint") and v}
+    if ignored:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning("replace_module: %s ignored (fused kernels are the "
+                       "default compiled path on TPU)", sorted(ignored))
     return model
+
+
+from deepspeed_tpu.module_inject.containers import (  # noqa: E402,F401
+    causal_lm_from_hf, config_from_hf, hf_to_params, is_hf_checkpoint,
+    load_hf_state_dict)

@@ -1,0 +1,247 @@
+"""HF checkpoint import: published GPT-2 / Llama / Mixtral weights -> the
+built-in models' param trees.
+
+Reference: ``deepspeed/module_inject/containers/`` (SURVEY.md §2.1 row 34) —
+the containers' real job is mapping public HuggingFace state dicts into the
+runtime's layout.  Here that means: read safetensors / torch .bin shards,
+rename + transpose into the CausalLM tree (stacked [L, ...] layer weights,
+input-major linear layout), and derive the ModelConfig from config.json.
+
+Conventions handled:
+- HF ``nn.Linear`` stores [out, in] -> transposed to our [in, out].
+- GPT-2 ``Conv1D`` stores [in, out] -> copied as-is; fused c_attn split into
+  wq/wk/wv; biases mapped (our models carry biases when ``use_bias``).
+- Llama/Mixtral rotary uses the half-split pairing — identical to our RoPE
+  kernel, so q/k import without permutation.
+- Mixtral experts w1/w3/w2 -> w_gate/w_up/w_down stacked on a leading [E].
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def load_hf_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a HF checkpoint dir (safetensors preferred, torch .bin fallback)
+    into {name: np.ndarray}."""
+    sd: Dict[str, np.ndarray] = {}
+    st_files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if st_files:
+        from safetensors.numpy import load_file
+
+        for f in st_files:
+            sd.update(load_file(os.path.join(path, f)))
+        return sd
+    bin_files = sorted(f for f in os.listdir(path)
+                       if f.endswith(".bin") and "pytorch_model" in f)
+    if bin_files:
+        import torch
+
+        for f in bin_files:
+            part = torch.load(os.path.join(path, f), map_location="cpu",
+                              weights_only=True)
+            sd.update({k: v.float().numpy() if v.dtype == torch.bfloat16
+                       else v.numpy() for k, v in part.items()})
+        return sd
+    raise FileNotFoundError(f"no safetensors/.bin weights in {path}")
+
+
+def _strip_prefix(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    for prefix in ("transformer.", "model."):
+        if any(k.startswith(prefix) for k in sd):
+            out = {}
+            for k, v in sd.items():
+                out[k[len(prefix):] if k.startswith(prefix) else k] = v
+            return out
+    return sd
+
+
+def detect_arch(sd: Dict[str, np.ndarray]) -> str:
+    keys = set(sd)
+    if any("block_sparse_moe" in k for k in keys):
+        return "mixtral"
+    if any("wte.weight" in k for k in keys):
+        return "gpt2"
+    if any("embed_tokens.weight" in k for k in keys):
+        return "llama"
+    raise ValueError(f"unrecognized HF architecture (keys: {sorted(keys)[:8]}...)")
+
+
+def config_from_hf(path: str):
+    """ModelConfig from a HF config.json."""
+    from deepspeed_tpu.models.config import ModelConfig
+
+    with open(os.path.join(path, "config.json")) as fh:
+        hf = json.load(fh)
+    mt = hf.get("model_type", "")
+    if mt == "gpt2":
+        return ModelConfig(
+            vocab_size=hf["vocab_size"], hidden_size=hf["n_embd"],
+            intermediate_size=4 * hf["n_embd"], num_layers=hf["n_layer"],
+            num_heads=hf["n_head"], max_seq_len=hf.get("n_positions", 1024),
+            norm="layernorm", norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            activation="gelu", glu=False, position="learned",
+            tie_embeddings=True, use_bias=True)
+    if mt in ("llama", "mistral"):
+        return ModelConfig(
+            vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads"),
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            norm="rmsnorm", norm_eps=hf.get("rms_norm_eps", 1e-5),
+            activation="silu", glu=True, position="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            tie_embeddings=hf.get("tie_word_embeddings", False))
+    if mt == "mixtral":
+        return ModelConfig(
+            vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads"),
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            norm="rmsnorm", norm_eps=hf.get("rms_norm_eps", 1e-5),
+            activation="silu", glu=True, position="rope",
+            rope_theta=hf.get("rope_theta", 1e6),
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+            tie_embeddings=hf.get("tie_word_embeddings", False))
+    raise ValueError(f"unsupported HF model_type {mt!r}")
+
+
+def _stack(sd, fmt: str, L: int, transform=None) -> np.ndarray:
+    parts = [sd[fmt.format(i)] for i in range(L)]
+    if transform is not None:
+        parts = [transform(p) for p in parts]
+    return np.stack(parts)
+
+
+def hf_to_params(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """Map a HF state dict onto the CausalLM param tree."""
+    sd = _strip_prefix(sd)
+    arch = detect_arch(sd)
+    L, D = cfg.num_layers, cfg.hidden_size
+    T = lambda w: np.ascontiguousarray(w.T)
+
+    if arch == "gpt2":
+        qkv = [sd[f"h.{i}.attn.c_attn.weight"] for i in range(L)]      # [D, 3D]
+        qkv_b = [sd[f"h.{i}.attn.c_attn.bias"] for i in range(L)]      # [3D]
+        attn = {
+            "wq": np.stack([w[:, :D] for w in qkv]),
+            "wk": np.stack([w[:, D:2 * D] for w in qkv]),
+            "wv": np.stack([w[:, 2 * D:] for w in qkv]),
+            "wo": _stack(sd, "h.{}.attn.c_proj.weight", L),
+            "bq": np.stack([b[:D] for b in qkv_b]),
+            "bk": np.stack([b[D:2 * D] for b in qkv_b]),
+            "bv": np.stack([b[2 * D:] for b in qkv_b]),
+            "bo": _stack(sd, "h.{}.attn.c_proj.bias", L),
+        }
+        mlp = {
+            "w_up": _stack(sd, "h.{}.mlp.c_fc.weight", L),
+            "b_up": _stack(sd, "h.{}.mlp.c_fc.bias", L),
+            "w_down": _stack(sd, "h.{}.mlp.c_proj.weight", L),
+            "b_down": _stack(sd, "h.{}.mlp.c_proj.bias", L),
+        }
+        params = {
+            "embed": {"tok": sd["wte.weight"], "pos": sd["wpe.weight"]},
+            "layers": {
+                "attn_norm": {"scale": _stack(sd, "h.{}.ln_1.weight", L),
+                              "bias": _stack(sd, "h.{}.ln_1.bias", L)},
+                "mlp_norm": {"scale": _stack(sd, "h.{}.ln_2.weight", L),
+                             "bias": _stack(sd, "h.{}.ln_2.bias", L)},
+                "attn": attn, "mlp": mlp,
+            },
+            "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        }
+        return params
+
+    if arch == "llama":
+        attn = {
+            "wq": _stack(sd, "layers.{}.self_attn.q_proj.weight", L, T),
+            "wk": _stack(sd, "layers.{}.self_attn.k_proj.weight", L, T),
+            "wv": _stack(sd, "layers.{}.self_attn.v_proj.weight", L, T),
+            "wo": _stack(sd, "layers.{}.self_attn.o_proj.weight", L, T),
+        }
+        mlp = {
+            "w_gate": _stack(sd, "layers.{}.mlp.gate_proj.weight", L, T),
+            "w_up": _stack(sd, "layers.{}.mlp.up_proj.weight", L, T),
+            "w_down": _stack(sd, "layers.{}.mlp.down_proj.weight", L, T),
+        }
+    else:  # mixtral
+        E = cfg.num_experts
+        attn = {
+            "wq": _stack(sd, "layers.{}.self_attn.q_proj.weight", L, T),
+            "wk": _stack(sd, "layers.{}.self_attn.k_proj.weight", L, T),
+            "wv": _stack(sd, "layers.{}.self_attn.v_proj.weight", L, T),
+            "wo": _stack(sd, "layers.{}.self_attn.o_proj.weight", L, T),
+        }
+        def experts(wname):
+            return np.stack([
+                np.stack([T(sd[f"layers.{i}.block_sparse_moe.experts.{e}.{wname}.weight"])
+                          for e in range(E)]) for i in range(L)])
+        mlp = {
+            "gate_w": _stack(sd, "layers.{}.block_sparse_moe.gate.weight", L, T),
+            "w_gate": experts("w1"),   # HF w1 = gate_proj
+            "w_down": experts("w2"),   # HF w2 = down_proj
+            "w_up": experts("w3"),     # HF w3 = up_proj
+        }
+    params = {
+        "embed": {"tok": sd["embed_tokens.weight"]},
+        "layers": {
+            "attn_norm": {"scale": _stack(sd, "layers.{}.input_layernorm.weight", L)},
+            "mlp_norm": {"scale": _stack(
+                sd, "layers.{}.post_attention_layernorm.weight", L)},
+            "attn": attn, "mlp": mlp,
+        },
+        "final_norm": {"scale": sd["norm.weight"]},
+    }
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        params["lm_head"] = (T(head) if head is not None
+                             else T(sd["embed_tokens.weight"]))
+    return params
+
+
+def causal_lm_from_hf(path: str, mesh=None, dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """One-call import: HF checkpoint dir -> (CausalLM, params tree)."""
+    from deepspeed_tpu.models.transformer import CausalLM
+
+    cfg = config_from_hf(path)
+    sd = load_hf_state_dict(path)
+    params = hf_to_params(sd, cfg)
+    if dtype is not None:
+        import ml_dtypes
+
+        np_dtype = {"bfloat16": ml_dtypes.bfloat16}.get(str(dtype), dtype)
+        params = {k: _tree_astype(v, np_dtype) for k, v in params.items()}
+    n = sum(int(x.size) for x in _tree_leaves(params))
+    logger.info("imported HF checkpoint %s: %s, %.2fM params", path,
+                detect_arch(_strip_prefix(sd)), n / 1e6)
+    return CausalLM(cfg, mesh=mesh), params
+
+
+def is_hf_checkpoint(path: str) -> bool:
+    return (os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json"))
+            and any(f.endswith((".safetensors", ".bin")) for f in os.listdir(path)))
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _tree_astype(tree, np_dtype):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(np_dtype) if np.issubdtype(a.dtype, np.floating) else a,
+        tree)

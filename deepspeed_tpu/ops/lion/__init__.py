@@ -1,0 +1,64 @@
+"""DeepSpeedCPULion: host Lion step over offloaded fp32 states.
+
+Reference parity: ``deepspeed/ops/lion/cpu_lion.py`` (verified API at
+SURVEY.md (L2:93)).  The C step is compiled into csrc/cpu_adam
+(``ds_lion_step``); this wrapper makes it reachable from the offload path
+(VERDICT r2 row 50).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedCPULion:
+    def __init__(self, params: Optional[List[np.ndarray]] = None, lr: float = 1e-4,
+                 betas=(0.9, 0.99), weight_decay: float = 0.0):
+        self.lr = lr
+        self.betas = betas
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self.params = [np.ascontiguousarray(p, np.float32) for p in (params or [])]
+        self.state: Dict[int, Dict[str, np.ndarray]] = {}
+        try:
+            from deepspeed_tpu.ops.op_builder.native import CPUAdamBuilder
+
+            self._native = CPUAdamBuilder().load()
+        except Exception as e:  # pragma: no cover
+            logger.warning("cpu_lion native lib unavailable (%s); numpy fallback", e)
+            self._native = None
+
+    def _native_step(self, p: np.ndarray, g: np.ndarray, m: np.ndarray):
+        b1, b2 = self.betas
+        self._native.ds_lion_step(
+            ctypes.c_int64(p.size),
+            p.ctypes.data_as(ctypes.c_void_p), g.ctypes.data_as(ctypes.c_void_p),
+            m.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_float(self.lr), ctypes.c_float(b1), ctypes.c_float(b2),
+            ctypes.c_float(self.weight_decay))
+
+    def _numpy_step(self, p, g, m):
+        b1, b2 = self.betas
+        update = np.sign(b1 * m + (1 - b1) * g)
+        if self.weight_decay:
+            update = update + self.weight_decay * p
+        p -= self.lr * update
+        m *= b2
+        m += (1 - b2) * g
+
+    def step(self, grads: Optional[List[np.ndarray]] = None):
+        self.step_count += 1
+        for i, p in enumerate(self.params):
+            if i not in self.state:
+                self.state[i] = {"exp_avg": np.zeros_like(p)}
+            g = np.ascontiguousarray(grads[i], np.float32).reshape(p.shape)
+            m = self.state[i]["exp_avg"]
+            if self._native is not None:
+                self._native_step(p.reshape(-1), g.reshape(-1), m.reshape(-1))
+            else:
+                self._numpy_step(p, g, m)

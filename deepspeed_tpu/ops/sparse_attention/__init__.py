@@ -1,0 +1,18 @@
+"""Block-sparse attention.
+
+Reference: ``deepspeed/ops/sparse_attention/`` (SURVEY.md §2.1 "Sparse
+attention") — Triton block-sparse matmul/softmax kernels driven by a
+``SparsityConfig`` family (fixed, bigbird, bslongformer, variable).
+
+TPU-native shape: the sparsity layout is a STATIC [nq, nk] block mask built
+host-side by the same config family; compute gathers only the allowed KV
+blocks per query block (static max-degree padding keeps shapes fixed for
+XLA) and runs an online-softmax over them — block-skipping delivers the
+FLOP/memory win the Triton kernels got, without materializing [S, S].
+"""
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (  # noqa: F401
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparsityConfig, VariableSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (  # noqa: F401
+    SparseSelfAttention, block_sparse_attention)

@@ -1,0 +1,106 @@
+"""Block-sparse attention compute + SparseSelfAttention wrapper.
+
+Reference: ``deepspeed/ops/sparse_attention/{matmul,softmax,sparse_self_attention}.py``
+— Triton SDD/DSD block matmuls around a block softmax.  TPU-native: gather
+the allowed KV blocks per query block (static max-degree from the layout,
+padded; XLA-friendly fixed shapes) and run an online softmax over the
+gathered blocks.  FLOPs and HBM traffic scale with the number of ALLOWED
+blocks, not S².
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _bsa(q, k, v, gather_idx, block: int, causal: bool):
+    """q/k/v: [B, H, S, D]; gather_idx: [H, nq, deg] int32 (padded with -1).
+
+    Computes, per query block, attention over its ``deg`` gathered KV blocks.
+    """
+    B, H, S, D = q.shape
+    nq = S // block
+    deg = gather_idx.shape[-1]
+    qb = q.reshape(B, H, nq, block, D)
+    kb = k.reshape(B, H, nq, block, D)
+    vb = v.reshape(B, H, nq, block, D)
+    scale = 1.0 / (D ** 0.5)
+
+    idx = jnp.maximum(gather_idx, 0)                              # [H, nq, deg]
+    valid = gather_idx >= 0                                       # [H, nq, deg]
+
+    def gather_blocks(xb):
+        # xb: [B, H, nk, block, D] -> [B, H, nq, deg, block, D]
+        return jax.vmap(lambda xh, ih: xh[:, ih], in_axes=(1, 0),
+                        out_axes=1)(xb, idx)
+
+    kg = gather_blocks(kb)
+    vg = gather_blocks(vb)
+    # scores: [B, H, nq, block, deg, block]
+    s = jnp.einsum("bhqid,bhqkjd->bhqikj", qb.astype(jnp.float32),
+                   kg.astype(jnp.float32)) * scale
+    s = jnp.where(valid[None, :, :, None, :, None], s, NEG_INF)
+    if causal:
+        qpos = (jnp.arange(nq)[:, None] * block
+                + jnp.arange(block)[None, :])                     # [nq, block]
+        kpos = (idx[..., None] * block
+                + jnp.arange(block)[None, None, None])            # [H,nq,deg,block]
+        mask = qpos[None, :, :, None, None] >= kpos[:, :, None, :, :]
+        s = jnp.where(mask[None], s, NEG_INF)
+    s_flat = s.reshape(B, H, nq, block, deg * block)
+    m = jnp.max(s_flat, axis=-1, keepdims=True)
+    p = jnp.exp(s_flat - m)
+    p = jnp.where(s_flat <= NEG_INF / 2, 0.0, p)
+    denom = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+    p = (p / denom).reshape(B, H, nq, block, deg, block)
+    out = jnp.einsum("bhqikj,bhqkjd->bhqid", p, vg.astype(jnp.float32))
+    return out.reshape(B, H, S, D).astype(q.dtype)
+
+
+def block_sparse_attention(q, k, v, layout: np.ndarray, block: int,
+                           causal: bool = False):
+    """Attention restricted to the layout's allowed blocks.
+
+    layout: [H, nq, nk] (numpy, static).  Compute cost is
+    O(max_degree / nk) of dense attention.
+    """
+    H, nq, nk = layout.shape
+    deg = max(1, int(layout.sum(axis=-1).max()))
+    gather = np.full((H, nq, deg), -1, np.int32)
+    for h in range(H):
+        for i in range(nq):
+            cols = np.nonzero(layout[h, i])[0]
+            gather[h, i, :len(cols)] = cols
+    return _bsa(q, k, v, jnp.asarray(gather), block, causal)
+
+
+class SparseSelfAttention:
+    """Reference-parity wrapper: config in, attention callable out."""
+
+    def __init__(self, sparsity_config, key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul", max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config
+        self._layouts = {}
+
+    def _layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        S = query.shape[-2]
+        layout = self._layout(S)
+        causal = getattr(self.sparsity_config, "attention",
+                         "bidirectional") == "unidirectional"
+        return block_sparse_attention(query, key, value, layout,
+                                      self.sparsity_config.block, causal=causal)

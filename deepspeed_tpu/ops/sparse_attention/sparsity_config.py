@@ -1,0 +1,176 @@
+"""Sparsity layout builders (reference: sparsity_config.py class family).
+
+Each config emits a static numpy block mask ``layout [num_heads, nq, nk]``
+(1 = compute the block).  Names, parameters, and pattern semantics follow
+the reference: ``Fixed`` (local + periodic global columns), ``BigBird``
+(random + window + global), ``BSLongformer`` (sliding window + global
+indices), ``Variable`` (custom local windows + globals), ``Dense``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class SparsityConfig:
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block:
+            raise ValueError(f"seq_len {seq_len} not divisible by block {self.block}")
+        n = seq_len // self.block
+        return np.zeros((self.num_heads, n, n), np.int8)
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def _apply_causal(self, layout: np.ndarray) -> np.ndarray:
+        n = layout.shape[-1]
+        return layout * np.tril(np.ones((n, n), np.int8))
+
+
+class DenseSparsityConfig(SparsityConfig):
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = 1
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Local blocks + periodic global columns (reference Fixed pattern)."""
+
+    def __init__(self, num_heads: int, block: int = 16, num_local_blocks: int = 4,
+                 num_global_blocks: int = 1, attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False,
+                 num_different_global_patterns: int = 1, **kw):
+        super().__init__(num_heads, block, **kw)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[-1]
+        L = self.num_local_blocks
+        for h in range(self.num_heads):
+            pat = (h % self.num_different_global_patterns
+                   if self.different_layout_per_head else 0)
+            for i in range(n):
+                blk = i // L
+                # local window: blocks in the same local chunk
+                lo, hi = blk * L, min(n, (blk + 1) * L)
+                layout[h, i, lo:hi] = 1
+                # global columns: last num_global_blocks of each prior chunk
+                for c in range(blk + 1):
+                    gstart = min(n, (c + 1) * L) - self.num_global_blocks - pat
+                    gstart = max(0, gstart)
+                    gend = min(n, gstart + self.num_global_blocks)
+                    layout[h, i, gstart:gend] = 1
+                if self.horizontal_global_attention:
+                    g = min(n, (blk + 1) * L) - self.num_global_blocks
+                    if max(0, g) <= i < max(0, g) + self.num_global_blocks:
+                        layout[h, i, :] = 1
+        if self.attention == "unidirectional":
+            layout = self._apply_causal(layout)
+        return layout
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """random + sliding window + global blocks (reference BigBird)."""
+
+    def __init__(self, num_heads: int, block: int = 16, num_random_blocks: int = 1,
+                 num_sliding_window_blocks: int = 3, num_global_blocks: int = 1,
+                 attention: str = "bidirectional", seed: int = 0, **kw):
+        super().__init__(num_heads, block, **kw)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[-1]
+        rng = np.random.default_rng(self.seed)
+        w = self.num_sliding_window_blocks // 2
+        for h in range(self.num_heads):
+            for i in range(n):
+                layout[h, i, max(0, i - w):min(n, i + w + 1)] = 1
+                cand = rng.choice(n, size=min(n, self.num_random_blocks),
+                                  replace=False)
+                layout[h, i, cand] = 1
+            layout[h, :, :self.num_global_blocks] = 1
+            layout[h, :self.num_global_blocks, :] = 1
+        if self.attention == "unidirectional":
+            layout = self._apply_causal(layout)
+        return layout
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """sliding window + user-specified global block indices."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 num_sliding_window_blocks: int = 3,
+                 global_block_indices: Optional[List[int]] = None,
+                 global_block_end_indices: Optional[List[int]] = None,
+                 attention: str = "bidirectional", **kw):
+        super().__init__(num_heads, block, **kw)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[-1]
+        w = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            layout[:, i, max(0, i - w):min(n, i + w + 1)] = 1
+        ends = (self.global_block_end_indices
+                or [g + 1 for g in self.global_block_indices])
+        for g, e in zip(self.global_block_indices, ends):
+            layout[:, :, g:e] = 1
+            layout[:, g:e, :] = 1
+        if self.attention == "unidirectional":
+            layout = self._apply_causal(layout)
+        return layout
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """custom local window sizes + global blocks (reference Variable)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 num_local_blocks: Optional[List[int]] = None,
+                 num_global_blocks: int = 1, attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False, **kw):
+        super().__init__(num_heads, block, **kw)
+        self.local_windows = num_local_blocks or [4]
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[-1]
+        start = 0
+        wi = 0
+        while start < n:
+            w = self.local_windows[min(wi, len(self.local_windows) - 1)]
+            end = min(n, start + w)
+            layout[:, start:end, start:end] = 1
+            start = end
+            wi += 1
+        layout[:, :, :self.num_global_blocks] = 1
+        if self.horizontal_global_attention:
+            layout[:, :self.num_global_blocks, :] = 1
+        if self.attention == "unidirectional":
+            layout = self._apply_causal(layout)
+        return layout

@@ -110,8 +110,14 @@ class FlopsProfiler:
         gas = 1
         if self.ds_engine is not None:
             gas = self.ds_engine.config.gradient_accumulation_steps
-        total = (self._cost.get("accum", {}).get("flops", 0.0) * gas
-                 + self._cost.get("apply", {}).get("flops", 0.0))
+        if "train_step" in self._cost:
+            # the fused single-dispatch program already spans all gas
+            # microbatches + the update: it IS the train step
+            total = self._cost["train_step"].get("flops", 0.0)
+        else:
+            total = (self._cost.get("accum", {}).get("flops", 0.0) * gas
+                     + self._cost.get("apply", {}).get("flops", 0.0)
+                     + self._cost.get("fwdbwd", {}).get("flops", 0.0) * gas)
         if not total and self._cost:
             total = sum(c.get("flops", 0.0) for c in self._cost.values())
         return number_to_string(total) + "FLOPs" if as_string else total

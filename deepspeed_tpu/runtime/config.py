@@ -83,8 +83,11 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     nvme_path: Optional[str] = None
     buffer_count: int = 4
     pin_memory: bool = False
-    pipeline_read: bool = False
-    pipeline_write: bool = False
+    # TPU-native default: the NVMe swapper pipelines read-ahead and async
+    # write-back unless explicitly disabled (the reference defaults these
+    # off because its plain swapper predates the pipelined one).
+    pipeline_read: bool = True
+    pipeline_write: bool = True
     fast_init: bool = False
     ratio: float = 1.0
 

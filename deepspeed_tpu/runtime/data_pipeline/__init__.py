@@ -1,0 +1,7 @@
+"""Data-efficiency pipeline (reference: ``deepspeed/runtime/data_pipeline/``,
+SURVEY.md §2.1): curriculum learning + random-LTD token dropping."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
+    CurriculumScheduler, truncate_batch)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (  # noqa: F401
+    RandomLTDScheduler, random_ltd_layer, random_token_select, scatter_back)

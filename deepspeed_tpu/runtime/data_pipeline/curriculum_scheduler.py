@@ -1,0 +1,90 @@
+"""Curriculum learning scheduler.
+
+Reference: ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+(SURVEY.md §2.1 "Data efficiency"): difficulty (typically sequence length)
+ramps from ``min_difficulty`` to ``max_difficulty`` on a fixed schedule.
+Schedules and config keys match the reference (``fixed_linear``,
+``fixed_root``, ``fixed_discrete``).
+
+TPU note: difficulty changes alter tensor shapes, so each distinct
+difficulty compiles one program.  ``difficulty_step`` (reference knob)
+quantizes the ramp — keep it coarse (e.g. 64) so the compile count stays
+small; ``CurriculumDataLoader``/``truncate_batch`` apply the current
+difficulty by slicing the sequence dim.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        assert "curriculum_type" in config, "curriculum_type required"
+        self.curriculum_type = config["curriculum_type"]
+        self.min_difficulty = config.get("min_difficulty", 8)
+        self.max_difficulty = config.get("max_difficulty", 1 << 30)
+        self.current_difficulty = self.min_difficulty
+        sched = config.get("schedule_config", config)
+        if self.curriculum_type in (FIXED_LINEAR, FIXED_ROOT):
+            self.total_step = sched.get("total_curriculum_step",
+                                        sched.get("total_step", 1000))
+            self.difficulty_step = sched.get("difficulty_step", 8)
+            self.root_degree = sched.get("root_degree", 2)
+        elif self.curriculum_type == FIXED_DISCRETE:
+            self.difficulties = list(sched["difficulty"])
+            self.max_steps = list(sched["max_step"])
+            assert len(self.difficulties) == len(self.max_steps) + 1, \
+                "need one more difficulty than boundaries"
+        else:
+            raise ValueError(f"unknown curriculum_type {self.curriculum_type}")
+
+    def update_difficulty(self, global_steps: int) -> int:
+        t = self.curriculum_type
+        if t == FIXED_DISCRETE:
+            d = self.difficulties[-1]
+            for diff, boundary in zip(self.difficulties, self.max_steps):
+                if global_steps <= boundary:
+                    d = diff
+                    break
+            self.current_difficulty = d
+            return d
+        frac = min(1.0, global_steps / max(1, self.total_step))
+        if t == FIXED_ROOT:
+            frac = frac ** (1.0 / self.root_degree)
+        raw = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        quant = self.difficulty_step
+        d = int(raw // quant * quant)
+        d = max(self.min_difficulty, min(self.max_difficulty, d))
+        self.current_difficulty = d
+        return d
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = state["current_difficulty"]
+
+
+def truncate_batch(batch, difficulty: int, seq_axis: int = 1):
+    """Apply the current difficulty by truncating the sequence dim — the
+    reference's seqlen-based curriculum semantics."""
+    import jax
+
+    def trunc(x):
+        if hasattr(x, "ndim") and x.ndim > seq_axis and x.shape[seq_axis] > difficulty:
+            sl = [slice(None)] * x.ndim
+            sl[seq_axis] = slice(0, difficulty)
+            return x[tuple(sl)]
+        return x
+
+    return jax.tree.map(trunc, batch)

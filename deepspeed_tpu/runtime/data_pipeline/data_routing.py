@@ -1,0 +1,74 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``deepspeed/runtime/data_pipeline/data_routing/`` + the
+``csrc/random_ltd`` token-sort/gather kernels (SURVEY.md §2.1 "Data
+efficiency", §2.2 "Random-LTD"): during training, middle layers process a
+random subset of tokens; the skipped tokens bypass the layer and rejoin
+afterwards.  On TPU the gather/scatter is plain ``jnp.take_along_axis``
+over a random permutation — XLA fuses it (the CUDA sort/gather kernels
+exist because of eager-launch overheads; SURVEY §2.2 prescribes exactly
+this jnp mapping).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_token_select(x, rng, keep: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pick ``keep`` random token positions per sequence.
+
+    x: [B, S, D] -> (kept [B, keep, D], perm [B, S]) where perm's first
+    ``keep`` entries index the kept tokens (the rest are the dropped ones,
+    used to restore order in :func:`scatter_back`).
+    """
+    B, S, _ = x.shape
+    noise = jax.random.uniform(rng, (B, S))
+    perm = jnp.argsort(noise, axis=-1)                 # random permutation
+    kept = jnp.take_along_axis(x, perm[:, :keep, None], axis=1)
+    return kept, perm
+
+
+def scatter_back(x_full, y_kept, perm, keep: int):
+    """Write processed kept tokens back into their original positions;
+    dropped tokens keep their (layer-input) values — the random-LTD bypass."""
+    idx = perm[:, :keep, None]
+    return jnp.take_along_axis(  # inverse permutation scatter via argsort
+        jnp.concatenate([y_kept,
+                         jnp.take_along_axis(x_full, perm[:, keep:, None], axis=1)],
+                        axis=1),
+        jnp.argsort(perm, axis=-1)[..., None], axis=1), idx
+
+
+class RandomLTDScheduler:
+    """Ramp the kept-token count from ``seq_start`` to the full sequence over
+    ``total_steps`` (reference: random_ltd schedule config)."""
+
+    def __init__(self, seq_start: int, seq_full: int, total_steps: int,
+                 step_size: int = 16):
+        self.seq_start = seq_start
+        self.seq_full = seq_full
+        self.total_steps = total_steps
+        self.step_size = step_size
+        self.current = seq_start
+
+    def update(self, global_step: int) -> int:
+        frac = min(1.0, global_step / max(1, self.total_steps))
+        raw = self.seq_start + frac * (self.seq_full - self.seq_start)
+        cur = int(raw // self.step_size * self.step_size)
+        self.current = max(self.seq_start, min(self.seq_full, cur))
+        return self.current
+
+
+def random_ltd_layer(layer_fn, x, rng, keep: int):
+    """Apply ``layer_fn`` to a random ``keep``-token subset; dropped tokens
+    bypass (identity).  ``layer_fn``: [B, keep, D] -> [B, keep, D]."""
+    if keep >= x.shape[1]:
+        return layer_fn(x)
+    kept, perm = random_token_select(x, rng, keep)
+    y_kept = layer_fn(kept)
+    out, _ = scatter_back(x, y_kept, perm, keep)
+    return out

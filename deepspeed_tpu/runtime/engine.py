@@ -141,6 +141,13 @@ class DeepSpeedEngine:
                      if self.config.optimizer else "")
         self._onebit = (_opt_name in ("onebitadam", "zerooneadam", "onebitlamb")
                         and not self._offload)
+        if (_opt_name in ("onebitadam", "zerooneadam", "onebitlamb")
+                and self._offload):
+            logger.warning("%s with offload_optimizer: the compressed-"
+                           "communication path does not combine with host-"
+                           "offloaded states (reference constraint); states "
+                           "will be stepped by DeepSpeedCPUAdam instead",
+                           self.config.optimizer.type)
         if self._onebit:
             if self.zero_stage >= 2:
                 raise ValueError("1-bit optimizers do not support ZeRO stage >= 2 "
@@ -287,11 +294,18 @@ class DeepSpeedEngine:
                     "DeepSpeedCPUAdam on the host",
                     type(self.client_optimizer).__name__)
             opt_type = (self.config.optimizer.type if self.config.optimizer
-                        else "AdamW").lower()
-            if "adam" not in opt_type:
-                logger.warning("offload_optimizer supports the Adam family; "
-                               "%s config will be stepped by DeepSpeedCPUAdam",
-                               opt_type)
+                        else "AdamW").lower().replace("_", "").replace("-", "")
+            if "adagrad" in opt_type:
+                self._offload_opt_type = "adagrad"
+            elif "lion" in opt_type:
+                self._offload_opt_type = "lion"
+            else:
+                self._offload_opt_type = "adam"
+                if "adam" not in opt_type:
+                    logger.warning(
+                        "offload_optimizer supports the Adam/Adagrad/Lion "
+                        "families; %s config will be stepped by "
+                        "DeepSpeedCPUAdam", opt_type)
             self.optimizer = optax.identity()
         elif self.client_optimizer is not None:
             self.optimizer = self.client_optimizer
@@ -431,7 +445,9 @@ class DeepSpeedEngine:
             weight_decay=p.get("weight_decay", 0.0),
             adamw_mode=p.get("adam_w_mode", p.get("adamw_mode", True)),
             swap_dir=off.nvme_path, aio_config=self.config.aio,
-            pipeline=True)
+            pipeline=off.pipeline_read,
+            pipeline_write=off.pipeline_write,
+            opt_type=getattr(self, "_offload_opt_type", "adam"))
 
     def lazy_init_from_batch(self, batch: Any) -> None:
         """zero.Init-equivalent: abstract-init then shard-on-create
@@ -523,7 +539,8 @@ class DeepSpeedEngine:
 
         def offload_prep(state: TrainState):
             """Device half of the offload step: unscale + clip; grads leave
-            the device once, already final."""
+            the device once, already final — in bf16 when the engine computes
+            in bf16 (halves D2H traffic and feeds the csrc bf16g fast path)."""
             scale = state.scaler.scale if fp16 else jnp.float32(1.0)
             overflow = has_overflow(state.grad_acc) if fp16 else jnp.zeros((), bool)
             grads = jax.tree.map(lambda g: g / scale, state.grad_acc)
@@ -531,6 +548,10 @@ class DeepSpeedEngine:
                 grads, gnorm = clip_grad_norm(grads, clip)
             else:
                 gnorm = global_norm(grads)
+            if compute_dtype == jnp.bfloat16:
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.bfloat16)
+                    if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
             return grads, gnorm, overflow
 
         def offload_commit(state: TrainState, overflow):
@@ -801,9 +822,16 @@ class DeepSpeedEngine:
         return gnorm, False
 
     def _step_offload(self):
-        """Optimizer step with host-resident states (ZeRO-Offload path):
-        device prep (unscale/clip) -> grads to host -> DeepSpeedCPUAdam ->
-        updated compute-dtype params back to device."""
+        """Optimizer step with host-resident states (ZeRO-Offload path),
+        leaf-streamed and overlapped (reference: pipelined_optimizer_swapper):
+
+        - all grad D2H transfers are put in flight up front
+          (``copy_to_host_async``), so leaf i+1 streams while leaf i steps;
+        - bf16 engines use the csrc ``ds_adam_step_bf16g`` fast path — bf16
+          grads in, bf16 params out, no fp32 conversion pass;
+        - each leaf's updated params go back with a per-leaf async
+          ``device_put``, overlapping H2D with the next leaf's host step.
+        """
         import ml_dtypes
 
         state = self.state
@@ -812,17 +840,39 @@ class DeepSpeedEngine:
         # flag here costs nothing extra (reference offload is host-synced too).
         skipped = self.fp16_enabled and bool(overflow)
         if not skipped:
-            grads_flat = [np.asarray(g) for g in
-                          jax.tree_util.tree_leaves(jax.device_get(grads))]
+            flat, treedef = jax.tree_util.tree_flatten(grads)
+            for leaf in flat:  # start every D2H now; np.asarray below collects
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:
+                    pass
             lr = self.get_lr()[0]
-            masters = self._offload_opt.step([g.reshape(-1) for g in grads_flat], lr=lr)
+            opt = self._offload_opt
             np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
                         jnp.float16: np.float16}.get(self.compute_dtype, np.float32)
-            # step() already returns the updated masters; rebuilding the tree
-            # from them avoids a second full read of every NVMe state file.
-            master = self._offload_opt.tree_from_masters(masters)
-            compute = jax.tree.map(lambda a: a.astype(np_dtype), master)
-            new_params = jax.device_put(compute, self._param_shardings)
+            use_bf16g = (opt.opt_type == "adam"
+                         and self.compute_dtype == jnp.bfloat16
+                         and opt.adam is not None)
+            shardings = jax.tree_util.tree_leaves(self._param_shardings)
+            opt.begin_step(lr=lr)
+            new_leaves = []
+            for i, leaf in enumerate(flat):
+                g = np.asarray(leaf)
+                if use_bf16g and str(g.dtype) == "bfloat16":
+                    # fresh buffer per leaf: device_put is async, so a reused
+                    # buffer could be overwritten mid-transfer
+                    out = opt.step_leaf_bf16(i, g.reshape(-1),
+                                             np.empty(opt._sizes[i],
+                                                      ml_dtypes.bfloat16))
+                else:
+                    master = opt.step_leaf(
+                        i, np.ascontiguousarray(g, np.float32).reshape(-1))
+                    out = master.astype(np_dtype)
+                # per-leaf async H2D overlaps with the next leaf's host step
+                new_leaves.append(jax.device_put(out.reshape(opt._shapes[i]),
+                                                 shardings[i]))
+            opt.end_step()
+            new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         else:
             new_params = state.params
         zero_acc, steps, scaler = self._offload_commit_fn(state, overflow)
@@ -864,11 +914,10 @@ class DeepSpeedEngine:
             first = jax.tree.map(lambda x: x[0], stacked)
             self.lazy_init_from_batch(shard_batch(first, self.mesh))
         if self._fused_fn is None:  # offload path: host step between programs
-            for i in range(gas):
-                self.forward(jax.tree.map(lambda x: x[i], stacked))
-            loss = self._last_loss
+            losses = [self.forward(jax.tree.map(lambda x: x[i], stacked))
+                      for i in range(gas)]
             self.step()
-            return loss
+            return jnp.mean(jnp.stack(losses))
         stacked = shard_batch(stacked, self.mesh, stacked=True)
         self._rng, rng = jax.random.split(self._rng)
         if self.flops_profiler is not None:
@@ -901,8 +950,15 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         gas = self.config.gradient_accumulation_steps
         micros = [next(data_iter) for _ in range(gas)]
-        stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
-                               *micros)
+
+        def stack(*xs):
+            # keep device-resident batches on device (shard_batch reshards
+            # without a host hop); only host data goes through numpy
+            if all(isinstance(x, jax.Array) for x in xs):
+                return jnp.stack(xs)
+            return np.stack([np.asarray(x) for x in xs])
+
+        stacked = jax.tree.map(stack, *micros)
         loss = self.train_step(stacked)
         self.tput_timer.stop()
         return loss

@@ -1,0 +1,78 @@
+"""Hybrid engine: one model flipping between training and inference (RLHF).
+
+Reference: ``deepspeed/runtime/hybrid_engine.py`` (SURVEY.md §2.1 "Hybrid
+engine (RLHF)"): in RLHF loops the actor alternates between ZeRO-3 training
+steps and fast generation; the reference re-gathers/releases params and
+swaps kernels per phase.
+
+TPU-native: params are immutable sharded arrays, so the "flip" is free —
+the inference engine reads the training state's params directly (same
+buffers; ``device_put`` only reshards if the serving layout differs).  No
+gather, no kernel swap, no copies when layouts agree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Training engine + generation on the live weights (reference class)."""
+
+    def __init__(self, *args, inference_config: Optional[dict] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_config = dict(inference_config or {})
+        self._infer_engine = None
+        self._in_generate = False
+
+    # -- reference API ---------------------------------------------------
+    def eval(self):
+        self._training = False
+        return self
+
+    def train(self, mode: bool = True):
+        self._training = mode
+        return self
+
+    def _inference_engine(self):
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        if self._infer_engine is None:
+            cfg = dict(self._inference_config)
+            cfg.setdefault("dtype", "bfloat16" if self.bfloat16_enabled
+                           else ("float16" if self.fp16_enabled else "float32"))
+            cfg.setdefault("max_out_tokens", 2048)
+            self._infer_engine = InferenceEngine(
+                self.module, DeepSpeedInferenceConfig(**cfg), mesh=self.mesh)
+            log_dist("hybrid engine: inference path initialized", ranks=[0])
+        return self._infer_engine
+
+    def generate(self, input_ids, **kwargs):
+        """Generate with the CURRENT training weights — the RLHF actor's
+        experience-collection phase.  Weights are shared by reference; the
+        inference engine reshards lazily only if layouts differ."""
+        if self.state is None:
+            raise RuntimeError("generate() before training state exists")
+        engine = self._inference_engine()
+        if engine._params is None or self._params_stale:
+            engine.set_params(self.state.params)
+            self._params_stale = False
+        return engine.generate(input_ids, **kwargs)
+
+    @property
+    def _params_stale(self) -> bool:
+        # params change on every optimizer step; track by step count
+        cur = self._host_steps
+        stale = getattr(self, "_gen_step_sync", -1) != cur
+        return stale
+
+    @_params_stale.setter
+    def _params_stale(self, value: bool) -> None:
+        if not value:
+            self._gen_step_sync = self._host_steps

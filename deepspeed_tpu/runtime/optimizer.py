@@ -81,7 +81,17 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
                            optax.adam(learning_rate, **_adam_args(p)))
     if name == ADAMW_OPTIMIZER:
         return optax.adamw(learning_rate, weight_decay=wd, **_adam_args(p))
-    if name in (LAMB_OPTIMIZER, FUSED_LAMB):
+    if name == FUSED_LAMB:
+        # Pallas two-phase LAMB kernel (norm reductions fused into the
+        # moment-update pass); "torch_lamb": true opts back into optax.
+        if not p.get("torch_lamb", False):
+            from deepspeed_tpu.ops.pallas.fused_lamb import fused_lamb
+
+            a = _adam_args(p)
+            return fused_lamb(learning_rate, beta1=a["b1"], beta2=a["b2"],
+                              eps=p.get("eps", 1e-6), weight_decay=wd)
+        name = LAMB_OPTIMIZER
+    if name == LAMB_OPTIMIZER:
         return optax.lamb(learning_rate, weight_decay=wd, **_adam_args(p))
     if name == LION_OPTIMIZER:
         betas = p.get("betas", (0.9, 0.99))

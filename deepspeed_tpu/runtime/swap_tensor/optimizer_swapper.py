@@ -24,13 +24,12 @@ from deepspeed_tpu.ops.aio import aio_handle
 
 
 class OptimizerStateSwapper:
-    STATES = 3  # master, exp_avg, exp_avg_sq
-
     def __init__(self, swap_dir: str, sizes: List[int], aio_config=None,
-                 n_buffers: int = 3):
+                 n_buffers: int = 3, n_slots: int = 3):
         os.makedirs(swap_dir, exist_ok=True)
         self.dir = swap_dir
         self.sizes = sizes
+        self.STATES = n_slots  # master + aux slots (adam: m, v)
         kw = {}
         if aio_config is not None:
             kw = dict(block_size=aio_config.block_size,
@@ -66,7 +65,8 @@ class OptimizerStateSwapper:
     def initialize(self, i: int, master_flat: np.ndarray) -> None:
         """Create the state file: master = given, moments = 0."""
         buf = np.concatenate([master_flat.astype(np.float32),
-                              np.zeros(2 * self.sizes[i], np.float32)])
+                              np.zeros((self.STATES - 1) * self.sizes[i],
+                                       np.float32)])
         rc = self._write_h.sync_pwrite(buf, self._path(i))
         assert rc == 0, f"nvme write failed for leaf {i}"
 

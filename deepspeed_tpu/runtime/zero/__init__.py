@@ -1,0 +1,6 @@
+"""ZeRO: partitioning-as-sharding (partition.py), host/NVMe tiering
+(offload.py), and the reference param-context API (partition_parameters.py).
+Reference: ``deepspeed/runtime/zero/`` (SURVEY.md §2.1)."""
+
+from deepspeed_tpu.runtime.zero.partition_parameters import (  # noqa: F401
+    GatheredParameters, Init)

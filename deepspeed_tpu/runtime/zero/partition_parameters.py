@@ -1,0 +1,90 @@
+"""zero.Init / GatheredParameters — reference-parity param-context API.
+
+Reference: ``deepspeed/runtime/zero/partition_parameters.py`` (SURVEY.md
+§2.1 "zero.Init / partitioned params"; the ``GatheredParameters`` ctx mgr is
+verified-in-SURVEY API used by HF at (L1:344-346)).
+
+TPU-native semantics: parameters are jax arrays whose ZeRO partitioning is a
+*sharding*, so "gather" = fetch to host (numpy, mutable), "repartition" =
+``device_put`` back with the original shardings.  ``GatheredParameters``
+yields the mutable host tree; mutations made inside the context are written
+back on exit (matching the reference's modifier_rank contract — on TPU every
+process runs the same modification, or rank 0's result is broadcast).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Init:
+    """``with deepspeed.zero.Init():`` — reference context that makes modules
+    materialize pre-partitioned.  The TPU engine already abstract-inits and
+    shards on create (engine.lazy_init_from_batch), so this context is a
+    compatibility no-op that records its config for introspection."""
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None):
+        self.enabled = enabled
+        self.remote_device = remote_device
+        self.dtype = dtype
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class GatheredParameters:
+    """Gather -> modify -> repartition (reference ctx mgr).
+
+    ``params``: a pytree of jax arrays (e.g. ``engine.state.params`` or a
+    subtree), or an ``(engine, subpath)`` pair via ``engine=``/``path=``.
+    Inside the context, ``.params`` is a mutable numpy tree; on exit the
+    (possibly modified) values are re-placed with their original shardings.
+    When ``engine`` is given, the engine's live state is updated in place.
+    """
+
+    def __init__(self, params: Any = None, modifier_rank: Optional[int] = 0,
+                 fwd_module=None, enabled: bool = True, engine: Any = None):
+        self.enabled = enabled
+        self.engine = engine
+        self._src = params if params is not None else (
+            engine.state.params if engine is not None else None)
+        if self._src is None:
+            raise ValueError("GatheredParameters needs params or engine=")
+        self.params: Any = None
+        self._shardings = None
+
+    def __enter__(self):
+        if not self.enabled:
+            self.params = self._src
+            return self.params
+        self._shardings = jax.tree.map(
+            lambda a: a.sharding if isinstance(a, jax.Array) else None, self._src)
+        # mutable host copies (device_get hands back read-only buffers)
+        self.params = jax.tree.map(
+            lambda a: np.array(jax.device_get(a)), self._src)
+        return self.params
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self.enabled or exc_type is not None:
+            return False
+        replaced = jax.tree.map(
+            lambda host, sh: jax.device_put(host, sh) if sh is not None else host,
+            self.params, self._shardings)
+        if self.engine is not None:
+            if self._src is self.engine.state.params:
+                self.engine.state = self.engine.state._replace(params=replaced)
+            else:
+                logger.warning("GatheredParameters: engine given but params is "
+                               "a subtree; caller must reinstall .result")
+        self.result = replaced
+        return False

@@ -1,0 +1,89 @@
+"""HF checkpoint import tests (VERDICT r2 item 9): fixture-based logits
+parity against tiny HF-format checkpoints (GPT-2, Llama, Mixtral) written by
+the transformers library itself.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.module_inject import causal_lm_from_hf, is_hf_checkpoint
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _save_tiny(tmp_path, kind: str) -> str:
+    torch.manual_seed(0)
+    out = str(tmp_path / kind)
+    if kind == "gpt2":
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        model = transformers.GPT2LMHeadModel(cfg)
+    elif kind == "llama":
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        model = transformers.LlamaForCausalLM(cfg)
+    else:
+        cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        model = transformers.MixtralForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(out, safe_serialization=True)
+    return out
+
+
+def _hf_logits(path: str, toks: np.ndarray) -> np.ndarray:
+    model = transformers.AutoModelForCausalLM.from_pretrained(path)
+    model.eval()
+    with torch.no_grad():
+        return model(torch.tensor(toks)).logits.numpy()
+
+
+@pytest.mark.parametrize("kind", ["gpt2", "llama"])
+def test_logits_parity(tmp_path, kind, mesh8):
+    path = _save_tiny(tmp_path, kind)
+    assert is_hf_checkpoint(path)
+    toks = np.array([[1, 5, 9, 2, 77, 31, 8, 4]], np.int32)
+    want = _hf_logits(path, toks)
+
+    model, params = causal_lm_from_hf(path, mesh=mesh8)
+    model.config.remat = False
+    got = np.asarray(jax.jit(model.apply)(params, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_mixtral_imports_and_runs(tmp_path, mesh8):
+    """Mixtral: exact logits parity is confounded by our fixed-capacity
+    GShard dispatch (HF routes densely per token), so assert import shape
+    correctness + a finite forward instead."""
+    path = _save_tiny(tmp_path, "mixtral")
+    model, params = causal_lm_from_hf(path, mesh=mesh8)
+    model.config.remat = False
+    assert params["layers"]["mlp"]["w_up"].shape == (2, 4, 32, 64)
+    assert params["layers"]["mlp"]["gate_w"].shape == (2, 32, 4)
+    toks = jnp.asarray(np.array([[1, 5, 9, 2]], np.int32))
+    logits = jax.jit(model.apply)(params, toks)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert logits.shape == (1, 4, 128)
+
+
+def test_inference_engine_loads_hf(tmp_path, mesh8):
+    import deepspeed_tpu
+
+    path = _save_tiny(tmp_path, "llama")
+    model, params = causal_lm_from_hf(path, mesh=mesh8)
+    model.config.remat = False
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 32,
+                       "checkpoint": path})
+    out = engine.generate(jnp.asarray([[1, 5, 9]]), max_new_tokens=4)
+    assert out.shape == (1, 7)

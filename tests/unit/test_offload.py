@@ -111,3 +111,49 @@ def test_offload_checkpoint_resume(devices, rng, tmp_path):
 def test_offload_with_grad_accumulation(devices, rng):
     _, losses = _train(devices, rng, offload_device="cpu", steps=4, accum=2)
     assert losses[-1] < losses[0]
+
+
+class TestOffloadOptFamilies:
+    """CPU Adagrad/Lion reachable from the offload path (VERDICT r2 row 50)."""
+
+    @pytest.mark.parametrize("opt", ["Adagrad", "Lion"])
+    def test_offload_family_trains(self, opt):
+        from tests.unit.simple_model import SimpleModel, random_dataset
+
+        x, y = random_dataset(n=16)
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": opt, "params": {"lr": 1e-2}},
+               "zero_optimization": {"stage": 1,
+                                     "offload_optimizer": {"device": "cpu"}}}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16), config=cfg,
+            rng=jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(10):
+            loss = engine.forward((x[:8], y[:8]))
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        assert engine._offload_opt.opt_type == opt.lower()
+
+    @pytest.mark.parametrize("opt", ["Adagrad", "Lion"])
+    def test_native_matches_numpy(self, opt):
+        import numpy as np
+
+        if opt == "Adagrad":
+            from deepspeed_tpu.ops.adagrad import DeepSpeedCPUAdagrad as Cls
+        else:
+            from deepspeed_tpu.ops.lion import DeepSpeedCPULion as Cls
+        rng = np.random.default_rng(0)
+        p0 = rng.standard_normal(300).astype(np.float32)
+        g = rng.standard_normal(300).astype(np.float32)
+        nat = Cls(params=[p0.copy()], lr=1e-2, weight_decay=0.01)
+        ref = Cls(params=[p0.copy()], lr=1e-2, weight_decay=0.01)
+        ref._native = None
+        for _ in range(3):
+            nat.step([g])
+            ref.step([g])
+        np.testing.assert_allclose(nat.params[0], ref.params[0],
+                                   rtol=1e-5, atol=1e-6)

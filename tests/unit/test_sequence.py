@@ -96,3 +96,32 @@ def test_model_trains_on_sp_mesh(devices, rng, sp_mode):
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_ring_attention_residual_memory(devices, rng):
+    """VERDICT r2 item 10 done-criterion: backward residuals must be O(S/P)
+    — the custom VJP re-runs the ring instead of letting scan save every
+    visiting KV chunk (which would add ~2x the input bytes again)."""
+    from jax._src.ad_checkpoint import saved_residuals
+
+    from deepspeed_tpu.comm.mesh import build_mesh
+    from deepspeed_tpu.sequence.layer import ring_attention
+
+    mesh = build_mesh(sp=4, fsdp=2, devices=devices)
+    B, H, S, D = 2, 2, 64, 8
+    q = jax.random.normal(rng, (B, H, S, D))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, H, S, D))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, H, S, D))
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, mesh).astype(jnp.float32).sum()
+
+    res = saved_residuals(f, q, k, v)
+    res_bytes = sum(int(np.prod(aval.shape)) * aval.dtype.itemsize
+                    for aval, _ in res)
+    base = 3 * B * H * S * D * 4          # q, k, v inputs
+    out_lse = B * H * S * D * 4 + B * H * S * 4
+    # old scan-residual version saved every visited KV chunk (~+2x inputs);
+    # the custom VJP saves only inputs + out + lse (+ small scalars)
+    assert res_bytes <= base + out_lse + 4096, \
+        (res_bytes, base + out_lse)
