@@ -1,10 +1,11 @@
 """``deepspeed_tpu.comm`` — mesh-first communication layer (SURVEY.md §5.8)."""
 
-from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_reduce, all_to_all_single,
-                                     axis_index, barrier, broadcast, broadcast_object_list,
-                                     comms_logger, configure, get_local_rank, get_process_count,
-                                     get_rank, get_world_size, init_distributed, is_initialized,
-                                     log_summary, ppermute, reduce_scatter)
+from deepspeed_tpu.comm.comm import (ProcessGroup, ReduceOp, all_gather, all_reduce,
+                                     all_to_all_single, axis_index, barrier, broadcast,
+                                     broadcast_object_list, comms_logger, configure,
+                                     get_local_rank, get_process_count, get_rank,
+                                     get_world_size, init_distributed, is_initialized,
+                                     log_summary, new_group, ppermute, reduce_scatter)
 from deepspeed_tpu.comm.mesh import (MESH_AXES, axis_size, batch_sharding, build_mesh,
                                      data_axes, get_data_parallel_world_size,
                                      get_expert_parallel_world_size, get_global_mesh,

@@ -104,11 +104,13 @@ class CompressionConfig:
         d = d.get("compression_training", d) or {}
         wq = d.get("weight_quantization", {}).get("shared_parameters", {})
         self.wq_enabled = wq.get("enabled", False)
-        self.wq_bits = d.get("weight_quantization", {}).get(
-            "different_groups", {}).get("wq1", {}).get(
-            "params", {}).get("target_bits", wq.get("quantize_weight_in_forward", 8)
-                              if isinstance(wq.get("quantize_weight_in_forward"), int)
-                              else 8)
+        self.wq_bits = 8
+        for group in d.get("weight_quantization", {}).get(
+                "different_groups", {}).values():
+            bits = group.get("params", {}).get("target_bits")
+            if isinstance(bits, int):
+                self.wq_bits = bits
+                break
         sp = d.get("sparse_pruning", {}).get("shared_parameters", {})
         self.sp_enabled = sp.get("enabled", False)
         self.sp_density = d.get("sparse_pruning", {}).get("different_groups", {}).get(
@@ -137,14 +139,16 @@ class CompressedParams:
 
     def apply(self, params, global_step: int = 10**9):
         out = params
-        if self.cfg.lr_enabled and self.cfg.keep_layers:
-            out = reduce_layers(out, self.cfg.keep_layers)
+        # masks were built against the FULL layer stack: apply them before
+        # any layer reduction slices the leading dim
         if self.cfg.sp_enabled and self.masks and global_step >= self.cfg.sp_offset:
             out = {**out, "layers": jax.tree.map(lambda w, m: w * m,
                                                  out["layers"], self.masks)}
+        if self.cfg.lr_enabled and self.cfg.keep_layers:
+            out = reduce_layers(out, self.cfg.keep_layers)
         if self.cfg.wq_enabled:
             out = {**out, "layers": jax.tree.map(
-                lambda w: fake_quantize(w, bits=8)
+                lambda w: fake_quantize(w, bits=self.cfg.wq_bits)
                 if getattr(w, "ndim", 0) >= 2 else w, out["layers"])}
         return out
 

@@ -229,8 +229,15 @@ def causal_lm_from_hf(path: str, mesh=None, dtype=None) -> Tuple[Any, Dict[str, 
 
 
 def is_hf_checkpoint(path: str) -> bool:
-    return (os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json"))
-            and any(f.endswith((".safetensors", ".bin")) for f in os.listdir(path)))
+    """True only for genuine HF layouts (config.json + safetensors or
+    pytorch_model*.bin) — the framework's own shard_p*.bin files must not
+    match, or its checkpoints would become unloadable next to a config.json."""
+    if not (os.path.isdir(path)
+            and os.path.exists(os.path.join(path, "config.json"))):
+        return False
+    return any(f.endswith(".safetensors")
+               or (f.endswith(".bin") and "pytorch_model" in f)
+               for f in os.listdir(path))
 
 
 def _tree_leaves(tree):

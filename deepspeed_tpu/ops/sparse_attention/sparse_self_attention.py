@@ -98,6 +98,10 @@ class SparseSelfAttention:
 
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
                  attn_mask=None):
+        if rpe is not None or key_padding_mask is not None or attn_mask is not None:
+            raise NotImplementedError(
+                "SparseSelfAttention: rpe/key_padding_mask/attn_mask are not "
+                "supported yet — silently ignoring them would change results")
         S = query.shape[-2]
         layout = self._layout(S)
         causal = getattr(self.sparsity_config, "attention",

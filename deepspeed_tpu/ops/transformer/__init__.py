@@ -1,0 +1,9 @@
+"""Fused transformer layer wrappers.
+
+Reference: ``deepspeed/ops/transformer/`` — ``DeepSpeedTransformerLayer`` +
+``DeepSpeedTransformerConfig`` (the fused BERT-style training layer backed by
+csrc/transformer kernels; SURVEY.md §2.1 "Ops: transformer kernels").
+"""
+
+from deepspeed_tpu.ops.transformer.transformer import (  # noqa: F401
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)

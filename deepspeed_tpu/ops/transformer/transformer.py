@@ -1,0 +1,138 @@
+"""DeepSpeedTransformerLayer: the fused encoder layer, TPU-native.
+
+Reference: ``deepspeed/ops/transformer/transformer.py`` (+
+``csrc/transformer/*`` kernels, SURVEY.md §2.2 "Transformer training
+kernels"): a BERT-style post-LN (or pre-LN) encoder block where the CUDA
+version fuses LayerNorm, bias+GeLU, bias+dropout+residual, and strided-batch
+GEMM attention.  Here the same block is built from the Pallas kernel set
+(flash attention, fused LayerNorm) with XLA fusing the epilogues — the
+config surface matches the reference so user code ports directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas import flash_attention
+from deepspeed_tpu.ops.pallas.layer_norm import layer_norm
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """Reference config surface (unsupported CUDA-specific knobs accepted
+    and ignored where XLA owns the behavior)."""
+
+    batch_size: int = 1
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    heads: int = 12
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = 1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    seed: int = 0
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False     # memory trick; remat covers it
+    gelu_checkpoint: bool = False          # ditto
+    stochastic_mode: bool = False          # CUDA fast-path; XLA is deterministic
+    return_tuple: bool = False
+    training: bool = True
+
+
+class DeepSpeedTransformerLayer:
+    """Functional fused encoder layer: ``init(rng) -> params``;
+    ``apply(params, x, attention_mask=None, rng=None) -> y``."""
+
+    def __init__(self, config: DeepSpeedTransformerConfig,
+                 initial_weights: Optional[Dict[str, Any]] = None,
+                 initial_biases: Optional[Dict[str, Any]] = None):
+        self.config = config
+        self._initial = (initial_weights, initial_biases)
+
+    def init(self, rng) -> Dict[str, Any]:
+        c = self.config
+        D, F = c.hidden_size, c.intermediate_size
+        k = iter(jax.random.split(rng, 8))
+        s = c.initializer_range
+        norm_p = lambda: {"scale": jnp.ones((D,), jnp.float32),
+                          "bias": jnp.zeros((D,), jnp.float32)}
+        return {
+            "attn": {"wqkv": jax.random.normal(next(k), (D, 3 * D)) * s,
+                     "bqkv": jnp.zeros((3 * D,)),
+                     "wo": jax.random.normal(next(k), (D, D)) * s,
+                     "bo": jnp.zeros((D,))},
+            "attn_norm": norm_p(),
+            "mlp": {"w1": jax.random.normal(next(k), (D, F)) * s,
+                    "b1": jnp.zeros((F,)),
+                    "w2": jax.random.normal(next(k), (F, D)) * s,
+                    "b2": jnp.zeros((D,))},
+            "mlp_norm": norm_p(),
+        }
+
+    def apply(self, params, x, attention_mask=None, rng=None):
+        c = self.config
+        B, S, D = x.shape
+        H = c.heads
+        Dh = D // H
+        dtype = jnp.float16 if c.fp16 else x.dtype
+        x = x.astype(dtype)
+
+        def ln(t, p):
+            flat = t.reshape(-1, D)
+            return layer_norm(flat, p["scale"], p["bias"],
+                              eps=c.layer_norm_eps).reshape(t.shape)
+
+        def drop(t, key, rate):
+            if not c.training or rate <= 0.0 or key is None:
+                return t
+            keep = jax.random.bernoulli(key, 1.0 - rate, t.shape)
+            return jnp.where(keep, t / (1.0 - rate), jnp.zeros((), t.dtype))
+
+        k_attn = k_mlp = None
+        if rng is not None:
+            k_attn, k_mlp = jax.random.split(rng)
+
+        h = ln(x, params["attn_norm"]) if c.pre_layer_norm else x
+        qkv = h @ params["attn"]["wqkv"].astype(dtype) + params["attn"]["bqkv"].astype(dtype)
+        q, kk, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        if attention_mask is not None:
+            # masked path: additive-bias attention (BERT-style pad masking);
+            # mask: [B, S] (1 = attend) or broadcastable additive bias
+            from deepspeed_tpu.ops.pallas import mha_reference
+
+            m = jnp.asarray(attention_mask)
+            if m.ndim == 2:  # key padding mask -> additive bias on keys
+                bias = jnp.where(m[:, None, None, :] > 0, 0.0, -1e30)
+            else:
+                bias = m
+            o = mha_reference(to_heads(q), to_heads(kk), to_heads(v),
+                              causal=False, bias=bias)
+        else:
+            o = flash_attention(to_heads(q), to_heads(kk), to_heads(v),
+                                causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, D)
+        o = o @ params["attn"]["wo"].astype(dtype) + params["attn"]["bo"].astype(dtype)
+        o = drop(o, k_attn, c.hidden_dropout_ratio)
+        x = x + o
+        if not c.pre_layer_norm:
+            x = ln(x, params["attn_norm"])
+
+        h = ln(x, params["mlp_norm"]) if c.pre_layer_norm else x
+        h = jax.nn.gelu(h @ params["mlp"]["w1"].astype(dtype)
+                        + params["mlp"]["b1"].astype(dtype), approximate=True)
+        h = h @ params["mlp"]["w2"].astype(dtype) + params["mlp"]["b2"].astype(dtype)
+        h = drop(h, k_mlp, c.hidden_dropout_ratio)
+        x = x + h
+        if not c.pre_layer_norm:
+            x = ln(x, params["mlp_norm"])
+        return (x,) if c.return_tuple else x
+
+    def __call__(self, params, x, **kw):
+        return self.apply(params, x, **kw)

@@ -1,0 +1,6 @@
+"""Activation checkpointing (reference:
+``deepspeed/runtime/activation_checkpointing/``, SURVEY.md §2.1)."""
+
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (  # noqa: F401
+    CudaRNGStatesTracker, checkpoint, checkpoint_wrapper, configure,
+    get_cuda_rng_tracker, is_configured, model_parallel_cuda_manual_seed)

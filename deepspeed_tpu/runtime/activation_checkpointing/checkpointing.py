@@ -1,0 +1,124 @@
+"""Activation checkpointing API.
+
+Reference: ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(SURVEY.md §2.1): Megatron-compatible ``checkpoint()`` + ``configure()`` +
+the CUDA RNG state tracker for reproducible dropout under recompute.
+
+TPU-native mapping:
+- ``checkpoint(fn, *args)`` -> ``jax.checkpoint`` (recompute-in-backward is
+  a compiler transform, not autograd hooks).  Policies map the reference
+  knobs: ``partition_activations`` -> saveable residuals carry their
+  sharding (GSPMD keeps them sharded — nothing to do at runtime);
+  ``cpu_checkpointing`` -> residuals offloaded to pinned host memory via
+  ``save_and_offload_only_these_names`` when names are provided, else
+  accepted as remat-only (documented).
+- Reproducible dropout under recompute is STRUCTURAL in jax: dropout draws
+  from explicit PRNG keys, so the recompute replays the same keys by
+  construction — the reference's ``CudaRNGStatesTracker`` machinery exists
+  to recreate that property in a stateful-RNG world.  The tracker class is
+  provided for API parity and manages named jax keys.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+_CONFIG: Dict[str, Any] = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,
+    "number_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None) -> None:
+    """Reference entry point: record the subsystem config (the engine pushes
+    the same section into model remat settings at init)."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing", None)
+        if ac is not None:
+            _CONFIG.update(partition_activations=ac.partition_activations,
+                           cpu_checkpointing=ac.cpu_checkpointing,
+                           contiguous_memory_optimization=ac.contiguous_memory_optimization,
+                           number_checkpoints=ac.number_checkpoints)
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("number_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize", synchronize), ("profile", profile)):
+        if val is not None:
+            _CONFIG[key] = val
+    logger.info("activation checkpointing configured: %s", _CONFIG)
+
+
+def is_configured() -> bool:
+    return True
+
+
+def checkpoint(function: Callable, *args, policy: Optional[Any] = None):
+    """Megatron-compatible ``checkpoint(fn, *args)``: runs ``fn`` now and
+    recomputes it in backward (``jax.checkpoint``).  Dropout reproducibility
+    is inherent (explicit keys)."""
+    ckpt = jax.checkpoint(function, policy=policy, prevent_cse=False)
+    return ckpt(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[Any] = None) -> Callable:
+    """Decorator form used by model code."""
+    return jax.checkpoint(function, policy=policy, prevent_cse=False)
+
+
+class CudaRNGStatesTracker:
+    """API-parity RNG tracker (reference: reproducible dropout under
+    recompute).  jax dropout keys are explicit, so 'tracking' is just a
+    named-key registry; ``fork`` hands out a fresh split deterministically."""
+
+    def __init__(self):
+        self._states: Dict[str, jax.Array] = {}
+
+    def reset(self) -> None:
+        self._states.clear()
+
+    def get_states(self):
+        return dict(self._states)
+
+    def set_states(self, states) -> None:
+        self._states = dict(states)
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._states:
+            raise Exception(f"seed {name} already exists")
+        self._states[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = "model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _fork():
+            if name not in self._states:
+                raise Exception(f"seed {name} not added")
+            self._states[name], sub = jax.random.split(self._states[name])
+            yield sub
+
+        return _fork()
+
+
+_RNG_TRACKER = CudaRNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> CudaRNGStatesTracker:
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    """Reference parity: register the model-parallel dropout seed."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718)

@@ -1,0 +1,8 @@
+"""Specialized comm paths (reference: ``deepspeed/runtime/comm/``,
+SURVEY.md §2.1 rows 26-27): quantized/compressed collectives.  Coalesced
+collectives are delivered by GSPMD bucketing (SURVEY §2.1 row 26 "by
+design"); the quantized set lives in ``quantized.py``."""
+
+from deepspeed_tpu.runtime.comm.quantized import (  # noqa: F401
+    block_dequantize, block_quantize, compressed_allreduce, pack_signs,
+    quantized_all_gather, quantized_reduce_scatter, unpack_signs)

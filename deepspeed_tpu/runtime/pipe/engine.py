@@ -1,26 +1,51 @@
 """Pipeline engine (reference: ``deepspeed/runtime/pipe/engine.py``).
 
-The reference subclass replaces forward/backward with an instruction scheduler
-(SURVEY.md §3.4).  Here pipelining happens *inside* the jitted train step
-(runtime/pipe/spmd.py), so the engine surface is unchanged — this subclass
-only adds the pipeline-specific introspection the reference exposes and makes
-``train_batch``/``eval_batch`` the primary entry points.
+The reference subclass replaces forward/backward with an instruction
+scheduler (SURVEY.md §3.4).  Here pipelining happens *inside* the jitted
+train step (runtime/pipe/spmd.py), so this subclass adds the
+pipeline-specific surface around it: schedule/bubble introspection,
+microbatch accounting, and ``train_batch``/``eval_batch`` as the primary
+entry points (with the reference's data-iterator management —
+``set_dataiterator``/``reset_activation_shape`` parity).
 """
 
 from __future__ import annotations
 
+from typing import Any, Optional
+
 from deepspeed_tpu.comm.mesh import axis_size
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
 
 
 class PipelineEngine(DeepSpeedEngine):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.is_pipe_parallel = axis_size(self.mesh, "pp") > 1
+        self._data_iter = None
+        mcfg = getattr(self.module, "config", None)
+        self.micro_batches = (getattr(mcfg, "pp_microbatches", 0)
+                              or self.num_stages)
+        if self.is_pipe_parallel:
+            log_dist(f"pipeline engine: {self.num_stages} stages, "
+                     f"{self.micro_batches} microbatches, bubble "
+                     f"{self.bubble_fraction:.1%}", ranks=[0])
 
+    # -- schedule introspection -----------------------------------------
     @property
     def num_stages(self) -> int:
         return axis_size(self.mesh, "pp")
+
+    @property
+    def schedule_steps(self) -> int:
+        """GPipe fill-drain length: M + pp - 1 pipeline ticks per batch."""
+        return self.micro_batches + self.num_stages - 1
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the schedule — (pp-1)/(M+pp-1), the reference
+        TrainSchedule's cost model."""
+        return (self.num_stages - 1) / max(1, self.schedule_steps)
 
     def stage_id(self) -> int:
         # SPMD: every process drives all stages; stage placement is a mesh
@@ -32,3 +57,38 @@ class PipelineEngine(DeepSpeedEngine):
 
     def is_last_stage(self) -> bool:
         return True
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        # the whole schedule (all microbatches) runs inside one jitted step,
+        # so every train_batch IS an accumulation boundary
+        return True
+
+    # -- reference data-iterator management -------------------------------
+    def set_dataiterator(self, iterator) -> None:
+        self._data_iter = iterator
+
+    def set_batch_fn(self, fn) -> None:
+        """Reference API: transform applied to every batch pulled from the
+        data iterator (``train_batch`` wraps the iterator with it)."""
+        self._batch_fn = fn
+
+    def reset_activation_shape(self) -> None:
+        """Reference API: invalidate cached P2P buffer shapes.  Shapes are
+        compiled into the XLA program here; a new shape simply triggers a
+        new compile, so there is nothing to reset."""
+
+    def train_batch(self, data_iter=None):
+        it = data_iter or self._data_iter
+        if it is None and self.training_dataloader is not None:
+            from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+            self._data_iter = it = iter(RepeatingLoader(self.training_dataloader))
+        fn = getattr(self, "_batch_fn", None)
+        if fn is not None and it is not None:
+            it = (fn(b) for b in it)
+        loss = super().train_batch(it)
+        return loss
+
+    def eval_batch(self, data_iter=None, **kw):
+        it = data_iter or self._data_iter
+        return super().eval_batch(it)

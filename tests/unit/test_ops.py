@@ -333,3 +333,30 @@ class TestFusedLamb:
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
         assert isinstance(engine.state.opt_state, FusedLambState)
+
+
+class TestDeepSpeedTransformerLayer:
+    """Fused encoder layer (reference: ops/transformer; SURVEY.md §2.1)."""
+
+    def test_forward_backward_and_mask(self, rng):
+        from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                                   DeepSpeedTransformerLayer)
+
+        cfg = DeepSpeedTransformerConfig(hidden_size=64, intermediate_size=128,
+                                         heads=4)
+        layer = DeepSpeedTransformerLayer(cfg)
+        p = layer.init(rng)
+        x = jax.random.normal(jax.random.fold_in(rng, 1), (2, 16, 64))
+        y = jax.jit(layer.apply)(p, x)
+        assert y.shape == x.shape
+        g = jax.grad(lambda p: layer.apply(p, x).astype(jnp.float32).sum())(p)
+        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+        # key padding mask: masked keys must not influence real positions
+        mask = np.ones((2, 16), np.int32)
+        mask[:, 8:] = 0
+        y_mask = layer.apply(p, x, attention_mask=jnp.asarray(mask))
+        x2 = x.at[:, 8:].set(0.0)  # change padded content
+        y_mask2 = layer.apply(p, x2, attention_mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(y_mask[:, :8]),
+                                   np.asarray(y_mask2[:, :8]),
+                                   rtol=1e-4, atol=1e-5)
