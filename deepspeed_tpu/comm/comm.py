@@ -268,12 +268,27 @@ class ProcessGroup:
     def size(self) -> int:
         return len(self.ranks)
 
-    def all_reduce(self, x, op: str = "sum"):
-        """Eager allreduce over the subset (control plane): runs a tiny
-        shard_map program on the group's sub-mesh."""
+    def all_reduce(self, values, op: str = "sum"):
+        """Eager allreduce over the subset (single-controller control
+        plane): ``values`` carries ONE entry per group member (leading dim
+        == ``size()``, or a list of per-member values); entry i is placed on
+        member i's device and the reduction runs over the sub-mesh axis.
+        Multi-process eager reduction is not supported — inside jit, use
+        ``group.mesh``/``group.axis`` with shard_map instead."""
         from jax.sharding import PartitionSpec
 
         import functools
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "eager ProcessGroup.all_reduce is single-controller only; "
+                "use group.mesh with shard_map inside jit for multi-host")
+        stacked = (jnp.stack([jnp.asarray(v) for v in values])
+                   if isinstance(values, (list, tuple))
+                   else jnp.asarray(values))
+        if stacked.shape[0] != self.size():
+            raise ValueError(f"expected {self.size()} per-member values, "
+                             f"got leading dim {stacked.shape[0]}")
 
         @functools.partial(jax.shard_map, mesh=self.mesh,
                            in_specs=PartitionSpec(self.AXIS),
@@ -281,7 +296,6 @@ class ProcessGroup:
         def _reduce(xl):
             return all_reduce(xl, self.AXIS, op=op)[0]
 
-        stacked = jnp.stack([jnp.asarray(x)] * self.size())
         placed = jax.device_put(
             stacked, jax.sharding.NamedSharding(self.mesh,
                                                 PartitionSpec(self.AXIS)))

@@ -1,9 +1,12 @@
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention, mha_reference
 from deepspeed_tpu.ops.pallas.fused_adam import fused_adam_update
 from deepspeed_tpu.ops.pallas.layer_norm import layer_norm, rms_norm
+from deepspeed_tpu.ops.pallas.quantizer import (dequantize, pack_int4, quantize,
+                                                unpack_int4)
 from deepspeed_tpu.ops.pallas.rope import apply_rotary_pos_emb, rope_angles
 from deepspeed_tpu.ops.pallas.softmax import bias_act, scaled_masked_softmax
 
 __all__ = ["flash_attention", "mha_reference", "fused_adam_update", "layer_norm",
            "rms_norm", "apply_rotary_pos_emb", "rope_angles", "bias_act",
-           "scaled_masked_softmax"]
+           "scaled_masked_softmax", "quantize", "dequantize", "pack_int4",
+           "unpack_int4"]

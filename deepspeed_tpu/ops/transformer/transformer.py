@@ -88,32 +88,48 @@ class DeepSpeedTransformerLayer:
             return layer_norm(flat, p["scale"], p["bias"],
                               eps=c.layer_norm_eps).reshape(t.shape)
 
+        if (c.training and rng is None
+                and (c.attn_dropout_ratio > 0 or c.hidden_dropout_ratio > 0)):
+            raise ValueError(
+                "DeepSpeedTransformerLayer: dropout is configured "
+                f"(attn={c.attn_dropout_ratio}, hidden={c.hidden_dropout_ratio}) "
+                "but no rng was passed to apply(); pass rng= or zero the "
+                "ratios — silently training without dropout would diverge "
+                "from the reference layer")
+
         def drop(t, key, rate):
             if not c.training or rate <= 0.0 or key is None:
                 return t
             keep = jax.random.bernoulli(key, 1.0 - rate, t.shape)
             return jnp.where(keep, t / (1.0 - rate), jnp.zeros((), t.dtype))
 
-        k_attn = k_mlp = None
+        k_attn = k_probs = k_mlp = None
         if rng is not None:
-            k_attn, k_mlp = jax.random.split(rng)
+            k_attn, k_probs, k_mlp = jax.random.split(rng, 3)
 
         h = ln(x, params["attn_norm"]) if c.pre_layer_norm else x
         qkv = h @ params["attn"]["wqkv"].astype(dtype) + params["attn"]["bqkv"].astype(dtype)
         q, kk, v = jnp.split(qkv, 3, axis=-1)
         to_heads = lambda t: t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
-        if attention_mask is not None:
-            # masked path: additive-bias attention (BERT-style pad masking);
-            # mask: [B, S] (1 = attend) or broadcastable additive bias
-            from deepspeed_tpu.ops.pallas import mha_reference
-
-            m = jnp.asarray(attention_mask)
-            if m.ndim == 2:  # key padding mask -> additive bias on keys
-                bias = jnp.where(m[:, None, None, :] > 0, 0.0, -1e30)
-            else:
-                bias = m
-            o = mha_reference(to_heads(q), to_heads(kk), to_heads(v),
-                              causal=False, bias=bias)
+        use_probs_drop = (c.training and c.attn_dropout_ratio > 0
+                          and k_probs is not None)
+        if attention_mask is not None or use_probs_drop:
+            # dense path: additive-bias attention (BERT-style pad masking)
+            # and/or attention-probability dropout (the flash kernel has no
+            # dropout hook; the reference CUDA layer drops probs here too)
+            bias = None
+            if attention_mask is not None:
+                m = jnp.asarray(attention_mask)
+                bias = (jnp.where(m[:, None, None, :] > 0, 0.0, -1e30)
+                        if m.ndim == 2 else m)
+            qh, kh, vh = to_heads(q), to_heads(kk), to_heads(v)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                           kh.astype(jnp.float32)) / (Dh ** 0.5)
+            if bias is not None:
+                s = s + bias
+            p = jax.nn.softmax(s, axis=-1)
+            p = drop(p, k_probs, c.attn_dropout_ratio)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(dtype), vh)
         else:
             o = flash_attention(to_heads(q), to_heads(kk), to_heads(v),
                                 causal=False)

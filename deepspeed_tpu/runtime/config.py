@@ -387,6 +387,8 @@ class DeepSpeedConfig:
         self.elasticity = ElasticityConfig(**d.get("elasticity", {}))
         self.tensor_parallel = TensorParallelConfig(**d.get("tensor_parallel", {}))
         self.data_efficiency = DataEfficiencyConfig(**d.get("data_efficiency", {}))
+        # legacy top-level curriculum section (reference accepts both forms)
+        self.curriculum_learning = d.get("curriculum_learning", {})
         self.compression_training = CompressionConfig(**d.get("compression_training", {}))
         self.autotuning = AutotuningConfig(**d.get("autotuning", {}))
         self.pipeline = d.get("pipeline", {})

@@ -200,6 +200,24 @@ class DeepSpeedEngine:
         self.lr_scheduler = None
         self._build_optimizer()
 
+        # Curriculum learning (reference: data_efficiency.data_sampling.
+        # curriculum_learning / legacy top-level curriculum_learning):
+        # seqlen difficulty applied by truncating batches before dispatch.
+        self.curriculum_scheduler = None
+        cl = {}
+        if self.config.data_efficiency is not None:
+            cl = self.config.data_efficiency.data_sampling.get(
+                "curriculum_learning", {})
+        if not cl.get("enabled"):
+            cl = getattr(self.config, "curriculum_learning", {}) or {}
+        if cl.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl)
+            log_dist(f"curriculum learning: {cl.get('curriculum_type')} "
+                     f"{self.curriculum_scheduler.min_difficulty} -> "
+                     f"{self.curriculum_scheduler.max_difficulty}", ranks=[0])
+
         self.checkpoint_engine = ShardedCheckpointEngine(self.config.checkpoint_config)
         self.monitor = MonitorMaster(self.config)
         self.flops_profiler = None
@@ -702,9 +720,20 @@ class DeepSpeedEngine:
     def __call__(self, batch):
         return self.forward(batch)
 
+    def curriculum_difficulty(self) -> Optional[int]:
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.update_difficulty(self._host_steps)
+
     def forward(self, batch):
         """One micro-batch forward (+backward: gradients are produced in the
         same XLA program and accumulated — see module docstring)."""
+        if self.curriculum_scheduler is not None and self._training:
+            # curriculum applies to TRAINING data only (reference semantics);
+            # eval always sees full sequences
+            from deepspeed_tpu.runtime.data_pipeline import truncate_batch
+
+            batch = truncate_batch(batch, self.curriculum_difficulty())
         batch = shard_batch(batch, self.mesh)
         if self.state is None:
             self.lazy_init_from_batch(batch)
@@ -910,6 +939,12 @@ class DeepSpeedEngine:
             return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
         stacked = jax.tree.map(stack, batch)
+        if self.curriculum_scheduler is not None:
+            from deepspeed_tpu.runtime.data_pipeline import truncate_batch
+
+            # stacked layout is [gas, micro, seq, ...]: seq is axis 2
+            stacked = truncate_batch(stacked, self.curriculum_difficulty(),
+                                     seq_axis=2)
         if self.state is None:
             first = jax.tree.map(lambda x: x[0], stacked)
             self.lazy_init_from_batch(shard_batch(first, self.mesh))

@@ -91,4 +91,10 @@ class PipelineEngine(DeepSpeedEngine):
 
     def eval_batch(self, data_iter=None, **kw):
         it = data_iter or self._data_iter
+        if it is None:
+            raise ValueError("eval_batch needs data_iter or a prior "
+                             "set_dataiterator()")
+        fn = getattr(self, "_batch_fn", None)
+        if fn is not None:
+            it = (fn(b) for b in it)
         return super().eval_batch(it)

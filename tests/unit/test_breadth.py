@@ -205,3 +205,35 @@ def test_hybrid_engine_train_and_generate(mesh8, rng):
     out2 = engine.generate(toks[:2, :8], max_new_tokens=4)
     assert out2.shape == (2, 12)
     assert np.isfinite(float(loss1))
+
+
+def test_engine_curriculum_integration(mesh8, rng):
+    """ds_config curriculum section drives per-step seqlen truncation."""
+    from deepspeed_tpu.comm.mesh import set_global_mesh
+    from deepspeed_tpu.models import causal_lm
+
+    set_global_mesh(mesh8)
+    model = causal_lm("llama-tiny", mesh=mesh8, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    cfg = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "curriculum_learning": {"enabled": True,
+                                   "curriculum_type": "fixed_linear",
+                                   "min_difficulty": 16, "max_difficulty": 64,
+                                   "schedule_config": {"total_curriculum_step": 4,
+                                                       "difficulty_step": 16}},
+           "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg,
+                                               mesh=mesh8,
+                                               rng=jax.random.PRNGKey(0))
+    assert engine.curriculum_scheduler is not None
+    toks = jax.random.randint(rng, (8, 64), 0, 256)
+    engine.forward((toks, toks))
+    engine.step()
+    # step 1 of 4: raw 16 + 0.25*48 = 28, floored to the 16-step grid
+    assert engine.curriculum_difficulty() == 16
+    for _ in range(4):
+        engine.forward((toks, toks))
+        engine.step()
+    assert engine.curriculum_difficulty() == 64  # ramp complete

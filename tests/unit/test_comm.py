@@ -127,7 +127,11 @@ def test_new_group_subset_allreduce(devices):
 
     g = comm.new_group([1, 3, 5])
     assert g.size() == 3
-    out = g.all_reduce(jnp.asarray(2.0))
+    out = g.all_reduce([jnp.asarray(1.0), jnp.asarray(2.0), jnp.asarray(3.0)])
     assert float(out) == 6.0
     with pytest.raises(ValueError):
         comm.new_group([0, 99])
+    with pytest.raises(ValueError):
+        comm.new_group([0, -1])
+    with pytest.raises(ValueError):
+        g.all_reduce([jnp.asarray(1.0)])  # wrong member count

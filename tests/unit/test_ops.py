@@ -360,3 +360,37 @@ class TestDeepSpeedTransformerLayer:
         np.testing.assert_allclose(np.asarray(y_mask[:, :8]),
                                    np.asarray(y_mask2[:, :8]),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestQuantizerKernels:
+    """Pallas block quant/dequant (reference: csrc/quantization)."""
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_roundtrip_error_bound(self, rng, bits):
+        from deepspeed_tpu.ops.pallas.quantizer import dequantize, quantize
+
+        x = jax.random.normal(rng, (5000,)) * 2.0
+        q, scale, pad = quantize(x, bits=bits, block=256, impl="interpret")
+        out = dequantize(q, scale, pad, x.shape)
+        qmax = 127 if bits == 8 else 7
+        bound = float(jnp.abs(x).max()) / qmax + 1e-6
+        assert np.abs(np.asarray(out - x)).max() <= bound
+
+    def test_kernel_matches_xla(self, rng):
+        from deepspeed_tpu.ops.pallas.quantizer import quantize
+
+        x = jax.random.normal(rng, (4096,))
+        qk, sk, _ = quantize(x, block=512, impl="interpret")
+        qx, sx, _ = quantize(x, block=512, impl="xla")
+        np.testing.assert_array_equal(np.asarray(qk), np.asarray(qx))
+        np.testing.assert_allclose(np.asarray(sk), np.asarray(sx), rtol=1e-6)
+
+    def test_int4_pack_roundtrip(self, rng):
+        from deepspeed_tpu.ops.pallas.quantizer import (pack_int4, quantize,
+                                                        unpack_int4)
+
+        x = jax.random.normal(rng, (999,))
+        q, scale, pad = quantize(x, bits=4, block=256, impl="xla")
+        packed = pack_int4(q)
+        restored = unpack_int4(packed, q.size).reshape(q.shape)
+        np.testing.assert_array_equal(np.asarray(restored), np.asarray(q))
