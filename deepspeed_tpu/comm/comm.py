@@ -286,6 +286,9 @@ class ProcessGroup:
         stacked = (jnp.stack([jnp.asarray(v) for v in values])
                    if isinstance(values, (list, tuple))
                    else jnp.asarray(values))
+        if stacked.ndim == 0:
+            raise ValueError("all_reduce takes one value PER MEMBER (leading "
+                             f"dim {self.size()}), got a scalar")
         if stacked.shape[0] != self.size():
             raise ValueError(f"expected {self.size()} per-member values, "
                              f"got leading dim {stacked.shape[0]}")

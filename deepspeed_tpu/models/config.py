@@ -94,9 +94,14 @@ _PRESETS = {
                     num_layers=48, num_heads=25, max_seq_len=1024,
                     norm="layernorm", activation="gelu", glu=False,
                     position="learned", tie_embeddings=True, remat=True),
-    # Llama family (configs[2]/[4]: 8B on v5p-8, 70B on v5p-128)
+    # Llama family (configs[2]/[4]: 8B on v5p-8, 70B on v5p-128; llama2-7b is
+    # the BASELINE.json "7B" north-star size)
     "llama-tiny": dict(vocab_size=32000, hidden_size=256, intermediate_size=688,
                        num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=2048),
+    "llama2-7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                      num_layers=32, num_heads=32, max_seq_len=4096, remat=True),
+    "llama2-13b": dict(vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+                       num_layers=40, num_heads=40, max_seq_len=4096, remat=True),
     "llama3-8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
                       num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
                       rope_theta=500000.0, remat=True),

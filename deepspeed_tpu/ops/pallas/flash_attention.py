@@ -47,7 +47,9 @@ NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
-                  bias=None):
+                  bias=None, probs_transform=None):
+    """jnp attention; ``probs_transform`` hooks the post-softmax
+    probabilities (e.g. attention dropout in the fused transformer layer)."""
     *_, S, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -59,6 +61,8 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
         mask = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
         logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    if probs_transform is not None:
+        probs = probs_transform(probs)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
 

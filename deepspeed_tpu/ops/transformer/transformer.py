@@ -117,19 +117,17 @@ class DeepSpeedTransformerLayer:
             # dense path: additive-bias attention (BERT-style pad masking)
             # and/or attention-probability dropout (the flash kernel has no
             # dropout hook; the reference CUDA layer drops probs here too)
+            from deepspeed_tpu.ops.pallas import mha_reference
+
             bias = None
             if attention_mask is not None:
                 m = jnp.asarray(attention_mask)
                 bias = (jnp.where(m[:, None, None, :] > 0, 0.0, -1e30)
                         if m.ndim == 2 else m)
-            qh, kh, vh = to_heads(q), to_heads(kk), to_heads(v)
-            s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
-                           kh.astype(jnp.float32)) / (Dh ** 0.5)
-            if bias is not None:
-                s = s + bias
-            p = jax.nn.softmax(s, axis=-1)
-            p = drop(p, k_probs, c.attn_dropout_ratio)
-            o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(dtype), vh)
+            pt = ((lambda p: drop(p, k_probs, c.attn_dropout_ratio))
+                  if use_probs_drop else None)
+            o = mha_reference(to_heads(q), to_heads(kk), to_heads(v),
+                              causal=False, bias=bias, probs_transform=pt)
         else:
             o = flash_attention(to_heads(q), to_heads(kk), to_heads(v),
                                 causal=False)

@@ -45,23 +45,22 @@ def _pad_to(x: jnp.ndarray, multiple: int) -> Tuple[jnp.ndarray, int]:
 
 
 def block_quantize(x, block: int = DEFAULT_BLOCK):
-    """Per-block symmetric absmax int8 quantization.
+    """Per-block symmetric absmax int8 quantization (delegates to the
+    shared quantizer in ops/pallas/quantizer.py; the XLA path is used here
+    because these run inside shard_map manual regions).
 
     Returns (q int8 [nblocks, block], scale fp32 [nblocks, 1], pad).
     """
-    flat, pad = _pad_to(x.astype(jnp.float32), block)
-    blocks = flat.reshape(-1, block)
-    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
-    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
-    return q, scale, pad
+    from deepspeed_tpu.ops.pallas.quantizer import quantize
+
+    q, scale, pad = quantize(x, bits=8, block=block, impl="xla")
+    return q, scale[:, None], pad
 
 
 def block_dequantize(q, scale, pad: int, shape, dtype=jnp.float32):
-    out = (q.astype(jnp.float32) * scale).reshape(-1)
-    if pad:
-        out = out[: out.size - pad]
-    return out.reshape(shape).astype(dtype)
+    from deepspeed_tpu.ops.pallas.quantizer import dequantize
+
+    return dequantize(q, scale.reshape(-1), pad, shape, dtype=dtype)
 
 
 def pack_signs(x) -> jnp.ndarray:

@@ -130,3 +130,45 @@ def test_save_16bit_model_sharded(tmp_path):
     eng = ShardedCheckpointEngine()
     flat = eng.load(out)
     assert len(flat) == len(jax.tree.leaves(engine.state.params))
+
+
+def test_reshard_across_mesh_shapes(tmp_path, devices):
+    """Save on an fsdp=8 mesh, resume on a dp=2 x fsdp=4 mesh (different
+    axis factorization): each device reads only the byte ranges backing its
+    new slice."""
+    from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+    from deepspeed_tpu.models import causal_lm
+
+    kw = dict(num_layers=2, hidden_size=64, intermediate_size=128,
+              num_heads=4, num_kv_heads=2, vocab_size=256, remat=False)
+    cfg = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3}, "steps_per_print": 10**9}
+    toks = jax.random.randint(jax.random.PRNGKey(0), (8, 32), 0, 256)
+
+    mesh_a = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh_a)
+    model_a = causal_lm("llama-tiny", mesh=mesh_a, **kw)
+    ea, _, _, _ = deepspeed_tpu.initialize(model=model_a, config=cfg,
+                                           mesh=mesh_a, rng=jax.random.PRNGKey(1))
+    ea.forward((toks, toks))
+    ea.step()
+    ea.save_checkpoint(str(tmp_path), tag="x")
+    saved = jax.device_get(ea.state.params)
+
+    mesh_b = build_mesh(dp=2, fsdp=4, devices=devices)
+    set_global_mesh(mesh_b)
+    model_b = causal_lm("llama-tiny", mesh=mesh_b, **kw)
+    eb, _, _, _ = deepspeed_tpu.initialize(model=model_b, config=cfg,
+                                           mesh=mesh_b, rng=jax.random.PRNGKey(2))
+    eb.forward((toks, toks))
+    eb.step()
+    eb.load_checkpoint(str(tmp_path), tag="x")
+    for a, b in zip(jax.tree.leaves(saved),
+                    jax.tree.leaves(jax.device_get(eb.state.params))):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+    # training continues on the new topology
+    loss = eb.forward((toks, toks))
+    eb.step()
+    assert np.isfinite(float(loss))
