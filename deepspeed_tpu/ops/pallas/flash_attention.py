@@ -47,9 +47,12 @@ NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
-                  bias=None, probs_transform=None):
+                  bias=None, probs_transform=None, pv_dtype=None):
     """jnp attention; ``probs_transform`` hooks the post-softmax
-    probabilities (e.g. attention dropout in the fused transformer layer)."""
+    probabilities (e.g. attention dropout in the fused transformer layer);
+    ``pv_dtype`` sets the probs@V matmul precision (default fp32 — the
+    parity-reference contract; pass the compute dtype for MXU-rate serving
+    of the masked path)."""
     *_, S, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -63,7 +66,9 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
     probs = jax.nn.softmax(logits, axis=-1)
     if probs_transform is not None:
         probs = probs_transform(probs)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
+    pv = pv_dtype if pv_dtype is not None else jnp.float32
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(pv),
+                      v.astype(pv)).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

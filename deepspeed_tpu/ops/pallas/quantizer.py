@@ -45,7 +45,11 @@ def quantize(x, bits: int = 8, block: int = 2048,
     qmax = 127.0 if bits == 8 else 7.0
     impl = resolve_impl(impl)
     n = x.size
-    block = max(_LANE, min(block, 1 << 16))
+    block = min(block, 1 << 16)
+    if impl != "xla":
+        # the Pallas kernel tiles on 128 lanes; the XLA path honors any
+        # caller granularity (quantized collectives use small blocks)
+        block = max(_LANE, block)
     pad = (-n) % block
     flat = x.reshape(-1).astype(jnp.float32)
     if pad:

@@ -127,7 +127,8 @@ class DeepSpeedTransformerLayer:
             pt = ((lambda p: drop(p, k_probs, c.attn_dropout_ratio))
                   if use_probs_drop else None)
             o = mha_reference(to_heads(q), to_heads(kk), to_heads(v),
-                              causal=False, bias=bias, probs_transform=pt)
+                              causal=False, bias=bias, probs_transform=pt,
+                              pv_dtype=dtype)  # MXU-rate probs@V
         else:
             o = flash_attention(to_heads(q), to_heads(kk), to_heads(v),
                                 causal=False)

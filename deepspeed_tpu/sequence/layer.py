@@ -43,8 +43,14 @@ def ulysses_attention(q, k, v, mesh: Mesh, attn_fn: Optional[Callable] = None,
     before calling).  Requires H % sp == 0 and S % sp == 0.
     """
     if attn_fn is None:
-        from deepspeed_tpu.ops.pallas import mha_reference
-        attn_fn = functools.partial(mha_reference, causal=causal)
+        # flash kernel on TPU for lane-aligned sequences (mirrors
+        # attention_core's s % 128 gate — unaligned tiles stay on the jnp
+        # reference); resolve_impl falls back to the reference on CPU anyway
+        from deepspeed_tpu.ops.pallas import flash_attention, mha_reference
+        if q.shape[2] % 128 == 0:
+            attn_fn = functools.partial(flash_attention, causal=causal)
+        else:
+            attn_fn = functools.partial(mha_reference, causal=causal)
     sp = axis_size(mesh, axis)
     if sp == 1:
         return attn_fn(q, k, v)

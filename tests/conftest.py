@@ -32,6 +32,17 @@ if not os.environ.get("DSTPU_TEST_ON_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
 
+@pytest.fixture(autouse=True)
+def _restore_global_mesh():
+    """Tests that set_global_mesh (sp/pp/ep layouts) must not leak their
+    mesh into later tests that build engines off the global default."""
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    prev = mesh_mod._GLOBAL_MESH
+    yield
+    mesh_mod._GLOBAL_MESH = prev
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
