@@ -9,9 +9,11 @@ TPU-native mapping:
   a compiler transform, not autograd hooks).  Policies map the reference
   knobs: ``partition_activations`` -> saveable residuals carry their
   sharding (GSPMD keeps them sharded — nothing to do at runtime);
-  ``cpu_checkpointing`` -> residuals offloaded to pinned host memory via
-  ``save_and_offload_only_these_names`` when names are provided, else
-  accepted as remat-only (documented).
+  ``cpu_checkpointing`` -> currently enables remat ONLY (the engine warns at
+  init): residuals are recomputed, not paged to host memory.  Real
+  pinned-host offload of saved residuals is a tracked gap — the runtime
+  here intermittently faults on many-stream host DMA (see engine.py
+  offload_param note), so the remat policy is the supported memory lever.
 - Reproducible dropout under recompute is STRUCTURAL in jax: dropout draws
   from explicit PRNG keys, so the recompute replays the same keys by
   construction — the reference's ``CudaRNGStatesTracker`` machinery exists

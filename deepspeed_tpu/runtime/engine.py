@@ -167,6 +167,9 @@ class DeepSpeedEngine:
         self.gradient_accumulation_steps = lambda: self.config.gradient_accumulation_steps
         self.train_batch_size = lambda: self.config.train_batch_size
         self.train_micro_batch_size_per_gpu = lambda: self.config.train_micro_batch_size_per_gpu
+        self._audit_config()
+        if self.config.dump_state and comm.get_rank() == 0:
+            self.config.print_config()
 
         self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         self._apply_activation_checkpointing_config(model)
@@ -242,6 +245,65 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # construction helpers
     # ------------------------------------------------------------------
+    def _audit_config(self) -> None:
+        """Loud degradation for parsed-but-inert config (VERDICT r3 item 6).
+
+        Two classes of accepted keys exist and only one warns:
+
+        - *by-design no-ops*: knobs whose capability XLA/GSPMD delivers
+          structurally (bucket sizes, ``contiguous_gradients``,
+          ``overlap_comm``, ``prescale_gradients`` — gradient scaling order
+          is numerically immaterial inside one XLA program,
+          ``round_robin_gradients`` — a CUDA-stream scheduling detail).
+          These stay silent: the behavior the user asked for happens.
+        - *inert behavior knobs*: sections that would change observable
+          behavior and currently change nothing.  Each warns once here so a
+          capability gap can never hide behind a successfully-parsed config.
+        """
+        cfg = self.config
+        zc = cfg.zero_config
+        inert = []
+        if cfg.amp.enabled:
+            inert.append(("amp", "torch/apex AMP has no TPU analog; use the "
+                                 "bf16 (recommended) or fp16 sections"))
+        if cfg.sparse_gradients_enabled:
+            inert.append(("sparse_gradients", "sparse gradient compaction is "
+                          "not implemented (dense grads are always exchanged)"))
+        if cfg.communication_data_type:
+            inert.append(("communication_data_type", "collective dtype "
+                          "follows the compute dtype under GSPMD"))
+        if not self._zeropp_active():
+            if zc.zero_quantized_weights:
+                inert.append(("zero_optimization.zero_quantized_weights",
+                              self._zeropp_inactive_reason()))
+            if zc.zero_quantized_gradients:
+                inert.append(("zero_optimization.zero_quantized_gradients",
+                              self._zeropp_inactive_reason()))
+            if zc.zero_hpz_partition_size > 1:
+                inert.append(("zero_optimization.zero_hpz_partition_size",
+                              self._zeropp_inactive_reason()))
+        for key, why in inert:
+            logger.warning("config key %r is set but INERT: %s", key, why)
+        self._inert_config_keys = [k for k, _ in inert]
+        # Degraded (not inert): the key does something, but less than the
+        # reference's version of it — say exactly what.
+        if cfg.activation_checkpointing.cpu_checkpointing:
+            logger.warning(
+                "config key 'activation_checkpointing.cpu_checkpointing' is "
+                "DEGRADED: it enables remat (recompute-in-backward) but "
+                "residuals are NOT paged to host memory")
+
+    def _zeropp_active(self) -> bool:
+        """Whether the ZeRO++ quantized-collective path is active.  Stub:
+        always False until the wiring lands; _audit_config warns on the
+        ZeRO++ knobs exactly while this returns False, so flipping it is the
+        single switch that retires those warnings."""
+        return False
+
+    def _zeropp_inactive_reason(self) -> str:
+        return ("ZeRO++ quantized collectives are not active for this "
+                "configuration; the knob changes nothing")
+
     def _apply_activation_checkpointing_config(self, model) -> None:
         """Push the ds_config ``activation_checkpointing`` section into the
         model (reference: runtime/activation_checkpointing/checkpointing.py

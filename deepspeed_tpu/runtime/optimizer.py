@@ -8,9 +8,12 @@ update kernel (deepspeed_tpu/ops/adam/fused_adam.py) where beneficial; on the
 jnp path XLA fuses the elementwise update chain anyway, which is most of what
 CUDA fused-Adam bought.
 
-1-bit variants currently fall back to their dense counterparts with a warning
-(compressed-communication optimizers need the error-feedback comm path —
-tracked as a capability gap until the compressed collectives land).
+1-bit variants are REAL when built through the engine: ``DeepSpeedEngine``
+routes OneBitAdam/OneBitLamb/ZeroOneAdam to the shard_map error-feedback path
+(``runtime/fp16/onebit/``) before this builder is consulted.  This module's
+1-bit branch is only reachable when ``build_optimizer`` is called directly
+(bypassing the engine) — there is no compressed-comm context in that case, so
+it falls back dense with a loud warning naming the engine path.
 """
 
 from __future__ import annotations
@@ -51,14 +54,20 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
     learning_rate: Schedule = lr if lr is not None else p.get("lr", 1e-3)
     wd = p.get("weight_decay", 0.0)
 
-    if name in (ONEBIT_ADAM, ZERO_ONE_ADAM):
-        logger.warning("%s: compressed-communication path not yet wired; using dense AdamW",
-                       type_name)
-        name = ADAMW_OPTIMIZER
-    if name == ONEBIT_LAMB:
-        logger.warning("%s: compressed-communication path not yet wired; using dense Lamb",
-                       type_name)
-        name = LAMB_OPTIMIZER
+    if name in (ONEBIT_ADAM, ZERO_ONE_ADAM, ONEBIT_LAMB):
+        # The engine never reaches this branch: it builds the real
+        # compressed-communication optimizer (runtime/fp16/onebit/) before
+        # consulting build_optimizer.  A direct build_optimizer() call has no
+        # mesh/shard_map context to run error feedback over, so it degrades
+        # dense — loudly, since training would otherwise silently diverge
+        # from the named algorithm.
+        logger.warning(
+            "%s built via build_optimizer() directly: the compressed-"
+            "communication path lives in the engine (deepspeed_tpu.initialize "
+            "routes it to runtime/fp16/onebit); falling back to the DENSE %s "
+            "update", type_name,
+            "Lamb" if name == ONEBIT_LAMB else "AdamW")
+        name = LAMB_OPTIMIZER if name == ONEBIT_LAMB else ADAMW_OPTIMIZER
 
     if name == FUSED_ADAM:
         # The Pallas single-pass update kernel (ops/pallas/fused_adam.py);
