@@ -398,19 +398,25 @@ class CausalLM:
                     return {"nll": jnp.where(valid, nll, 0.0).sum(),
                             "cnt": valid.sum().astype(jnp.float32)}
 
+                # When the model remats per layer (cfg.remat), the scan's
+                # per-step residuals are already bounded by the tuned layer
+                # policy — an outer save-nothing wrap would override it.
+                # Only un-rematted models take the pipeline's own stage remat.
                 red, aux_loss = spmd_pipeline(
                     stage_fn, params["layers"], x, mesh,
                     num_microbatches=cfg.pp_microbatches,
                     broadcast_args=(cos, sin), scan_args=keys,
                     reduce_fn=reduce_mb, reduce_xs=(labels, mask_arg),
-                    reduce_consts=(params["final_norm"], head_pp))
+                    reduce_consts=(params["final_norm"], head_pp),
+                    remat_stage=not bool(cfg.remat))
                 loss = red["nll"] / jnp.maximum(red["cnt"], 1.0)
                 return (loss + cfg.moe_aux_loss_coef * aux_loss
                         if cfg.is_moe else loss)
 
             x, aux_loss = spmd_pipeline(stage_fn, params["layers"], x, mesh,
                                         num_microbatches=cfg.pp_microbatches,
-                                        broadcast_args=(cos, sin), scan_args=keys)
+                                        broadcast_args=(cos, sin), scan_args=keys,
+                                        remat_stage=not bool(cfg.remat))
         elif cfg.scan_layers:
             x, auxes = jax.lax.scan(scan_body, x, (params["layers"], keys))
             aux_loss = jnp.sum(auxes)

@@ -282,16 +282,19 @@ class DeepSpeedEngine:
             if zc.zero_hpz_partition_size > 1:
                 inert.append(("zero_optimization.zero_hpz_partition_size",
                               self._zeropp_inactive_reason()))
+        import logging as _logging
+
         for key, why in inert:
-            logger.warning("config key %r is set but INERT: %s", key, why)
+            log_dist(f"config key {key!r} is set but INERT: {why}",
+                     ranks=[0], level=_logging.WARNING)
         self._inert_config_keys = [k for k, _ in inert]
         # Degraded (not inert): the key does something, but less than the
         # reference's version of it — say exactly what.
         if cfg.activation_checkpointing.cpu_checkpointing:
-            logger.warning(
-                "config key 'activation_checkpointing.cpu_checkpointing' is "
-                "DEGRADED: it enables remat (recompute-in-backward) but "
-                "residuals are NOT paged to host memory")
+            log_dist("config key 'activation_checkpointing.cpu_checkpointing'"
+                     " is DEGRADED: it enables remat (recompute-in-backward) "
+                     "but residuals are NOT paged to host memory",
+                     ranks=[0], level=_logging.WARNING)
 
     def _zeropp_active(self) -> bool:
         """Whether the ZeRO++ quantized-collective path is active.  Stub:
