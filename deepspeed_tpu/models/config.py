@@ -98,6 +98,14 @@ _PRESETS = {
     # the BASELINE.json "7B" north-star size)
     "llama-tiny": dict(vocab_size=32000, hidden_size=256, intermediate_size=688,
                        num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=2048),
+    # 1.34B dense rung (VERDICT r4 item 1: a >1B model that fits one 16GB
+    # chip with int8 optimizer states + bf16 grad accum + remat).  Vocab
+    # padded to a multiple of 128 for MXU tiling; head_dim 128 fills the
+    # systolic array (D=64 heads halve it — see ops/pallas notes).
+    "llama-1b4": dict(vocab_size=50304, hidden_size=2048, intermediate_size=5632,
+                      num_layers=24, num_heads=16, num_kv_heads=16,
+                      max_seq_len=2048, tie_embeddings=True, remat=True,
+                      remat_policy="mlp_dots"),
     "llama2-7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
                       num_layers=32, num_heads=32, max_seq_len=4096, remat=True),
     "llama2-13b": dict(vocab_size=32000, hidden_size=5120, intermediate_size=13824,

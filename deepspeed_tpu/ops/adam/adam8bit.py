@@ -1,0 +1,232 @@
+"""AdamW with 8-bit blockwise-quantized optimizer states.
+
+Role parity: the reference family of memory-reduced optimizer states
+(``(R) csrc/quantization/*`` block quantization + DeepSpeed's quantized
+optimizer-state configs); algorithmically this is the 8-bit Adam of
+Dettmers et al. (bitsandbytes), re-built on the shared block quantizer
+(ops/pallas/quantizer.py).  Purpose on TPU: optimizer states are the largest
+persistent HBM tenant after the fp32 masters (8 bytes/param for fp32 m+v);
+int8 blockwise states cut that to ~2 bytes/param, which is what lets a
+>1B-param model train on one 16GB chip (BENCH r4 rung).
+
+Design notes:
+- ``m`` is quantized linearly (signed absmax int8 per block).
+- ``v`` is quantized in **sqrt space** (stores ``sqrt(v)``): v spans many
+  decades within a tensor; sqrt halves the dynamic range in log terms, so a
+  127-level linear code loses far less.  Dequant squares it back.
+- The update math runs in fp32 per block: dequant -> moment update ->
+  bias-corrected AdamW direction -> requant.  XLA fuses dequant/requant into
+  the elementwise chain, so the step stays bandwidth-bound on the int8
+  reads/writes — the memory win is also a ~3x optimizer-step bandwidth win
+  over fp32 states.
+- Tensors smaller than ``min_quant_size`` keep fp32 moments (norms, biases:
+  quantizing them saves nothing and costs precision — same escape hatch as
+  bitsandbytes' ``min_8bit_size``).
+- **Stochastic rounding** (``stochastic_rounding="auto"``): when params are
+  bf16 there is no fp32 master, and deterministic round-to-nearest would
+  drop any update smaller than ~2^-8 of the param — training stalls.  The
+  update is computed in fp32 per block and rounded to bf16 *stochastically*
+  (unbiased: E[round(x)] = x), the established recipe for master-free bf16
+  training on TPUs.  fp32 params skip SR (the sum is already exact).
+- The transformation returns **new params, not deltas** (``
+  updates_are_new_params``): returning deltas would force a full fp32
+  update tree (bf16 deltas under-round, fp32 deltas cost O(model) HBM);
+  per-leaf new-params keeps every transient leaf-sized.  The engine checks
+  the flag; ``optax.apply_updates`` must not be used with this optimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class Adam8bitState(NamedTuple):
+    count: jnp.ndarray
+    m_q: Any        # int8 [nb, block] per leaf (or fp32 [n] for small leaves)
+    m_scale: Any    # fp32 [nb] per leaf (or () placeholder)
+    v_q: Any        # int8 [nb, block], sqrt-space (or fp32 [n])
+    v_scale: Any
+
+
+class NewParamsTransformation(NamedTuple):
+    """optax-shaped transformation whose ``update`` returns the NEW params
+    (the engine branches on ``updates_are_new_params``)."""
+
+    init: Callable
+    update: Callable
+    updates_are_new_params: bool = True
+
+
+def stochastic_round_bf16(x32: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """Unbiased fp32 -> bf16 rounding: add uniform noise below the truncated
+    mantissa bits, then truncate.  Works in sign-magnitude space (the integer
+    add only grows the magnitude bits; carries into the exponent produce the
+    correctly-rounded next binade)."""
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    noise = jax.random.bits(key, x32.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(rounded, jnp.float32).astype(jnp.bfloat16)
+
+
+def _block_quant(x2d: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[nb, B] fp32 -> (int8 [nb, B], fp32 [nb]) via the shared quantizer
+    (already block-aligned, so pad is always 0)."""
+    from deepspeed_tpu.ops.pallas.quantizer import quantize
+
+    q, scale, _pad = quantize(x2d, bits=8, block=x2d.shape[-1], impl="xla")
+    return q, scale
+
+
+def _block_dequant(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    from deepspeed_tpu.ops.pallas.quantizer import dequantize
+
+    return dequantize(q, scale, 0, q.shape, dtype=jnp.float32)
+
+
+def adam8bit(learning_rate: Union[float, Callable] = 1e-3, b1: float = 0.9,
+             b2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
+             block: int = 512, min_quant_size: int = 4096,
+             stochastic_rounding: Union[bool, str] = "auto",
+             sr_seed: int = 0x5EED) -> NewParamsTransformation:
+    """AdamW with int8 blockwise m/v.  ``update`` returns NEW params (see
+    module docstring); weight decay is decoupled (AdamW-style).
+    ``stochastic_rounding="auto"`` applies SR exactly to non-fp32 params."""
+
+    # Per-leaf chunking: the fp32 temporaries of the update (dequantized
+    # m/v, direction, new params) must never materialize for a whole big
+    # leaf at once — a stacked-layers leaf of a >1B model is ~278M elements,
+    # and ~6 fp32 temporaries of that size is ~7GB, which is what OOMs a
+    # 16GB chip.  Big leaves are processed as a ``lax.map`` over chunks of
+    # <= 2^25 elements; inputs stay in their storage dtype outside the
+    # chunk body.
+    chunk_target = 1 << 25
+
+    def _quantized(p) -> bool:
+        return int(np.prod(p.shape)) >= min_quant_size
+
+    def _layout(p):
+        n = int(np.prod(p.shape))
+        split = max(1, -(-n // chunk_target))
+        chunk = -(-(-(-n // split)) // block) * block  # ceil to block mult
+        return n, split, chunk
+
+    def init(params):
+        def mk_q(p):
+            if not _quantized(p):
+                return jnp.zeros((int(np.prod(p.shape)),), jnp.float32)
+            _, split, chunk = _layout(p)
+            return jnp.zeros((split * chunk // block, block), jnp.int8)
+
+        def mk_s(p):
+            if not _quantized(p):
+                return jnp.zeros((), jnp.float32)
+            _, split, chunk = _layout(p)
+            return jnp.ones((split * chunk // block,), jnp.float32)
+
+        return Adam8bitState(
+            count=jnp.zeros((), jnp.int32),
+            m_q=jax.tree.map(mk_q, params), m_scale=jax.tree.map(mk_s, params),
+            v_q=jax.tree.map(mk_q, params), v_scale=jax.tree.map(mk_s, params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("adam8bit requires params (for weight decay)")
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+        count = state.count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_mq = treedef.flatten_up_to(state.m_q)
+        flat_ms = treedef.flatten_up_to(state.m_scale)
+        flat_vq = treedef.flatten_up_to(state.v_q)
+        flat_vs = treedef.flatten_up_to(state.v_scale)
+
+        base_key = jax.random.fold_in(jax.random.PRNGKey(sr_seed),
+                                      state.count)
+
+        new_p, n_mq, n_ms, n_vq, n_vs = [], [], [], [], []
+        for i, (p, g, mq, ms, vq, vs) in enumerate(zip(
+                flat_p, flat_g, flat_mq, flat_ms, flat_vq, flat_vs)):
+            n = int(np.prod(p.shape))
+            # SR only ever applies to bf16 params (it IS bf16 rounding);
+            # True and "auto" are equivalent there, and fp32 params skip it
+            # because their update sum is already exact.
+            use_sr = (stochastic_rounding in (True, "auto")
+                      and p.dtype == jnp.bfloat16)
+
+            if _quantized(p):
+                _, split, chunk = _layout(p)
+                n_pad = split * chunk
+                bpc = chunk // block              # blocks per chunk
+
+                def pad_flat(x):  # keep storage dtype: no fp32 full copy
+                    flat = x.reshape(-1)
+                    return jnp.pad(flat, (0, n_pad - n)).reshape(split, chunk)
+
+                g_c = pad_flat(g)
+                p_c = pad_flat(p)
+                keys = jax.random.split(jax.random.fold_in(base_key, i), split)
+
+                def chunk_fn(xs):
+                    gc, pc, mqc, msc, vqc, vsc, key = xs
+                    g32 = gc.astype(jnp.float32).reshape(bpc, block)
+                    m = _block_dequant(mqc, msc)
+                    rv = _block_dequant(vqc, vsc)
+                    v = rv * rv                   # sqrt-space storage
+                    m = b1 * m + (1.0 - b1) * g32
+                    v = b2 * v + (1.0 - b2) * g32 * g32
+                    direction = (m / c1) / (jnp.sqrt(v / c2) + eps)
+                    mq2, ms2 = _block_quant(m)
+                    vq2, vs2 = _block_quant(jnp.sqrt(v))
+                    p32 = pc.astype(jnp.float32)
+                    new32 = (p32 - lr * (direction.reshape(-1)
+                                         + weight_decay * p32))
+                    if use_sr:
+                        out = stochastic_round_bf16(new32, key)
+                    else:
+                        out = new32.astype(p.dtype)
+                    return out, mq2, ms2, vq2, vs2
+
+                xs = (g_c, p_c, mq.reshape(split, bpc, block),
+                      ms.reshape(split, bpc), vq.reshape(split, bpc, block),
+                      vs.reshape(split, bpc), keys)
+                if split == 1:  # no loop: fuses flat, compiles faster
+                    res = chunk_fn(jax.tree.map(lambda a: a[0], xs))
+                    out, mq2, ms2, vq2, vs2 = jax.tree.map(
+                        lambda a: a[None], res)
+                else:
+                    out, mq2, ms2, vq2, vs2 = jax.lax.map(chunk_fn, xs)
+                new_p.append(out.reshape(-1)[:n].reshape(p.shape))
+                n_mq.append(mq2.reshape(-1, block))
+                n_ms.append(ms2.reshape(-1))
+                n_vq.append(vq2.reshape(-1, block))
+                n_vs.append(vs2.reshape(-1))
+            else:
+                g32 = g.astype(jnp.float32).reshape(-1)
+                m = b1 * mq + (1.0 - b1) * g32
+                v = b2 * (vq * vq) + (1.0 - b2) * g32 * g32
+                direction = (m / c1) / (jnp.sqrt(v / c2) + eps)
+                p32 = p.astype(jnp.float32)
+                new32 = p32 - lr * (direction.reshape(p.shape)
+                                    + weight_decay * p32)
+                if use_sr:
+                    new_p.append(stochastic_round_bf16(
+                        new32, jax.random.fold_in(base_key, i)))
+                else:
+                    new_p.append(new32.astype(p.dtype))
+                n_mq.append(m); n_ms.append(jnp.zeros((), jnp.float32))
+                n_vq.append(jnp.sqrt(v)); n_vs.append(jnp.zeros((), jnp.float32))
+
+        unflat = treedef.unflatten
+        return (unflat(new_p), Adam8bitState(
+            count=count, m_q=unflat(n_mq), m_scale=unflat(n_ms),
+            v_q=unflat(n_vq), v_scale=unflat(n_vs)))
+
+    return NewParamsTransformation(init, update)

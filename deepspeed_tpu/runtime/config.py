@@ -46,6 +46,12 @@ class FP16Config(DeepSpeedConfigModel):
 
 class BF16Config(DeepSpeedConfigModel):
     enabled: bool = False
+    # TPU extension: master_weights=False drops the fp32 master copy — the
+    # training state itself is bf16 and the optimizer applies updates with
+    # stochastic rounding (Adam8bit does this natively).  This is the memory
+    # recipe for >1B params on one 16GB chip: no fp32 master (4N bytes) and
+    # no fp32 grad tree ever materializes.
+    master_weights: bool = True
 
 
 class AMPConfig(DeepSpeedConfigModel):
@@ -56,6 +62,21 @@ class AMPConfig(DeepSpeedConfigModel):
 class OptimizerConfig(DeepSpeedConfigModel):
     type: str = "Adam"
     params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    """``data_types`` section (reference key): gradient-accumulation dtype.
+
+    ``grad_accum_dtype: "bf16"`` halves the persistent accumulator (and the
+    reduce-scatter bytes from stage 2 up); fp32 (default) is exact.  fp16
+    loss scaling requires fp32 accumulation (overflow/unscale semantics)."""
+
+    grad_accum_dtype: Optional[str] = None  # None -> fp32
+
+
+_DTYPE_NAMES = {"fp32": "float32", "float32": "float32", "float": "float32",
+                "bf16": "bfloat16", "bfloat16": "bfloat16",
+                "fp16": "float16", "float16": "float16"}
 
 
 class SchedulerConfig(DeepSpeedConfigModel):
@@ -371,6 +392,7 @@ class DeepSpeedConfig:
         # -- sections -------------------------------------------------------
         self.fp16 = FP16Config(**d.get("fp16", {}))
         self.bf16 = BF16Config(**d.get("bf16", d.get("bfloat16", {})))
+        self.data_types = DataTypesConfig(**d.get("data_types", {}))
         self.amp = AMPConfig(**d.get("amp", {}))
         self.optimizer = OptimizerConfig(**d["optimizer"]) if "optimizer" in d else None
         self.scheduler = SchedulerConfig(**d["scheduler"]) if "scheduler" in d else None
@@ -432,9 +454,24 @@ class DeepSpeedConfig:
     def get(self, dotted_key: str, default: Any = None) -> Any:
         return get_scalar_param(self._param_dict, dotted_key, default)
 
+    def grad_accum_dtype(self):
+        """jnp dtype for the gradient accumulator (None config -> fp32)."""
+        import jax.numpy as jnp
+
+        name = self.data_types.grad_accum_dtype
+        if name is None:
+            return jnp.float32
+        return getattr(jnp, _DTYPE_NAMES[name.lower()])
+
     def _validate(self) -> None:
         if self.fp16.enabled and self.bf16.enabled:
             raise ValueError("fp16 and bf16 cannot both be enabled")
+        ga = self.data_types.grad_accum_dtype
+        if ga is not None and ga.lower() not in _DTYPE_NAMES:
+            raise ValueError(f"data_types.grad_accum_dtype: unknown dtype {ga!r}")
+        if self.fp16.enabled and ga is not None and _DTYPE_NAMES[ga.lower()] != "float32":
+            raise ValueError("fp16 loss scaling requires fp32 gradient "
+                             "accumulation (data_types.grad_accum_dtype)")
         if self.zero_config.stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_optimization.stage must be 0-3, got {self.zero_config.stage}")
         if self.train_batch_size <= 0:

@@ -39,6 +39,8 @@ ONEBIT_ADAM = "onebitadam"
 ZERO_ONE_ADAM = "zerooneadam"
 ONEBIT_LAMB = "onebitlamb"
 MUON = "muon"
+ADAM_8BIT = "adam8bit"
+ADAMW_8BIT = "adamw8bit"
 
 
 def _adam_args(params: Dict[str, Any]) -> Dict[str, Any]:
@@ -69,6 +71,15 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
             "Lamb" if name == ONEBIT_LAMB else "AdamW")
         name = LAMB_OPTIMIZER if name == ONEBIT_LAMB else ADAMW_OPTIMIZER
 
+    if name in (ADAM_8BIT, ADAMW_8BIT):
+        # int8 blockwise optimizer states (~2 bytes/param for m+v instead of
+        # 8) — the memory lever that fits the >1B single-chip training rung.
+        from deepspeed_tpu.ops.adam.adam8bit import adam8bit
+
+        a = _adam_args(p)
+        return adam8bit(learning_rate, b1=a["b1"], b2=a["b2"], eps=a["eps"],
+                        weight_decay=wd, block=p.get("block_size", 512),
+                        min_quant_size=p.get("min_quant_size", 4096))
     if name == FUSED_ADAM:
         # The Pallas single-pass update kernel (ops/pallas/fused_adam.py);
         # "torch_adam": true opts back into the plain optax path, mirroring

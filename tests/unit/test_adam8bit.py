@@ -1,0 +1,159 @@
+"""8-bit blockwise Adam (ops/adam/adam8bit.py): math parity, state memory,
+and engine integration with bf16 grad accumulation (the >1B-rung recipe)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu.ops.adam.adam8bit import adam8bit
+
+
+def _run(opt, params, grads_seq):
+    state = opt.init(params)
+    new_params = getattr(opt, "updates_are_new_params", False)
+    for g in grads_seq:
+        ups, state = opt.update(g, state, params)
+        params = ups if new_params else optax.apply_updates(params, ups)
+    return params
+
+
+def test_small_leaves_match_adamw_exactly():
+    # below min_quant_size the moments stay fp32 -> exact AdamW math
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(8,)), jnp.float32)}
+    grads_seq = [jax.tree.map(lambda p: jnp.asarray(
+        rng.normal(size=p.shape), jnp.float32), params) for _ in range(5)]
+    p8 = _run(adam8bit(1e-2, weight_decay=0.01, min_quant_size=10**9),
+              params, grads_seq)
+    pw = _run(optax.adamw(1e-2, weight_decay=0.01), params, grads_seq)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p8[k]), np.asarray(pw[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_quantized_path_tracks_adamw():
+    # int8 moments introduce bounded error; the resulting trajectory must
+    # stay close to fp32 AdamW over several steps
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.normal(size=(64, 256)) * 0.1, jnp.float32)}
+    grads_seq = [{"w": jnp.asarray(rng.normal(size=(64, 256)) * 0.01,
+                                   jnp.float32)} for _ in range(10)]
+    p8 = _run(adam8bit(1e-3, block=512, min_quant_size=1), params, grads_seq)
+    pw = _run(optax.adamw(1e-3), params, grads_seq)
+    delta8 = np.asarray(p8["w"] - params["w"]).ravel()
+    deltaw = np.asarray(pw["w"] - params["w"]).ravel()
+    cos = float(delta8 @ deltaw / (np.linalg.norm(delta8) *
+                                   np.linalg.norm(deltaw)))
+    assert cos > 0.99, cos
+    assert abs(np.linalg.norm(delta8) / np.linalg.norm(deltaw) - 1) < 0.05
+
+
+def test_state_is_8bit_sized():
+    params = {"w": jnp.zeros((1024, 1024), jnp.float32)}
+    opt = adam8bit(1e-3, block=512)
+    state = opt.init(params)
+    state_bytes = sum(x.nbytes for x in jax.tree.leaves(state))
+    fp32_state_bytes = 2 * 4 * 1024 * 1024  # fp32 m + v
+    # int8 m+v (+ fp32 scales / 512) ~= 0.253x of fp32 states
+    assert state_bytes < 0.3 * fp32_state_bytes, state_bytes
+
+
+def test_stochastic_round_is_unbiased():
+    from deepspeed_tpu.ops.adam.adam8bit import stochastic_round_bf16
+
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(4096,)) * 0.1,
+                    jnp.float32)
+    acc = np.zeros_like(np.asarray(x))
+    K = 64
+    for i in range(K):
+        acc += np.asarray(stochastic_round_bf16(x, jax.random.PRNGKey(i)),
+                          np.float32)
+    mean = acc / K
+    # unbiased: the mean over draws converges to x well below one bf16 ulp
+    ulp = np.abs(np.asarray(x)) * 2**-8 + 1e-9
+    assert np.all(np.abs(mean - np.asarray(x)) < 0.5 * ulp)
+
+
+def test_sr_moves_sub_ulp_updates_rtn_stalls():
+    """The reason master-free bf16 needs SR: with lr far below one bf16 ulp,
+    round-to-nearest never moves the param; stochastic rounding drifts by
+    the expected amount."""
+    params = {"w": jnp.full((512, 8), 1.0, jnp.bfloat16)}
+    g = {"w": jnp.full((512, 8), 1.0, jnp.float32)}  # direction ~= +1
+
+    def run(sr):
+        opt = adam8bit(1e-4, weight_decay=0.0, min_quant_size=1,
+                       stochastic_rounding=sr)
+        st = opt.init(params)
+        p = params
+        for _ in range(300):
+            p, st = opt.update(g, st, p)
+        return float(jnp.mean(p["w"].astype(jnp.float32)))
+
+    assert run(False) == 1.0                     # RTN: stuck at 1.0 forever
+    drift = 1.0 - run("auto")                    # SR: E[drift] = 300 * lr
+    assert 0.5 * 300e-4 < drift < 1.5 * 300e-4, drift
+
+
+def test_engine_adam8bit_bf16_accum_trains():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+
+    mesh = build_mesh(fsdp=8, devices=jax.devices())
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256)
+    cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 2,
+           "bf16": {"enabled": True},
+           "data_types": {"grad_accum_dtype": "bf16"},
+           "optimizer": {"type": "Adam8bit",
+                         "params": {"lr": 3e-3, "min_quant_size": 256}},
+           "zero_optimization": {"stage": 1}, "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, mesh=mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 8, 32), 0, 256)
+    losses = [float(engine.train_step((toks, toks))) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    # the persistent accumulator really is bf16
+    acc_leaf = jax.tree.leaves(engine.state.grad_acc)[0]
+    assert acc_leaf.dtype == jnp.bfloat16
+
+
+def test_engine_master_free_bf16_trains():
+    """bf16.master_weights=false: the persistent state is bf16 (no fp32
+    master) and training still converges via stochastic rounding."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+
+    mesh = build_mesh(fsdp=8, devices=jax.devices())
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256)
+    cfg = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+           "bf16": {"enabled": True, "master_weights": False},
+           "data_types": {"grad_accum_dtype": "bf16"},
+           "optimizer": {"type": "Adam8bit",
+                         "params": {"lr": 3e-3, "min_quant_size": 256}},
+           "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, mesh=mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (1, 8, 32), 0, 256)
+    losses = [float(engine.train_step((toks, toks))) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    for leaf in jax.tree.leaves(engine.state.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+
+
+def test_grad_accum_dtype_fp16_rejected():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True},
+                         "data_types": {"grad_accum_dtype": "bf16"}})
