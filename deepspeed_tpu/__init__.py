@@ -50,13 +50,17 @@ def init_inference(model=None, config=None, **kwargs):
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 
+    # engine-level kwargs (not config keys): jax models are functional, so
+    # weights arrive separately from the module (torch bundles them)
+    params = kwargs.pop("params", None)
+    mesh = kwargs.pop("mesh", None)
     if config is None:
         config = kwargs
     elif kwargs:
         config = {**(config if isinstance(config, dict) else {}), **kwargs}
     if not isinstance(config, DeepSpeedInferenceConfig):
         config = DeepSpeedInferenceConfig(**config)
-    return InferenceEngine(model, config)
+    return InferenceEngine(model, config, params=params, mesh=mesh)
 
 
 def init_distributed(dist_backend: str = "xla", **kwargs):

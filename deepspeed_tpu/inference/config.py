@@ -27,7 +27,13 @@ class InferenceCheckpointConfig(DeepSpeedConfigModel):
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    # "int8" serves int8 weights (per-output-channel scales, dequant fused
+    # into the matmuls; activations stay bf16) — reference
+    # ``init_inference(dtype=torch.int8)`` parity.
     dtype: str = "bfloat16"
+    # TPU extension: int8 KV cache (per-position/head scales) — halves the
+    # cache footprint and its decode read bandwidth.
+    quantize_kv_cache: bool = False
     tensor_parallel: Optional[InferenceTPConfig] = None
     max_out_tokens: int = 1024
     min_out_tokens: int = 1              # enforced: generate() raises if the

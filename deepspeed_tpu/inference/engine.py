@@ -45,8 +45,13 @@ class InferenceEngine:
             mesh = build_mesh(tp=tp)
             set_global_mesh(mesh)
         self.mesh = mesh
-        self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else (
-            jnp.float16 if config.dtype in ("float16", "fp16", "half") else jnp.float32)
+        # int8 = quantized WEIGHTS; activations/KV math stays bf16
+        self._int8_weights = config.dtype in ("int8", "qint8")
+        if self._int8_weights:
+            self.dtype = jnp.bfloat16
+        else:
+            self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else (
+                jnp.float16 if config.dtype in ("float16", "fp16", "half") else jnp.float32)
         self._params = None
         self._cache = None
         self._gen_fns = {}
@@ -70,10 +75,38 @@ class InferenceEngine:
             lambda a: a.astype(self.dtype)
             if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else jnp.asarray(a),
             params)
+        if self._int8_weights:
+            from jax.sharding import PartitionSpec as P
+
+            from deepspeed_tpu.models.quant import (QTensor,
+                                                    is_qtensor,
+                                                    quantize_layer_params)
+
+            cast = jax.jit(lambda p: quantize_layer_params(
+                p, getattr(self.module, "config", None)))(cast)
+
+            # Carry the AutoTP logical specs THROUGH quantization: the q
+            # payload keeps the dense leaf's spec; the per-out-channel
+            # scale keeps only the last-dim entry (its contraction dim is
+            # size 1).  Dropping the specs here would silently replicate
+            # the whole model on every TP device.
+            def qspec(leaf, spec):
+                if not is_qtensor(leaf):
+                    return spec
+                entries = list(spec) + [None] * (leaf.ndim - len(spec))
+                scale_spec = (P(*entries[:-2], None, entries[-1])
+                              if leaf.ndim >= 2 else P())
+                return QTensor(P(*entries), scale_spec)
+
+            specs = jax.tree.map(qspec, cast, specs, is_leaf=is_qtensor)
+            shardings = shardings_from_pspecs(specs, self.mesh)
         self._params = jax.device_put(cast, shardings)
         n = sum(x.size for x in jax.tree.leaves(self._params))
-        log_dist(f"inference engine ready: {n/1e6:.2f}M params, tp="
-                 f"{self.mesh.shape.get('tp', 1)}, dtype {self.dtype.__name__}", ranks=[0])
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(self._params))
+        log_dist(f"inference engine ready: {n/1e6:.2f}M params "
+                 f"({nbytes/2**30:.2f}GB), tp={self.mesh.shape.get('tp', 1)}, "
+                 f"dtype {'int8-weights/' if self._int8_weights else ''}"
+                 f"{self.dtype.__name__}", ranks=[0])
 
     def load_checkpoint(self, path: str) -> None:
         from deepspeed_tpu.runtime.checkpoint_engine import (
@@ -117,7 +150,9 @@ class InferenceEngine:
         cfg = self.module.config
         if self._cache is None or self._cache["k"].shape[1] != batch or \
                 self._cache["k"].shape[3] < max_len:
-            self._cache = init_kv_cache(cfg, batch, max_len, dtype=self.dtype)
+            self._cache = init_kv_cache(
+                cfg, batch, max_len, dtype=self.dtype,
+                quantized=self._config.quantize_kv_cache)
             self._prefill_fns = {}
             self._gen_fns = {}
 
@@ -237,9 +272,18 @@ class InferenceEngine:
 
 
     def __call__(self, tokens):
-        """Plain forward (logits) — reference ``engine(inputs)`` parity."""
+        """Plain forward (logits) — reference ``engine(inputs)`` parity.
+        int8 weights are dequantized inside the jit (transient per-leaf;
+        the training-forward path expects dense arrays)."""
         if self._forward_fn is None:
-            self._forward_fn = jax.jit(self.module.apply)
+            if self._int8_weights:
+                from deepspeed_tpu.models.quant import dequantize_tree
+
+                self._forward_fn = jax.jit(
+                    lambda p, t: self.module.apply(
+                        dequantize_tree(p, self.dtype), t))
+            else:
+                self._forward_fn = jax.jit(self.module.apply)
         return self._forward_fn(self._params, jnp.asarray(tokens))
 
     @property

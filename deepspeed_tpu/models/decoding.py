@@ -26,28 +26,58 @@ from deepspeed_tpu.ops.pallas import apply_rotary_pos_emb, rope_angles
 NEG_INF = -1e30
 
 
-def init_kv_cache(cfg, batch: int, max_len: int, dtype=jnp.bfloat16) -> Dict[str, Any]:
+def init_kv_cache(cfg, batch: int, max_len: int, dtype=jnp.bfloat16,
+                  quantized: bool = False) -> Dict[str, Any]:
+    """``quantized=True`` stores int8 K/V with a per-(position, head) fp32
+    scale over the head dim — ~1.03 bytes/element vs 2 for bf16 (reference
+    int8 KV role, ``(R) inference_context.h`` workspace + dequant kernels)."""
     L, Hkv, Dh = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    if quantized:
+        return {
+            "k": jnp.zeros((L, batch, Hkv, max_len, Dh), jnp.int8),
+            "v": jnp.zeros((L, batch, Hkv, max_len, Dh), jnp.int8),
+            "k_scale": jnp.zeros((L, batch, Hkv, max_len, 1), jnp.float32),
+            "v_scale": jnp.zeros((L, batch, Hkv, max_len, 1), jnp.float32),
+            # decode activations still need a dtype anchor (cache dtype is
+            # int8); keep it alongside the buffers
+            "x_dtype": jnp.zeros((), dtype),
+        }
     return {
         "k": jnp.zeros((L, batch, Hkv, max_len, Dh), dtype),
         "v": jnp.zeros((L, batch, Hkv, max_len, Dh), dtype),
     }
 
 
-def _cached_attention(q, kcache, vcache, q_pos, scale):
+def _quantize_kv_rows(x):
+    """[B, Hkv, s, Dh] -> (int8 payload, fp32 [B, Hkv, s, 1] scale)."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _cached_attention(q, kcache, vcache, q_pos, scale, k_scale=None,
+                      v_scale=None):
     """q: [B, H, s, Dh]; caches: [B, Hkv, Smax, Dh]; q_pos: [s] absolute
-    positions of the queries.  Masked attention over the whole static cache."""
+    positions of the queries.  Masked attention over the whole static cache;
+    int8 caches are dequantized on the fly (fused into the einsum reads)."""
     B, H, s, Dh = q.shape
     Hkv = kcache.shape[1]
-    k = _repeat_kv(kcache, H // Hkv)
-    v = _repeat_kv(vcache, H // Hkv)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    kf = kcache.astype(jnp.float32)
+    vf = vcache.astype(jnp.float32)
+    if k_scale is not None:
+        kf = kf * k_scale
+    if v_scale is not None:
+        vf = vf * v_scale
+    k = _repeat_kv(kf, H // Hkv)
+    v = _repeat_kv(vf, H // Hkv)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k) * scale
     key_pos = jnp.arange(k.shape[-2])
     mask = key_pos[None, :] <= q_pos[:, None]          # causal vs absolute pos
     logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return out.astype(q.dtype)
 
 
@@ -63,11 +93,12 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
     batch_ax = ("dp", "fsdp", "ep")
     B, s = tokens.shape
     H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    quant_kv = "k_scale" in cache
     x = jnp.take(params["embed"]["tok"], tokens, axis=0)
     if cfg.position == "learned":
         pos_idx = start_pos + jnp.arange(s)
         x = x + jnp.take(params["embed"]["pos"], pos_idx, axis=0)[None]
-    x = x.astype(cache["k"].dtype)
+    x = x.astype(cache["x_dtype"].dtype if quant_kv else cache["k"].dtype)
     x = constrain(x, mesh, batch_ax, None, None)
     q_pos = start_pos + jnp.arange(s)
 
@@ -83,7 +114,11 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
 
     def layer_step(carry, xs):
         h_in = carry
-        lp, kc, vc = xs
+        if quant_kv:
+            lp, kc, vc, ksc, vsc = xs
+        else:
+            lp, kc, vc = xs
+            ksc = vsc = None
         h = norm(h_in, lp["attn_norm"], cfg.norm, cfg.norm_eps)
         a = lp["attn"]
         q = h @ a["wq"].astype(h.dtype)
@@ -99,9 +134,19 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         if cfg.position == "rope":
             q = apply_rotary_pos_emb(q, cos, sin)
             k = apply_rotary_pos_emb(k, cos, sin)
-        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, start_pos, 0))
-        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, start_pos, 0))
-        o = _cached_attention(q, kc, vc, q_pos, scale)
+        if quant_kv:
+            kq, ks = _quantize_kv_rows(k)
+            vq, vs = _quantize_kv_rows(v)
+            kc = jax.lax.dynamic_update_slice(kc, kq, (0, 0, start_pos, 0))
+            vc = jax.lax.dynamic_update_slice(vc, vq, (0, 0, start_pos, 0))
+            ksc = jax.lax.dynamic_update_slice(ksc, ks, (0, 0, start_pos, 0))
+            vsc = jax.lax.dynamic_update_slice(vsc, vs, (0, 0, start_pos, 0))
+        else:
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                              (0, 0, start_pos, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                              (0, 0, start_pos, 0))
+        o = _cached_attention(q, kc, vc, q_pos, scale, ksc, vsc)
         o = o.transpose(0, 2, 1, 3).reshape(B, s, H * Dh)
         o = o @ a["wo"].astype(h.dtype)
         if cfg.use_bias:
@@ -130,15 +175,27 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
             if cfg.use_bias:
                 mlp_out = mlp_out + m["b_down"].astype(h.dtype)
         h_in = h_in + mlp_out
+        if quant_kv:
+            return h_in, (kc, vc, ksc, vsc)
         return h_in, (kc, vc)
 
-    x, (kc_new, vc_new) = jax.lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    if quant_kv:
+        x, (kc_new, vc_new, ks_new, vs_new) = jax.lax.scan(
+            layer_step, x, (params["layers"], cache["k"], cache["v"],
+                            cache["k_scale"], cache["v_scale"]))
+        new_cache = {"k": kc_new, "v": vc_new, "k_scale": ks_new,
+                     "v_scale": vs_new, "x_dtype": cache["x_dtype"]}
+    else:
+        x, (kc_new, vc_new) = jax.lax.scan(
+            layer_step, x, (params["layers"], cache["k"], cache["v"]))
+        new_cache = {"k": kc_new, "v": vc_new}
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    head = (params["embed"]["tok"].T if cfg.tie_embeddings
-            else params["lm_head"]).astype(x.dtype)
+    if cfg.tie_embeddings:
+        head = params["embed"]["tok"].T.astype(x.dtype)
+    else:
+        head = params["lm_head"].astype(x.dtype)  # QTensor-aware (.astype)
     logits = (x @ head).astype(jnp.float32)
-    return logits, {"k": kc_new, "v": vc_new}
+    return logits, new_cache
 
 
 def sample_token(logits, rng, temperature: float = 1.0, top_k: int = 0,

@@ -1,0 +1,124 @@
+"""Quantized weight tensors for serving.
+
+Reference role: the int8 inference path (``(R)
+csrc/transformer/inference/csrc/dequantize.cu``, ``init_inference(dtype=
+torch.int8)``; SURVEY.md §3.5).  TPU-native shape: weights are stored as
+int8 with a per-output-channel fp32 scale; ``QTensor.astype(dtype)``
+dequantizes, so model code written as ``x @ w.astype(h.dtype)`` consumes
+quantized or dense weights unchanged — XLA fuses the ``int8 -> bf16 *
+scale`` dequant into the matmul's operand read, which is the role the CUDA
+dequant kernels play.  HBM cost: ~1 byte/weight (+ scale/d_in), and decode
+is bandwidth-bound, so int8 weights are also a decode *throughput* lever,
+not just a memory one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """int8 payload + broadcastable fp32 scale.  Quacks like an array for
+    the handful of attributes model code touches (.astype/.shape/.ndim)."""
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    # -- array-protocol surface used by the models ----------------------
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def size(self):
+        return self.q.size
+
+    @property
+    def dtype(self):
+        return jnp.int8
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.scale.nbytes
+
+    def astype(self, dtype):
+        """Dequantize.  fp32 multiply then cast: one fused elementwise op
+        under XLA, folded into the consuming matmul's operand."""
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+    def __matmul__(self, other):
+        return self.astype(other.dtype) @ other
+
+    def __rmatmul__(self, other):
+        return other @ self.astype(other.dtype)
+
+    # -- pytree ----------------------------------------------------------
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QTensor(int8{self.q.shape}, scale{self.scale.shape})"
+
+
+def quantize_weight(w: jnp.ndarray, axis: int = -2) -> QTensor:
+    """Symmetric per-output-channel int8: absmax over the contraction axis
+    (default -2, the d_in dim of a [..., d_in, d_out] matmul weight)."""
+    w32 = jnp.asarray(w).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q, scale)
+
+
+def is_qtensor(x: Any) -> bool:
+    return isinstance(x, QTensor)
+
+
+def quantize_layer_params(params: Any, cfg=None) -> Any:
+    """Quantize the transformer-layer matmul weights of a model param tree
+    (>=2D leaves under ``layers`` plus ``lm_head``); embeddings (gathered,
+    not matmul'd), norms, and biases stay dense.  MoE expert weights are
+    left dense (the expert dispatch einsums index weights in ways QTensor
+    does not mimic)."""
+    out = dict(params)
+    skip_mlp = bool(getattr(cfg, "is_moe", False))
+
+    def quant_leaf(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if skip_mlp and "mlp" in keys:
+            return leaf
+        # stacked layer trees hold matmul weights as [L, d_in, d_out]
+        # (ndim 3); 2D leaves under ``layers`` are stacked per-layer
+        # VECTORS (norm scales, biases) — quantizing those saves nothing
+        # and their [L]-leading scale shape would break the layer scan
+        if getattr(leaf, "ndim", 0) >= 3:
+            return quantize_weight(leaf)
+        return leaf
+
+    if "layers" in out:
+        out["layers"] = jax.tree_util.tree_map_with_path(
+            quant_leaf, out["layers"])
+    if "lm_head" in out and getattr(out["lm_head"], "ndim", 0) >= 2:
+        out["lm_head"] = quantize_weight(out["lm_head"])
+    return out
+
+
+def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
+    """QTensor leaves -> dense arrays (for paths that need plain params)."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if is_qtensor(x) else x, params,
+        is_leaf=is_qtensor)

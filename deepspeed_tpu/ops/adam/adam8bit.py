@@ -47,8 +47,9 @@ import optax
 
 class Adam8bitState(NamedTuple):
     count: jnp.ndarray
-    m_q: Any        # int8 [nb, block] per leaf (or fp32 [n] for small leaves)
-    m_scale: Any    # fp32 [nb] per leaf (or () placeholder)
+    m_q: Any        # int8 [nb, block] per leaf (or fp32 [n] for small leaves);
+    #                 nb is padded to the kernel row tile (ROW_MULT)
+    m_scale: Any    # fp32 [nb, 1] per leaf (or () placeholder)
     v_q: Any        # int8 [nb, block], sqrt-space (or fp32 [n])
     v_scale: Any
 
@@ -73,21 +74,6 @@ def stochastic_round_bf16(x32: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(rounded, jnp.float32).astype(jnp.bfloat16)
 
 
-def _block_quant(x2d: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """[nb, B] fp32 -> (int8 [nb, B], fp32 [nb]) via the shared quantizer
-    (already block-aligned, so pad is always 0)."""
-    from deepspeed_tpu.ops.pallas.quantizer import quantize
-
-    q, scale, _pad = quantize(x2d, bits=8, block=x2d.shape[-1], impl="xla")
-    return q, scale
-
-
-def _block_dequant(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
-    from deepspeed_tpu.ops.pallas.quantizer import dequantize
-
-    return dequantize(q, scale, 0, q.shape, dtype=jnp.float32)
-
-
 def adam8bit(learning_rate: Union[float, Callable] = 1e-3, b1: float = 0.9,
              b2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
              block: int = 512, min_quant_size: int = 4096,
@@ -97,36 +83,31 @@ def adam8bit(learning_rate: Union[float, Callable] = 1e-3, b1: float = 0.9,
     module docstring); weight decay is decoupled (AdamW-style).
     ``stochastic_rounding="auto"`` applies SR exactly to non-fp32 params."""
 
-    # Per-leaf chunking: the fp32 temporaries of the update (dequantized
-    # m/v, direction, new params) must never materialize for a whole big
-    # leaf at once — a stacked-layers leaf of a >1B model is ~278M elements,
-    # and ~6 fp32 temporaries of that size is ~7GB, which is what OOMs a
-    # 16GB chip.  Big leaves are processed as a ``lax.map`` over chunks of
-    # <= 2^25 elements; inputs stay in their storage dtype outside the
-    # chunk body.
-    chunk_target = 1 << 25
+    # The update itself runs as ONE fused Pallas pass per leaf
+    # (ops/pallas/fused_adam8bit.py): dequant -> moment update -> requant ->
+    # stochastic round in VMEM tiles, so no whole-leaf fp32 temporary ever
+    # materializes (a stacked-layers leaf of a >1B model is ~278M elements;
+    # ~6 fp32 temporaries of that is ~7GB — an instant OOM on a 16GB chip).
+    from deepspeed_tpu.ops.pallas.fused_adam8bit import ROW_MULT
 
     def _quantized(p) -> bool:
         return int(np.prod(p.shape)) >= min_quant_size
 
-    def _layout(p):
+    def _nb(p) -> int:
         n = int(np.prod(p.shape))
-        split = max(1, -(-n // chunk_target))
-        chunk = -(-(-(-n // split)) // block) * block  # ceil to block mult
-        return n, split, chunk
+        nb = -(-n // block)
+        return -(-nb // ROW_MULT) * ROW_MULT  # kernel row-tile alignment
 
     def init(params):
         def mk_q(p):
             if not _quantized(p):
                 return jnp.zeros((int(np.prod(p.shape)),), jnp.float32)
-            _, split, chunk = _layout(p)
-            return jnp.zeros((split * chunk // block, block), jnp.int8)
+            return jnp.zeros((_nb(p), block), jnp.int8)
 
         def mk_s(p):
             if not _quantized(p):
                 return jnp.zeros((), jnp.float32)
-            _, split, chunk = _layout(p)
-            return jnp.ones((split * chunk // block,), jnp.float32)
+            return jnp.ones((_nb(p), 1), jnp.float32)
 
         return Adam8bitState(
             count=jnp.zeros((), jnp.int32),
@@ -136,10 +117,15 @@ def adam8bit(learning_rate: Union[float, Callable] = 1e-3, b1: float = 0.9,
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("adam8bit requires params (for weight decay)")
+        from deepspeed_tpu.ops.pallas.fused_adam8bit import fused_adam8bit_update
+
         lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
         count = state.count + 1
-        c1 = 1.0 - b1 ** count.astype(jnp.float32)
-        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        countf = count.astype(jnp.float32)
+        c1 = 1.0 - b1 ** countf          # small-leaf form: direction m / c1
+        c2 = 1.0 - b2 ** countf
+        c1k = 1.0 / c1                   # kernel form: m * c1k
+        c2k = 1.0 / c2
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
@@ -162,52 +148,20 @@ def adam8bit(learning_rate: Union[float, Callable] = 1e-3, b1: float = 0.9,
                       and p.dtype == jnp.bfloat16)
 
             if _quantized(p):
-                _, split, chunk = _layout(p)
-                n_pad = split * chunk
-                bpc = chunk // block              # blocks per chunk
+                nb = _nb(p)
+                n_pad = nb * block
 
-                def pad_flat(x):  # keep storage dtype: no fp32 full copy
+                def pad2d(x):  # keep storage dtype: no fp32 full copy
                     flat = x.reshape(-1)
-                    return jnp.pad(flat, (0, n_pad - n)).reshape(split, chunk)
+                    return jnp.pad(flat, (0, n_pad - n)).reshape(nb, block)
 
-                g_c = pad_flat(g)
-                p_c = pad_flat(p)
-                keys = jax.random.split(jax.random.fold_in(base_key, i), split)
-
-                def chunk_fn(xs):
-                    gc, pc, mqc, msc, vqc, vsc, key = xs
-                    g32 = gc.astype(jnp.float32).reshape(bpc, block)
-                    m = _block_dequant(mqc, msc)
-                    rv = _block_dequant(vqc, vsc)
-                    v = rv * rv                   # sqrt-space storage
-                    m = b1 * m + (1.0 - b1) * g32
-                    v = b2 * v + (1.0 - b2) * g32 * g32
-                    direction = (m / c1) / (jnp.sqrt(v / c2) + eps)
-                    mq2, ms2 = _block_quant(m)
-                    vq2, vs2 = _block_quant(jnp.sqrt(v))
-                    p32 = pc.astype(jnp.float32)
-                    new32 = (p32 - lr * (direction.reshape(-1)
-                                         + weight_decay * p32))
-                    if use_sr:
-                        out = stochastic_round_bf16(new32, key)
-                    else:
-                        out = new32.astype(p.dtype)
-                    return out, mq2, ms2, vq2, vs2
-
-                xs = (g_c, p_c, mq.reshape(split, bpc, block),
-                      ms.reshape(split, bpc), vq.reshape(split, bpc, block),
-                      vs.reshape(split, bpc), keys)
-                if split == 1:  # no loop: fuses flat, compiles faster
-                    res = chunk_fn(jax.tree.map(lambda a: a[0], xs))
-                    out, mq2, ms2, vq2, vs2 = jax.tree.map(
-                        lambda a: a[None], res)
-                else:
-                    out, mq2, ms2, vq2, vs2 = jax.lax.map(chunk_fn, xs)
+                seed = count * jnp.int32(1000003) + jnp.int32(i * 7919)
+                out, mq2, ms2, vq2, vs2 = fused_adam8bit_update(
+                    pad2d(p), pad2d(g), mq, ms, vq, vs, c1k, c2k, lr, seed,
+                    b1=b1, b2=b2, eps=eps, wd=weight_decay, sr=use_sr)
                 new_p.append(out.reshape(-1)[:n].reshape(p.shape))
-                n_mq.append(mq2.reshape(-1, block))
-                n_ms.append(ms2.reshape(-1))
-                n_vq.append(vq2.reshape(-1, block))
-                n_vs.append(vs2.reshape(-1))
+                n_mq.append(mq2); n_ms.append(ms2)
+                n_vq.append(vq2); n_vs.append(vs2)
             else:
                 g32 = g.astype(jnp.float32).reshape(-1)
                 m = b1 * mq + (1.0 - b1) * g32

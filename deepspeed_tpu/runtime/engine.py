@@ -490,12 +490,27 @@ class DeepSpeedEngine:
         self._param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self._zpp_state_param_specs,
             is_leaf=lambda x: isinstance(x, P))
-        opt_shapes = jax.eval_shape(self.optimizer.init, primary)
-        self._opt_shardings = jax.tree.map(
-            lambda l: scalar_sh if getattr(l, "ndim", 0) == 0 else fsdp_sh,
+        # Optimizer state is initialized on the LOCAL shards (inside
+        # shard_map) and stored stacked over fsdp: optimizers whose state
+        # layout depends on the leaf size (Adam8bit's [nb, block] int8
+        # blocks) must see the same shapes at init and at update — a global
+        # init would bake in the unsharded layout and crash the in-region
+        # update.  For elementwise optimizers (optax Adam et al.) local
+        # init + stacking is identical to sharding a global init.
+        local_struct = jax.tree.map(
+            lambda L: jax.ShapeDtypeStruct((L // Pfsdp,), jnp.float32), lens)
+        opt_shapes = jax.eval_shape(self.optimizer.init, local_struct)
+        opt_specs = jax.tree.map(
+            lambda l: P() if getattr(l, "ndim", 0) == 0 else P("fsdp"),
             opt_shapes)
-        opt_state = jax.jit(self.optimizer.init,
-                            out_shardings=self._opt_shardings)(primary)
+        self._zpp_opt_specs = opt_specs
+        self._opt_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), opt_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        prim_spec_tree = jax.tree.map(lambda _: P("fsdp"), lens)
+        opt_state = jax.jit(jax.shard_map(
+            self.optimizer.init, mesh=mesh, in_specs=(prim_spec_tree,),
+            out_specs=opt_specs, check_vma=False))(primary)
         grad_acc = jax.jit(
             lambda pr: jax.tree.map(jnp.zeros_like, pr),
             out_shardings=jax.tree.map(lambda _: fsdp_sh, lens))(primary)
@@ -880,13 +895,7 @@ class DeepSpeedEngine:
         optimizer = self.optimizer
         new_params_opt = getattr(optimizer, "updates_are_new_params", False)
         prim_spec = jax.tree.map(lambda _: P("fsdp"), lens)
-        opt_shapes = jax.eval_shape(
-            optimizer.init,
-            jax.tree.map(lambda L: jax.ShapeDtypeStruct((L,), jnp.float32),
-                         lens))
-        opt_specs = jax.tree.map(
-            lambda l: P() if getattr(l, "ndim", 0) == 0 else P("fsdp"),
-            opt_shapes)
+        opt_specs = self._zpp_opt_specs
         state_specs = TrainState(
             params=self._zpp_state_param_specs, opt_state=opt_specs,
             grad_acc=prim_spec, global_steps=P(),
@@ -1517,8 +1526,17 @@ class DeepSpeedEngine:
 
     def _cast_like(self, tree, like):
         """Cast loaded leaves to the live state's dtypes (cheap jitted map;
-        checkpoints may hold a different precision than the running config)."""
+        checkpoints may hold a different precision than the running config).
+        Shape mismatches get a clear error — e.g. optimizer-state layouts
+        that changed between releases cannot be silently coerced."""
         def cast(a, b):
+            if tuple(getattr(a, "shape", ())) != tuple(getattr(b, "shape", ())):
+                raise ValueError(
+                    f"checkpoint leaf shape {getattr(a, 'shape', ())} does "
+                    f"not match the live state's {getattr(b, 'shape', ())} — "
+                    "the state layout changed (e.g. Adam8bit block layout); "
+                    "restart without load or export/import via the "
+                    "universal checkpoint")
             return a.astype(b.dtype) if a.dtype != b.dtype else a
 
         return jax.tree.map(cast, tree, like)
