@@ -22,7 +22,7 @@ from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
 def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                training_data=None, lr_scheduler=None, distributed_port=29500,
                mpu=None, dist_init_required=None, collate_fn=None, config=None,
-               config_params=None, mesh=None, rng=None):
+               config_params=None, mesh=None, rng=None, loss_fn=None):
     """Create a training engine (reference contract: SURVEY.md §3.2).
 
     Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
@@ -41,7 +41,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                              model_parameters=model_parameters, training_data=training_data,
                              lr_scheduler=lr_scheduler, mpu=mpu,
                              dist_init_required=dist_init_required, collate_fn=collate_fn,
-                             config=cfg, mesh=mesh, rng=rng)
+                             config=cfg, mesh=mesh, rng=rng, loss_fn=loss_fn)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

@@ -433,35 +433,102 @@ class CausalLM:
                 x, aux = body(lp, x, keys[i])
                 aux_loss = aux_loss + aux
 
-        x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-        head = (params["embed"]["tok"].T if cfg.tie_embeddings
-                else params["lm_head"]).astype(x.dtype)
         if labels is None:
+            x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+            head = (params["embed"]["tok"].T if cfg.tie_embeddings
+                    else params["lm_head"]).astype(x.dtype)
             logits = x @ head
             return constrain(logits, mesh, batch_ax, "sp", "tp")
-        # Next-token objective (HF CausalLM convention: shift inside when
-        # labels == input_ids): logits[t] predicts labels[t+1].
+        head = (params["embed"]["tok"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        loss = self._loss_tail(params["final_norm"], head, x, labels, loss_mask)
+        return loss + cfg.moe_aux_loss_coef * aux_loss if cfg.is_moe else loss
+
+    def _loss_tail(self, fnorm, head, x, labels, loss_mask):
+        """Final norm + LM cross-entropy — the single implementation behind
+        both ``apply`` and the streamed head segment (their numerical parity
+        is load-bearing for the offload tests).  ``head`` is [D, V].
+
+        Next-token objective (HF CausalLM convention: shift inside when
+        labels == input_ids): logits[t] predicts labels[t+1]."""
+        cfg = self.config
+        mesh = self.mesh
+        batch_ax = ("dp", "fsdp", "ep")
+        h = norm(x, fnorm, cfg.norm, cfg.norm_eps)
+        head = head.astype(h.dtype)
         shifted_labels = labels[:, 1:]
         shifted_mask = loss_mask[:, 1:] if loss_mask is not None else None
-        B, S, _ = x.shape
+        B, S, _ = h.shape
         chunk = cfg.ce_chunk
         if chunk is None:  # auto: chunk when the fp32 logits would be >2^28 elts
             chunk = 2048 if B * S * cfg.vocab_size > (1 << 28) else 0
         if chunk:
-            loss = blockwise_cross_entropy(x[:, :-1], head, shifted_labels,
+            return blockwise_cross_entropy(h[:, :-1], head, shifted_labels,
                                            chunk=chunk, z_loss=cfg.z_loss,
                                            mask=shifted_mask)
-        else:
-            logits = x[:, :-1] @ head
-            logits = constrain(logits, mesh, batch_ax, "sp", "tp")
-            loss = cross_entropy(logits, shifted_labels, z_loss=cfg.z_loss,
-                                 mask=shifted_mask)
-        return loss + cfg.moe_aux_loss_coef * aux_loss if cfg.is_moe else loss
+        logits = h[:, :-1] @ head
+        logits = constrain(logits, mesh, batch_ax, "sp", "tp")
+        return cross_entropy(logits, shifted_labels, z_loss=cfg.z_loss,
+                             mask=shifted_mask)
 
     # flax-style call-through so `model.apply(params, batch...)` also accepts
     # dict batches via engine's kwargs path
     def __call__(self, params, *args, **kwargs):
         return self.apply(params, *args, **kwargs)
+
+    # ------------------------------------------------------------------
+    # streamed per-layer segments (ZeRO-Infinity grad streaming)
+    # ------------------------------------------------------------------
+    def stream_segments(self):
+        """Pure per-segment functions for the engine's streamed fwd/bwd driver
+        (``runtime/zero/stream_grad.py``).  The reference's ZeRO-Infinity
+        streams params *and* grads per layer (``(R)
+        runtime/swap_tensor/partitioned_param_swapper.py`` role); these
+        segments let the engine run one layer at a time so no [model]-sized
+        buffer — params or grads — ever exists on device.
+
+        Returns None when the model cannot be segment-streamed (pipeline
+        parallelism owns the layer loop there).
+        """
+        cfg = self.config
+        mesh = self.mesh
+        if mesh is not None and not mesh.empty and axis_size(mesh, "pp") > 1:
+            return None
+        batch_ax = ("dp", "fsdp", "ep")
+
+        def embed_fwd(embed, tokens):
+            toks = constrain(tokens, mesh, batch_ax, "sp")
+            x = jnp.take(embed["tok"], toks, axis=0)
+            if cfg.position == "learned":
+                x = x + embed["pos"][: toks.shape[1]][None]
+            return constrain(x, mesh, batch_ax, "sp", None)
+
+        def layer_fwd(lp, x, key, cos, sin, use_drop):
+            return self._layer(lp, x, key, cos, sin, batch_ax, use_drop)
+
+        def head_loss(head_tree, x, labels, loss_mask):
+            head = head_tree["head"]
+            if cfg.tie_embeddings:  # head passed as the [V, D] tok table
+                head = head.T
+            return self._loss_tail(head_tree["final_norm"], head, x, labels,
+                                   loss_mask)
+
+        def rope(S, dtype):
+            if cfg.position != "rope":
+                return jnp.zeros((), dtype), jnp.zeros((), dtype)
+            cos, sin = rope_cache(S, cfg.head_dim, cfg.rope_theta)
+            return cos.astype(dtype), sin.astype(dtype)
+
+        return {
+            "num_layers": cfg.num_layers,
+            "dropout": cfg.dropout,
+            "moe_coef": cfg.moe_aux_loss_coef if cfg.is_moe else 0.0,
+            "tied": cfg.tie_embeddings,
+            "embed_fwd": embed_fwd,
+            "layer_fwd": layer_fwd,
+            "head_loss": head_loss,
+            "rope": rope,
+        }
 
 
 def _dropout(x, key, rate: float):

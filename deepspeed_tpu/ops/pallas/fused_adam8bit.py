@@ -28,6 +28,7 @@ from jax.experimental.pallas import tpu as pltpu
 from deepspeed_tpu.ops.pallas.common import interpret_flag, resolve_impl
 
 ROW_MULT = 32  # int8 sublane tile; nb is padded to a multiple of this
+XLA_CHUNK_ELEMS = 1 << 25  # fp32-temporary bound per chunk in the xla fallback
 
 
 def _kernel(c1_ref, c2_ref, lr_ref, seed_ref, p_ref, g_ref, mq_ref, ms_ref,
@@ -74,30 +75,50 @@ def fused_adam8bit_update(p2d, g2d, mq, ms, vq, vs, c1, c2, lr, seed, *,
     assert nb % ROW_MULT == 0, (nb, ROW_MULT)
     impl = resolve_impl(impl)
     if impl == "xla":
-        m = mq.astype(jnp.float32) * ms
-        v = jnp.square(vq.astype(jnp.float32) * vs)
-        g = g2d.astype(jnp.float32)
-        m = b1 * m + (1.0 - b1) * g
-        v = b2 * v + (1.0 - b2) * g * g
-        p = p2d.astype(jnp.float32)
-        new = p - lr * ((m * c1) / (jnp.sqrt(v * c2) + eps) + wd * p)
+        def xla_step(p_c, g_c, mq_c, ms_c, vq_c, vs_c, seed_c):
+            m = mq_c.astype(jnp.float32) * ms_c
+            v = jnp.square(vq_c.astype(jnp.float32) * vs_c)
+            g = g_c.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            p = p_c.astype(jnp.float32)
+            new = p - lr * ((m * c1) / (jnp.sqrt(v * c2) + eps) + wd * p)
 
-        def requant(x):  # shared quantizer: same semantics as the kernel
-            from deepspeed_tpu.ops.pallas.quantizer import quantize
+            def requant(x):  # shared quantizer: same semantics as the kernel
+                from deepspeed_tpu.ops.pallas.quantizer import quantize
 
-            q, scale, _pad = quantize(x, bits=8, block=block, impl="xla")
-            return q, scale[:, None]
+                q, scale, _pad = quantize(x, bits=8, block=block, impl="xla")
+                return q, scale[:, None]
 
-        mq2, ms2 = requant(m)
-        vq2, vs2 = requant(jnp.sqrt(v))
-        if sr and p2d.dtype == jnp.bfloat16:
-            from deepspeed_tpu.ops.adam.adam8bit import stochastic_round_bf16
+            mq2, ms2 = requant(m)
+            vq2, vs2 = requant(jnp.sqrt(v))
+            if sr and p_c.dtype == jnp.bfloat16:
+                from deepspeed_tpu.ops.adam.adam8bit import stochastic_round_bf16
 
-            key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
-            new_p = stochastic_round_bf16(new, key)
-        else:
-            new_p = new.astype(p2d.dtype)
-        return new_p, mq2, ms2, vq2, vs2
+                key = jax.random.fold_in(jax.random.PRNGKey(0), seed_c)
+                new_p = stochastic_round_bf16(new, key)
+            else:
+                new_p = new.astype(p_c.dtype)
+            return new_p, mq2, ms2, vq2, vs2
+
+        # Bound fp32 temporaries to ~XLA_CHUNK_ELEMS per chunk: this debug
+        # path must not reintroduce whole-leaf fp32 copies (a >1B model's
+        # stacked-layers leaf is ~278M elements; ~6 fp32 temporaries of
+        # that is ~7GB — an instant OOM on a 16GB chip).
+        chunk_rows = max(ROW_MULT, XLA_CHUNK_ELEMS // block)
+        if nb <= chunk_rows:
+            return xla_step(p2d, g2d, mq, ms, vq, vs, seed)
+        S = -(-nb // chunk_rows)
+        pad_rows = S * chunk_rows - nb
+
+        def padr(x):
+            return jnp.pad(x, ((0, pad_rows), (0, 0))).reshape(
+                S, chunk_rows, x.shape[1])
+
+        xs = (padr(p2d), padr(g2d), padr(mq), padr(ms), padr(vq), padr(vs),
+              seed + jnp.arange(S, dtype=jnp.int32) * jnp.int32(7919))
+        outs = jax.lax.map(lambda t: xla_step(*t), xs)
+        return tuple(o.reshape(S * chunk_rows, -1)[:nb] for o in outs)
 
     rows = min(256, nb)
     while nb % rows:

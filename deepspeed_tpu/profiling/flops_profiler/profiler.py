@@ -106,6 +106,18 @@ class FlopsProfiler:
         """Record cost analysis for one compiled program under ``name``."""
         self._cost[name] = _cost_analysis(jitted, *args, **kwargs)
 
+    def collect_scaled(self, name: str, parts) -> None:
+        """Record one entry summing several programs, each weighted by its
+        per-step call count (the streamed offload path dispatches per-layer
+        programs L times per micro-batch instead of one whole program)."""
+        total: Dict[str, float] = {}
+        for jitted, args, mult in parts:
+            ca = _cost_analysis(jitted, *args)
+            for k, v in ca.items():
+                if isinstance(v, (int, float)):
+                    total[k] = total.get(k, 0.0) + float(v) * mult
+        self._cost[name] = total
+
     def get_total_flops(self, as_string: bool = False):
         gas = 1
         if self.ds_engine is not None:

@@ -97,6 +97,11 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     buffer_size: int = 100_000_000
     max_in_cpu: int = 1_000_000_000
     pin_memory: bool = False
+    # TPU extension: stream the backward per layer so gradients never exist
+    # as a [model]-sized device buffer (the reference's swap pipeline moves
+    # grads off-device per parameter as autograd produces them; the
+    # whole-program jax path can't — see runtime/zero/stream_grad.py).
+    stream_grads: bool = True
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

@@ -106,6 +106,8 @@ class DeepSpeedEngine:
         self._offload_device = off_cfg.device if off_cfg is not None else "none"
         self._offload = self._offload_device in ("cpu", "nvme")
         self._offload_opt = None
+        self._streamed = None
+        self._np_params = None
         if self._offload:
             log_dist(f"ZeRO-Offload: optimizer states -> {self._offload_device}"
                      + (f" ({off_cfg.nvme_path})" if self._offload_device == "nvme"
@@ -215,6 +217,7 @@ class DeepSpeedEngine:
                     "offload_param: model %s does not expose a param_offload "
                     "hook; params stay host-resident but the model will not "
                     "stream them per-layer", type(model).__name__)
+        self._client_loss_fn = loss_fn is not None
         self._loss_fn = loss_fn or self._make_loss_fn(model)
         if param_pspecs is None and hasattr(model, "logical_pspecs"):
             # Built-in models publish their tensor/expert-parallel layout
@@ -849,6 +852,7 @@ class DeepSpeedEngine:
             self._accum_fn = None
             self._apply_fn = None
             self._eval_fn = jax.jit(evaluate)
+            self._build_streamed_fwdbwd(gas)
             return
         if self._onebit:
             self._compile_onebit_steps(loss_fn, cast_params, gas)
@@ -1078,11 +1082,22 @@ class DeepSpeedEngine:
         self.timers(SynchronizedWallClockTimer.FORWARD).start()
         self._rng, rng = jax.random.split(self._rng)
         if self._param_offload:
-            loss, grads = self._pofwdbwd_fn(self.state.params, batch, rng)
-            self._accum_host_grads(grads)
-            if self.flops_profiler is not None:
-                self._profile_probes["fwdbwd"] = (
-                    self._pofwdbwd_fn, (self.state.params, batch, rng))
+            unpacked = (self._unpack_lm_batch(batch)
+                        if self._streamed is not None else None)
+            if unpacked is not None:
+                toks, labels, mask = unpacked
+                if self._host_grad_acc is None:
+                    self._host_grad_acc = jax.tree.map(
+                        lambda a: np.zeros(a.shape, np.float32),
+                        self._np_params)
+                loss = self._streamed.run(self._np_params, toks, labels,
+                                          mask, rng, self._host_grad_acc)
+            else:
+                loss, grads = self._pofwdbwd_fn(self.state.params, batch, rng)
+                self._accum_host_grads(grads)
+                if self.flops_profiler is not None:
+                    self._profile_probes["fwdbwd"] = (
+                        self._pofwdbwd_fn, (self.state.params, batch, rng))
         else:
             if self.flops_profiler is not None:
                 self._profile_probes["accum"] = (self._accum_fn,
@@ -1096,11 +1111,65 @@ class DeepSpeedEngine:
     def _accum_host_grads(self, grads) -> None:
         """Accumulate host-resident micro-batch grads into fp32 numpy buffers
         (ZeRO-Offload semantics: the accumulator never touches the device)."""
-        leaves = jax.tree_util.tree_leaves(grads)
         if self._host_grad_acc is None:
-            self._host_grad_acc = [np.zeros(l.shape, np.float32) for l in leaves]
-        for buf, leaf in zip(self._host_grad_acc, leaves):
-            buf += np.asarray(leaf, dtype=np.float32)
+            self._host_grad_acc = jax.tree.map(
+                lambda g: np.zeros(g.shape, np.float32), grads)
+        jax.tree.map(lambda buf, g: buf.__iadd__(np.asarray(g, np.float32)),
+                     self._host_grad_acc, grads)
+
+    def _build_streamed_fwdbwd(self, gas: int) -> None:
+        """Construct the per-layer streamed fwd/bwd driver when the model
+        supports segmenting (ZeRO-Infinity grad streaming; VERDICT r3 item 2).
+        Falls back to the whole-program path (``_pofwdbwd_fn``) otherwise."""
+        self._streamed = None
+        p_off = self.config.zero_config.offload_param
+        if p_off is None or not getattr(p_off, "stream_grads", True):
+            return
+        if self._client_loss_fn:
+            # a custom objective can't route through the model's built-in
+            # head segment; the whole-program path honors it
+            logger.warning("offload_param.stream_grads: client loss_fn "
+                           "supplied — falling back to the whole-program "
+                           "fwd/bwd (device grad tree is O(model))")
+            return
+        if not hasattr(self.module, "stream_segments"):
+            return
+        seg = self.module.stream_segments()
+        if seg is None:
+            return
+        from deepspeed_tpu.runtime.zero.stream_grad import StreamedFwdBwd
+
+        specs = self._param_specs
+        layer_specs = jax.tree.map(lambda s: P(*tuple(s)[1:]), specs["layers"])
+        head_specs = {"final_norm": specs["final_norm"],
+                      "head": (specs["embed"]["tok"] if seg["tied"]
+                               else specs["lm_head"])}
+        self._streamed = StreamedFwdBwd(
+            seg, gas=gas,
+            layer_shardings=shardings_from_pspecs(layer_specs, self.mesh),
+            embed_shardings=shardings_from_pspecs(specs["embed"], self.mesh),
+            head_shardings=shardings_from_pspecs(head_specs, self.mesh),
+            use_dropout=True)
+        # numpy compute-dtype copy for the per-layer H2D slices — built only
+        # now that streaming is actually active (a second host-resident model
+        # copy is wasted memory on the whole-program fallback)
+        self._np_params = jax.device_get(self.state.params)
+        log_dist("offload_param: streamed per-layer fwd/bwd active "
+                 "(device grads bounded to one layer)", ranks=[0])
+
+    @staticmethod
+    def _unpack_lm_batch(batch):
+        """(tokens, labels, loss_mask) matching ``model.apply``'s batch
+        conventions, or None for forms the whole-program path defines
+        differently (the caller falls back so both paths keep one contract).
+        A loss mask is only accepted by its explicit dict key — a positional
+        third element is ambiguous (position_ids? attention_mask?) and the
+        whole-program path rejects it."""
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            return batch[0], batch[1], None
+        if isinstance(batch, dict) and "tokens" in batch and "labels" in batch:
+            return batch["tokens"], batch["labels"], batch.get("loss_mask")
+        return None
 
     def backward(self, loss, retain_graph: bool = False):
         """Reference-parity no-op: gradients were already computed and
@@ -1148,6 +1217,13 @@ class DeepSpeedEngine:
             return
         if self._apply_fn is not None and self.state is not None:
             self._profile_probes.setdefault("apply", (self._apply_fn, (self.state,)))
+        if self._streamed is not None and self._streamed.probes:
+            # streamed offload: fwd+bwd is L dispatches of the per-layer
+            # programs plus the embed/head segments
+            L = self._streamed.L
+            parts = [(fn, spec, L if name.startswith("layer") else 1)
+                     for name, (fn, spec) in self._streamed.probes.items()]
+            self.flops_profiler.collect_scaled("fwdbwd", parts)
         for name, (fn, args) in self._profile_probes.items():
             self.flops_profiler.collect(name, fn, *args)
         fp = self.config.flops_profiler
@@ -1164,23 +1240,26 @@ class DeepSpeedEngine:
         acc = self._host_grad_acc
         if acc is None:
             raise RuntimeError("step() before any forward() in offload_param mode")
+        leaves = jax.tree_util.tree_leaves(acc)
         gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
-                                  for g in acc)))
+                                  for g in leaves)))
         clip = self.config.gradient_clipping
         if clip and clip > 0 and gnorm > clip:
             scale = clip / (gnorm + 1e-6)
-            for g in acc:
+            for g in leaves:
                 g *= scale
         lr = self.get_lr()[0]
-        masters = self._offload_opt.step([g.reshape(-1) for g in acc], lr=lr)
+        masters = self._offload_opt.step([g.reshape(-1) for g in leaves], lr=lr)
         np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
                     jnp.float16: np.float16}.get(self.compute_dtype, np.float32)
         master = self._offload_opt.tree_from_masters(masters)
         compute = jax.tree.map(lambda a: a.astype(np_dtype), master)
+        if self._streamed is not None:
+            self._np_params = compute
         new_params = jax.device_put(compute, self._param_shardings)
         self.state = self.state._replace(
             params=new_params, global_steps=self.state.global_steps + 1)
-        for g in acc:
+        for g in leaves:
             g[:] = 0.0
         self._last_grad_norm = gnorm
         return gnorm, False
@@ -1481,6 +1560,8 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.state = new_state
+        if self._param_offload and getattr(self, "_streamed", None) is not None:
+            self._np_params = jax.device_get(self.state.params)
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})
 
@@ -1521,6 +1602,8 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.state = new_state
+        if self._param_offload and getattr(self, "_streamed", None) is not None:
+            self._np_params = jax.device_get(self.state.params)
         log_dist(f"loaded legacy checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})
 

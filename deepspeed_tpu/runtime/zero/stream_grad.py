@@ -1,0 +1,242 @@
+"""ZeRO-Infinity gradient streaming: per-layer fwd/bwd with host-resident
+params AND grads.
+
+Role of ``(R) runtime/swap_tensor/partitioned_param_swapper.py`` +
+``parameter_offload.py`` on the backward side (SURVEY.md §2.1 "NVMe swap",
+§7.6): the reference fetches each layer's params before use and moves each
+layer's grads off-device as soon as autograd produces them.  The
+whole-program jax path cannot do that — ``jax.grad`` over the layer scan
+materializes the full stacked grad pytree as a device-resident program
+output (VERDICT r3 weak #6).
+
+This driver replaces the single program with five small ones, compiled once
+and dispatched per layer:
+
+  embed_fwd   (embed, tokens) -> x0
+  layer_fwd   (lp_i, x_i) -> (x_{i+1}, aux_i)           [forward loop]
+  head_vag    (head, x_L, labels) -> loss, d(head), d(x_L)
+  layer_bwd   (lp_i, x_i, ct) -> d(lp_i), ct'            [backward loop,
+               recomputes the layer forward: per-layer remat]
+  embed_bwd   (embed, tokens, ct) -> d(embed)
+
+Per layer, the host: H2D-copies one layer's params (double-buffered — layer
+i+1's transfer is in flight while layer i computes), runs the segment, and
+D2H-copies the layer's grads straight into the fp32 numpy accumulators the
+host optimizer consumes.  Peak device memory is O(boundary activations +
+2 layers' params + 1 layer's grads) — never O(model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StreamedFwdBwd:
+    """Drives per-layer streamed forward+backward for a segmented model.
+
+    ``segments`` is the dict from ``model.stream_segments()``;
+    ``layer_shardings`` / ``embed_shardings`` / ``head_shardings`` are
+    device-memory NamedSharding trees used for the per-segment H2D puts
+    (one layer's specs = stacked specs with the leading [L] dim stripped).
+    """
+
+    def __init__(self, segments: Dict[str, Any], *, gas: int,
+                 layer_shardings, embed_shardings, head_shardings,
+                 use_dropout: bool):
+        self.seg = segments
+        self.gas = gas
+        self.L = segments["num_layers"]
+        self.moe_coef = float(segments["moe_coef"])
+        self.tied = segments["tied"]
+        self.use_drop = use_dropout and segments["dropout"] > 0
+        self._layer_sh = layer_shardings
+        self._embed_sh = embed_shardings
+        self._head_sh = head_shardings
+        self._rope_cache: Dict[Any, Any] = {}
+
+        layer_fwd = segments["layer_fwd"]
+        head_loss = segments["head_loss"]
+        embed_fwd = segments["embed_fwd"]
+        use_drop = self.use_drop
+
+        def lfwd(lp, x, key, cos, sin):
+            return layer_fwd(lp, x, key, cos, sin, use_drop)
+
+        def lbwd(lp, x, key, cos, sin, ct_y, ct_aux):
+            _, vjp = jax.vjp(
+                lambda lp_, x_: layer_fwd(lp_, x_, key, cos, sin, use_drop),
+                lp, x)
+            g_lp, ct_x = vjp((ct_y, ct_aux))
+            return ct_x, g_lp
+
+        def hvag(head_tree, x, labels, mask):
+            def f(ht, x_):
+                # grads scaled 1/gas exactly like the whole-program path
+                return head_loss(ht, x_, labels, mask).astype(jnp.float32) / gas
+
+            loss, (g_ht, ct_x) = jax.value_and_grad(f, argnums=(0, 1))(head_tree, x)
+            return loss * gas, g_ht, ct_x
+
+        def ebwd(embed, tokens, ct_x):
+            _, vjp = jax.vjp(lambda e: embed_fwd(e, tokens), embed)
+            (g_embed,) = vjp(ct_x)
+            return g_embed
+
+        self._embed_fwd = jax.jit(embed_fwd)
+        self._layer_fwd = jax.jit(lfwd)
+        self._layer_bwd = jax.jit(lbwd)
+        self._head_vag = jax.jit(hvag)
+        self._embed_bwd = jax.jit(ebwd)
+        # abstract arg specs for each segment, recorded on first run —
+        # lets tests lower+compile the per-layer programs and assert the
+        # device window (memory_analysis) without holding real arrays
+        self.probes: Dict[str, Any] = {}
+
+    @staticmethod
+    def _abstract(args):
+        from jax.sharding import NamedSharding
+
+        def spec(a):
+            if not isinstance(a, jax.Array):
+                return jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+            # keep only mesh-wide shardings: committed single-device
+            # placements (rng keys etc.) would conflict at lower() time
+            sh = a.sharding if isinstance(a.sharding, NamedSharding) else None
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+
+        return jax.tree.map(spec, args)
+
+    # ------------------------------------------------------------------
+    def _rope(self, S: int, dtype):
+        key = (S, jnp.dtype(dtype).name)
+        if key not in self._rope_cache:
+            self._rope_cache[key] = jax.jit(
+                lambda: self.seg["rope"](S, dtype))()
+        return self._rope_cache[key]
+
+    def _put_layer(self, np_layers, i: int):
+        """Async H2D of layer i's params (numpy slice views -> device)."""
+        sl = jax.tree.map(lambda a: a[i], np_layers)
+        return jax.device_put(sl, self._layer_sh)
+
+    @staticmethod
+    def _acc(buf_tree, grad_tree):
+        jax.tree.map(
+            lambda buf, g: buf.__iadd__(np.asarray(g, np.float32)),
+            buf_tree, grad_tree)
+
+    @staticmethod
+    def _acc_indexed(buf_tree, i: int, grad_tree):
+        def add(buf, g):
+            buf[i] += np.asarray(g, np.float32)
+
+        jax.tree.map(add, buf_tree, grad_tree)
+
+    @staticmethod
+    def _d2h_async(tree):
+        for leaf in jax.tree.leaves(tree):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:
+                pass
+        return tree
+
+    # ------------------------------------------------------------------
+    def run(self, np_params, tokens, labels, loss_mask, rng, acc_tree):
+        """One micro-batch fwd+bwd.  Grads accumulate (scaled 1/gas, fp32)
+        into ``acc_tree`` (numpy, mirrors the param pytree).  Returns the
+        device scalar loss."""
+        L = self.L
+        compute_dtype = np_params["layers"]["attn"]["wq"].dtype
+        cos, sin = self._rope(int(tokens.shape[1]), jnp.dtype(str(compute_dtype)))
+        if self.use_drop:
+            keys = list(jax.random.split(rng, L))
+        else:
+            keys = [jnp.zeros((2,), jnp.uint32)] * L
+
+        embed_dev = jax.device_put(np_params["embed"], self._embed_sh)
+        x = self._embed_fwd(embed_dev, tokens)
+        del embed_dev
+
+        # ---- forward: double-buffered layer streaming ----------------
+        xs = [x]            # boundary activations (device)
+        auxes = []
+        lp_last = None      # keep the final layer's device copy for backward
+        pending = self._put_layer(np_params["layers"], 0)
+        for i in range(L):
+            lp = pending
+            if i + 1 < L:   # overlap next layer's H2D with this compute
+                pending = self._put_layer(np_params["layers"], i + 1)
+            if i == 0 and "layer_fwd" not in self.probes:
+                self.probes["layer_fwd"] = (
+                    self._layer_fwd, self._abstract((lp, x, keys[i], cos, sin)))
+            x, aux = self._layer_fwd(lp, x, keys[i], cos, sin)
+            xs.append(x)
+            auxes.append(aux)
+            if i == L - 1:
+                lp_last = lp
+            del lp
+
+        # ---- head: loss + first cotangent ----------------------------
+        head_np = (np_params["embed"]["tok"] if self.tied
+                   else np_params["lm_head"])
+        head_tree = jax.device_put(
+            {"final_norm": np_params["final_norm"], "head": head_np},
+            self._head_sh)
+        if "head_vag" not in self.probes:
+            self.probes["head_vag"] = (
+                self._head_vag,
+                self._abstract((head_tree, xs[-1], labels, loss_mask)))
+            self.probes["embed_fwd"] = (
+                self._embed_fwd, self._abstract((np_params["embed"], tokens)))
+        loss, g_head, ct = self._head_vag(head_tree, xs[-1], labels, loss_mask)
+        del head_tree
+        self._d2h_async(g_head)
+        self._acc(acc_tree["final_norm"], g_head["final_norm"])
+        if self.tied:
+            self._acc(acc_tree["embed"]["tok"], g_head["head"])
+        else:
+            self._acc(acc_tree["lm_head"], g_head["head"])
+        del g_head
+
+        if self.moe_coef:
+            aux_total = jnp.stack(auxes).sum()
+            loss = loss + self.moe_coef * aux_total
+        ct_aux = jnp.asarray(self.moe_coef / self.gas, jnp.float32)
+
+        # ---- backward: stream layers in reverse (layer L-1's device
+        # copy from the forward is still live — no re-upload) -----------
+        pending = lp_last
+        lp_last = None
+        prev_grads: Optional[Any] = None
+        prev_idx = -1
+        for i in range(L - 1, -1, -1):
+            lp = pending
+            if i - 1 >= 0:
+                pending = self._put_layer(np_params["layers"], i - 1)
+            if "layer_bwd" not in self.probes:
+                self.probes["layer_bwd"] = (
+                    self._layer_bwd,
+                    self._abstract((lp, xs[i], keys[i], cos, sin, ct, ct_aux)))
+            ct, g_lp = self._layer_bwd(lp, xs[i], keys[i], cos, sin, ct, ct_aux)
+            del lp
+            xs[i + 1] = None  # free this boundary activation
+            self._d2h_async(g_lp)
+            if prev_grads is not None:  # collect while layer i's bwd runs
+                self._acc_indexed(acc_tree["layers"], prev_idx, prev_grads)
+            prev_grads, prev_idx = g_lp, i
+        if prev_grads is not None:
+            self._acc_indexed(acc_tree["layers"], prev_idx, prev_grads)
+
+        embed_dev = jax.device_put(np_params["embed"], self._embed_sh)
+        if "embed_bwd" not in self.probes:
+            self.probes["embed_bwd"] = (
+                self._embed_bwd, self._abstract((embed_dev, tokens, ct)))
+        g_embed = self._embed_bwd(embed_dev, tokens, ct)
+        del embed_dev
+        self._acc(acc_tree["embed"], g_embed)
+        return loss

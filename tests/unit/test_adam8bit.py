@@ -157,3 +157,30 @@ def test_grad_accum_dtype_fp16_rejected():
         DeepSpeedConfig({"train_batch_size": 8,
                          "fp16": {"enabled": True},
                          "data_types": {"grad_accum_dtype": "bf16"}})
+
+
+def test_xla_fallback_chunked_matches_unchunked(monkeypatch):
+    """The xla debug fallback must chunk big leaves (bounded fp32
+    temporaries) and produce the same result as the single-chunk path."""
+    import deepspeed_tpu.ops.pallas.fused_adam8bit as fab
+
+    block = 64
+    nb = 128  # 4 chunks once the bound is shrunk below
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(nb, block), jnp.float32)
+    g = jnp.asarray(rng.randn(nb, block), jnp.float32)
+    mq = jnp.asarray(rng.randint(-127, 128, (nb, block)), jnp.int8)
+    ms = jnp.asarray(np.abs(rng.randn(nb, 1)) * 0.01, jnp.float32)
+    vq = jnp.asarray(rng.randint(0, 128, (nb, block)), jnp.int8)
+    vs = jnp.asarray(np.abs(rng.randn(nb, 1)) * 0.01, jnp.float32)
+    args = dict(b1=0.9, b2=0.999, eps=1e-8, wd=0.01, sr=False, impl="xla")
+    c1 = jnp.float32(1.0 / (1 - 0.9))
+    c2 = jnp.float32(1.0 / (1 - 0.999))
+    lr = jnp.float32(1e-2)
+    seed = jnp.int32(7)
+    ref = fab.fused_adam8bit_update(p, g, mq, ms, vq, vs, c1, c2, lr, seed, **args)
+    monkeypatch.setattr(fab, "XLA_CHUNK_ELEMS", fab.ROW_MULT * block)
+    chunked = fab.fused_adam8bit_update(p, g, mq, ms, vq, vs, c1, c2, lr, seed, **args)
+    for a, b in zip(ref, chunked):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-6, atol=1e-6)

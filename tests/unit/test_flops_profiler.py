@@ -42,6 +42,34 @@ def test_engine_profile_printed():
     assert engine.flops_profiler.get_total_flops() > 0
 
 
+def test_streamed_offload_profile_nonzero(mesh8):
+    """The per-layer streamed offload path must still report train-step FLOPs
+    (regression: the whole-program fwdbwd probe doesn't exist there)."""
+    from deepspeed_tpu.comm.mesh import set_global_mesh
+    from deepspeed_tpu.models import causal_lm
+
+    set_global_mesh(mesh8)
+    model = causal_lm("llama-tiny", mesh=mesh8, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, max_seq_len=64, remat=False)
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1, "bf16": {"enabled": True},
+           "zero_optimization": {"stage": 3,
+                                 "offload_optimizer": {"device": "cpu"},
+                                 "offload_param": {"device": "cpu"}},
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+           "flops_profiler": {"enabled": True, "profile_step": 1},
+           "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg,
+                                               mesh=mesh8,
+                                               rng=jax.random.PRNGKey(3))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 256)
+    engine.forward((toks, toks))
+    engine.step()
+    assert engine._streamed is not None
+    assert engine.flops_profiler.get_total_flops() > 0
+
+
 def test_profiler_api_shapes():
     p = FlopsProfiler()
     p.start_profile()
