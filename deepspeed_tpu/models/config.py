@@ -38,6 +38,7 @@ class ModelConfig:
     rope_theta: float = 10000.0
     tie_embeddings: bool = False
     use_bias: bool = False                 # attn/mlp projection biases (gpt2)
+    qkv_bias: bool = False                 # biases on q/k/v only (qwen2)
     dropout: float = 0.0                   # residual dropout (needs a dropout rng)
     # MoE (mixtral family); num_experts == 0 -> dense MLP
     num_experts: int = 0

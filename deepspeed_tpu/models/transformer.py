@@ -88,11 +88,12 @@ class CausalLM:
             "wv": linit(next(keys), (D, Hkv * Dh), s_in),
             "wo": linit(next(keys), (H * Dh, D), (H * Dh) ** -0.5),
         }
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             attn.update(bq=jnp.zeros((L, H * Dh), dtype),
                         bk=jnp.zeros((L, Hkv * Dh), dtype),
-                        bv=jnp.zeros((L, Hkv * Dh), dtype),
-                        bo=jnp.zeros((L, D), dtype))
+                        bv=jnp.zeros((L, Hkv * Dh), dtype))
+        if cfg.use_bias:
+            attn.update(bo=jnp.zeros((L, D), dtype))
         if cfg.is_moe:
             mlp = {
                 "gate_w": _uniform(next(keys), (L, D, E), s_in, dtype),
@@ -144,11 +145,12 @@ class CausalLM:
         if cfg.norm == "layernorm":
             norm_spec["bias"] = P(None, None)
         attn = {"wq": col, "wk": col, "wv": col, "wo": row}
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             # column-split outputs carry tp-split biases; row outputs are
             # reduced across tp, so their bias stays replicated
-            attn.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"),
-                        bo=P(None, None))
+            attn.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
+        if cfg.use_bias:
+            attn.update(bo=P(None, None))
         if cfg.is_moe:
             mlp = {"gate_w": P(None, None, None),
                    "w_up": P(None, "ep", None, "tp"),
@@ -197,7 +199,7 @@ class CausalLM:
         q = h @ a["wq"]
         k = h @ a["wk"]
         v = h @ a["wv"]
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             q, k, v = q + a["bq"], k + a["bk"], v + a["bv"]
         q = q.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)

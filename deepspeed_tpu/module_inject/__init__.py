@@ -29,7 +29,14 @@ def tp_model_init(model, tp_size: int = 1, dtype=None, params: Any = None, mesh=
             set_global_mesh(mesh)
     if params is None:
         return model, None
-    logical = model.logical_pspecs() if hasattr(model, "logical_pspecs") else None
+    if hasattr(model, "logical_pspecs"):
+        logical = model.logical_pspecs()
+    else:
+        # arbitrary param tree: classify column/row by name analysis
+        # (reference auto_tp.py role)
+        from deepspeed_tpu.module_inject.auto_tp import autotp_pspecs
+
+        logical = autotp_pspecs(params)
     specs = params_pspecs(params, mesh, shard=False, logical_specs=logical)
     sharded = jax.device_put(params, shardings_from_pspecs(specs, mesh))
     if dtype is not None:

@@ -1,5 +1,5 @@
-"""HF checkpoint import: published GPT-2 / Llama / Mixtral weights -> the
-built-in models' param trees.
+"""HF checkpoint import: published GPT-2 / Llama / Mixtral / OPT / Qwen2
+weights -> the built-in models' param trees.
 
 Reference: ``deepspeed/module_inject/containers/`` (SURVEY.md §2.1 row 34) —
 the containers' real job is mapping public HuggingFace state dicts into the
@@ -68,7 +68,12 @@ def detect_arch(sd: Dict[str, np.ndarray]) -> str:
         return "mixtral"
     if any("wte.weight" in k for k in keys):
         return "gpt2"
+    if any("decoder.embed_positions" in k for k in keys):
+        return "opt"
     if any("embed_tokens.weight" in k for k in keys):
+        # qwen2 is llama-shaped with q/k/v biases
+        if any(k.endswith("q_proj.bias") for k in keys):
+            return "qwen2"
         return "llama"
     raise ValueError(f"unrecognized HF architecture (keys: {sorted(keys)[:8]}...)")
 
@@ -88,7 +93,7 @@ def config_from_hf(path: str):
             norm="layernorm", norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             activation="gelu", glu=False, position="learned",
             tie_embeddings=True, use_bias=True)
-    if mt in ("llama", "mistral"):
+    if mt in ("llama", "mistral", "qwen2"):
         return ModelConfig(
             vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
             intermediate_size=hf["intermediate_size"],
@@ -99,7 +104,25 @@ def config_from_hf(path: str):
             norm="rmsnorm", norm_eps=hf.get("rms_norm_eps", 1e-5),
             activation="silu", glu=True, position="rope",
             rope_theta=hf.get("rope_theta", 10000.0),
+            qkv_bias=(mt == "qwen2"),
             tie_embeddings=hf.get("tie_word_embeddings", False))
+    if mt == "opt":
+        D = hf["hidden_size"]
+        if hf.get("word_embed_proj_dim", D) != D:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                             "(project_in/out) is not supported")
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError("OPT with do_layer_norm_before=false (350m "
+                             "post-LN variant) is not supported")
+        return ModelConfig(
+            vocab_size=hf["vocab_size"], hidden_size=D,
+            intermediate_size=hf["ffn_dim"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="relu", glu=False,
+            position="learned", use_bias=True,
+            tie_embeddings=hf.get("tie_word_embeddings", True))
     if mt == "mixtral":
         return ModelConfig(
             vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
@@ -163,13 +186,59 @@ def hf_to_params(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
         }
         return params
 
-    if arch == "llama":
+    if arch == "opt":
+        attn = {
+            "wq": _stack(sd, "decoder.layers.{}.self_attn.q_proj.weight", L, T),
+            "wk": _stack(sd, "decoder.layers.{}.self_attn.k_proj.weight", L, T),
+            "wv": _stack(sd, "decoder.layers.{}.self_attn.v_proj.weight", L, T),
+            "wo": _stack(sd, "decoder.layers.{}.self_attn.out_proj.weight", L, T),
+            "bq": _stack(sd, "decoder.layers.{}.self_attn.q_proj.bias", L),
+            "bk": _stack(sd, "decoder.layers.{}.self_attn.k_proj.bias", L),
+            "bv": _stack(sd, "decoder.layers.{}.self_attn.v_proj.bias", L),
+            "bo": _stack(sd, "decoder.layers.{}.self_attn.out_proj.bias", L),
+        }
+        mlp = {
+            "w_up": _stack(sd, "decoder.layers.{}.fc1.weight", L, T),
+            "b_up": _stack(sd, "decoder.layers.{}.fc1.bias", L),
+            "w_down": _stack(sd, "decoder.layers.{}.fc2.weight", L, T),
+            "b_down": _stack(sd, "decoder.layers.{}.fc2.bias", L),
+        }
+        params = {
+            "embed": {
+                "tok": sd["decoder.embed_tokens.weight"],
+                # OPT's learned positions carry a +2 fairseq padding offset;
+                # with a full attention mask position ids are arange+2, so
+                # rows [2:] are the effective table
+                "pos": sd["decoder.embed_positions.weight"][2:],
+            },
+            "layers": {
+                "attn_norm": {
+                    "scale": _stack(sd, "decoder.layers.{}.self_attn_layer_norm.weight", L),
+                    "bias": _stack(sd, "decoder.layers.{}.self_attn_layer_norm.bias", L)},
+                "mlp_norm": {
+                    "scale": _stack(sd, "decoder.layers.{}.final_layer_norm.weight", L),
+                    "bias": _stack(sd, "decoder.layers.{}.final_layer_norm.bias", L)},
+                "attn": attn, "mlp": mlp,
+            },
+            "final_norm": {"scale": sd["decoder.final_layer_norm.weight"],
+                           "bias": sd["decoder.final_layer_norm.bias"]},
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = T(sd["lm_head.weight"])
+        return params
+
+    if arch in ("llama", "qwen2"):
         attn = {
             "wq": _stack(sd, "layers.{}.self_attn.q_proj.weight", L, T),
             "wk": _stack(sd, "layers.{}.self_attn.k_proj.weight", L, T),
             "wv": _stack(sd, "layers.{}.self_attn.v_proj.weight", L, T),
             "wo": _stack(sd, "layers.{}.self_attn.o_proj.weight", L, T),
         }
+        if arch == "qwen2":
+            attn.update(
+                bq=_stack(sd, "layers.{}.self_attn.q_proj.bias", L),
+                bk=_stack(sd, "layers.{}.self_attn.k_proj.bias", L),
+                bv=_stack(sd, "layers.{}.self_attn.v_proj.bias", L))
         mlp = {
             "w_gate": _stack(sd, "layers.{}.mlp.gate_proj.weight", L, T),
             "w_up": _stack(sd, "layers.{}.mlp.up_proj.weight", L, T),

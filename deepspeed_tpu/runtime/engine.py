@@ -108,6 +108,7 @@ class DeepSpeedEngine:
         self._offload_opt = None
         self._streamed = None
         self._np_params = None
+        self._pinned_stale = False
         if self._offload:
             log_dist(f"ZeRO-Offload: optimizer states -> {self._offload_device}"
                      + (f" ({off_cfg.nvme_path})" if self._offload_device == "nvme"
@@ -230,7 +231,7 @@ class DeepSpeedEngine:
         self._last_loss = None
         self._last_grad_norm = None
         self._last_overflow = None
-        self.state: Optional[TrainState] = None
+        self._state: Optional[TrainState] = None
         self._accum_fn = None
         self._apply_fn = None
         self._eval_fn = None
@@ -363,6 +364,24 @@ class DeepSpeedEngine:
         # silently reset to the section's default.
         if section_active and hasattr(mcfg, "remat_policy"):
             mcfg.remat_policy = ac.policy
+
+    @property
+    def state(self) -> Optional["TrainState"]:
+        """Training state.  In streamed offload mode the pinned-host param
+        copy refreshes lazily here — the hot loop trains from the numpy
+        masters and never pays the full-model host->pinned copy per step;
+        external readers (eval, checkpointing, fragments) always see the
+        current weights."""
+        if self._pinned_stale:
+            self._pinned_stale = False
+            self._state = self._state._replace(
+                params=jax.device_put(self._np_params, self._param_shardings))
+        return self._state
+
+    @state.setter
+    def state(self, value) -> None:
+        self._state = value
+        self._pinned_stale = False
 
     def _make_loss_fn(self, model) -> Callable:
         if hasattr(model, "apply"):  # flax module computing loss in __call__
@@ -531,6 +550,16 @@ class DeepSpeedEngine:
 
     def _init_state(self, params: Any) -> None:
         """Build shardings for the full state and compile the step functions."""
+        if (self._client_param_pspecs is None
+                and self.mesh.shape.get("tp", 1) > 1):
+            # model without logical_pspecs on a tp>1 mesh: generic AutoTP —
+            # classify column/row splits by name analysis (reference
+            # auto_tp.py role)
+            from deepspeed_tpu.module_inject.auto_tp import autotp_pspecs
+
+            self._client_param_pspecs = autotp_pspecs(params)
+            log_dist("AutoTP: derived tp layout from param names "
+                     "(no logical_pspecs on the model)", ranks=[0])
         if self._zeropp:
             return self._init_state_zeropp(params)
         mesh = self.mesh
@@ -1074,7 +1103,7 @@ class DeepSpeedEngine:
 
             batch = truncate_batch(batch, self.curriculum_difficulty())
         batch = shard_batch(batch, self.mesh)
-        if self.state is None:
+        if self._state is None:
             self.lazy_init_from_batch(batch)
         if not self._training:
             self._rng, rng = jax.random.split(self._rng)
@@ -1133,9 +1162,17 @@ class DeepSpeedEngine:
                            "fwd/bwd (device grad tree is O(model))")
             return
         if not hasattr(self.module, "stream_segments"):
+            logger.warning(
+                "offload_param.stream_grads: model %s exposes no "
+                "stream_segments; falling back to the whole-program fwd/bwd "
+                "(device grad tree is O(model))", type(self.module).__name__)
             return
         seg = self.module.stream_segments()
         if seg is None:
+            logger.warning(
+                "offload_param.stream_grads: model declined segmenting "
+                "(e.g. pipeline parallelism owns the layer loop); falling "
+                "back to the whole-program fwd/bwd")
             return
         from deepspeed_tpu.runtime.zero.stream_grad import StreamedFwdBwd
 
@@ -1215,8 +1252,8 @@ class DeepSpeedEngine:
         if (self.flops_profiler is None
                 or self._host_steps != self.config.flops_profiler.profile_step):
             return
-        if self._apply_fn is not None and self.state is not None:
-            self._profile_probes.setdefault("apply", (self._apply_fn, (self.state,)))
+        if self._apply_fn is not None and self._state is not None:
+            self._profile_probes.setdefault("apply", (self._apply_fn, (self._state,)))
         if self._streamed is not None and self._streamed.probes:
             # streamed offload: fwd+bwd is L dispatches of the per-layer
             # programs plus the embed/head segments
@@ -1255,10 +1292,18 @@ class DeepSpeedEngine:
         master = self._offload_opt.tree_from_masters(masters)
         compute = jax.tree.map(lambda a: a.astype(np_dtype), master)
         if self._streamed is not None:
+            # training reads only the numpy masters; the pinned-host
+            # state.params refreshes lazily on the next external read
+            # (eval/checkpoint) instead of paying a full-model host copy
+            # every optimizer step
             self._np_params = compute
-        new_params = jax.device_put(compute, self._param_shardings)
-        self.state = self.state._replace(
-            params=new_params, global_steps=self.state.global_steps + 1)
+            self._state = self._state._replace(
+                global_steps=self._state.global_steps + 1)
+            self._pinned_stale = True
+        else:
+            new_params = jax.device_put(compute, self._param_shardings)
+            self.state = self._state._replace(
+                params=new_params, global_steps=self._state.global_steps + 1)
         for g in leaves:
             g[:] = 0.0
         self._last_grad_norm = gnorm
@@ -1425,18 +1470,18 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     @property
     def global_steps(self) -> int:
-        return int(self.state.global_steps) if self.state is not None else 0
+        return int(self._state.global_steps) if self._state is not None else 0
 
     def get_global_grad_norm(self) -> Optional[float]:
         return float(self._last_grad_norm) if self._last_grad_norm is not None else None
 
     @property
     def loss_scale(self) -> float:
-        return float(self.state.scaler.scale) if self.state is not None else 1.0
+        return float(self._state.scaler.scale) if self._state is not None else 1.0
 
     @property
     def skipped_steps(self) -> int:
-        return int(self.state.scaler.skipped_steps) if self.state is not None else 0
+        return int(self._state.scaler.skipped_steps) if self._state is not None else 0
 
     def get_lr(self):
         if self.lr_scheduler is not None:

@@ -1,0 +1,136 @@
+"""Generic AutoTP name-analysis classification (VERDICT r3 item 7).
+
+The classifier must produce correct column/row PartitionSpecs for param
+trees it has never seen (HF-style naming, unknown custom layers), mirror the
+built-in models' hand-written logical_pspecs, and actually shard a no-
+logical_pspecs model end-to-end through the engine on a tp mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.module_inject.auto_tp import autotp_pspecs, classify
+
+
+def test_classify_hf_style_names():
+    # column (out-features split, no comm)
+    for name in ("q_proj", "k_proj", "v_proj", "up_proj", "gate_proj",
+                 "c_attn", "c_fc", "fc1", "query_key_value", "dense_h_to_4h"):
+        assert classify(name, 2) == "column", name
+    # row (in-features split, all-reduce after)
+    for name in ("o_proj", "out_proj", "down_proj", "c_proj", "fc2",
+                 "dense_4h_to_h"):
+        assert classify(name, 2) == "row", name
+    # embeddings split the vocab dim
+    for name in ("embed_tokens", "wte", "word_embeddings"):
+        assert classify(name, 2) == "embedding", name
+    # unknown 2D tensors are left replicated, never guessed
+    assert classify("my_custom_linear", 2) == "replicated"
+    assert classify("router_gate_matrix", 2) == "replicated"
+    # norms/biases replicated unless they belong to a column split
+    assert classify("scale", 1) == "replicated"
+    assert classify("bq", 1) == "column_bias"
+
+
+def test_autotp_pspecs_unseen_tree():
+    """An arbitrary HF-shaped tree (names the framework's models never use)
+    gets the Megatron layout."""
+    D, F, V = 8, 16, 32
+    tree = {
+        "embed_tokens": {"weight": np.zeros((V, D))},
+        "h": {
+            "attn": {"q_proj": {"weight": np.zeros((D, D)),
+                                "bias": np.zeros((D,))},
+                     "out_proj": {"weight": np.zeros((D, D)),
+                                  "bias": np.zeros((D,))}},
+            "mlp": {"fc1": {"weight": np.zeros((D, F))},
+                    "fc2": {"weight": np.zeros((F, D))}},
+            "ln": {"weight": np.zeros((D,))},
+            "mystery_proj": {"weight": np.zeros((D, D))},
+        },
+    }
+    specs = autotp_pspecs(tree)
+    assert specs["embed_tokens"]["weight"] == P("tp", None)
+    assert specs["h"]["attn"]["q_proj"]["weight"] == P(None, "tp")
+    assert specs["h"]["attn"]["q_proj"]["bias"] == P("tp")
+    assert specs["h"]["attn"]["out_proj"]["weight"] == P("tp", None)
+    assert specs["h"]["attn"]["out_proj"]["bias"] == P(None)
+    assert specs["h"]["mlp"]["fc1"]["weight"] == P(None, "tp")
+    assert specs["h"]["mlp"]["fc2"]["weight"] == P("tp", None)
+    assert specs["h"]["ln"]["weight"] == P(None)
+    assert specs["h"]["mystery_proj"]["weight"] == P(None, None)
+
+
+def test_autotp_matches_builtin_logical_pspecs():
+    """On the built-in CausalLM tree the classifier must agree with the
+    hand-written logical_pspecs for every 2D+ weight."""
+    from deepspeed_tpu.models import causal_lm
+
+    model = causal_lm("llama-tiny", num_layers=2, hidden_size=32,
+                      intermediate_size=64, num_heads=4, num_kv_heads=2,
+                      vocab_size=128, max_seq_len=32)
+    params = model.init(jax.random.PRNGKey(0))
+    want = model.logical_pspecs()
+    got = autotp_pspecs(params)
+    flat_w, _ = jax.tree_util.tree_flatten_with_path(want)
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(got)
+    for (pw, sw), (pg, sg) in zip(flat_w, flat_g):
+        assert pw == pg
+        assert tuple(sw) == tuple(sg), (jax.tree_util.keystr(pw), sw, sg)
+
+
+def test_engine_autotp_fallback_shards(rng):
+    """A model with params but no logical_pspecs trains on a tp=2 mesh with
+    AutoTP-derived shardings actually applied."""
+    devs = jax.devices()[:4]
+    mesh = build_mesh(tp=2, devices=devs)
+    set_global_mesh(mesh)
+
+    D, F, V = 16, 32, 64
+
+    class NoSpecModel:
+        def init(self, rng, *a):
+            k = jax.random.split(rng, 3)
+            return {
+                "embed_tokens": jax.random.normal(k[0], (V, D)) * 0.02,
+                "fc1": {"weight": jax.random.normal(k[1], (D, F)) * 0.1,
+                        "bias": jnp.zeros((F,))},
+                "fc2": {"weight": jax.random.normal(k[2], (F, V)) * 0.1},
+            }
+
+        def apply(self, params, toks, labels=None, rngs=None):
+            x = jnp.take(params["embed_tokens"], toks, axis=0)
+            h = jax.nn.relu(x @ params["fc1"]["weight"] + params["fc1"]["bias"])
+            logits = h @ params["fc2"]["weight"]
+            if labels is None:
+                return logits
+            lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), -1)
+            gold = jnp.take_along_axis(logits.astype(jnp.float32),
+                                       labels[..., None], -1).squeeze(-1)
+            return (lse - gold).mean()
+
+    cfg = {"train_batch_size": 4, "train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=NoSpecModel(), config=cfg,
+                                               mesh=mesh, rng=rng)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, V)
+    losses = []
+    for _ in range(5):
+        loss = engine.forward((toks, toks))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # the AutoTP classification was applied: fc1 out-dim is tp-split
+    spec = engine._param_specs["fc1"]["weight"]
+    assert "tp" in tuple(spec), spec
+    emb_spec = engine._param_specs["embed_tokens"]
+    assert tuple(emb_spec)[0] == "tp", emb_spec

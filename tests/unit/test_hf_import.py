@@ -29,6 +29,18 @@ def _save_tiny(tmp_path, kind: str) -> str:
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=64, tie_word_embeddings=False)
         model = transformers.LlamaForCausalLM(cfg)
+    elif kind == "opt":
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            word_embed_proj_dim=32, dropout=0.0, do_layer_norm_before=True)
+        model = transformers.OPTForCausalLM(cfg)
+    elif kind == "qwen2":
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        model = transformers.Qwen2ForCausalLM(cfg)
     else:
         cfg = transformers.MixtralConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -48,7 +60,7 @@ def _hf_logits(path: str, toks: np.ndarray) -> np.ndarray:
         return model(torch.tensor(toks)).logits.numpy()
 
 
-@pytest.mark.parametrize("kind", ["gpt2", "llama"])
+@pytest.mark.parametrize("kind", ["gpt2", "llama", "opt", "qwen2"])
 def test_logits_parity(tmp_path, kind, mesh8):
     path = _save_tiny(tmp_path, kind)
     assert is_hf_checkpoint(path)
