@@ -141,8 +141,10 @@ class FlopsProfiler:
     def get_total_params(self, as_string: bool = False):
         n = 0
         if self.ds_engine is not None and self.ds_engine.state is not None:
-            n = sum(int(x.size) for x in
-                    jax.tree_util.tree_leaves(self.ds_engine.state.params))
+            params = (self.ds_engine.module_params()
+                      if hasattr(self.ds_engine, "module_params")
+                      else self.ds_engine.state.params)
+            n = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
         return number_to_string(float(n)) + "params" if as_string else n
 
     def get_total_duration(self, as_string: bool = False):

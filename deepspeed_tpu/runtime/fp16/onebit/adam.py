@@ -142,12 +142,29 @@ class OneBitAdam:
 
 
 def onebit_from_config(opt_type: str, params: Dict[str, Any], world: int,
-                       axis_names: Sequence[str]) -> OneBitAdam:
+                       axis_names: Sequence[str]):
     name = opt_type.lower().replace("_", "").replace("-", "")
     betas = tuple(params.get("betas", (0.9, 0.999)))
-    return OneBitAdam(
-        world=world, axis_names=axis_names,
-        lr=params.get("lr", 1e-3), betas=betas, eps=params.get("eps", 1e-8),
-        weight_decay=params.get("weight_decay", 0.0),
-        freeze_step=params.get("freeze_step", 100),
-        lamb=(name == "onebitlamb"))
+    common = dict(world=world, axis_names=axis_names,
+                  lr=params.get("lr", 1e-3), betas=betas,
+                  eps=params.get("eps", 1e-8),
+                  weight_decay=params.get("weight_decay", 0.0))
+    if name == "zerooneadam":
+        from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneAdam
+
+        if "local_step_scaler" in params:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "ZeroOneAdam: local_step_scaler is accepted but the LR-"
+                "tracking interval policy it configures is approximated by "
+                "doubling-to-local_step_clipper; the knob itself has no "
+                "effect")
+        return ZeroOneAdam(
+            var_freeze_step=params.get("var_freeze_step", 100),
+            var_update_scaler=params.get("var_update_scaler", 16),
+            local_step_scaler=params.get("local_step_scaler", 32678),
+            local_step_clipper=params.get("local_step_clipper", 16),
+            **common)
+    return OneBitAdam(freeze_step=params.get("freeze_step", 100),
+                      lamb=(name == "onebitlamb"), **common)

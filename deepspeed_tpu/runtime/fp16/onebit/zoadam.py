@@ -1,0 +1,221 @@
+"""0/1 Adam: communication-skipping (0-bit) + sign-compressed (1-bit) Adam.
+
+Reference: ``deepspeed/runtime/fp16/onebit/zoadam.py`` (SURVEY.md §2.1 row
+14) implementing the 0/1 Adam paper (PAPERS.md): on top of 1-bit Adam's
+frozen-variance compressed momentum exchange, workers additionally SKIP
+communication for growing intervals ("local steps"), updating their own
+param replicas from purely local momentum, and reconcile at sync points by
+sign-compressing the accumulated parameter displacement since the last sync.
+
+Schedule (knob names match the reference config):
+
+- ``var_freeze_step``: last step at which the variance may update.
+- ``var_update_scaler``: while unfrozen, ``v`` refreshes every this many
+  steps (from a full-precision grad pmean — rare by construction).
+- ``local_step_clipper``: cap on the local-step interval.  Until
+  ``var_freeze_step`` the interval is 1 (sync every step); after freezing
+  it doubles at each sync up to the cap (the reference ties growth to the
+  LR schedule via ``local_step_scaler``; doubling-to-cap is that policy's
+  shape with a constant LR).
+
+TPU-native contract: like OneBitAdam this is a *per-worker local* update
+meant for a full-manual ``shard_map`` region, but params are [W]-stacked
+(spec ``P(waxes, ...)``) because replicas legitimately diverge between
+syncs — each device holds exactly its own replica, so total memory matches
+the reference's per-rank torch tensors.  The engine stacks/unstacks
+(``_compile_onebit_steps``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.runtime.comm.quantized import compressed_allreduce
+from deepspeed_tpu.runtime.fp16.onebit.adam import _chunk_size
+
+
+class ZeroOneState(NamedTuple):
+    exp_avg: Any          # per-worker momentum, [W, ...] stacked
+    exp_avg_sq: Any       # variance, replicated (updates only from synced grads)
+    anchor: Any           # params at last sync, replicated (fp32)
+    error_m: Any          # momentum-compression worker error, [W, ...]
+    server_error_m: Any   # momentum-compression server error, [W, chunk]
+    error_p: Any          # displacement-compression worker error, [W, ...]
+    server_error_p: Any   # displacement-compression server error, [W, chunk]
+    count: jnp.ndarray    # i32 step counter, replicated
+    var_updates: jnp.ndarray    # i32 number of variance EMA updates so far
+    syncs: jnp.ndarray          # i32 number of executed sync exchanges
+    sync_interval: jnp.ndarray  # i32 current local-step interval, replicated
+    next_sync: jnp.ndarray      # i32 step index of the next sync, replicated
+
+
+class ZeroOneAdam:
+    """0/1 Adam local update functions (see module docstring)."""
+
+    stacked_params = True  # engine: params carry a leading [W] worker axis
+
+    def __init__(self, world: int, axis_names: Sequence[str], lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, var_freeze_step: int = 100,
+                 var_update_scaler: int = 16, local_step_scaler: int = 32678,
+                 local_step_clipper: int = 16):
+        self.world = world
+        self.axis_names = tuple(axis_names)
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = max(1, var_update_scaler)
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = max(1, local_step_clipper)
+
+    # -- state ----------------------------------------------------------
+    def init(self, params_stacked: Any) -> ZeroOneState:
+        """``params_stacked`` leaves carry the [W] worker axis."""
+        W = self.world
+
+        def unstack(p):
+            return p[0]
+
+        base = jax.tree.map(unstack, params_stacked)
+        zeros_w = lambda p: jnp.zeros((W,) + p.shape, jnp.float32)
+        serr = lambda p: jnp.zeros((W, _chunk_size(p.size, W)), jnp.float32)
+        return ZeroOneState(
+            exp_avg=jax.tree.map(zeros_w, base),
+            exp_avg_sq=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), base),
+            anchor=jax.tree.map(lambda p: p.astype(jnp.float32), base),
+            error_m=jax.tree.map(zeros_w, base),
+            server_error_m=jax.tree.map(serr, base),
+            error_p=jax.tree.map(zeros_w, base),
+            server_error_p=jax.tree.map(serr, base),
+            count=jnp.zeros((), jnp.int32),
+            var_updates=jnp.zeros((), jnp.int32),
+            syncs=jnp.zeros((), jnp.int32),
+            sync_interval=jnp.ones((), jnp.int32),
+            next_sync=jnp.ones((), jnp.int32))
+
+    def state_pspecs(self, params: Any, waxes) -> "ZeroOneState":
+        """PartitionSpecs for the state (stacked leaves over the worker
+        axes, scalars and variance/anchor replicated)."""
+        wspec = lambda p: P(waxes, *([None] * getattr(p, "ndim", 0)))
+        rspec = lambda p: P(*([None] * getattr(p, "ndim", 0)))
+        return ZeroOneState(
+            exp_avg=jax.tree.map(wspec, params),
+            exp_avg_sq=jax.tree.map(rspec, params),
+            anchor=jax.tree.map(rspec, params),
+            error_m=jax.tree.map(wspec, params),
+            server_error_m=jax.tree.map(lambda p: P(waxes, None), params),
+            error_p=jax.tree.map(wspec, params),
+            server_error_p=jax.tree.map(lambda p: P(waxes, None), params),
+            count=P(), var_updates=P(), syncs=P(), sync_interval=P(),
+            next_sync=P())
+
+    # -- local (in-shard_map) update ------------------------------------
+    def update_local(self, grads_local: Any, state: ZeroOneState,
+                     params_local: Any, lr=None):
+        """One step from THIS worker's local grads.  ``params_local`` leaves
+        are this worker's [1, ...] replica slices; stacked state leaves
+        arrive as [1, ...] slices.  Returns (new_params [1, ...], state)."""
+        lr = self.lr if lr is None else lr
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        unfrozen = count <= self.var_freeze_step
+        # the variance updates EVERY step until var_update_scaler updates
+        # have landed (v==0 early would divide the momentum by eps), then
+        # thins out to every var_update_scaler-th step until the freeze
+        var_due = unfrozen & ((count <= self.var_update_scaler)
+                              | (count % self.var_update_scaler == 0))
+        var_updates = state.var_updates + var_due.astype(jnp.int32)
+        vu = var_updates.astype(jnp.float32)
+        sync = count >= state.next_sync
+
+        def leaf(g, m, v, anc, em, sm_, ep, sp_, p):
+            g = g.astype(jnp.float32)
+            p32 = p[0].astype(jnp.float32)
+
+            def warm_branch(_):
+                # variance-adaptation phase: dense Adam over the averaged
+                # gradient (replicas stay bit-identical; anchor rides along)
+                g_avg = lax.pmean(g, self.axis_names)
+                m_new = self.b1 * m[0] + (1 - self.b1) * g_avg
+                v_new = jnp.where(var_due,
+                                  self.b2 * v + (1 - self.b2) * g_avg * g_avg,
+                                  v)
+                m_hat = m_new / (1 - self.b1 ** cf)
+                # bias-correct by the number of EMA updates v actually
+                # received, not the step count — with thinned updates the
+                # step-count form undersizes v_hat by ~the scaler factor
+                v_hat = v_new / (1 - self.b2 ** jnp.maximum(vu, 1.0))
+                upd = m_hat / (jnp.sqrt(v_hat) + self.eps)
+                if self.weight_decay:
+                    upd = upd + self.weight_decay * p32
+                p_new = p32 - lr * upd
+                return p_new, m_new, v_new, p_new, em[0], sm_[0], ep[0], sp_[0]
+
+            def frozen_branch(_):
+                # frozen variance: purely local momentum + update; replicas
+                # diverge until the sync step reconciles them
+                m_w = self.b1 * m[0] + (1 - self.b1) * g
+                upd = m_w / (jnp.sqrt(v) + self.eps)
+                if self.weight_decay:
+                    upd = upd + self.weight_decay * p32
+                p_local = p32 - lr * upd
+
+                def sync_branch(_):
+                    # sign-compress the displacement since the last sync and
+                    # the momentum; everyone lands on identical replicas
+                    delta = p_local - anc
+                    d_avg, ep2, sp2 = compressed_allreduce(
+                        delta, ep[0], sp_[0], self.axis_names)
+                    p_sync = anc + d_avg
+                    m_avg, em2, sm2 = compressed_allreduce(
+                        m_w, em[0], sm_[0], self.axis_names)
+                    return p_sync, m_avg, v, p_sync, em2, sm2, ep2, sp2
+
+                def local_branch(_):
+                    return (p_local, m_w, v, anc, em[0], sm_[0], ep[0], sp_[0])
+
+                return lax.cond(sync, sync_branch, local_branch, operand=None)
+
+            p_new, m_out, v_out, anc_out, em_out, sm_out, ep_out, sp_out = \
+                lax.cond(unfrozen, warm_branch, frozen_branch, operand=None)
+            return (p_new.astype(p.dtype)[None], m_out[None], v_out, anc_out,
+                    em_out[None], sm_out[None], ep_out[None], sp_out[None])
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params_local)
+        z = zip(jax.tree_util.tree_leaves(grads_local),
+                jax.tree_util.tree_leaves(state.exp_avg),
+                jax.tree_util.tree_leaves(state.exp_avg_sq),
+                jax.tree_util.tree_leaves(state.anchor),
+                jax.tree_util.tree_leaves(state.error_m),
+                jax.tree_util.tree_leaves(state.server_error_m),
+                jax.tree_util.tree_leaves(state.error_p),
+                jax.tree_util.tree_leaves(state.server_error_p),
+                flat_p)
+        outs = [leaf(g, m, v, anc, em, sm_, ep, sp_, p)
+                for g, m, v, anc, em, sm_, ep, sp_, p in z]
+        unflat = lambda i: jax.tree_util.tree_unflatten(treedef,
+                                                        [o[i] for o in outs])
+        # local-step interval: 1 while the variance adapts; after freezing,
+        # double at each sync up to the clipper cap
+        grown = jnp.minimum(state.sync_interval * 2,
+                            jnp.int32(self.local_step_clipper))
+        synced = unfrozen | sync
+        next_interval = jnp.where(
+            synced, jnp.where(unfrozen, jnp.int32(1), grown),
+            state.sync_interval)
+        next_sync = jnp.where(synced, count + next_interval, state.next_sync)
+        new_state = ZeroOneState(
+            exp_avg=unflat(1), exp_avg_sq=unflat(2), anchor=unflat(3),
+            error_m=unflat(4), server_error_m=unflat(5),
+            error_p=unflat(6), server_error_p=unflat(7),
+            count=count, var_updates=var_updates,
+            syncs=state.syncs + synced.astype(jnp.int32),
+            sync_interval=next_interval, next_sync=next_sync)
+        return unflat(0), new_state

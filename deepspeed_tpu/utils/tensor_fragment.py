@@ -65,13 +65,24 @@ def _replace_leaf(tree: Any, name: str, value) -> Any:
 
 # -- params ----------------------------------------------------------------
 
+def _model_params(engine):
+    """Model-shaped params (engines may stack worker replicas on [W])."""
+    if hasattr(engine, "module_params"):
+        return engine.module_params()
+    return engine.state.params
+
+
 def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
     """Gather the full fp32 master value of a (possibly sharded) param."""
-    _, leaf = _find(engine.state.params, name)
+    _, leaf = _find(_model_params(engine), name)
     return np.asarray(jax.device_get(leaf), dtype=np.float32)
 
 
 def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    if getattr(engine, "_onebit_stacked", False):
+        # setting a param sets every worker replica
+        _, leaf = _find(engine.state.params, name)
+        value = np.broadcast_to(np.asarray(value)[None], leaf.shape)
     engine.state = engine.state._replace(
         params=_replace_leaf(engine.state.params, name, value))
 

@@ -87,3 +87,64 @@ def test_rejects_zero2_and_fp16():
     with pytest.raises(ValueError, match="fp16|bf16"):
         deepspeed_tpu.initialize(model=SimpleModel(16),
                                  config={**cfg_base, "fp16": {"enabled": True}})
+
+
+# ---------------------------------------------------------------------------
+# 0/1 Adam (real local-step schedule; VERDICT r3 item 8)
+# ---------------------------------------------------------------------------
+
+def test_zoadam_warmup_matches_dense_adam():
+    """While the variance adapts every step (var_update_scaler=1) and is not
+    yet frozen, 0/1 Adam is exactly dense Adam."""
+    dense, _ = _train("Adam", steps=8, adam_w_mode=False)
+    zo, engine = _train("ZeroOneAdam", steps=8, var_freeze_step=100,
+                        var_update_scaler=1)
+    np.testing.assert_allclose(dense, zo, rtol=2e-4, atol=2e-5)
+    assert engine._onebit_stacked
+
+
+def test_zoadam_local_steps_converge():
+    """After the variance freezes, communication-skipping local steps with
+    compressed reconciliation still train the model."""
+    losses, engine = _train("ZeroOneAdam", steps=40, var_freeze_step=10,
+                            var_update_scaler=2, local_step_clipper=4,
+                            lr=1e-3, eps=1e-3)
+    assert engine.global_steps == 40
+    assert np.isfinite(losses).all(), losses
+    assert min(losses[10:]) < losses[0], losses
+
+
+def test_zoadam_replicas_reconcile_at_sync():
+    """Replicas diverge during local steps and become bit-identical again at
+    each sync step (sign-compressed displacement exchange)."""
+    def replicas_equal(engine):
+        eq = True
+        for leaf in jax.tree.leaves(jax.device_get(engine.state.params)):
+            eq &= all(np.array_equal(leaf[0], leaf[i])
+                      for i in range(1, leaf.shape[0]))
+        return eq
+
+    # schedule: steps 1-4 warm (synced), step 5 sync, interval->2,
+    # step 6 local (diverged), step 7 sync (reconciled)
+    _, engine6 = _train("ZeroOneAdam", steps=6, var_freeze_step=4,
+                        var_update_scaler=1, local_step_clipper=2, lr=1e-3)
+    assert not replicas_equal(engine6), "replicas should diverge locally"
+    _, engine7 = _train("ZeroOneAdam", steps=7, var_freeze_step=4,
+                        var_update_scaler=1, local_step_clipper=2, lr=1e-3)
+    assert replicas_equal(engine7), "sync step must reconcile replicas"
+
+
+def test_zoadam_comm_skipped_on_local_steps():
+    """Local steps execute no sync exchange: 0/1 Adam's whole point.  The
+    CommsLogger counts at trace time (the sync sits in a lax.cond branch),
+    so assert on the state's executed-sync counter instead."""
+    def executed_syncs(clipper):
+        _, engine = _train("ZeroOneAdam", steps=20, var_freeze_step=4,
+                           var_update_scaler=1, local_step_clipper=clipper,
+                           lr=1e-3)
+        return int(jax.device_get(engine.state.opt_state.syncs))
+
+    # clipper=1: all 20 steps sync (4 warm + 16 frozen at interval 1);
+    # clipper=8: 4 warm + frozen syncs at steps 5,7,11,19 = 8 total
+    assert executed_syncs(1) == 20
+    assert executed_syncs(8) == 8
