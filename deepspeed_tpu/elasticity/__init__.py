@@ -4,3 +4,4 @@ from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
     ElasticityConfig, ElasticityConfigError, ElasticityError,
     ElasticityIncompatibleWorldSize, compute_elastic_config, get_best_candidates,
     get_valid_gpus)
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent  # noqa: F401

@@ -1,0 +1,184 @@
+"""Elastic agent v2: supervise, shrink, restart from checkpoint.
+
+Reference: ``deepspeed/elasticity/elastic_agent.py`` (SURVEY.md §2.1 row 45,
+§5.3) — the reference extends torch-elastic's agent: when a member dies, the
+rendezvous re-forms with the survivors and training restarts from the latest
+checkpoint at the new world size (which elasticity v1 guarantees keeps the
+global batch invariant).
+
+TPU-native shape: there is no torch-elastic; the agent owns the process
+group directly.  It spawns the ranks with the same env contract as
+``launcher/launch.py``, and on a member failure — instead of the launcher's
+fail-fast exit — it
+
+1. tears the remaining ranks down,
+2. validates the surviving count against the elastic config
+   (``compute_elastic_config(world_size=survivors)``, which also yields the
+   micro-batch for the invariant global batch),
+3. relaunches on the survivors with a fresh coordinator port and
+   ``DS_ELASTIC_RESTART`` bumped; the training script resumes from the
+   latest checkpoint tag (``engine.load_checkpoint`` with no tag).
+
+Give-up conditions: ``max_restarts`` exhausted, or the surviving count is
+not in the elastic set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityError, compute_elastic_config)
+from deepspeed_tpu.utils.logging import logger
+
+POLL_INTERVAL_S = 0.25
+
+
+class DSElasticAgent:
+    """Process-level elastic supervisor (see module docstring)."""
+
+    def __init__(self, ds_config: Dict, user_script: str,
+                 user_args: Optional[List[str]] = None, num_procs: int = 1,
+                 master_addr: str = "127.0.0.1", master_port: int = 29600,
+                 max_restarts: int = 3, env: Optional[Dict[str, str]] = None,
+                 no_local_rank: bool = False):
+        self.ds_config = ds_config
+        self.user_script = user_script
+        self.user_args = list(user_args or [])
+        self.num_procs = num_procs
+        self.master_addr = master_addr
+        self.master_port = master_port
+        self.max_restarts = max_restarts
+        self.base_env = dict(env if env is not None else os.environ)
+        self.no_local_rank = no_local_rank
+        self.restart_count = 0
+
+    # -- membership validation ------------------------------------------
+    def _validate_world(self, world_size: int) -> int:
+        """Return the micro-batch for this world size, or raise if the
+        elastic config rejects it."""
+        _, _, micro = compute_elastic_config(
+            self.ds_config, world_size=world_size, return_microbatch=True)
+        return micro
+
+    # -- one incarnation -------------------------------------------------
+    def _spawn(self, world_size: int, port: int) -> List[subprocess.Popen]:
+        procs = []
+        for rank in range(world_size):
+            env = dict(self.base_env)
+            env["COORDINATOR_ADDRESS"] = f"{self.master_addr}:{port}"
+            env["MASTER_ADDR"] = self.master_addr
+            env["MASTER_PORT"] = str(port)
+            env["RANK"] = str(rank)
+            env["LOCAL_RANK"] = str(rank)
+            env["WORLD_SIZE"] = str(world_size)
+            env["DS_ELASTIC_RESTART"] = str(self.restart_count)
+            env["DS_ELASTIC_WORLD_SIZE"] = str(world_size)
+            cmd = [sys.executable, "-u", self.user_script]
+            if not self.no_local_rank:
+                cmd.append(f"--local_rank={rank}")
+            cmd.extend(self.user_args)
+            procs.append(subprocess.Popen(cmd, env=env))
+        return procs
+
+    @staticmethod
+    def _terminate(procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + 10
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    def run(self) -> int:
+        world = self.num_procs
+        port = self.master_port
+        try:
+            micro = self._validate_world(world)
+            logger.info("elastic agent: starting world=%d (micro_batch=%d)",
+                        world, micro)
+        except Exception as exc:  # initial world must be valid
+            logger.error("elastic agent: initial world invalid: %s", exc)
+            return 1
+        while True:
+            procs = self._spawn(world, port)
+            failed = None
+            alive = set(range(len(procs)))
+            while alive and failed is None:
+                time.sleep(POLL_INTERVAL_S)
+                for i in sorted(alive):
+                    code = procs[i].poll()
+                    if code is None:
+                        continue
+                    alive.discard(i)
+                    if code != 0:
+                        failed = (i, code)
+                        break
+            if failed is None:
+                logger.info("elastic agent: job completed (restarts=%d)",
+                            self.restart_count)
+                return 0
+            rank, code = failed
+            logger.warning("elastic agent: rank %d died (exit %d); tearing "
+                           "down survivors", rank, code)
+            self._terminate(procs)
+            if self.restart_count >= self.max_restarts:
+                logger.error("elastic agent: max_restarts=%d exhausted",
+                             self.max_restarts)
+                return code
+            new_world = world - 1
+            try:
+                micro = self._validate_world(new_world)
+            except ElasticityError as exc:
+                logger.error("elastic agent: surviving world %d rejected by "
+                             "elastic config: %s", new_world, exc)
+                return code
+            self.restart_count += 1
+            world = new_world
+            port += 1  # fresh coordinator port: the old one may sit in TIME_WAIT
+            logger.info("elastic agent: restart #%d at world=%d "
+                        "(micro_batch=%d); training resumes from the latest "
+                        "checkpoint", self.restart_count, world, micro)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ds_elastic",
+        description="Elastic training supervisor (restart-on-membership-change)")
+    parser.add_argument("--ds_config", required=True,
+                        help="path to a ds_config.json with an elasticity section")
+    parser.add_argument("--num_procs", type=int, default=1)
+    parser.add_argument("--master_addr", default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29600)
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--no_local_rank", action="store_true")
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    with open(args.ds_config) as fh:
+        ds_config = json.load(fh)
+    agent = DSElasticAgent(ds_config, args.user_script, args.user_args,
+                           num_procs=args.num_procs,
+                           master_addr=args.master_addr,
+                           master_port=args.master_port,
+                           max_restarts=args.max_restarts,
+                           no_local_rank=args.no_local_rank)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

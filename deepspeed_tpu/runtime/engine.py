@@ -645,6 +645,26 @@ class DeepSpeedEngine:
                 params = jax.device_put(params, self._param_shardings)
             else:
                 params = jax.jit(to_compute, out_shardings=self._param_shardings)(params)
+        elif self._onebit_stacked:
+            # must win over the master-free bf16 branch below: the stacked
+            # specs/opt state are built for [W]-leading leaves, so the cast
+            # (when bf16.master_weights=false) composes with the stacking
+            W = self.optimizer.world
+            master_free = (self.bfloat16_enabled
+                           and not self.config.bf16.master_weights)
+            if master_free:
+                logger.warning(
+                    "bf16.master_weights=false with optimizer %s: plain "
+                    "round-to-nearest bf16 updates lose sub-ulp steps",
+                    self.config.optimizer.type if self.config.optimizer else "?")
+
+            def stack(x):
+                if master_free and jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(jnp.bfloat16)
+                return jnp.broadcast_to(x[None], (W,) + x.shape)
+
+            params = jax.jit(lambda p: jax.tree.map(stack, p),
+                             out_shardings=self._param_shardings)(params)
         elif self.bfloat16_enabled and not self.config.bf16.master_weights:
             # Master-free bf16: the persistent training state IS bf16 (no
             # fp32 master, no fp32 grads anywhere in the step program).
@@ -660,12 +680,6 @@ class DeepSpeedEngine:
                 lambda p: jax.tree.map(
                     lambda x: x.astype(jnp.bfloat16)
                     if jnp.issubdtype(x.dtype, jnp.floating) else x, p),
-                out_shardings=self._param_shardings)(params)
-        elif self._onebit_stacked:
-            W = self.optimizer.world
-            params = jax.jit(
-                lambda p: jax.tree.map(
-                    lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), p),
                 out_shardings=self._param_shardings)(params)
         else:
             params = jax.jit(lambda p: p, out_shardings=self._param_shardings)(params)

@@ -61,7 +61,8 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             raise RuntimeError("generate() before training state exists")
         engine = self._inference_engine()
         if engine._params is None or self._params_stale:
-            engine.set_params(self.state.params)
+            # module_params(): model-shaped view (0/1 Adam stacks replicas)
+            engine.set_params(self.module_params())
             self._params_stale = False
         return engine.generate(input_ids, **kwargs)
 

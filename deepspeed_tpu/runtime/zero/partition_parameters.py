@@ -56,8 +56,17 @@ class GatheredParameters:
                  fwd_module=None, enabled: bool = True, engine: Any = None):
         self.enabled = enabled
         self.engine = engine
-        self._src = params if params is not None else (
-            engine.state.params if engine is not None else None)
+        # 0/1 Adam stacks worker replicas on a leading [W] axis; users see
+        # the model-shaped view and writes broadcast to every replica
+        self._stacked_engine = (params is None and engine is not None
+                                and getattr(engine, "_onebit_stacked", False))
+        if params is not None:
+            self._src = params
+        elif engine is not None:
+            self._src = (engine.module_params() if self._stacked_engine
+                         else engine.state.params)
+        else:
+            self._src = None
         if self._src is None:
             raise ValueError("GatheredParameters needs params or engine=")
         self.params: Any = None
@@ -76,6 +85,19 @@ class GatheredParameters:
 
     def __exit__(self, exc_type, exc, tb):
         if not self.enabled or exc_type is not None:
+            return False
+        if self._stacked_engine:
+            # broadcast each (possibly modified) model-shaped value back to
+            # every worker replica with the live stacked shardings
+            live = self.engine.state.params
+            stacked_sh = self.engine._param_shardings
+            replaced = jax.tree.map(
+                lambda host, leaf, sh: jax.device_put(
+                    np.broadcast_to(np.asarray(host, leaf.dtype)[None],
+                                    leaf.shape), sh),
+                self.params, live, stacked_sh)
+            self.engine.state = self.engine.state._replace(params=replaced)
+            self.result = replaced
             return False
         replaced = jax.tree.map(
             lambda host, sh: jax.device_put(host, sh) if sh is not None else host,

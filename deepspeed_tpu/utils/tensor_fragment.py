@@ -65,16 +65,11 @@ def _replace_leaf(tree: Any, name: str, value) -> Any:
 
 # -- params ----------------------------------------------------------------
 
-def _model_params(engine):
-    """Model-shaped params (engines may stack worker replicas on [W])."""
-    if hasattr(engine, "module_params"):
-        return engine.module_params()
-    return engine.state.params
-
-
 def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
     """Gather the full fp32 master value of a (possibly sharded) param."""
-    _, leaf = _find(_model_params(engine), name)
+    _, leaf = _find(engine.state.params, name)
+    if getattr(engine, "_onebit_stacked", False):
+        leaf = leaf[0]  # model-shaped view: worker-0's replica
     return np.asarray(jax.device_get(leaf), dtype=np.float32)
 
 

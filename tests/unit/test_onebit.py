@@ -148,3 +148,20 @@ def test_zoadam_comm_skipped_on_local_steps():
     # clipper=8: 4 warm + frozen syncs at steps 5,7,11,19 = 8 total
     assert executed_syncs(1) == 20
     assert executed_syncs(8) == 8
+
+
+def test_zoadam_gathered_parameters_model_shaped():
+    """GatheredParameters over a 0/1 Adam engine exposes model-shaped leaves
+    (no [W] replica axis) and a write lands on every replica."""
+    from deepspeed_tpu.runtime.zero.partition_parameters import GatheredParameters
+
+    _, engine = _train("ZeroOneAdam", steps=2, var_freeze_step=100,
+                       var_update_scaler=1)
+    stacked_shapes = [l.shape for l in jax.tree.leaves(engine.state.params)]
+    with GatheredParameters(engine=engine) as p:
+        for leaf, st in zip(jax.tree.leaves(p), stacked_shapes):
+            assert leaf.shape == st[1:], (leaf.shape, st)
+        jax.tree.leaves(p)[0][:] = 0.0
+    first = np.asarray(jax.device_get(jax.tree.leaves(engine.state.params)[0]),
+                       np.float32)
+    assert (first == 0).all(), "write must reach every worker replica"
