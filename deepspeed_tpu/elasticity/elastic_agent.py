@@ -41,6 +41,14 @@ from deepspeed_tpu.utils.logging import logger
 POLL_INTERVAL_S = 0.25
 
 
+def _free_port(addr: str = "127.0.0.1") -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind((addr, 0))
+        return s.getsockname()[1]
+
+
 class DSElasticAgent:
     """Process-level elastic supervisor (see module docstring)."""
 
@@ -149,7 +157,10 @@ class DSElasticAgent:
                 return code
             self.restart_count += 1
             world = new_world
-            port += 1  # fresh coordinator port: the old one may sit in TIME_WAIT
+            # fresh coordinator port: the old one may sit in TIME_WAIT, and a
+            # sequential guess could land on an occupied port (which would
+            # masquerade as another member loss) — bind an ephemeral one
+            port = _free_port(self.master_addr)
             logger.info("elastic agent: restart #%d at world=%d "
                         "(micro_batch=%d); training resumes from the latest "
                         "checkpoint", self.restart_count, world, micro)

@@ -1,0 +1,79 @@
+"""jax.profiler trace capture for a window of train steps.
+
+Role of the reference's NVTX + nsys flow (``deepspeed/utils/nvtx.py``,
+SURVEY.md §5.1): ``wall_clock_breakdown: true`` gives coarse host-side
+fwd/bwd/step timers; this module additionally dumps an xplane trace
+(viewable in XProf/Perfetto/TensorBoard) so collective latency, kernel
+times, and host<->device gaps are attributable per step.  Host-side phases
+appear as ``jax.profiler.TraceAnnotation`` ranges named after the engine
+timers (``ds_forward`` / ``ds_step`` / ...) — the NVTX-range analog — and
+device ops carry the ``ds_fwd_bwd`` / ``ds_optimizer_step``
+``jax.named_scope`` prefixes from the compiled step functions.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class TraceCapture:
+    """Start/stop a ``jax.profiler`` trace over steps
+    ``[start_step, start_step + num_steps)``.  ``after_step(completed)`` is
+    called by the engine after each optimizer step with the number of
+    completed steps; the trace starts after step ``start_step - 1`` so the
+    captured window contains whole steps (every micro-batch dispatch + the
+    update)."""
+
+    def __init__(self, output_path: str, start_step: int = 2,
+                 num_steps: int = 2):
+        self.output_path = output_path
+        self.start_step = max(1, int(start_step))
+        self.num_steps = max(1, int(num_steps))
+        self.active = False
+        self.done = False
+
+    def maybe_start(self, upcoming_step: int) -> None:
+        """Called before the first micro-batch of ``upcoming_step``: opens
+        the window so the captured steps include their forward dispatches.
+        ``>=`` (not ``==``): a checkpoint-resumed run starts past
+        ``start_step`` and should still capture its first steps."""
+        if self.done or self.active or upcoming_step < self.start_step:
+            return
+        import atexit
+
+        os.makedirs(self.output_path, exist_ok=True)
+        jax.profiler.start_trace(self.output_path)
+        self.active = True
+        # training may end inside the window; close() is idempotent
+        atexit.register(self.close)
+        self.start_step = upcoming_step  # anchor the window where it opened
+        logger.info("profile_trace: capturing steps %d..%d -> %s",
+                    self.start_step, self.start_step + self.num_steps - 1,
+                    self.output_path)
+
+    def after_step(self, completed_steps: int) -> Optional[str]:
+        """Returns the trace directory when the capture just finished."""
+        if self.done or not self.active:
+            return None
+        if completed_steps >= self.start_step + self.num_steps - 1:
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            logger.info("profile_trace: wrote %s (xplane; open with XProf/"
+                        "TensorBoard profile plugin)", self.output_path)
+            return self.output_path
+        return None
+
+    def close(self) -> None:
+        """Stop a still-open trace (training ended inside the window)."""
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            logger.info("profile_trace: training ended inside the window; "
+                        "wrote partial trace %s", self.output_path)

@@ -199,6 +199,19 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class ProfileTraceConfig(DeepSpeedConfigModel):
+    """``profile_trace`` section (TPU extension; SURVEY.md §5.1): capture a
+    ``jax.profiler`` trace (xplane/Perfetto) for a window of train steps —
+    the NVTX/nsys analog, attributing collective and kernel latency that the
+    wall-clock timers cannot.  ``enabled: null`` follows
+    ``wall_clock_breakdown``."""
+
+    enabled: Optional[bool] = None
+    start_step: int = 2
+    num_steps: int = 2
+    output_path: Optional[str] = None
+
+
 class TensorBoardConfig(DeepSpeedConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -406,6 +419,7 @@ class DeepSpeedConfig:
             **d.get("activation_checkpointing", {}))
         self.aio = AIOConfig(**d.get("aio", {}))
         self.flops_profiler = FlopsProfilerConfig(**d.get("flops_profiler", {}))
+        self.profile_trace = ProfileTraceConfig(**d.get("profile_trace", {}))
         self.tensorboard = TensorBoardConfig(**d.get("tensorboard", {}))
         self.wandb = WandbConfig(**d.get("wandb", {}))
         self.csv_monitor = CSVConfig(**d.get("csv_monitor", {}))

@@ -265,7 +265,22 @@ class DeepSpeedEngine:
 
             self.flops_profiler = FlopsProfiler(ds_engine=self)
             self.flops_profiler.start_profile()
-        self.timers = SynchronizedWallClockTimer(synchronize=self.config.wall_clock_breakdown)
+        # jax.profiler trace window (SURVEY §5.1; the NVTX/nsys analog):
+        # enabled explicitly, or implied by wall_clock_breakdown
+        self._trace = None
+        ptc = self.config.profile_trace
+        trace_on = bool(ptc.enabled or (ptc.enabled is None
+                                        and self.config.wall_clock_breakdown))
+        self.timers = SynchronizedWallClockTimer(
+            synchronize=self.config.wall_clock_breakdown, annotate=trace_on)
+        if trace_on:
+            from deepspeed_tpu.profiling.trace import TraceCapture
+
+            trace_dir = ptc.output_path or os.path.join(
+                self.config.csv_monitor.output_path or "./csv_monitor",
+                "ds_trace")
+            self._trace = TraceCapture(trace_dir, start_step=ptc.start_step,
+                                       num_steps=ptc.num_steps)
         self.tput_timer = ThroughputTimer(batch_size=self.config.train_batch_size)
         self.training_dataloader = None
         if training_data is not None:
@@ -798,10 +813,14 @@ class DeepSpeedEngine:
                 loss = loss_fn(cast_params(params), batch, rng)
                 return (loss.astype(jnp.float32) * scale) / gas, loss
 
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
-            new_acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype), state.grad_acc, grads)
+            # named_scope: fwd/bwd ops carry this prefix in the xplane trace
+            with jax.named_scope("ds_fwd_bwd"):
+                grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
+                new_acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                                       state.grad_acc, grads)
             return state._replace(grad_acc=new_acc), loss
 
+        @jax.named_scope("ds_optimizer_step")
         def apply(state: TrainState):
             scale = state.scaler.scale if fp16 else jnp.float32(1.0)
             overflow = has_overflow(state.grad_acc) if fp16 else jnp.zeros((), bool)
@@ -1162,6 +1181,8 @@ class DeepSpeedEngine:
         if not self._training:
             self._rng, rng = jax.random.split(self._rng)
             return self._eval_fn(self.state.params, batch, rng)
+        if self._trace is not None and self._micro_count == 0:
+            self._trace.maybe_start(self._host_steps + 1)
         self.timers(SynchronizedWallClockTimer.FORWARD).start()
         self._rng, rng = jax.random.split(self._rng)
         if self._param_offload:
@@ -1301,6 +1322,8 @@ class DeepSpeedEngine:
         if self._host_steps % self.config.steps_per_print == 0:
             self._report(self.global_steps)
         self._maybe_emit_flops_profile()
+        if self._trace is not None:
+            self._trace.after_step(self._host_steps)
 
     def _maybe_emit_flops_profile(self) -> None:
         if (self.flops_profiler is None
@@ -1471,6 +1494,8 @@ class DeepSpeedEngine:
         if self.flops_profiler is not None:
             self._profile_probes["train_step"] = (self._fused_fn,
                                                   (self.state, stacked, rng))
+        if self._trace is not None:
+            self._trace.maybe_start(self._host_steps + 1)
         self.timers(SynchronizedWallClockTimer.STEP).start()
         self.state, loss, gnorm, overflow = self._fused_fn(self.state, stacked, rng)
         self.timers(SynchronizedWallClockTimer.STEP).stop()
@@ -1484,6 +1509,8 @@ class DeepSpeedEngine:
         if self._host_steps % self.config.steps_per_print == 0:
             self._report(self.global_steps)
         self._maybe_emit_flops_profile()
+        if self._trace is not None:
+            self._trace.after_step(self._host_steps)
         return loss
 
     def train_batch(self, data_iter=None):

@@ -103,6 +103,13 @@ def safe_get_full_optimizer_state(engine, name: str, state_key: str) -> np.ndarr
             if hasattr(st, "_fields") and field in st._fields:
                 sub = getattr(st, field)
                 _, leaf = _find(sub, name)
+                if getattr(engine, "_onebit_stacked", False):
+                    # stacked fields (exp_avg, error buffers) carry a [W]
+                    # replica axis; replicated ones (exp_avg_sq, anchor)
+                    # don't — compare against the stacked param shape
+                    _, p = _find(engine.state.params, name)
+                    if leaf.shape == p.shape:
+                        leaf = leaf[0]
                 return np.asarray(jax.device_get(leaf), dtype=np.float32)
     raise KeyError(f"optimizer state has no field {state_key!r}")
 
@@ -132,8 +139,13 @@ def safe_set_full_optimizer_state(engine, name: str, state_key: str, value) -> N
 # -- gradients -------------------------------------------------------------
 
 def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
-    """The accumulated gradient for a param (None before any forward)."""
+    """The accumulated gradient for a param (None before any forward).
+    1-bit engines accumulate per-worker local grads on a [W] axis; the
+    "full" gradient is their mean (the dense-equivalent value)."""
     if engine.state is None:
         return None
     _, leaf = _find(engine.state.grad_acc, name)
-    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+    out = np.asarray(jax.device_get(leaf), dtype=np.float32)
+    if getattr(engine, "_onebit", False) and out.ndim:
+        out = out.mean(axis=0)
+    return out

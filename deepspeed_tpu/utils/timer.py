@@ -27,9 +27,12 @@ def _device_synchronize() -> None:
 
 
 class _Timer:
-    def __init__(self, name: str, synchronize: bool = False):
+    def __init__(self, name: str, synchronize: bool = False,
+                 annotate: bool = False):
         self.name = name
         self.synchronize = synchronize
+        self.annotate = annotate  # emit a jax.profiler TraceAnnotation range
+        self._annotation = None
         self._start: Optional[float] = None
         self._elapsed = 0.0
         self._records: List[float] = []
@@ -40,6 +43,16 @@ class _Timer:
             raise RuntimeError(f"timer {self.name} already started")
         if self.synchronize:
             _device_synchronize()
+        if self.annotate:
+            # host-timeline range in the xplane trace (the NVTX-range analog;
+            # no-op cost when no trace is being captured)
+            try:
+                import jax
+
+                self._annotation = jax.profiler.TraceAnnotation(f"ds_{self.name}")
+                self._annotation.__enter__()
+            except Exception:  # pragma: no cover
+                self._annotation = None
         self._start = time.time()
         self.started = True
 
@@ -48,6 +61,9 @@ class _Timer:
             raise RuntimeError(f"timer {self.name} not started")
         if self.synchronize:
             _device_synchronize()
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
         elapsed = time.time() - self._start
         self._elapsed += elapsed
         if record:
@@ -77,13 +93,15 @@ class SynchronizedWallClockTimer:
     STEP = "step"
     BATCH = "batch"
 
-    def __init__(self, synchronize: bool = False):
+    def __init__(self, synchronize: bool = False, annotate: bool = False):
         self.timers: Dict[str, _Timer] = {}
         self.synchronize = synchronize
+        self.annotate = annotate
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
-            self.timers[name] = _Timer(name, synchronize=self.synchronize)
+            self.timers[name] = _Timer(name, synchronize=self.synchronize,
+                                       annotate=self.annotate)
         return self.timers[name]
 
     def has(self, name: str) -> bool:
