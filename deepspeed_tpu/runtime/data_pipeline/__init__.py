@@ -1,7 +1,10 @@
 """Data-efficiency pipeline (reference: ``deepspeed/runtime/data_pipeline/``,
-SURVEY.md §2.1): curriculum learning + random-LTD token dropping."""
+SURVEY.md §2.1): curriculum learning, random-LTD token dropping, and the
+data analysis/sampling half (``data_sampling/``)."""
 
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
     CurriculumScheduler, truncate_batch)
 from deepspeed_tpu.runtime.data_pipeline.data_routing import (  # noqa: F401
     RandomLTDScheduler, random_ltd_layer, random_token_select, scatter_back)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (  # noqa: F401
+    DataAnalyzer, DeepSpeedDataSampler, seqlen_metric)

@@ -1,0 +1,225 @@
+"""Data analysis + curriculum-aware sampling.
+
+Reference: ``deepspeed/runtime/data_pipeline/data_sampling/`` (SURVEY.md
+§2.1 "Data efficiency") — two halves:
+
+- **DataAnalyzer** (``data_analyzer.py`` role): a map/reduce pass over a
+  dataset computing per-sample difficulty metrics (seqlen, custom fns).
+  Map workers each write their shard's values; reduce merges them into the
+  on-disk index the sampler consumes: ``sample_to_metric.npy`` (value per
+  sample) and ``metric_to_sample.npy`` (sample ids sorted by value).
+- **DeepSpeedDataSampler** (``data_sampler.py`` role): a deterministic,
+  resumable sampler that composes each global batch from the samples whose
+  metric values the current curriculum difficulty admits, then hands THIS
+  data-parallel rank its shard.  Difficulty follows the same schedules as
+  ``CurriculumScheduler``; ``difficulty_type`` is ``"value"`` (admit
+  metric <= difficulty) or ``"percentile"`` (admit the easiest d% of the
+  sorted index).
+
+TPU note: the sampler emits *index arrays* (host-side numpy); batch
+assembly stays on the host and only the assembled batch is transferred —
+sampling never touches the device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.utils.logging import logger
+
+SAMPLE_TO_METRIC = "sample_to_metric.npy"
+METRIC_TO_SAMPLE = "metric_to_sample.npy"
+
+
+def seqlen_metric(sample) -> int:
+    """Default metric: token count (reference's seqlen analyzer)."""
+    if isinstance(sample, dict):
+        sample = sample.get("input_ids", next(iter(sample.values())))
+    if isinstance(sample, (tuple, list)):
+        sample = sample[0]
+    arr = np.asarray(sample)
+    return int(arr.shape[-1] if arr.ndim else 1)
+
+
+class DataAnalyzer:
+    """Map/reduce per-sample metric analysis (see module docstring).
+
+    ``metric_functions`` maps metric name -> fn(sample) -> scalar.  Workers
+    call ``run_map`` over disjoint shards (``worker_id``/``num_workers``),
+    then one process calls ``run_reduce`` to merge and index.
+    """
+
+    def __init__(self, dataset: Sequence, save_path: str,
+                 metric_functions: Optional[Dict[str, Callable]] = None,
+                 num_workers: int = 1, worker_id: int = 0):
+        self.dataset = dataset
+        self.save_path = save_path
+        self.metric_functions = metric_functions or {"seqlen": seqlen_metric}
+        self.num_workers = max(1, num_workers)
+        self.worker_id = worker_id
+
+    def _metric_dir(self, name: str) -> str:
+        return os.path.join(self.save_path, name)
+
+    def run_map(self) -> None:
+        n = len(self.dataset)
+        idx = np.arange(self.worker_id, n, self.num_workers)
+        for name, fn in self.metric_functions.items():
+            vals = np.asarray([fn(self.dataset[int(i)]) for i in idx],
+                              dtype=np.float64)
+            d = self._metric_dir(name)
+            os.makedirs(d, exist_ok=True)
+            np.save(os.path.join(d, f"worker{self.worker_id}_idx.npy"), idx)
+            np.save(os.path.join(d, f"worker{self.worker_id}_val.npy"), vals)
+        logger.info("data analyzer: worker %d/%d mapped %d samples (%s)",
+                    self.worker_id, self.num_workers, len(idx),
+                    list(self.metric_functions))
+
+    def run_reduce(self) -> None:
+        n = len(self.dataset)
+        for name in self.metric_functions:
+            d = self._metric_dir(name)
+            sample_to_metric = np.zeros((n,))
+            written = np.zeros((n,), bool)  # NaN is a legal metric value
+            for w in range(self.num_workers):
+                ipath = os.path.join(d, f"worker{w}_idx.npy")
+                if not os.path.exists(ipath):
+                    raise RuntimeError(
+                        f"data analyzer: worker {w} wrote no {name} values — "
+                        f"did every worker run_map?")
+                idx = np.load(ipath)
+                val = np.load(os.path.join(d, f"worker{w}_val.npy"))
+                sample_to_metric[idx] = val
+                written[idx] = True
+            if not written.all():
+                missing = int((~written).sum())
+                raise RuntimeError(f"data analyzer: {missing} samples have no "
+                                   f"{name} value — did every worker run_map?")
+            order = np.argsort(sample_to_metric, kind="stable")
+            np.save(os.path.join(d, SAMPLE_TO_METRIC), sample_to_metric)
+            np.save(os.path.join(d, METRIC_TO_SAMPLE), order)
+            with open(os.path.join(d, "meta.json"), "w") as fh:
+                json.dump({"num_samples": int(n),
+                           "min": float(sample_to_metric.min()),
+                           "max": float(sample_to_metric.max())}, fh)
+            logger.info("data analyzer: %s indexed (%d samples, min=%g "
+                        "max=%g)", name, n, sample_to_metric.min(),
+                        sample_to_metric.max())
+
+    def run(self) -> None:
+        """Single-process convenience: map (all shards) then reduce."""
+        for w in range(self.num_workers):
+            DataAnalyzer(self.dataset, self.save_path, self.metric_functions,
+                         self.num_workers, w).run_map()
+        self.run_reduce()
+
+
+class DeepSpeedDataSampler:
+    """Curriculum-aware deterministic index sampler (see module docstring).
+
+    ``curriculum_metrics``: {name: {"index_path": <analyzer dir>,
+    "difficulty_type": "value"|"percentile", + CurriculumScheduler keys
+    (curriculum_type, min/max_difficulty, total_curriculum_step, ...)}}.
+    Yields, per global step, the sample indices for THIS dp rank.
+    """
+
+    def __init__(self, num_samples: int, global_batch_size: int,
+                 data_parallel_rank: int = 0, data_parallel_size: int = 1,
+                 curriculum_metrics: Optional[Dict[str, Dict]] = None,
+                 seed: int = 1234, drop_last: bool = True,
+                 shuffle: bool = True):
+        assert global_batch_size % data_parallel_size == 0, \
+            (global_batch_size, data_parallel_size)
+        self.num_samples = num_samples
+        self.global_batch_size = global_batch_size
+        self.rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.seed = seed
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.global_step = 0
+        self.consumed_samples = 0
+        self.metrics: Dict[str, Dict[str, Any]] = {}
+        for name, mcfg in (curriculum_metrics or {}).items():
+            mdir = mcfg["index_path"]
+            s2m = np.load(os.path.join(mdir, SAMPLE_TO_METRIC))
+            m2s = np.load(os.path.join(mdir, METRIC_TO_SAMPLE))
+            if len(s2m) != num_samples:
+                raise ValueError(f"metric {name}: index covers {len(s2m)} "
+                                 f"samples, dataset has {num_samples}")
+            sched_cfg = {k: v for k, v in mcfg.items()
+                         if k not in ("index_path", "difficulty_type")}
+            self.metrics[name] = {
+                "sample_to_metric": s2m,
+                "metric_to_sample": m2s,
+                # values in index order: O(log n) threshold lookup per step
+                "sorted_values": s2m[m2s],
+                "difficulty_type": mcfg.get("difficulty_type", "value"),
+                "scheduler": CurriculumScheduler(sched_cfg),
+            }
+
+    # -- difficulty gating ----------------------------------------------
+    def _admitted(self, step: int) -> np.ndarray:
+        """Sample ids the current difficulties admit (intersection over
+        metrics); everything when no curriculum metric is configured."""
+        admitted: Optional[np.ndarray] = None
+        for name, m in self.metrics.items():
+            diff = m["scheduler"].update_difficulty(step)
+            if m["difficulty_type"] == "percentile":
+                k = int(np.ceil(len(m["metric_to_sample"]) * diff / 100.0))
+                ids = m["metric_to_sample"][:max(1, k)]
+            else:  # value threshold: prefix of the sorted index
+                k = int(np.searchsorted(m["sorted_values"], diff,
+                                        side="right"))
+                ids = m["metric_to_sample"][:max(1, k)]
+            admitted = ids if admitted is None else \
+                np.intersect1d(admitted, ids, assume_unique=False)
+        if admitted is None:
+            admitted = np.arange(self.num_samples)
+        if not len(admitted):
+            admitted = np.arange(self.num_samples)[:1]
+        return admitted
+
+    # -- sampling --------------------------------------------------------
+    def sample_step(self, step: Optional[int] = None) -> np.ndarray:
+        """Indices for this rank at ``step`` (default: the next step)."""
+        if step is None:
+            step = self.global_step
+        pool = self._admitted(step)
+        rng = np.random.RandomState((self.seed * 1000003 + step) % (2 ** 31))
+        if self.shuffle:
+            picks = rng.choice(pool, size=self.global_batch_size,
+                               replace=len(pool) < self.global_batch_size)
+        else:
+            off = (step * self.global_batch_size) % len(pool)
+            picks = np.take(pool, np.arange(off, off + self.global_batch_size),
+                            mode="wrap")
+        per_rank = self.global_batch_size // self.dp_size
+        mine = picks[self.rank * per_rank:(self.rank + 1) * per_rank]
+        if step == self.global_step:
+            self.global_step += 1
+            self.consumed_samples += self.global_batch_size
+        return mine
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.sample_step()
+
+    # -- resume ----------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"global_step": self.global_step,
+                "consumed_samples": self.consumed_samples,
+                "seed": self.seed}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.global_step = int(sd["global_step"])
+        self.consumed_samples = int(sd["consumed_samples"])
+        if int(sd.get("seed", self.seed)) != self.seed:
+            logger.warning("data sampler: resuming with a different seed "
+                           "(%s -> %s); sample order will diverge",
+                           sd.get("seed"), self.seed)

@@ -117,14 +117,23 @@ def safe_get_full_optimizer_state(engine, name: str, state_key: str) -> np.ndarr
 def safe_set_full_optimizer_state(engine, name: str, state_key: str, value) -> None:
     fields = _candidate_fields(state_key)
     hit = []
+    value = np.asarray(value)
 
     def swap_state(st):
         if hasattr(st, "_fields"):
             for field in fields:
                 if field in st._fields:
                     hit.append(field)
-                    return st._replace(
-                        **{field: _replace_leaf(getattr(st, field), name, value)})
+                    sub = getattr(st, field)
+                    v = value
+                    if getattr(engine, "_onebit_stacked", False):
+                        # model-shaped value -> broadcast to every worker
+                        # replica when the stored leaf is [W]-stacked (the
+                        # getter returns the model-shaped view)
+                        _, leaf = _find(sub, name)
+                        if leaf.shape != v.shape and leaf.shape[1:] == v.shape:
+                            v = np.broadcast_to(v[None], leaf.shape)
+                    return st._replace(**{field: _replace_leaf(sub, name, v)})
         return st
 
     is_leaf = lambda x: hasattr(x, "_fields") and any(
