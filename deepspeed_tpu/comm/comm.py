@@ -177,6 +177,18 @@ def is_initialized() -> bool:
 
 
 def get_rank(group: Any = None) -> int:
+    """Caller's rank; with ``group=`` a ProcessGroup, the caller's position
+    in the group (reference semantics: -1 when not a member).  Group ranks
+    are PROCESS indices for this query; a device-id group on a multi-host
+    pod is ambiguous and gets a warning."""
+    if group is not None and hasattr(group, "ranks"):
+        me = jax.process_index()
+        if jax.process_count() > 1 and max(group.ranks) >= jax.process_count():
+            logger.warning(
+                "get_rank(group=): group ranks %s exceed the process world "
+                "(%d) — they look like device ids; group rank queries are "
+                "process-index based", group.ranks, jax.process_count())
+        return group.ranks.index(me) if me in group.ranks else -1
     return jax.process_index()
 
 
@@ -187,6 +199,9 @@ def get_local_rank() -> int:
 
 
 def get_world_size(group: Any = None) -> int:
+    """Device world; with ``group=`` a ProcessGroup, the group size."""
+    if group is not None and hasattr(group, "size"):
+        return group.size()
     return jax.device_count()
 
 
@@ -281,8 +296,10 @@ class ProcessGroup:
 
         if jax.process_count() > 1:
             raise NotImplementedError(
-                "eager ProcessGroup.all_reduce is single-controller only; "
-                "use group.mesh with shard_map inside jit for multi-host")
+                "eager per-member all_reduce is single-controller only; "
+                "multi-process callers pass THIS process's value to "
+                "all_reduce_across_processes (or use group.mesh with "
+                "shard_map inside jit)")
         stacked = (jnp.stack([jnp.asarray(v) for v in values])
                    if isinstance(values, (list, tuple))
                    else jnp.asarray(values))
@@ -303,6 +320,42 @@ class ProcessGroup:
             stacked, jax.sharding.NamedSharding(self.mesh,
                                                 PartitionSpec(self.AXIS)))
         return _reduce(placed)
+
+    def all_reduce_across_processes(self, value, op: str = "sum"):
+        """Eager control-plane reduce over the member PROCESSES on a real
+        pod: every process passes its own ``value``; members' contributions
+        are reduced and the result returned everywhere.  ``ranks`` MUST be
+        process indices here (the device-subset view of this group is
+        served by ``all_reduce``/``mesh``); out-of-range ranks raise rather
+        than silently misindexing.  Control plane only: per-step gradient
+        traffic belongs in jit."""
+        import numpy as np
+
+        n_proc = jax.process_count()
+        bad = [r for r in self.ranks if r >= n_proc]
+        if bad:
+            raise ValueError(
+                f"all_reduce_across_processes: ranks {bad} are not process "
+                f"indices (process world is {n_proc}); this helper reduces "
+                "over member PROCESSES — for device subsets use all_reduce "
+                "(per-member values) or group.mesh with shard_map")
+        arr = jnp.asarray(value)
+        if n_proc == 1:
+            gathered = np.asarray(arr)[None]
+        else:
+            from jax.experimental import multihost_utils
+
+            gathered = np.asarray(multihost_utils.process_allgather(arr))
+        subset = gathered[np.asarray(self.ranks)]
+        if op in ("sum", ReduceOp.SUM):
+            return jnp.asarray(subset.sum(axis=0))
+        if op in ("avg", ReduceOp.AVG):
+            return jnp.asarray(subset.mean(axis=0))
+        if op in ("max", ReduceOp.MAX):
+            return jnp.asarray(subset.max(axis=0))
+        if op in ("min", ReduceOp.MIN):
+            return jnp.asarray(subset.min(axis=0))
+        raise ValueError(f"unsupported reduce op {op}")
 
 
 def new_group(ranks, backend: Optional[str] = None) -> ProcessGroup:

@@ -131,8 +131,7 @@ class DeepSpeedDataSampler:
     def __init__(self, num_samples: int, global_batch_size: int,
                  data_parallel_rank: int = 0, data_parallel_size: int = 1,
                  curriculum_metrics: Optional[Dict[str, Dict]] = None,
-                 seed: int = 1234, drop_last: bool = True,
-                 shuffle: bool = True):
+                 seed: int = 1234, shuffle: bool = True):
         assert global_batch_size % data_parallel_size == 0, \
             (global_batch_size, data_parallel_size)
         self.num_samples = num_samples
@@ -140,8 +139,8 @@ class DeepSpeedDataSampler:
         self.rank = data_parallel_rank
         self.dp_size = data_parallel_size
         self.seed = seed
-        self.drop_last = drop_last
         self.shuffle = shuffle
+        self._warned_empty_intersection = False
         self.global_step = 0
         self.consumed_samples = 0
         self.metrics: Dict[str, Dict[str, Any]] = {}
@@ -168,6 +167,7 @@ class DeepSpeedDataSampler:
         """Sample ids the current difficulties admit (intersection over
         metrics); everything when no curriculum metric is configured."""
         admitted: Optional[np.ndarray] = None
+        pools: List[np.ndarray] = []
         for name, m in self.metrics.items():
             diff = m["scheduler"].update_difficulty(step)
             if m["difficulty_type"] == "percentile":
@@ -177,12 +177,22 @@ class DeepSpeedDataSampler:
                 k = int(np.searchsorted(m["sorted_values"], diff,
                                         side="right"))
                 ids = m["metric_to_sample"][:max(1, k)]
+            pools.append(ids)
             admitted = ids if admitted is None else \
                 np.intersect1d(admitted, ids, assume_unique=False)
         if admitted is None:
-            admitted = np.arange(self.num_samples)
+            return np.arange(self.num_samples)
         if not len(admitted):
-            admitted = np.arange(self.num_samples)[:1]
+            # disjoint per-metric pools (can happen early in multi-metric
+            # ramps): fall back to the union rather than starving the batch
+            # down to one repeated sample
+            if not self._warned_empty_intersection:
+                logger.warning(
+                    "data sampler: curriculum metrics admit disjoint sample "
+                    "sets at step %d; falling back to their union until the "
+                    "ramps overlap", step)
+                self._warned_empty_intersection = True
+            admitted = np.unique(np.concatenate(pools))
         return admitted
 
     # -- sampling --------------------------------------------------------
