@@ -182,12 +182,16 @@ def get_rank(group: Any = None) -> int:
     are PROCESS indices for this query; a device-id group on a multi-host
     pod is ambiguous and gets a warning."""
     if group is not None and hasattr(group, "ranks"):
-        me = jax.process_index()
-        if jax.process_count() > 1 and max(group.ranks) >= jax.process_count():
+        if (jax.process_count() > 1
+                and getattr(group, "kind", "device") != "process"):
+            # a device-id group has no process-membership meaning on a pod:
+            # device 1 being in the group says nothing about process 1
             logger.warning(
-                "get_rank(group=): group ranks %s exceed the process world "
-                "(%d) — they look like device ids; group rank queries are "
-                "process-index based", group.ranks, jax.process_count())
+                "get_rank(group=): group %s is a device-id group; process "
+                "membership is undefined on a multi-process world — build "
+                "it with new_group(..., kind='process')", group.ranks)
+            return -1
+        me = jax.process_index()
         return group.ranks.index(me) if me in group.ranks else -1
     return jax.process_index()
 
@@ -268,15 +272,26 @@ class ProcessGroup:
 
     AXIS = "sub"
 
-    def __init__(self, ranks):
+    def __init__(self, ranks, kind: str = "device"):
         from jax.sharding import Mesh
 
+        assert kind in ("device", "process"), kind
+        self.kind = kind
+        self.ranks = list(ranks)
+        if kind == "process":
+            n = jax.process_count()
+            missing = [r for r in ranks if not 0 <= r < n]
+            if missing:
+                raise ValueError(f"process ranks {missing} out of range "
+                                 f"({n} processes)")
+            self.mesh = None
+            self.axis = None
+            return
         devices = jax.devices()
         missing = [r for r in ranks if not 0 <= r < len(devices)]
         if missing:
             raise ValueError(f"ranks {missing} out of range "
                              f"({len(devices)} devices)")
-        self.ranks = list(ranks)
         self.mesh = Mesh([devices[r] for r in ranks], (self.AXIS,))
         self.axis = self.AXIS
 
@@ -294,6 +309,10 @@ class ProcessGroup:
 
         import functools
 
+        if self.mesh is None:
+            raise ValueError("per-member all_reduce needs a device-id group "
+                             "(this one is kind='process'); use "
+                             "all_reduce_across_processes")
         if jax.process_count() > 1:
             raise NotImplementedError(
                 "eager per-member all_reduce is single-controller only; "
@@ -332,13 +351,17 @@ class ProcessGroup:
         import numpy as np
 
         n_proc = jax.process_count()
+        if n_proc > 1 and self.kind != "process":
+            raise ValueError(
+                "all_reduce_across_processes needs a process-index group on "
+                "a multi-process world (new_group(..., kind='process')); "
+                "for device subsets use all_reduce (per-member values) or "
+                "group.mesh with shard_map")
         bad = [r for r in self.ranks if r >= n_proc]
         if bad:
             raise ValueError(
                 f"all_reduce_across_processes: ranks {bad} are not process "
-                f"indices (process world is {n_proc}); this helper reduces "
-                "over member PROCESSES — for device subsets use all_reduce "
-                "(per-member values) or group.mesh with shard_map")
+                f"indices (process world is {n_proc})")
         arr = jnp.asarray(value)
         if n_proc == 1:
             gathered = np.asarray(arr)[None]
@@ -358,10 +381,16 @@ class ProcessGroup:
         raise ValueError(f"unsupported reduce op {op}")
 
 
-def new_group(ranks, backend: Optional[str] = None) -> ProcessGroup:
-    """Create a collective group over an arbitrary device subset
-    (reference: ``deepspeed.comm.new_group``)."""
-    return ProcessGroup(ranks)
+def new_group(ranks, backend: Optional[str] = None,
+              kind: str = "device") -> ProcessGroup:
+    """Create a collective group over an arbitrary subset (reference:
+    ``deepspeed.comm.new_group``).  ``kind="device"`` (default, the
+    single-controller view: ranks are device ids, usable with
+    ``group.mesh``/``shard_map`` and the per-member eager ``all_reduce``);
+    ``kind="process"`` (multi-host control plane: ranks are process
+    indices, usable with ``all_reduce_across_processes`` and the
+    group-aware ``get_rank``/``get_world_size``)."""
+    return ProcessGroup(ranks, kind=kind)
 
 
 # ---------------------------------------------------------------------------

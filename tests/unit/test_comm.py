@@ -176,10 +176,10 @@ def test_two_process_group_allreduce(tmp_path):
         comm.init_distributed()
         import jax
         rank = jax.process_index()
-        g = comm.new_group([0, 1])
+        g = comm.new_group([0, 1], kind="process")
         total = g.all_reduce_across_processes(float(rank + 1))
         assert float(total) == 3.0, total
-        g1 = comm.new_group([1])
+        g1 = comm.new_group([1], kind="process")
         only1 = g1.all_reduce_across_processes(float(rank + 1))
         assert float(only1) == 2.0, only1
         assert comm.get_rank(group=g) == rank

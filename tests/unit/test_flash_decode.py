@@ -1,0 +1,61 @@
+"""Length-aware flash-decode attention (VERDICT r3 weak #10): numerical
+parity with the dense masked path, and the length bound (visited blocks
+track the current position, not Smax)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.decoding import (DECODE_BLOCK,
+                                           _cached_attention_dense,
+                                           _cached_attention_flash_decode,
+                                           _quantize_kv_rows)
+
+
+def _setup(B=2, H=4, Hkv=2, Smax=4 * DECODE_BLOCK, Dh=16, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, H, 1, Dh), jnp.float32)
+    k = jnp.asarray(rng.randn(B, Hkv, Smax, Dh), jnp.float32)
+    v = jnp.asarray(rng.randn(B, Hkv, Smax, Dh), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("pos", [0, 5, DECODE_BLOCK - 1, DECODE_BLOCK,
+                                 3 * DECODE_BLOCK + 17])
+def test_flash_decode_matches_dense(pos):
+    q, k, v = _setup()
+    q_pos = jnp.asarray([pos], jnp.int32)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    want = _cached_attention_dense(q, k, v, q_pos, scale)
+    got = _cached_attention_flash_decode(q, k, v, q_pos, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_decode_matches_dense_int8_kv():
+    q, k, v = _setup(seed=3)
+    kq, ks = _quantize_kv_rows(k)
+    vq, vs = _quantize_kv_rows(v)
+    q_pos = jnp.asarray([2 * DECODE_BLOCK + 3], jnp.int32)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    want = _cached_attention_dense(q, kq, vq, q_pos, scale, ks, vs)
+    got = _cached_attention_flash_decode(q, kq, vq, q_pos, scale, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_decode_visits_only_needed_blocks():
+    """The while_loop trip count is position-bound: corrupt the cache BEYOND
+    the needed blocks with NaNs — dense would propagate them through masked
+    lanes' exp; flash-decode must never read them."""
+    q, k, v = _setup()
+    Smax = k.shape[2]
+    # poison everything from block 1 onward
+    k = k.at[:, :, DECODE_BLOCK:].set(jnp.nan)
+    v = v.at[:, :, DECODE_BLOCK:].set(jnp.nan)
+    q_pos = jnp.asarray([7], jnp.int32)  # inside block 0
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out = _cached_attention_flash_decode(q, k, v, q_pos, scale)
+    assert np.isfinite(np.asarray(out)).all()
