@@ -122,128 +122,190 @@ def bench_8b_rung(budget_s: float = 600.0):
                 "elapsed_s": round(time.perf_counter() - t_start, 1)}
 
 
-def bench_1b4_rung(steps: int = 6, warmup: int = 2):
-    """1.34B dense rung (VERDICT r4 item 1: a measured >1B tokens/sec + MFU
-    on the real chip; BASELINE north-star is tokens/sec/chip at >1B scale).
+# micro=4 exceeds what the AOT compiler will place at 48 layers (probed:
+# fwd+grad compile-OOMs); micro=2 compiles under every policy
+LADDER_1B4 = [("mlp_dots", 2), ("dots", 2), ("full", 2), ("full", 1)]
 
-    Recipe (the whole point of the rung): 15.75GB HBM fits 1.34B params by
-    dropping the fp32 master (bf16 state + stochastic-rounding updates,
-    ``bf16.master_weights=false``), int8 blockwise Adam states (Adam8bit),
-    bf16 gradient accumulation (``data_types.grad_accum_dtype``), and remat.
-    Persistent bytes/param: 2 (params) + 2 (acc) + ~2.06 (int8 m+v+scales)
-    ~= 6.1 -> ~8.2GB, leaving ~7GB for transients + activations.
 
-    An OOM ladder walks remat policy / micro-batch down until a config fits;
-    the emitted result records which rung of the ladder ran.
+def bench_1b4_rung(policy: str, micro: int, steps: int = 6, warmup: int = 2):
+    """ONE rung of the 1.34B ladder (VERDICT r4 item 1: a measured >1B
+    tokens/sec + MFU on the real chip; BASELINE north-star is
+    tokens/sec/chip at >1B scale).
+
+    Recipe: 15.75GB HBM fits 1.34B params by dropping the fp32 master (bf16
+    state + stochastic-rounding updates, ``bf16.master_weights=false``;
+    the init program emits bf16 directly so no fp32 tree ever
+    materializes), int8 blockwise Adam states (Adam8bit), bf16 gradient
+    accumulation, and remat.  Persistent bytes/param: 2 (params) + 2 (acc)
+    + ~2.06 (int8 m+v+scales) ~= 6.1 -> ~8.2GB, leaving ~7GB for
+    transients + activations.
+
+    The parent walks the (policy, micro) ladder one SUBPROCESS per rung —
+    a failed rung's HBM dies with its process instead of poisoning the
+    next rung's attempt.
     """
     import deepspeed_tpu
     from deepspeed_tpu.models import causal_lm
 
-    ladder = [("mlp_dots", 4), ("dots", 4), ("full", 4), ("full", 2)]
-    last_err = None
-    for policy, micro in ladder:
-        t0 = time.perf_counter()
+    t0 = time.perf_counter()
+    try:
+        mesh = build_mesh(devices=jax.devices()[:1])
+        set_global_mesh(mesh)
+        accum = 32 // micro  # ~32k tokens/step regardless of micro
+        seq = 1024
+        model = causal_lm("llama-1b4", mesh=mesh)
+        cfg = model.config
+        ds_config = {
+            "train_batch_size": micro * accum,
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": accum,
+            "bf16": {"enabled": True, "master_weights": False},
+            "data_types": {"grad_accum_dtype": "bf16"},
+            "optimizer": {"type": "Adam8bit",
+                          "params": {"lr": 2e-4, "weight_decay": 0.1}},
+            "gradient_clipping": 1.0,
+            "activation_checkpointing": {"enabled": True, "policy": policy},
+            "steps_per_print": 10**9,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config=ds_config,
+                                                   mesh=mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (accum, micro, seq), 0, cfg.vocab_size)
+        batch = (tokens, tokens)
+        for _ in range(warmup):
+            engine.train_step(batch)
+        sync(engine.state.params)
+        t1 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_step(batch)
+        sync(engine.state.params)
+        dt = (time.perf_counter() - t1) / steps
+        n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
+        tps = micro * accum * seq / dt
+        fpt = 6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq
+        mfu = tps * fpt / peak_flops()
+        return {"status": "ok", "tokens_per_sec": round(tps, 1),
+                "mfu": round(mfu, 4), "params_b": round(n_params / 1e9, 3),
+                "micro_batch": micro, "grad_accum": accum, "seq": seq,
+                "steps": steps, "step_ms": round(dt * 1e3, 1),
+                "remat_policy": policy,
+                "recipe": "bf16 state + stochastic rounding (no fp32 "
+                          "master), Adam8bit int8 m/v, bf16 grad accum",
+                "loss_final": round(float(engine._last_loss), 3)}
+    except Exception as exc:
+        msg = str(exc)
+        oom = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+               or "out of memory" in msg)
+        return {"status": "oom" if oom else f"failed: {type(exc).__name__}",
+                "error": msg[:300],
+                "ladder": f"{policy}/micro={micro}",
+                "elapsed_s": round(time.perf_counter() - t0, 1)}
+
+
+def bench_decode(steps: int = 512, warmup: int = 8) -> dict:
+    """Decode throughput microbench (VERDICT r3 item 5 + weak #10): steady
+    single-stream tokens/sec on GPT-2 125M through the jitted while_loop
+    decode with the length-aware flash-decode attention, bf16 weights vs
+    int8 weights + int8 KV cache.  steps=512 makes the cache (prompt+512,
+    rounded up to 768) exceed DECODE_BLOCK so the measured path IS the
+    flash-decode one, not the small-cache dense fallback."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    mesh = build_mesh(devices=jax.devices()[:1])
+    set_global_mesh(mesh)
+    out = {}
+    for name, cfg_over in (("bf16", {"dtype": "bfloat16"}),
+                           ("int8", {"dtype": "int8",
+                                     "quantize_kv_cache": True})):
         try:
-            mesh = build_mesh(devices=jax.devices()[:1])
-            set_global_mesh(mesh)
-            accum = 32 // micro  # ~32k tokens/step regardless of micro
-            seq = 1024
-            model = causal_lm("llama-1b4", mesh=mesh)
-            cfg = model.config
-            ds_config = {
-                "train_batch_size": micro * accum,
-                "train_micro_batch_size_per_gpu": micro,
-                "gradient_accumulation_steps": accum,
-                "bf16": {"enabled": True, "master_weights": False},
-                "data_types": {"grad_accum_dtype": "bf16"},
-                "optimizer": {"type": "Adam8bit",
-                              "params": {"lr": 2e-4, "weight_decay": 0.1}},
-                "gradient_clipping": 1.0,
-                "activation_checkpointing": {"enabled": True, "policy": policy},
-                "steps_per_print": 10**9,
-            }
-            engine, _, _, _ = deepspeed_tpu.initialize(model=model,
-                                                       config=ds_config,
-                                                       mesh=mesh)
-            tokens = jax.random.randint(jax.random.PRNGKey(1),
-                                        (accum, micro, seq), 0, cfg.vocab_size)
-            batch = (tokens, tokens)
-            for _ in range(warmup):
-                engine.train_step(batch)
-            sync(engine.state.params)
-            t1 = time.perf_counter()
-            for _ in range(steps):
-                engine.train_step(batch)
-            sync(engine.state.params)
-            dt = (time.perf_counter() - t1) / steps
-            n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
-            tps = micro * accum * seq / dt
-            fpt = 6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq
-            mfu = tps * fpt / peak_flops()
-            return {"status": "ok", "tokens_per_sec": round(tps, 1),
-                    "mfu": round(mfu, 4), "params_b": round(n_params / 1e9, 3),
-                    "micro_batch": micro, "grad_accum": accum, "seq": seq,
-                    "steps": steps, "step_ms": round(dt * 1e3, 1),
-                    "remat_policy": policy,
-                    "recipe": "bf16 state + stochastic rounding (no fp32 "
-                              "master), Adam8bit int8 m/v, bf16 grad accum",
-                    "loss_final": round(float(engine._last_loss), 3)}
+            model = causal_lm("gpt2-small", mesh=mesh, vocab_size=50304)
+            params = jax.jit(model.init)(jax.random.PRNGKey(0))
+            engine = deepspeed_tpu.init_inference(
+                model, config={"max_out_tokens": 2048, **cfg_over})
+            engine.set_params(params)
+            prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                        50304)
+            # TWO warmup calls: the first compiles against the fresh
+            # (uncommitted) cache/rng, the second recompiles against the
+            # committed steady-state layouts the loop outputs carry — only
+            # call 3+ measures the cached program
+            for _ in range(2):
+                sync(engine.generate(prompt, max_new_tokens=steps,
+                                     do_sample=False))
+            t0 = time.perf_counter()
+            sync(engine.generate(prompt, max_new_tokens=steps,
+                                 do_sample=False))
+            dt = time.perf_counter() - t0
+            out[name] = {"tokens_per_sec": round(steps / dt, 1),
+                         "new_tokens": steps,
+                         "ms_per_token": round(1e3 * dt / steps, 2)}
         except Exception as exc:
-            msg = str(exc)
-            # free the failed rung's HBM before retrying: the engine's
-            # persistent state (params + opt + accumulator, ~8GB) would
-            # otherwise stay resident and spuriously OOM every later rung
+            out[name] = {"status": f"failed: {type(exc).__name__}",
+                         "error": str(exc)[:200]}
+        finally:
+            engine = params = model = None
             import gc
 
-            engine = model = tokens = batch = None  # drop device buffers
             gc.collect()
-            if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                    or "out of memory" in msg):
-                last_err = (f"{policy}/micro={micro}: OOM after "
-                            f"{time.perf_counter() - t0:.0f}s")
-                continue
-            return {"status": f"failed: {type(exc).__name__}",
-                    "error": msg[:300], "ladder": f"{policy}/micro={micro}"}
-    return {"status": "failed: OOM at every ladder config", "error": last_err}
+    out["note"] = ("single stream, 768-slot cache (3 decode blocks), "
+                   "flash-decode attention; int8 = int8 weights + int8 KV")
+    return out
 
 
 def _run_1b4_subprocess() -> dict:
-    """Run the 1.34B rung in a child process: a hard device fault (the
-    remote-tunnel runtime can abort the process) must not take the 125M
-    headline down with it."""
+    """Walk the 1.34B ladder, one CHILD PROCESS per rung: a failed rung's
+    HBM (and any hard device fault — the remote-tunnel runtime can abort
+    the process) dies with its child instead of poisoning the next rung or
+    the 125M headline."""
     import subprocess
+    import sys
     import tempfile
 
-    fd, out = tempfile.mkstemp(suffix=".json")
-    os.close(fd)
-    os.unlink(out)  # child creates it; absence = child died before a result
-    env = dict(os.environ, DSTPU_BENCH_1B4_OUT=out)
-    try:
-        import sys
-
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, timeout=3600, capture_output=True,
-                              text=True)
+    attempts = []
+    for policy, micro in LADDER_1B4:
+        fd, out = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        os.unlink(out)  # child creates it; absence = child died early
+        env = dict(os.environ, DSTPU_BENCH_1B4_OUT=out,
+                   DSTPU_BENCH_1B4_LADDER=f"{policy},{micro}")
         try:
-            with open(out) as fh:
-                return json.load(fh)
-        except (OSError, json.JSONDecodeError):
-            # child aborted before/while writing — exactly the fault the
-            # subprocess isolation exists to absorb
-            return {"status": f"failed: child exited {proc.returncode} "
-                              "without a (complete) result",
-                    "stderr_tail": proc.stderr[-400:]}
-    except subprocess.TimeoutExpired:
-        return {"status": "failed: child timeout (3600s)"}
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, timeout=1800, capture_output=True,
+                                  text=True)
+            try:
+                with open(out) as fh:
+                    result = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                result = {"status": f"failed: child exited {proc.returncode} "
+                                    "without a (complete) result",
+                          "ladder": f"{policy}/micro={micro}",
+                          "stderr_tail": proc.stderr[-400:]}
+        except subprocess.TimeoutExpired:
+            result = {"status": "failed: child timeout (1800s)",
+                      "ladder": f"{policy}/micro={micro}"}
+        if result.get("status") == "ok":
+            if attempts:
+                result["ladder_attempts"] = attempts
+            return result
+        if result.get("status", "").startswith("skipped"):
+            return result
+        attempts.append({k: result.get(k) for k in
+                         ("status", "ladder", "error", "elapsed_s",
+                          "stderr_tail") if result.get(k)})
+    return {"status": "failed: no ladder rung succeeded",
+            "ladder_attempts": attempts}
 
 
 def main():
     if os.environ.get("DSTPU_BENCH_1B4_OUT"):
-        # child mode: run only the 1.34B rung, write the result, exit
+        # child mode: run ONE ladder rung, write the result, exit
         if jax.default_backend() == "cpu":
             result = {"status": "skipped: cpu backend"}
         else:
-            result = bench_1b4_rung()
+            policy, micro = os.environ["DSTPU_BENCH_1B4_LADDER"].split(",")
+            result = bench_1b4_rung(policy, int(micro))
         with open(os.environ["DSTPU_BENCH_1B4_OUT"], "w") as fh:
             json.dump(result, fh)
         return
@@ -346,6 +408,10 @@ def main():
     else:
         rung_8b = None
 
+    # decode microbench (engine freed above keeps HBM available: the train
+    # engine's state remains live, but 125M leaves plenty)
+    rung_decode = bench_decode() if on_tpu else None
+
     tokens_per_step = batch * seq
     tps = steps * tokens_per_step / dt
     n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
@@ -367,7 +433,8 @@ def main():
                    "backend": jax.default_backend(),
                    "device": getattr(jax.devices()[0], "device_kind", "?"),
                    **({"llama_1b4": rung_1b4} if rung_1b4 else {}),
-                   **({"llama3_8b": rung_8b} if rung_8b else {})},
+                   **({"llama3_8b": rung_8b} if rung_8b else {}),
+                   **({"decode_125m": rung_decode} if rung_decode else {})},
     }))
 
 

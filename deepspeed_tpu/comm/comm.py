@@ -43,6 +43,7 @@ from deepspeed_tpu.comm.mesh import (MESH_AXES, build_mesh, get_global_mesh, mes
 from deepspeed_tpu.utils.logging import logger
 
 _INITIALIZED = False
+_WARNED_DEVICE_GROUP_RANK = False
 
 ReduceOp = type("ReduceOp", (), {"SUM": "sum", "AVG": "avg", "MAX": "max", "MIN": "min", "PRODUCT": "prod"})
 
@@ -186,10 +187,14 @@ def get_rank(group: Any = None) -> int:
                 and getattr(group, "kind", "device") != "process"):
             # a device-id group has no process-membership meaning on a pod:
             # device 1 being in the group says nothing about process 1
-            logger.warning(
-                "get_rank(group=): group %s is a device-id group; process "
-                "membership is undefined on a multi-process world — build "
-                "it with new_group(..., kind='process')", group.ranks)
+            global _WARNED_DEVICE_GROUP_RANK
+            if not _WARNED_DEVICE_GROUP_RANK:
+                _WARNED_DEVICE_GROUP_RANK = True
+                logger.warning(
+                    "get_rank(group=): group %s is a device-id group; "
+                    "process membership is undefined on a multi-process "
+                    "world — build it with new_group(..., kind='process')",
+                    group.ranks)
             return -1
         me = jax.process_index()
         return group.ranks.index(me) if me in group.ranks else -1

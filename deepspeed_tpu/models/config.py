@@ -56,7 +56,9 @@ class ModelConfig:
     # ~1/3 extra FLOPs, so it must be opted into when the model doesn't fit,
     # not paid by default. Large presets below turn it on.
     remat: Optional[bool] = None
-    remat_policy: str = "full"  # "full" | "dots" | "mlp_only" | "mlp_dots"
+    # "full" | "dots" | "mlp_only" | "mlp_dots" | "offload_dots" (saved
+    # matmul outputs page to pinned host memory — cpu_checkpointing)
+    remat_policy: str = "full"
     # ZeRO-Infinity parameter tiering (engine sets this from ds_config
     # offload_param): params live in host memory; the forward streams each
     # scanned layer's weights to the device on demand, so device-resident

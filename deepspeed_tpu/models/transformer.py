@@ -335,8 +335,28 @@ class CausalLM:
                         prevent_cse=False, policy=mlp_policy)
                     return mlp(lp, x, k_mlp)
             else:
-                policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                          if cfg.remat_policy == "dots" else None)
+                if cfg.remat_policy == "offload_dots":
+                    # cpu_checkpointing: saved matmul outputs page to pinned
+                    # host memory and stream back in backward — activations
+                    # stop occupying HBM between fwd and bwd (reference
+                    # activation_checkpointing cpu_checkpointing semantics).
+                    # The CPU backend cannot execute the placement custom
+                    # call inside sharded programs; residuals stay saved
+                    # on-"device" there (same memory on CPU anyway).
+                    if jax.default_backend() == "cpu":
+                        from deepspeed_tpu.utils.logging import logger as _lg
+
+                        _lg.warning("cpu_checkpointing: offloaded residuals "
+                                    "unsupported on the CPU backend; saving "
+                                    "dots without the host memory-space move")
+                        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                    else:
+                        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                            "device", "pinned_host")
+                elif cfg.remat_policy == "dots":
+                    policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                else:
+                    policy = None
                 body = jax.checkpoint(body, prevent_cse=False, policy=policy)
         pp = axis_size(mesh, "pp") if mesh is not None and not mesh.empty else 1
 

@@ -9,11 +9,12 @@ TPU-native mapping:
   a compiler transform, not autograd hooks).  Policies map the reference
   knobs: ``partition_activations`` -> saveable residuals carry their
   sharding (GSPMD keeps them sharded — nothing to do at runtime);
-  ``cpu_checkpointing`` -> currently enables remat ONLY (the engine warns at
-  init): residuals are recomputed, not paged to host memory.  Real
-  pinned-host offload of saved residuals is a tracked gap — the runtime
-  here intermittently faults on many-stream host DMA (see engine.py
-  offload_param note), so the remat policy is the supported memory lever.
+  ``cpu_checkpointing`` -> the "offload_dots" remat policy
+  (``jax.checkpoint_policies.offload_dot_with_no_batch_dims``): saved
+  matmul outputs page to pinned host memory in forward and stream back in
+  backward, so they stop occupying HBM between the passes — the
+  reference's checkpoint-to-CPU semantics as a compiler memory-space
+  annotation instead of explicit D2H copies.
 - Reproducible dropout under recompute is STRUCTURAL in jax: dropout draws
   from explicit PRNG keys, so the recompute replays the same keys by
   construction — the reference's ``CudaRNGStatesTracker`` machinery exists

@@ -175,7 +175,7 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     policy: str = "full"                   # "full" | "dots" (save matmul outs)
     # reference keys (SURVEY.md §2.1 "Activation checkpointing"):
     partition_activations: bool = False    # activations are sharded by GSPMD
-    cpu_checkpointing: bool = False        # accepted; currently enables remat only
+    cpu_checkpointing: bool = False        # saved residuals page to pinned host
     contiguous_memory_optimization: bool = False  # XLA owns layout; accepted
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False

@@ -338,13 +338,6 @@ class DeepSpeedEngine:
             log_dist(f"config key {key!r} is set but INERT: {why}",
                      ranks=[0], level=_logging.WARNING)
         self._inert_config_keys = [k for k, _ in inert]
-        # Degraded (not inert): the key does something, but less than the
-        # reference's version of it — say exactly what.
-        if cfg.activation_checkpointing.cpu_checkpointing:
-            log_dist("config key 'activation_checkpointing.cpu_checkpointing'"
-                     " is DEGRADED: it enables remat (recompute-in-backward) "
-                     "but residuals are NOT paged to host memory",
-                     ranks=[0], level=_logging.WARNING)
 
     def _zeropp_active(self) -> bool:
         """Whether the ZeRO++ quantized-collective path is active;
@@ -376,7 +369,17 @@ class DeepSpeedEngine:
         # play; otherwise a model built with remat_policy="dots" would be
         # silently reset to the section's default.
         if section_active and hasattr(mcfg, "remat_policy"):
-            mcfg.remat_policy = ac.policy
+            # cpu_checkpointing: saved residuals page to pinned host memory
+            # (the offloaded-dots policy) — overrides the plain policy knob
+            if ac.cpu_checkpointing and ac.policy not in ("full",
+                                                          "offload_dots"):
+                logger.warning(
+                    "activation_checkpointing: cpu_checkpointing overrides "
+                    "policy=%r with 'offload_dots' (host-paged residuals); "
+                    "drop cpu_checkpointing to keep the device-resident "
+                    "policy", ac.policy)
+            mcfg.remat_policy = ("offload_dots" if ac.cpu_checkpointing
+                                 else ac.policy)
 
     @property
     def state(self) -> Optional["TrainState"]:
@@ -777,14 +780,29 @@ class DeepSpeedEngine:
                 return self.module.init(rng, **b)
             return self.module.init(rng, b)
 
-        abstract = jax.eval_shape(init_fn, init_rng, batch)
+        # Master-free bf16: fold the cast into the init program so the fp32
+        # init values are per-buffer transients — the full fp32 tree (2x the
+        # persistent params) never materializes.  At the 1.34B single-chip
+        # rung that transient alone is ~5.4GB of the 15.75GB budget.
+        master_free = (self.bfloat16_enabled
+                       and not self.config.bf16.master_weights
+                       and not self._offload)
+        build_fn = init_fn
+        if master_free:
+            def build_fn(rng, b):
+                return jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    init_fn(rng, b))
+
+        abstract = jax.eval_shape(build_fn, init_rng, batch)
         zcfg = self.config.zero_config
         persist = zcfg.stage3_param_persistence_threshold if self.zero_stage == 3 else 0
         specs = params_pspecs(abstract, self.mesh, shard=self.zero_stage == 3,
                               persistence_threshold=persist,
                               logical_specs=self._client_param_pspecs)
         shardings = shardings_from_pspecs(specs, self.mesh)
-        params = jax.jit(init_fn, out_shardings=shardings)(init_rng, batch)
+        params = jax.jit(build_fn, out_shardings=shardings)(init_rng, batch)
         self._init_state(params)
 
     # ------------------------------------------------------------------
