@@ -1,4 +1,7 @@
 """Autotuning (reference: ``deepspeed/autotuning/``, SURVEY.md §2.1):
-in-process measured trials over the ZeRO/micro-batch/remat space."""
+in-process measured trials, launcher-driven experiments (one fresh process
+group per trial), and the affine cost-model tuner."""
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner, DEFAULT_TUNING_SPACE  # noqa: F401
+from deepspeed_tpu.autotuning.experiment import (  # noqa: F401
+    CostModelTuner, ExperimentRunner)

@@ -35,6 +35,49 @@ def _set_path(cfg: Dict[str, Any], dotted: str, value: Any) -> None:
     cur[parts[-1]] = value
 
 
+def patched_config(base: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply dotted-path overrides to a ds_config (shared by the in-process
+    and experiment tuners; the activation-checkpointing policy pseudo-key
+    and the batch-triad re-derivation live HERE only)."""
+    import copy
+
+    cfg = copy.deepcopy(base)
+    for k, v in overrides.items():
+        if k == "activation_checkpointing.policy":
+            if v == "none":
+                _set_path(cfg, "activation_checkpointing.enabled", False)
+                continue
+            _set_path(cfg, "activation_checkpointing.enabled", True)
+        _set_path(cfg, k, v)
+    cfg.pop("train_batch_size", None)  # re-derived from micro x gas x world
+    return cfg
+
+
+def pruned_grid(space: Dict[str, List[Any]], max_trials: int,
+                micro_key: str = "train_micro_batch_size_per_gpu"):
+    """Generator over the grid with per-branch OOM pruning (shared by the
+    in-process and experiment tuners).  Protocol: ``next()`` the first
+    overrides dict, then ``send(trial_oomed: bool)`` for each subsequent
+    one; micro-batches at or above an OOM point on the same branch are
+    skipped."""
+    keys = list(space)
+    combos = itertools.product(*(space[k] for k in keys))
+    oom_points: List[tuple] = []
+    tried = 0
+    for combo in combos:
+        if tried >= max_trials:
+            return
+        overrides = dict(zip(keys, combo))
+        branch = tuple(v for k, v in overrides.items() if k != micro_key)
+        micro = overrides.get(micro_key, 0)
+        if any(b == branch and m <= micro for b, m in oom_points):
+            continue
+        oomed = yield overrides
+        tried += 1
+        if oomed:
+            oom_points.append((branch, micro))
+
+
 class Autotuner:
     def __init__(self, model_fn: Callable[[], Tuple[Any, Any]],
                  base_config: Dict[str, Any],
@@ -51,23 +94,13 @@ class Autotuner:
 
     # -- one measured trial ---------------------------------------------
     def _trial(self, overrides: Dict[str, Any]) -> Dict[str, Any]:
-        import copy
-
         import jax
 
         import deepspeed_tpu
 
-        cfg = copy.deepcopy(self.base)
-        for k, v in overrides.items():
-            if k == "activation_checkpointing.policy":
-                if v == "none":
-                    _set_path(cfg, "activation_checkpointing.enabled", False)
-                    continue
-                _set_path(cfg, "activation_checkpointing.enabled", True)
-            _set_path(cfg, k, v)
+        cfg = patched_config(self.base, overrides)
         micro = cfg.get("train_micro_batch_size_per_gpu", 1)
         gas = cfg.get("gradient_accumulation_steps", 1)
-        cfg.pop("train_batch_size", None)  # re-derived from micro x gas
         rec: Dict[str, Any] = {"overrides": dict(overrides)}
         try:
             model, batch = self.model_fn()
@@ -101,41 +134,23 @@ class Autotuner:
 
     # -- search ----------------------------------------------------------
     def tune(self) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
-        keys = list(self.space)
-        combos = list(itertools.product(*(self.space[k] for k in keys)))
-        # micro-batch ascending so OOM prunes larger batches per branch
-        tried = 0
-        oom_branches = set()
-        for combo in combos:
-            if tried >= self.max_trials:
-                break
-            overrides = dict(zip(keys, combo))
-            branch = tuple(v for k, v in overrides.items()
-                           if k != "train_micro_batch_size_per_gpu")
-            micro = overrides.get("train_micro_batch_size_per_gpu", 0)
-            if any(b == branch and m <= micro for b, m in oom_branches):
-                continue  # larger than a known-OOM point on this branch
+        grid = pruned_grid(self.space, self.max_trials)
+        overrides = next(grid, None)
+        while overrides is not None:
             rec = self._trial(overrides)
             self.results.append(rec)
-            tried += 1
-            if rec["status"] == "oom":
-                oom_branches.add((branch, micro))
             log_dist(f"autotune trial {overrides}: {rec['status']} "
                      f"{rec.get('throughput', 0):.0f} tok/s", ranks=[0])
+            try:
+                overrides = grid.send(rec["status"] == "oom")
+            except StopIteration:
+                break
         ok = [r for r in self.results if r["status"] == "ok"]
         if not ok:
             logger.warning("autotuning: no successful trial; returning base config")
             return self.base, self.results
         best = max(ok, key=lambda r: r["throughput"])
-        import copy
-
-        best_cfg = copy.deepcopy(self.base)
-        for k, v in best["overrides"].items():
-            if k == "activation_checkpointing.policy" and v == "none":
-                _set_path(best_cfg, "activation_checkpointing.enabled", False)
-                continue
-            _set_path(best_cfg, k, v)
         log_dist(f"autotuning: best {best['overrides']} "
                  f"({best['throughput']:.0f} tok/s over {len(ok)} ok trials)",
                  ranks=[0])
-        return best_cfg, self.results
+        return patched_config(self.base, best["overrides"]), self.results

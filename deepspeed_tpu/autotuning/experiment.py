@@ -1,0 +1,213 @@
+"""Launcher-driven autotuning experiments + a model-based tuner.
+
+Reference: ``deepspeed/autotuning/`` (SURVEY.md §2.1 row 44) — beyond the
+in-process grid search (``autotuner.py``), the reference runs each trial as
+a fresh launcher JOB (ResourceManager + scheduler) and prunes the space
+with a fitted cost model (``tuner/model_based.py``).  TPU-native shape:
+
+- **ExperimentRunner**: each trial spawns the user script as a fresh
+  process (group) with the trial's patched ds_config delivered via
+  ``DS_AUTOTUNE_CONFIG``; the script trains a few steps and reports by
+  writing ``DS_AUTOTUNE_RESULT``.  Fresh processes give every trial clean
+  device memory (an OOM cannot poison the next trial) and let multi-process
+  worlds be tuned — the two things the in-process search cannot do.
+- **CostModelTuner**: step time is affine in the micro-batch on a fixed
+  branch (t = a + b*micro: constant dispatch/update cost + per-token
+  compute), so two measured points per branch predict every other
+  micro-batch.  The tuner measures the two smallest micros per branch,
+  extrapolates, and only spends real trials on each branch's predicted
+  best — the reference's XGBoost role with a closed-form model that
+  matches how the space actually behaves.
+
+User-script contract (mirrors the reference's ``--autotuning run`` hook):
+
+    cfg_path = os.environ["DS_AUTOTUNE_CONFIG"]     # patched ds_config.json
+    ... build engine with json.load(open(cfg_path)), time a few steps ...
+    json.dump({"throughput": tokens_per_sec},
+              open(os.environ["DS_AUTOTUNE_RESULT"], "w"))
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.autotuning.autotuner import (DEFAULT_TUNING_SPACE,
+                                                patched_config, pruned_grid)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class ExperimentRunner:
+    """One fresh process (group) per trial — see module docstring."""
+
+    def __init__(self, user_script: str, base_config: Dict[str, Any],
+                 tuning_space: Optional[Dict[str, List[Any]]] = None,
+                 user_args: Optional[List[str]] = None, num_procs: int = 1,
+                 max_trials: int = 12, trial_timeout_s: float = 600.0,
+                 results_dir: str = "autotuning_results",
+                 env: Optional[Dict[str, str]] = None):
+        self.user_script = user_script
+        self.user_args = list(user_args or [])
+        self.base = dict(base_config)
+        self.space = tuning_space or dict(DEFAULT_TUNING_SPACE)
+        self.num_procs = num_procs
+        self.max_trials = max_trials
+        self.trial_timeout_s = trial_timeout_s
+        self.results_dir = results_dir
+        self.env = dict(env if env is not None else os.environ)
+        self.results: List[Dict[str, Any]] = []
+
+    # -- one experiment --------------------------------------------------
+    def _experiment(self, overrides: Dict[str, Any], idx: int) -> Dict[str, Any]:
+        os.makedirs(self.results_dir, exist_ok=True)
+        cfg_path = os.path.join(self.results_dir, f"exp{idx}_config.json")
+        res_path = os.path.join(self.results_dir, f"exp{idx}_result.json")
+        with open(cfg_path, "w") as fh:
+            json.dump(patched_config(self.base, overrides), fh)
+        if os.path.exists(res_path):
+            os.unlink(res_path)
+        env = dict(self.env, DS_AUTOTUNE_CONFIG=cfg_path,
+                   DS_AUTOTUNE_RESULT=res_path)
+        rec: Dict[str, Any] = {"overrides": dict(overrides), "exp": idx}
+        if self.num_procs > 1:
+            cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+                   "--num_procs", str(self.num_procs), "--no_local_rank",
+                   self.user_script] + self.user_args
+        else:
+            cmd = [sys.executable, self.user_script] + self.user_args
+        t0 = time.perf_counter()
+        # own session: a timeout must kill the WHOLE process group (the
+        # launcher's grandchild workers would otherwise survive the direct
+        # child's SIGKILL and keep holding the device)
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                start_new_session=True)
+        try:
+            _out, err = proc.communicate(timeout=self.trial_timeout_s)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.communicate()
+            rec.update(status="timeout", elapsed_s=self.trial_timeout_s)
+            return rec
+        rec["elapsed_s"] = round(time.perf_counter() - t0, 1)
+        if os.path.exists(res_path):
+            try:
+                with open(res_path) as fh:
+                    rec.update(json.load(fh))
+                rec.setdefault("status", "ok")
+                return rec
+            except json.JSONDecodeError:
+                pass
+        err = err or ""
+        oom = ("RESOURCE_EXHAUSTED" in err or "Out of memory" in err
+               or "out of memory" in err)
+        rec.update(status="oom" if oom else f"failed: exit {proc.returncode}",
+                   stderr_tail=err[-300:])
+        return rec
+
+    # -- search ----------------------------------------------------------
+    def run(self) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+        grid = pruned_grid(self.space, self.max_trials)
+        overrides = next(grid, None)
+        while overrides is not None:
+            rec = self._experiment(overrides, len(self.results))
+            self.results.append(rec)
+            log_dist(f"autotune experiment {overrides}: {rec['status']} "
+                     f"{rec.get('throughput', 0):.0f} tok/s", ranks=[0])
+            try:
+                overrides = grid.send(rec["status"] == "oom")
+            except StopIteration:
+                break
+        ok = [r for r in self.results if r.get("status") == "ok"
+              and "throughput" in r]
+        summary_path = os.path.join(self.results_dir, "summary.json")
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(summary_path, "w") as fh:
+            json.dump(self.results, fh, indent=1)
+        if not ok:
+            logger.warning("autotuning experiments: no successful trial; "
+                           "returning base config (see %s)", summary_path)
+            return self.base, self.results
+        best = max(ok, key=lambda r: r["throughput"])
+        log_dist(f"autotuning experiments: best {best['overrides']} "
+                 f"({best['throughput']:.0f} tok/s; report {summary_path})",
+                 ranks=[0])
+        return patched_config(self.base, best["overrides"]), self.results
+
+
+class CostModelTuner:
+    """Affine-step-time model over micro-batch (see module docstring).
+
+    ``measure(overrides) -> dict`` is any callable with the Autotuner/
+    ExperimentRunner trial contract (returns ``status`` + ``step_s``).
+    """
+
+    def __init__(self, measure, tuning_space: Optional[Dict[str, List[Any]]] = None,
+                 micro_key: str = "train_micro_batch_size_per_gpu"):
+        self.measure = measure
+        self.space = tuning_space or dict(DEFAULT_TUNING_SPACE)
+        self.micro_key = micro_key
+        self.results: List[Dict[str, Any]] = []
+
+    def _measured(self, overrides):
+        rec = self.measure(dict(overrides))
+        rec = dict(rec, overrides=dict(overrides))
+        self.results.append(rec)
+        return rec
+
+    def tune(self) -> Tuple[Optional[Dict[str, Any]], List[Dict[str, Any]]]:
+        micros = sorted(self.space.get(self.micro_key, [1]))
+        branch_keys = [k for k in self.space if k != self.micro_key]
+        branches = list(itertools.product(*(self.space[k] for k in branch_keys)))
+        best = None
+        for combo in branches:
+            base_over = dict(zip(branch_keys, combo))
+            # fit t = a + b*micro from the two smallest micros
+            pts = []
+            for m in micros[:2]:
+                rec = self._measured({**base_over, self.micro_key: m})
+                if rec.get("status") != "ok":
+                    break
+                pts.append((m, rec["step_s"]))
+            if len(pts) == 2:
+                (m0, t0), (m1, t1) = pts
+                b = (t1 - t0) / (m1 - m0) if m1 != m0 else 0.0
+                a = t0 - b * m0
+                # predicted throughput = micro / (a + b*micro): increasing
+                # in micro while a > 0, so the model proposes the LARGEST
+                # micro; walk down from it on OOM
+                candidates = list(micros[2:])
+                candidates.sort(key=lambda m: -(m / max(a + b * m, 1e-9)))
+                for m in candidates:
+                    rec = self._measured({**base_over, self.micro_key: m})
+                    if rec.get("status") == "ok":
+                        break
+            # branch best over EVERYTHING measured ok on this branch — a
+            # single-fit-point branch (or a one-micro space) still counts
+            pool = [r for r in self.results
+                    if r.get("status") == "ok"
+                    and all(r["overrides"].get(k) == v
+                            for k, v in base_over.items())]
+            if not pool:
+                continue
+            tput = lambda r: r["overrides"][self.micro_key] / r["step_s"]
+            branch_best = max(pool, key=tput)
+            if best is None or tput(branch_best) > tput(best):
+                best = branch_best
+        if best is None:
+            logger.warning("cost-model tuner: no successful measurement")
+            return None, self.results
+        log_dist(f"cost-model tuner: best {best['overrides']} "
+                 f"({len(self.results)} measurements)", ranks=[0])
+        return dict(best["overrides"]), self.results
