@@ -39,6 +39,10 @@ class ModelConfig:
     tie_embeddings: bool = False
     use_bias: bool = False                 # attn/mlp projection biases (gpt2)
     qkv_bias: bool = False                 # biases on q/k/v only (qwen2)
+    # gpt-neox/pythia: x + attn(ln1(x)) + mlp(ln2(x)) — the MLP reads the
+    # LAYER INPUT, not the post-attention stream
+    parallel_residual: bool = False
+    rotary_pct: float = 1.0                # fraction of head dims rotated (neox)
     dropout: float = 0.0                   # residual dropout (needs a dropout rng)
     # MoE (mixtral family); num_experts == 0 -> dense MLP
     num_experts: int = 0

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.layers import activation_fn, constrain, norm, _repeat_kv
-from deepspeed_tpu.ops.pallas import apply_rotary_pos_emb, rope_angles
+from deepspeed_tpu.models.transformer import apply_partial_rope, rope_dim
+from deepspeed_tpu.ops.pallas import rope_angles
 
 NEG_INF = -1e30
 
@@ -197,7 +198,7 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
     if cfg.position == "rope":
         # angles for the whole cache window once; gather the query slice
         cos_all, sin_all = rope_angles(jnp.arange(cache["k"].shape[-2]),
-                                       Dh, theta=cfg.rope_theta)
+                                       rope_dim(cfg), theta=cfg.rope_theta)
         cos = jax.lax.dynamic_slice_in_dim(cos_all, start_pos, s).astype(x.dtype)
         sin = jax.lax.dynamic_slice_in_dim(sin_all, start_pos, s).astype(x.dtype)
     else:
@@ -211,12 +212,13 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         else:
             lp, kc, vc = xs
             ksc = vsc = None
+        x0 = h_in  # layer input (parallel residual reads it twice)
         h = norm(h_in, lp["attn_norm"], cfg.norm, cfg.norm_eps)
         a = lp["attn"]
         q = h @ a["wq"].astype(h.dtype)
         k = h @ a["wk"].astype(h.dtype)
         v = h @ a["wv"].astype(h.dtype)
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             q = q + a["bq"].astype(h.dtype)
             k = k + a["bk"].astype(h.dtype)
             v = v + a["bv"].astype(h.dtype)
@@ -224,8 +226,8 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         k = k.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
         v = v.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
         if cfg.position == "rope":
-            q = apply_rotary_pos_emb(q, cos, sin)
-            k = apply_rotary_pos_emb(k, cos, sin)
+            q = apply_partial_rope(q, cos, sin, cfg.rotary_pct)
+            k = apply_partial_rope(k, cos, sin, cfg.rotary_pct)
         if quant_kv:
             kq, ks = _quantize_kv_rows(k)
             vq, vs = _quantize_kv_rows(v)
@@ -243,9 +245,14 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         o = o @ a["wo"].astype(h.dtype)
         if cfg.use_bias:
             o = o + a["bo"].astype(h.dtype)
-        h_in = h_in + o
+        if cfg.parallel_residual:
+            # gpt-neox: MLP reads the LAYER INPUT; both branches add at once
+            mlp_src = x0
+        else:
+            h_in = h_in + o
+            mlp_src = h_in
 
-        h = norm(h_in, lp["mlp_norm"], cfg.norm, cfg.norm_eps)
+        h = norm(mlp_src, lp["mlp_norm"], cfg.norm, cfg.norm_eps)
         if cfg.is_moe:
             from deepspeed_tpu.moe.sharded_moe import moe_mlp
             mlp_out, _ = moe_mlp(jax.tree.map(lambda a: a.astype(h.dtype), lp["mlp"]),
@@ -266,7 +273,7 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
             mlp_out = gated @ m["w_down"].astype(h.dtype)
             if cfg.use_bias:
                 mlp_out = mlp_out + m["b_down"].astype(h.dtype)
-        h_in = h_in + mlp_out
+        h_in = (x0 + o + mlp_out) if cfg.parallel_residual else (h_in + mlp_out)
         if quant_kv:
             return h_in, (kc, vc, ksc, vsc)
         return h_in, (kc, vc)

@@ -44,6 +44,22 @@ def _uniform(rng, shape, scale, dtype):
     return jax.random.uniform(rng, shape, dtype, -scale, scale)
 
 
+def apply_partial_rope(x, cos, sin, pct: float):
+    """Rotate the first ``2*cos.shape[-1]`` head dims, pass the rest through
+    (gpt-neox ``rotary_pct``; pct=1 is the full-rotation fast path)."""
+    if pct >= 1.0:
+        return apply_rotary_pos_emb(x, cos, sin)
+    rot = 2 * cos.shape[-1]
+    rotated = apply_rotary_pos_emb(x[..., :rot], cos, sin)
+    return jnp.concatenate([rotated, x[..., rot:]], axis=-1)
+
+
+def rope_dim(cfg) -> int:
+    """Rotated head dims (even; head_dim * rotary_pct, neox convention)."""
+    d = int(cfg.head_dim * cfg.rotary_pct)
+    return max(2, d - (d % 2))
+
+
 class CausalLM:
     """Functional causal language model over a device mesh."""
 
@@ -189,7 +205,8 @@ class CausalLM:
     # ------------------------------------------------------------------
     # forward
     # ------------------------------------------------------------------
-    def _attn_block(self, lp, x, k_attn, cos, sin, batch_ax, use_drop):
+    def _attn_out(self, lp, x, k_attn, cos, sin, batch_ax, use_drop):
+        """Attention sub-block OUTPUT (residual not added)."""
         cfg = self.config
         mesh = self.mesh
         B, S, D = x.shape
@@ -205,8 +222,8 @@ class CausalLM:
         k = k.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         if cfg.position == "rope":  # [B, H, S, Dh] is the kernel's layout
-            q = apply_rotary_pos_emb(q, cos, sin)
-            k = apply_rotary_pos_emb(k, cos, sin)
+            q = apply_partial_rope(q, cos, sin, cfg.rotary_pct)
+            k = apply_partial_rope(k, cos, sin, cfg.rotary_pct)
         k = _repeat_kv(k, H // Hkv)
         v = _repeat_kv(v, H // Hkv)
         o = attention_core(q, k, v, mesh, causal=True, sp_mode=cfg.sp_mode)
@@ -217,8 +234,11 @@ class CausalLM:
         o = o.astype(x.dtype)
         if use_drop:
             o = _dropout(o, k_attn, cfg.dropout)
-        x = x + o
-        return constrain(x, mesh, batch_ax, "sp", None)
+        return o
+
+    def _attn_block(self, lp, x, k_attn, cos, sin, batch_ax, use_drop):
+        x = x + self._attn_out(lp, x, k_attn, cos, sin, batch_ax, use_drop)
+        return constrain(x, self.mesh, batch_ax, "sp", None)
 
     def _mlp_block(self, lp, x, k_mlp, batch_ax, use_drop):
         cfg = self.config
@@ -252,6 +272,14 @@ class CausalLM:
 
     def _layer(self, lp, x, key, cos, sin, batch_ax, use_drop):
         k_attn, k_mlp = (jax.random.split(key) if use_drop else (None, None))
+        if self.config.parallel_residual:
+            # gpt-neox/pythia: both sub-blocks read the LAYER INPUT
+            attn_o = self._attn_out(lp, x, k_attn, cos, sin, batch_ax,
+                                    use_drop)
+            mlp_y, aux = self._mlp_block(lp, x, k_mlp, batch_ax, use_drop)
+            # _mlp_block returns x + mlp(ln2(x)); add the attention branch
+            x = mlp_y + attn_o
+            return constrain(x, self.mesh, batch_ax, "sp", None), aux
         x = self._attn_block(lp, x, k_attn, cos, sin, batch_ax, use_drop)
         return self._mlp_block(lp, x, k_mlp, batch_ax, use_drop)
 
@@ -297,7 +325,7 @@ class CausalLM:
         x = constrain(x, mesh, batch_ax, "sp", None)
 
         if cfg.position == "rope":
-            cos, sin = rope_cache(tokens.shape[1], cfg.head_dim, cfg.rope_theta)
+            cos, sin = rope_cache(tokens.shape[1], rope_dim(cfg), cfg.rope_theta)
             cos, sin = cos.astype(x.dtype), sin.astype(x.dtype)
         else:
             cos = sin = jnp.zeros((), x.dtype)
@@ -320,7 +348,11 @@ class CausalLM:
             # region entirely (its residuals persist; the flash kernel never
             # re-runs) and fully remats the MLP half — the fastest policy on
             # v5e when activations fit.
-            if cfg.remat_policy in ("mlp_only", "mlp_dots"):
+            if (cfg.remat_policy in ("mlp_only", "mlp_dots")
+                    and not cfg.parallel_residual):
+                # (parallel-residual layers have no post-attention stream to
+                # split the remat around; they fall through to whole-layer
+                # policies below)
                 mlp_policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                               if cfg.remat_policy == "mlp_dots" else None)
 
@@ -354,6 +386,17 @@ class CausalLM:
                         policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
                             "device", "pinned_host")
                 elif cfg.remat_policy == "dots":
+                    policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                elif (cfg.remat_policy in ("mlp_only", "mlp_dots")
+                      and cfg.parallel_residual):
+                    # no post-attention stream to split around: degrade to
+                    # whole-layer saved-dots, and say so
+                    from deepspeed_tpu.utils.logging import logger as _lg
+
+                    _lg.warning(
+                        "remat_policy=%r has no mlp-scoped form for parallel-"
+                        "residual layers; using whole-layer 'dots' instead",
+                        cfg.remat_policy)
                     policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                 else:
                     policy = None
@@ -538,7 +581,7 @@ class CausalLM:
         def rope(S, dtype):
             if cfg.position != "rope":
                 return jnp.zeros((), dtype), jnp.zeros((), dtype)
-            cos, sin = rope_cache(S, cfg.head_dim, cfg.rope_theta)
+            cos, sin = rope_cache(S, rope_dim(cfg), cfg.rope_theta)
             return cos.astype(dtype), sin.astype(dtype)
 
         return {

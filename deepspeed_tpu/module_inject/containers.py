@@ -1,5 +1,5 @@
-"""HF checkpoint import: published GPT-2 / Llama / Mixtral / OPT / Qwen2
-weights -> the built-in models' param trees.
+"""HF checkpoint import: published GPT-2 / Llama / Mixtral / OPT / Qwen2 /
+GPT-NeoX(Pythia) weights -> the built-in models' param trees.
 
 Reference: ``deepspeed/module_inject/containers/`` (SURVEY.md §2.1 row 34) —
 the containers' real job is mapping public HuggingFace state dicts into the
@@ -53,7 +53,7 @@ def load_hf_state_dict(path: str) -> Dict[str, np.ndarray]:
 
 
 def _strip_prefix(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    for prefix in ("transformer.", "model."):
+    for prefix in ("transformer.", "model.", "gpt_neox."):
         if any(k.startswith(prefix) for k in sd):
             out = {}
             for k, v in sd.items():
@@ -70,6 +70,8 @@ def detect_arch(sd: Dict[str, np.ndarray]) -> str:
         return "gpt2"
     if any("decoder.embed_positions" in k for k in keys):
         return "opt"
+    if any("embed_in.weight" in k for k in keys):
+        return "gpt_neox"
     if any("embed_tokens.weight" in k for k in keys):
         # qwen2 is llama-shaped with q/k/v biases
         if any(k.endswith("q_proj.bias") for k in keys):
@@ -105,6 +107,27 @@ def config_from_hf(path: str):
             activation="silu", glu=True, position="rope",
             rope_theta=hf.get("rope_theta", 10000.0),
             qkv_bias=(mt == "qwen2"),
+            tie_embeddings=hf.get("tie_word_embeddings", False))
+    if mt == "gpt_neox":
+        if not hf.get("attention_bias", True):
+            raise ValueError(
+                "gpt_neox with attention_bias=false is not supported: the "
+                "model's use_bias covers attention AND mlp biases together "
+                "(NeoX keeps mlp biases regardless)")
+        return ModelConfig(
+            vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm", norm_eps=hf.get("layer_norm_eps", 1e-5),
+            activation="gelu", glu=False, position="rope",
+            # transformers deprecated rotary_emb_base for rope_theta
+            rope_theta=hf.get("rotary_emb_base",
+                              hf.get("rope_theta", 10000.0)),
+            rotary_pct=hf.get("rotary_pct", 1.0),
+            parallel_residual=hf.get("use_parallel_residual", True),
+            use_bias=True,
             tie_embeddings=hf.get("tie_word_embeddings", False))
     if mt == "opt":
         D = hf["hidden_size"]
@@ -184,6 +207,53 @@ def hf_to_params(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
             },
             "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
         }
+        return params
+
+    if arch == "gpt_neox":
+        H, Dh = cfg.num_heads, cfg.head_dim
+
+        def qkv_w(which):
+            # fused [3D, D], per-head [q,k,v] interleave -> our [D, H*Dh]
+            def split(i):
+                w = sd[f"layers.{i}.attention.query_key_value.weight"]
+                part = w.reshape(H, 3, Dh, -1)[:, which]        # [H, Dh, D]
+                return np.ascontiguousarray(part.reshape(H * Dh, -1).T)
+            return np.stack([split(i) for i in range(L)])
+
+        def qkv_b(which):
+            def split(i):
+                b = sd[f"layers.{i}.attention.query_key_value.bias"]
+                return b.reshape(H, 3, Dh)[:, which].reshape(H * Dh)
+            return np.stack([split(i) for i in range(L)])
+
+        attn = {
+            "wq": qkv_w(0), "wk": qkv_w(1), "wv": qkv_w(2),
+            "wo": _stack(sd, "layers.{}.attention.dense.weight", L, T),
+            "bq": qkv_b(0), "bk": qkv_b(1), "bv": qkv_b(2),
+            "bo": _stack(sd, "layers.{}.attention.dense.bias", L),
+        }
+        mlp = {
+            "w_up": _stack(sd, "layers.{}.mlp.dense_h_to_4h.weight", L, T),
+            "b_up": _stack(sd, "layers.{}.mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, "layers.{}.mlp.dense_4h_to_h.weight", L, T),
+            "b_down": _stack(sd, "layers.{}.mlp.dense_4h_to_h.bias", L),
+        }
+        params = {
+            "embed": {"tok": sd["embed_in.weight"]},
+            "layers": {
+                "attn_norm": {
+                    "scale": _stack(sd, "layers.{}.input_layernorm.weight", L),
+                    "bias": _stack(sd, "layers.{}.input_layernorm.bias", L)},
+                "mlp_norm": {
+                    "scale": _stack(sd, "layers.{}.post_attention_layernorm.weight", L),
+                    "bias": _stack(sd, "layers.{}.post_attention_layernorm.bias", L)},
+                "attn": attn, "mlp": mlp,
+            },
+            "final_norm": {"scale": sd["final_layer_norm.weight"],
+                           "bias": sd["final_layer_norm.bias"]},
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = T(sd["embed_out.weight"])
         return params
 
     if arch == "opt":

@@ -41,6 +41,14 @@ def _save_tiny(tmp_path, kind: str) -> str:
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=64, tie_word_embeddings=False)
         model = transformers.Qwen2ForCausalLM(cfg)
+    elif kind == "gpt_neox":
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, rotary_pct=0.25,
+            use_parallel_residual=True, tie_word_embeddings=False,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        model = transformers.GPTNeoXForCausalLM(cfg)
     else:
         cfg = transformers.MixtralConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -60,7 +68,7 @@ def _hf_logits(path: str, toks: np.ndarray) -> np.ndarray:
         return model(torch.tensor(toks)).logits.numpy()
 
 
-@pytest.mark.parametrize("kind", ["gpt2", "llama", "opt", "qwen2"])
+@pytest.mark.parametrize("kind", ["gpt2", "llama", "opt", "qwen2", "gpt_neox"])
 def test_logits_parity(tmp_path, kind, mesh8):
     path = _save_tiny(tmp_path, kind)
     assert is_hf_checkpoint(path)
@@ -99,3 +107,26 @@ def test_inference_engine_loads_hf(tmp_path, mesh8):
                        "checkpoint": path})
     out = engine.generate(jnp.asarray([[1, 5, 9]]), max_new_tokens=4)
     assert out.shape == (1, 7)
+
+
+def test_gpt_neox_generate_parity(tmp_path, mesh8):
+    """The DECODE path re-implements the layer math (decoding.py), so the
+    parallel-residual + partial-rope + bias branches need their own parity
+    evidence: greedy generation must match HF token for token."""
+    import deepspeed_tpu
+
+    path = _save_tiny(tmp_path, "gpt_neox")
+    toks = np.array([[1, 5, 9, 2]], np.int32)
+    model_hf = transformers.AutoModelForCausalLM.from_pretrained(path)
+    model_hf.eval()
+    with torch.no_grad():
+        want = model_hf.generate(torch.tensor(toks), max_new_tokens=6,
+                                 do_sample=False).numpy()
+    model, params = causal_lm_from_hf(path, mesh=mesh8)
+    model.config.remat = False
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 64})
+    engine.set_params(params)
+    got = np.asarray(engine.generate(jnp.asarray(toks), max_new_tokens=6,
+                                     do_sample=False))
+    np.testing.assert_array_equal(got, want)
