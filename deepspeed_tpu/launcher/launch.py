@@ -101,9 +101,22 @@ def main(args=None) -> int:
         terminate_all()
         sys.exit(128 + signum)
 
-    signal.signal(signal.SIGTERM, handle_signal)
-    signal.signal(signal.SIGINT, handle_signal)
+    # save the previous handlers: main() is also called in-process (tests,
+    # embedding callers), where leaking this handler would hijack SIGTERM
+    # for the rest of the host process
+    prev_term = signal.signal(signal.SIGTERM, handle_signal)
+    prev_int = signal.signal(signal.SIGINT, handle_signal)
+    try:
+        return _spawn_and_supervise(args, local_slots, global_rank_offset,
+                                    world_size, log_dir, children,
+                                    terminate_all)
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
 
+
+def _spawn_and_supervise(args, local_slots, global_rank_offset, world_size,
+                         log_dir, children, terminate_all) -> int:
     for local_rank, _slot in enumerate(local_slots):
         global_rank = global_rank_offset + local_rank
         env = dict(os.environ)
@@ -138,8 +151,8 @@ def main(args=None) -> int:
                 continue
             alive.discard(i)
             if code != 0:
-                logger.error("rank %d exited with code %d; terminating remaining "
-                             "ranks", global_rank_offset + i, code)
+                logger.error("rank %d exited with code %d; terminating "
+                             "remaining ranks", global_rank_offset + i, code)
                 terminate_all()
                 for j in sorted(alive):
                     children[j].wait()

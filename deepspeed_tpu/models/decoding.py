@@ -23,8 +23,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.layers import activation_fn, constrain, norm, _repeat_kv
-from deepspeed_tpu.models.transformer import apply_partial_rope, rope_dim
+from deepspeed_tpu.models.layers import (activation_fn, apply_partial_rope,
+                                         constrain, norm, _repeat_kv, rope_dim)
 from deepspeed_tpu.ops.pallas import rope_angles
 
 NEG_INF = -1e30
@@ -226,8 +226,8 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         k = k.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
         v = v.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
         if cfg.position == "rope":
-            q = apply_partial_rope(q, cos, sin, cfg.rotary_pct)
-            k = apply_partial_rope(k, cos, sin, cfg.rotary_pct)
+            q = apply_partial_rope(q, cos, sin)
+            k = apply_partial_rope(k, cos, sin)
         if quant_kv:
             kq, ks = _quantize_kv_rows(k)
             vq, vs = _quantize_kv_rows(v)

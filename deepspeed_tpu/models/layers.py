@@ -71,7 +71,9 @@ def norm(x, params, kind: str, eps: float):
 
 
 def activation_fn(name: str):
-    return {"silu": jax.nn.silu, "gelu": functools.partial(jax.nn.gelu, approximate=True),
+    return {"silu": jax.nn.silu,
+            "gelu": functools.partial(jax.nn.gelu, approximate=True),
+            "gelu_exact": functools.partial(jax.nn.gelu, approximate=False),
             "relu": jax.nn.relu}[name]
 
 
@@ -130,3 +132,20 @@ def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
 
 def rope_cache(seq_len: int, head_dim: int, theta: float):
     return rope_angles(jnp.arange(seq_len), head_dim, theta=theta)
+
+
+def apply_partial_rope(x, cos, sin):
+    """Rotate the first ``2*cos.shape[-1]`` head dims, pass the rest through
+    (gpt-neox ``rotary_pct``).  The rotated span is defined by the cos/sin
+    width alone — build them with :func:`rope_cache` over ``rope_dim(cfg)``."""
+    rot = 2 * cos.shape[-1]
+    if rot == x.shape[-1]:
+        return apply_rotary_pos_emb(x, cos, sin)
+    rotated = apply_rotary_pos_emb(x[..., :rot], cos, sin)
+    return jnp.concatenate([rotated, x[..., rot:]], axis=-1)
+
+
+def rope_dim(cfg) -> int:
+    """Rotated head dims (even; head_dim * rotary_pct, neox convention)."""
+    d = int(cfg.head_dim * cfg.rotary_pct)
+    return max(2, d - (d % 2))

@@ -35,8 +35,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import axis_size, get_global_mesh
 from deepspeed_tpu.models.config import ModelConfig, get_model_config
-from deepspeed_tpu.models.layers import (activation_fn, attention_core, constrain,
-                                         norm, _repeat_kv, rope_cache)
+from deepspeed_tpu.models.layers import (activation_fn, apply_partial_rope,
+                                         attention_core, constrain, norm,
+                                         _repeat_kv, rope_cache, rope_dim)
 from deepspeed_tpu.ops.pallas import apply_rotary_pos_emb
 
 
@@ -44,20 +45,6 @@ def _uniform(rng, shape, scale, dtype):
     return jax.random.uniform(rng, shape, dtype, -scale, scale)
 
 
-def apply_partial_rope(x, cos, sin, pct: float):
-    """Rotate the first ``2*cos.shape[-1]`` head dims, pass the rest through
-    (gpt-neox ``rotary_pct``; pct=1 is the full-rotation fast path)."""
-    if pct >= 1.0:
-        return apply_rotary_pos_emb(x, cos, sin)
-    rot = 2 * cos.shape[-1]
-    rotated = apply_rotary_pos_emb(x[..., :rot], cos, sin)
-    return jnp.concatenate([rotated, x[..., rot:]], axis=-1)
-
-
-def rope_dim(cfg) -> int:
-    """Rotated head dims (even; head_dim * rotary_pct, neox convention)."""
-    d = int(cfg.head_dim * cfg.rotary_pct)
-    return max(2, d - (d % 2))
 
 
 class CausalLM:
@@ -222,8 +209,8 @@ class CausalLM:
         k = k.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         if cfg.position == "rope":  # [B, H, S, Dh] is the kernel's layout
-            q = apply_partial_rope(q, cos, sin, cfg.rotary_pct)
-            k = apply_partial_rope(k, cos, sin, cfg.rotary_pct)
+            q = apply_partial_rope(q, cos, sin)
+            k = apply_partial_rope(k, cos, sin)
         k = _repeat_kv(k, H // Hkv)
         v = _repeat_kv(v, H // Hkv)
         o = attention_core(q, k, v, mesh, causal=True, sp_mode=cfg.sp_mode)

@@ -114,6 +114,14 @@ def config_from_hf(path: str):
                 "gpt_neox with attention_bias=false is not supported: the "
                 "model's use_bias covers attention AND mlp biases together "
                 "(NeoX keeps mlp biases regardless)")
+        # HF "gelu" is the exact erf form; the tanh approximations map to
+        # this model zoo's default "gelu"
+        act_map = {"gelu": "gelu_exact", "gelu_new": "gelu",
+                   "gelu_fast": "gelu", "gelu_pytorch_tanh": "gelu"}
+        act = hf.get("hidden_act", "gelu")
+        if act not in act_map:
+            raise ValueError(f"gpt_neox hidden_act {act!r} is not supported "
+                             f"(supported: {sorted(act_map)})")
         return ModelConfig(
             vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
             intermediate_size=hf["intermediate_size"],
@@ -121,7 +129,7 @@ def config_from_hf(path: str):
             num_heads=hf["num_attention_heads"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
             norm="layernorm", norm_eps=hf.get("layer_norm_eps", 1e-5),
-            activation="gelu", glu=False, position="rope",
+            activation=act_map[act], glu=False, position="rope",
             # transformers deprecated rotary_emb_base for rope_theta
             rope_theta=hf.get("rotary_emb_base",
                               hf.get("rope_theta", 10000.0)),

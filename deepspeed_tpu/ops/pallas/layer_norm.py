@@ -27,14 +27,23 @@ from jax.experimental import pallas as pl
 from deepspeed_tpu.ops.pallas.common import interpret_flag, pick_block, resolve_impl
 
 # 512-row tiles: fewer grid steps than 256 while the bwd kernel's blocks and
-# fp32 temporaries stay inside the 16MB scoped-VMEM budget even when fused
-# into a large training program (1024 rows compiles standalone but trips the
-# scoped limit inside the full step at n=768).
+# fp32 temporaries stay inside the scoped-VMEM budget even when fused into a
+# large training program (1024 rows compiles standalone but trips the scoped
+# limit inside the full step at n=768).  Wider features shrink the rows: the
+# Mosaic compile hard-fails past ~512K elements per block there (measured on
+# v5e: 256x4096 and 128x8192 die, 128x4096 and 64x8192 compile), so past
+# n=2048 the cap is area-based.
 _BLOCK_ROWS = 512
+_WIDE_BLOCK_ELEMS = 512 * 1024
 
 
-def _rows_blocks(rows: int):
-    br = pick_block(rows, _BLOCK_ROWS, minimum=8) if rows >= 8 else rows
+def _rows_blocks(rows: int, n: int, wide_at: int = 2048):
+    """LayerNorm's backward carries more fp32 temporaries than RMSNorm's, so
+    it switches to the area-based cap one width step earlier
+    (``wide_at=1024``)."""
+    cap = (_BLOCK_ROWS if n <= wide_at
+           else max(8, (_WIDE_BLOCK_ELEMS // max(n, 1)) // 8 * 8))
+    br = pick_block(rows, cap, minimum=8) if rows >= 8 else rows
     return br, rows // br if rows % br == 0 else 1
 
 
@@ -113,7 +122,7 @@ def layer_norm(x, gamma, beta, eps: float = 1e-5, impl: Optional[str] = None):
     n = orig[-1]
     x2 = x.reshape(-1, n)
     rows = x2.shape[0]
-    br, grid = _rows_blocks(rows)
+    br, grid = _rows_blocks(rows, n, wide_at=1024)
     y = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(grid,),
@@ -161,7 +170,7 @@ def _layer_norm_bwd_vjp(eps, impl, res, dy):
         db = jnp.sum(dyf, axis=0)
     else:
         rows = x2.shape[0]
-        br, grid = _rows_blocks(rows)
+        br, grid = _rows_blocks(rows, n, wide_at=1024)
         dx, dg_part, db_part = pl.pallas_call(
             functools.partial(_ln_bwd_kernel, eps=eps),
             grid=(grid,),
@@ -199,7 +208,7 @@ def rms_norm(x, gamma, eps: float = 1e-6, impl: Optional[str] = None):
     n = orig[-1]
     x2 = x.reshape(-1, n)
     rows = x2.shape[0]
-    br, grid = _rows_blocks(rows)
+    br, grid = _rows_blocks(rows, n)
     y = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
         grid=(grid,),
@@ -234,7 +243,7 @@ def _rms_norm_bwd_vjp(eps, impl, res, dy):
         dg = jnp.sum(dyf * xhat, axis=0)
     else:
         rows = x2.shape[0]
-        br, grid = _rows_blocks(rows)
+        br, grid = _rows_blocks(rows, n)
         dx, dg_part = pl.pallas_call(
             functools.partial(_rms_bwd_kernel, eps=eps),
             grid=(grid,),

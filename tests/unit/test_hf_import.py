@@ -109,13 +109,14 @@ def test_inference_engine_loads_hf(tmp_path, mesh8):
     assert out.shape == (1, 7)
 
 
-def test_gpt_neox_generate_parity(tmp_path, mesh8):
+@pytest.mark.parametrize("kind", ["gpt_neox", "qwen2", "opt"])
+def test_generate_parity(tmp_path, kind, mesh8):
     """The DECODE path re-implements the layer math (decoding.py), so the
     parallel-residual + partial-rope + bias branches need their own parity
     evidence: greedy generation must match HF token for token."""
     import deepspeed_tpu
 
-    path = _save_tiny(tmp_path, "gpt_neox")
+    path = _save_tiny(tmp_path, kind)
     toks = np.array([[1, 5, 9, 2]], np.int32)
     model_hf = transformers.AutoModelForCausalLM.from_pretrained(path)
     model_hf.eval()
@@ -129,4 +130,5 @@ def test_gpt_neox_generate_parity(tmp_path, mesh8):
     engine.set_params(params)
     got = np.asarray(engine.generate(jnp.asarray(toks), max_new_tokens=6,
                                      do_sample=False))
-    np.testing.assert_array_equal(got, want)
+    # HF stops early at the model's eos token; compare the common prefix
+    np.testing.assert_array_equal(got[:, :want.shape[1]], want)
