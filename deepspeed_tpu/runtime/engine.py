@@ -1269,17 +1269,8 @@ class DeepSpeedEngine:
             return
         from deepspeed_tpu.runtime.zero.stream_grad import StreamedFwdBwd
 
-        specs = self._param_specs
-        layer_specs = jax.tree.map(lambda s: P(*tuple(s)[1:]), specs["layers"])
-        head_specs = {"final_norm": specs["final_norm"],
-                      "head": (specs["embed"]["tok"] if seg["tied"]
-                               else specs["lm_head"])}
-        self._streamed = StreamedFwdBwd(
-            seg, gas=gas,
-            layer_shardings=shardings_from_pspecs(layer_specs, self.mesh),
-            embed_shardings=shardings_from_pspecs(specs["embed"], self.mesh),
-            head_shardings=shardings_from_pspecs(head_specs, self.mesh),
-            use_dropout=True)
+        self._streamed = StreamedFwdBwd.from_param_specs(
+            seg, self._param_specs, self.mesh, gas=gas, use_dropout=True)
         # numpy compute-dtype copy for the per-layer H2D slices — built only
         # now that streaming is actually active (a second host-resident model
         # copy is wasted memory on the whole-program fallback)

@@ -44,6 +44,28 @@ class StreamedFwdBwd:
     (one layer's specs = stacked specs with the leading [L] dim stripped).
     """
 
+    @classmethod
+    def from_param_specs(cls, segments: Dict[str, Any], specs, mesh, *,
+                         gas: int, use_dropout: bool) -> "StreamedFwdBwd":
+        """Build from a full param-tree PartitionSpec tree (the engine's
+        ``_param_specs`` shape): one layer's specs are the stacked specs
+        with the leading [L] dim stripped; the head is the tok table when
+        embeddings are tied.  Single wiring point for the engine AND the
+        8B bench."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.zero.partition import shardings_from_pspecs
+
+        layer_specs = jax.tree.map(lambda s: P(*tuple(s)[1:]), specs["layers"])
+        head_specs = {"final_norm": specs["final_norm"],
+                      "head": (specs["embed"]["tok"] if segments["tied"]
+                               else specs["lm_head"])}
+        return cls(segments, gas=gas,
+                   layer_shardings=shardings_from_pspecs(layer_specs, mesh),
+                   embed_shardings=shardings_from_pspecs(specs["embed"], mesh),
+                   head_shardings=shardings_from_pspecs(head_specs, mesh),
+                   use_dropout=use_dropout)
+
     def __init__(self, segments: Dict[str, Any], *, gas: int,
                  layer_shardings, embed_shardings, head_shardings,
                  use_dropout: bool):

@@ -120,6 +120,10 @@ def test_generate_parity(tmp_path, kind, mesh8):
     toks = np.array([[1, 5, 9, 2]], np.int32)
     model_hf = transformers.AutoModelForCausalLM.from_pretrained(path)
     model_hf.eval()
+    # disable HF's eos early-stop (random tiny weights may pick eos first,
+    # which would shrink the compared span to one token); min_new_tokens is
+    # NOT equivalent — it bans eos and changes the greedy argmax
+    model_hf.generation_config.eos_token_id = None
     with torch.no_grad():
         want = model_hf.generate(torch.tensor(toks), max_new_tokens=6,
                                  do_sample=False).numpy()
@@ -130,5 +134,5 @@ def test_generate_parity(tmp_path, kind, mesh8):
     engine.set_params(params)
     got = np.asarray(engine.generate(jnp.asarray(toks), max_new_tokens=6,
                                      do_sample=False))
-    # HF stops early at the model's eos token; compare the common prefix
-    np.testing.assert_array_equal(got[:, :want.shape[1]], want)
+    assert want.shape[1] == toks.shape[1] + 6, want.shape  # full span compared
+    np.testing.assert_array_equal(got, want)
