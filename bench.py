@@ -459,6 +459,8 @@ def main():
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.40, 4),
+        "baseline_def": "mfu / 0.40 MFU north-star target (BASELINE.json "
+                        "published no measured reference number)",
         "detail": {"mfu": round(mfu, 4), "params_m": round(n_params / 1e6, 2),
                    "batch": batch, "micro_batch": micro, "grad_accum": accum,
                    "seq": seq, "steps": steps,

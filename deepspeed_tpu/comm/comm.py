@@ -43,7 +43,6 @@ from deepspeed_tpu.comm.mesh import (MESH_AXES, build_mesh, get_global_mesh, mes
 from deepspeed_tpu.utils.logging import logger
 
 _INITIALIZED = False
-_WARNED_DEVICE_GROUP_RANK = False
 
 ReduceOp = type("ReduceOp", (), {"SUM": "sum", "AVG": "avg", "MAX": "max", "MIN": "min", "PRODUCT": "prod"})
 
@@ -181,21 +180,19 @@ def get_rank(group: Any = None) -> int:
     """Caller's rank; with ``group=`` a ProcessGroup, the caller's position
     in the group (reference semantics: -1 when not a member).  Group ranks
     are PROCESS indices for this query; a device-id group on a multi-host
-    pod is ambiguous and gets a warning."""
+    pod is ambiguous and raises ValueError (build the group with
+    ``new_group(..., kind='process')``)."""
     if group is not None and hasattr(group, "ranks"):
         if (jax.process_count() > 1
                 and getattr(group, "kind", "device") != "process"):
             # a device-id group has no process-membership meaning on a pod:
-            # device 1 being in the group says nothing about process 1
-            global _WARNED_DEVICE_GROUP_RANK
-            if not _WARNED_DEVICE_GROUP_RANK:
-                _WARNED_DEVICE_GROUP_RANK = True
-                logger.warning(
-                    "get_rank(group=): group %s is a device-id group; "
-                    "process membership is undefined on a multi-process "
-                    "world — build it with new_group(..., kind='process')",
-                    group.ranks)
-            return -1
+            # device 1 being in the group says nothing about process 1.
+            # Returning -1 here would silently disable every
+            # ``get_rank(group) == 0`` gate, so fail loudly instead.
+            raise ValueError(
+                f"get_rank(group=): group {group.ranks} is a device-id "
+                "group; process membership is undefined on a multi-process "
+                "world — build it with new_group(..., kind='process')")
         me = jax.process_index()
         return group.ranks.index(me) if me in group.ranks else -1
     return jax.process_index()

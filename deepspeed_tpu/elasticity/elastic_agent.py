@@ -124,9 +124,9 @@ class DSElasticAgent:
             return 1
         while True:
             procs = self._spawn(world, port)
-            failed = None
+            failed: List[tuple] = []
             alive = set(range(len(procs)))
-            while alive and failed is None:
+            while alive and not failed:
                 time.sleep(POLL_INTERVAL_S)
                 for i in sorted(alive):
                     code = procs[i].poll()
@@ -134,21 +134,36 @@ class DSElasticAgent:
                         continue
                     alive.discard(i)
                     if code != 0:
-                        failed = (i, code)
-                        break
-            if failed is None:
+                        failed.append((i, code))
+            if not failed:
                 logger.info("elastic agent: job completed (restarts=%d)",
                             self.restart_count)
                 return 0
-            rank, code = failed
-            logger.warning("elastic agent: rank %d died (exit %d); tearing "
-                           "down survivors", rank, code)
+            # drain the poll window: several ranks may have died together
+            # (e.g. a host loss); shrinking by 1 per restart would burn one
+            # max_restarts budget slot per doomed relaunch before converging.
+            # Give co-failing ranks one grace interval to finish exiting
+            # before the drain pass, or they'd be miscounted as survivors.
+            time.sleep(POLL_INTERVAL_S)
+            for i in sorted(alive):
+                code = procs[i].poll()
+                if code is not None:
+                    alive.discard(i)
+                    if code != 0:
+                        failed.append((i, code))
+            code = failed[0][1]
+            logger.warning("elastic agent: rank(s) %s died (exit codes %s); "
+                           "tearing down survivors",
+                           [r for r, _ in failed], [c for _, c in failed])
             self._terminate(procs)
             if self.restart_count >= self.max_restarts:
                 logger.error("elastic agent: max_restarts=%d exhausted",
                              self.max_restarts)
                 return code
-            new_world = world - 1
+            new_world = world - len(failed)
+            if new_world < 1:
+                logger.error("elastic agent: no survivors to restart with")
+                return code
             try:
                 micro = self._validate_world(new_world)
             except ElasticityError as exc:

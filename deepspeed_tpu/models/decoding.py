@@ -41,7 +41,19 @@ def init_kv_cache(cfg, batch: int, max_len: int, dtype=jnp.bfloat16,
     cost memory only; they are never visited)."""
     L, Hkv, Dh = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
     if max_len > DECODE_BLOCK and max_len % DECODE_BLOCK:
-        max_len = -(-max_len // DECODE_BLOCK) * DECODE_BLOCK
+        rounded = -(-max_len // DECODE_BLOCK) * DECODE_BLOCK
+        # callers sizing masks/position buffers must read cache['k'].shape[-2]
+        # rather than their requested max_len — say so, once
+        global _WARNED_ROUNDED_CACHE
+        if not _WARNED_ROUNDED_CACHE:
+            _WARNED_ROUNDED_CACHE = True
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.info(
+                "init_kv_cache: max_len %d rounded up to %d (a %d-multiple) "
+                "for the flash-decode path; size position buffers from "
+                "cache['k'].shape[-2]", max_len, rounded, DECODE_BLOCK)
+        max_len = rounded
     if quantized:
         return {
             "k": jnp.zeros((L, batch, Hkv, max_len, Dh), jnp.int8),
@@ -69,6 +81,7 @@ def _quantize_kv_rows(x):
 
 DECODE_BLOCK = 256  # flash-decode cache block (power of two, MXU-friendly)
 _WARNED_ODD_CACHE = False
+_WARNED_ROUNDED_CACHE = False
 
 
 def _cached_attention_dense(q, kcache, vcache, q_pos, scale, k_scale=None,

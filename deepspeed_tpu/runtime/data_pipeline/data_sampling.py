@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -140,6 +141,7 @@ class DeepSpeedDataSampler:
         self.dp_size = data_parallel_size
         self.seed = seed
         self.shuffle = shuffle
+        self._perm_cache: Dict[Any, np.ndarray] = {}
         self._warned_empty_intersection = False
         self.global_step = 0
         self.consumed_samples = 0
@@ -201,10 +203,49 @@ class DeepSpeedDataSampler:
         if step is None:
             step = self.global_step
         pool = self._admitted(step)
-        rng = np.random.RandomState((self.seed * 1000003 + step) % (2 ** 31))
         if self.shuffle:
-            picks = rng.choice(pool, size=self.global_batch_size,
-                               replace=len(pool) < self.global_batch_size)
+            # Epoch-style traversal (reference data_sampler semantics): one
+            # permutation of the admitted pool per epoch, so while the pool
+            # is stable every admitted sample is visited before any repeats.
+            # Stateless in ``step`` (resume/replay-safe); a pool change
+            # (curriculum ramp) reseeds the permutation via the pool
+            # fingerprint — a mid-epoch change therefore restarts traversal
+            # at the cumulative position, which can skip part of the fresh
+            # permutation until the next epoch boundary (inherent to the
+            # stateless design; ramps change the pool every few steps
+            # anyway, so per-era traversal is approximate by nature).
+            n = len(pool)
+            if n * 4 <= self.global_batch_size:
+                # every batch repeats the pool several times over — epoch
+                # traversal is vacuous; sample with replacement instead of
+                # building ceil(gbs/n) permutations per step
+                rng = np.random.RandomState(
+                    (self.seed * 1000003 + step) % (2 ** 31))
+                picks = rng.choice(pool, size=self.global_batch_size,
+                                   replace=True)
+            else:
+                # multi-metric pools (intersect1d/union) are NOT prefixes of
+                # a fixed index, so the fingerprint must cover the content;
+                # the crc is O(n) like _admitted itself — not a new cost class
+                fp = zlib.crc32(np.ascontiguousarray(pool).tobytes())
+                start = step * self.global_batch_size
+                epoch, pos = divmod(start, n)
+
+                def perm(e):
+                    ck = (e, fp)
+                    cached = self._perm_cache.get(ck)
+                    if cached is None:
+                        prng = np.random.RandomState(
+                            (self.seed * 1000003 + e * 9176 + fp) % (2 ** 31))
+                        cached = prng.permutation(pool)
+                        if len(self._perm_cache) > 16:
+                            self._perm_cache.clear()
+                        self._perm_cache[ck] = cached
+                    return cached
+
+                need = pos + self.global_batch_size
+                chunks = [perm(epoch + i) for i in range(-(-need // n))]
+                picks = np.concatenate(chunks)[pos:pos + self.global_batch_size]
         else:
             off = (step * self.global_batch_size) % len(pool)
             picks = np.take(pool, np.arange(off, off + self.global_batch_size),

@@ -163,6 +163,15 @@ class DeepSpeedEngine:
             if bad:
                 raise ValueError(f"1-bit optimizers do not support model "
                                  f"parallelism (axes {bad} > 1)")
+            if self.config.gradient_clipping:
+                # incompatible by construction (clipping local grads breaks
+                # error feedback); the reference silently ignores the knob —
+                # a one-shot warning is too easy to miss in a config sweep
+                raise ValueError(
+                    "gradient_clipping is not supported with 1-bit "
+                    "optimizers (clipping local grads would break error "
+                    "feedback) — remove gradient_clipping or use a dense "
+                    "optimizer")
             log_dist(f"1-bit optimizer active: {self.config.optimizer.type} "
                      f"(compressed momentum exchange after freeze_step)", ranks=[0])
         # ZeRO++ (SURVEY §2.3; VERDICT r3 item 3): quantized weight
@@ -1099,10 +1108,6 @@ class DeepSpeedEngine:
         lr_schedule = self._lr_schedule
         base_lr = (self.config.optimizer.params.get("lr", 1e-3)
                    if self.config.optimizer else 1e-3)
-        if self.config.gradient_clipping:
-            logger.warning("gradient_clipping is ignored by the 1-bit "
-                           "optimizer path (clipping local grads would break "
-                           "error feedback; reference behavior)")
         state_specs = TrainState(
             params=jax.tree.map(lambda s: s.spec, self._param_shardings),
             opt_state=self._opt_specs,

@@ -152,16 +152,11 @@ def onebit_from_config(opt_type: str, params: Dict[str, Any], world: int,
     if name == "zerooneadam":
         from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneAdam
 
-        if "local_step_scaler" in params:
-            from deepspeed_tpu.utils.logging import logger
-
-            logger.warning(
-                "ZeroOneAdam: local_step_scaler is accepted but the LR-"
-                "tracking interval policy it configures is approximated by "
-                "doubling-to-local_step_clipper; the knob itself has no "
-                "effect")
+        # defaults match the reference ZeroOneAdam signature (var_freeze_step
+        # 100000 — freezing at 100 would begin divergent local stepping
+        # orders of magnitude earlier than the reference schedule)
         return ZeroOneAdam(
-            var_freeze_step=params.get("var_freeze_step", 100),
+            var_freeze_step=params.get("var_freeze_step", 100000),
             var_update_scaler=params.get("var_update_scaler", 16),
             local_step_scaler=params.get("local_step_scaler", 32678),
             local_step_clipper=params.get("local_step_clipper", 16),

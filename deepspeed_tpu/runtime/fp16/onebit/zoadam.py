@@ -12,11 +12,14 @@ Schedule (knob names match the reference config):
 - ``var_freeze_step``: last step at which the variance may update.
 - ``var_update_scaler``: while unfrozen, ``v`` refreshes every this many
   steps (from a full-precision grad pmean — rare by construction).
-- ``local_step_clipper``: cap on the local-step interval.  Until
-  ``var_freeze_step`` the interval is 1 (sync every step); after freezing
-  it doubles at each sync up to the cap (the reference ties growth to the
-  LR schedule via ``local_step_scaler``; doubling-to-cap is that policy's
-  shape with a constant LR).
+- ``local_step_scaler`` / ``local_step_clipper``: the learning-rate policy
+  for the local-step interval.  Until ``var_freeze_step`` the interval is
+  1 (sync every step).  After freezing, at each executed sync: if the LR
+  changed since the previous sync the interval RESETS to 1 (replicas must
+  reconcile often while the schedule moves); otherwise a stable-sync
+  counter advances and every ``local_step_scaler``-th stable sync the
+  interval doubles, capped at ``local_step_clipper``.  ``scaler=1``
+  degenerates to plain doubling-to-cap.
 
 TPU-native contract: like OneBitAdam this is a *per-worker local* update
 meant for a full-manual ``shard_map`` region, but params are [W]-stacked
@@ -52,6 +55,8 @@ class ZeroOneState(NamedTuple):
     syncs: jnp.ndarray          # i32 number of executed sync exchanges
     sync_interval: jnp.ndarray  # i32 current local-step interval, replicated
     next_sync: jnp.ndarray      # i32 step index of the next sync, replicated
+    last_sync_lr: jnp.ndarray   # f32 LR observed at the last sync (-1 = none)
+    stable_syncs: jnp.ndarray   # i32 consecutive same-LR syncs (LR policy)
 
 
 class ZeroOneAdam:
@@ -61,7 +66,7 @@ class ZeroOneAdam:
 
     def __init__(self, world: int, axis_names: Sequence[str], lr: float = 1e-3,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
-                 weight_decay: float = 0.0, var_freeze_step: int = 100,
+                 weight_decay: float = 0.0, var_freeze_step: int = 100000,
                  var_update_scaler: int = 16, local_step_scaler: int = 32678,
                  local_step_clipper: int = 16):
         self.world = world
@@ -72,7 +77,7 @@ class ZeroOneAdam:
         self.weight_decay = weight_decay
         self.var_freeze_step = var_freeze_step
         self.var_update_scaler = max(1, var_update_scaler)
-        self.local_step_scaler = local_step_scaler
+        self.local_step_scaler = max(1, local_step_scaler)
         self.local_step_clipper = max(1, local_step_clipper)
 
     # -- state ----------------------------------------------------------
@@ -98,7 +103,9 @@ class ZeroOneAdam:
             var_updates=jnp.zeros((), jnp.int32),
             syncs=jnp.zeros((), jnp.int32),
             sync_interval=jnp.ones((), jnp.int32),
-            next_sync=jnp.ones((), jnp.int32))
+            next_sync=jnp.ones((), jnp.int32),
+            last_sync_lr=jnp.full((), -1.0, jnp.float32),
+            stable_syncs=jnp.zeros((), jnp.int32))
 
     def state_pspecs(self, params: Any, waxes) -> "ZeroOneState":
         """PartitionSpecs for the state (stacked leaves over the worker
@@ -114,7 +121,7 @@ class ZeroOneAdam:
             error_p=jax.tree.map(wspec, params),
             server_error_p=jax.tree.map(lambda p: P(waxes, None), params),
             count=P(), var_updates=P(), syncs=P(), sync_interval=P(),
-            next_sync=P())
+            next_sync=P(), last_sync_lr=P(), stable_syncs=P())
 
     # -- local (in-shard_map) update ------------------------------------
     def update_local(self, grads_local: Any, state: ZeroOneState,
@@ -202,13 +209,27 @@ class ZeroOneAdam:
                 for g, m, v, anc, em, sm_, ep, sp_, p in z]
         unflat = lambda i: jax.tree_util.tree_unflatten(treedef,
                                                         [o[i] for o in outs])
-        # local-step interval: 1 while the variance adapts; after freezing,
-        # double at each sync up to the clipper cap
+        # local-step interval under the LR policy: 1 while the variance
+        # adapts; after freezing, each executed sync observes the LR —
+        # changed → interval resets to 1, stable → every local_step_scaler-th
+        # stable sync doubles the interval up to the clipper cap
+        lr_f = jnp.asarray(lr, jnp.float32)
+        synced = unfrozen | sync
+        frozen_sync = sync & ~unfrozen
+        lr_changed = frozen_sync & (state.last_sync_lr >= 0) & (
+            lr_f != state.last_sync_lr)
+        stable_syncs = jnp.where(
+            frozen_sync, jnp.where(lr_changed, 0, state.stable_syncs + 1),
+            state.stable_syncs)
+        grow = frozen_sync & ~lr_changed & (
+            stable_syncs % jnp.int32(self.local_step_scaler) == 0)
         grown = jnp.minimum(state.sync_interval * 2,
                             jnp.int32(self.local_step_clipper))
-        synced = unfrozen | sync
+        interval_after_sync = jnp.where(
+            lr_changed, jnp.int32(1),
+            jnp.where(grow, grown, state.sync_interval))
         next_interval = jnp.where(
-            synced, jnp.where(unfrozen, jnp.int32(1), grown),
+            synced, jnp.where(unfrozen, jnp.int32(1), interval_after_sync),
             state.sync_interval)
         next_sync = jnp.where(synced, count + next_interval, state.next_sync)
         new_state = ZeroOneState(
@@ -217,5 +238,7 @@ class ZeroOneAdam:
             error_p=unflat(6), server_error_p=unflat(7),
             count=count, var_updates=var_updates,
             syncs=state.syncs + synced.astype(jnp.int32),
-            sync_interval=next_interval, next_sync=next_sync)
+            sync_interval=next_interval, next_sync=next_sync,
+            last_sync_lr=jnp.where(synced, lr_f, state.last_sync_lr),
+            stable_syncs=stable_syncs)
         return unflat(0), new_state

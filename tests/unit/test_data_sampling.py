@@ -84,6 +84,18 @@ def test_sampler_dp_ranks_partition_batch(tmp_path):
     np.testing.assert_array_equal(np.concatenate([a, b]), full)
 
 
+def test_shuffle_epoch_traversal():
+    """shuffle=True visits every admitted sample exactly once per epoch
+    before any repeats (ADVICE r4: i.i.d. per-step choice had no epoch
+    semantics), and reshuffles between epochs."""
+    s = DeepSpeedDataSampler(num_samples=64, global_batch_size=8)
+    epoch0 = np.concatenate([s.sample_step(t) for t in range(8)])
+    assert sorted(map(int, epoch0)) == list(range(64))
+    epoch1 = np.concatenate([s.sample_step(t) for t in range(8, 16)])
+    assert sorted(map(int, epoch1)) == list(range(64))
+    assert not np.array_equal(epoch0, epoch1), "epochs must reshuffle"
+
+
 def test_percentile_difficulty(tmp_path):
     n = 64
     ds = _dataset(n)
