@@ -3,10 +3,10 @@
 Key schema parity (SURVEY.md §2.1 "Inference engine", §3.5):
 ``dtype``, ``tensor_parallel.tp_size`` (also the legacy ``mp_size`` alias),
 ``max_out_tokens``, ``replace_with_kernel_inject``, ``checkpoint``,
-``min_out_tokens``, ``max_tokens``.  On TPU the kernel-injection flag is
-honored trivially: the fused decode path (models/decoding.py) *is* the only
-path, so ``replace_with_kernel_inject`` is accepted and recorded but does not
-change behavior.
+``min_out_tokens``, ``max_tokens``.  ``replace_with_kernel_inject`` (and the
+auto-on ``use_fused_decode`` extension) selects the Pallas kernel-injected
+decode path (models/fused_decode.py): fused QKV weights + four fused kernels
+per layer, the TPU form of the reference's injection containers.
 """
 
 from __future__ import annotations
@@ -40,6 +40,12 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
                                          # cache budget cannot cover it
     max_batch_size: int = 0              # 0 = unlimited; else generate() raises
     replace_with_kernel_inject: bool = False
+    # TPU extensions for the fused decode path (models/fused_decode.py):
+    # use_fused_decode None = auto (on when the model/config supports it);
+    # decode_unroll = tokens generated per while_loop iteration (amortizes
+    # per-iteration loop overhead; EOS/max-token tails are masked exactly).
+    use_fused_decode: Optional[bool] = None
+    decode_unroll: int = 4
     checkpoint: Optional[Any] = None
     enable_cuda_graph: bool = False      # accepted for parity; XLA always "graphs"
     seed: int = 0

@@ -53,6 +53,7 @@ class InferenceEngine:
             self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else (
                 jnp.float16 if config.dtype in ("float16", "fp16", "half") else jnp.float32)
         self._params = None
+        self._dparams = None
         self._cache = None
         self._gen_fns = {}
         self._prefill_fns = {}
@@ -101,12 +102,48 @@ class InferenceEngine:
             specs = jax.tree.map(qspec, cast, specs, is_leaf=is_qtensor)
             shardings = shardings_from_pspecs(specs, self.mesh)
         self._params = jax.device_put(cast, shardings)
+        self._build_injected_view()
+        self._gen_fns = {}
+        self._prefill_fns = {}
         n = sum(x.size for x in jax.tree.leaves(self._params))
         nbytes = sum(x.nbytes for x in jax.tree.leaves(self._params))
         log_dist(f"inference engine ready: {n/1e6:.2f}M params "
                  f"({nbytes/2**30:.2f}GB), tp={self.mesh.shape.get('tp', 1)}, "
                  f"dtype {'int8-weights/' if self._int8_weights else ''}"
-                 f"{self.dtype.__name__}", ranks=[0])
+                 f"{self.dtype.__name__}"
+                 f"{', kernel-injected decode' if self._dparams is not None else ''}",
+                 ranks=[0])
+
+    def _build_injected_view(self) -> None:
+        """Kernel injection (reference ``replace_with_kernel_inject``): lay
+        the weights out for the fused Pallas decode kernels.  Auto-on when
+        supported; ``use_fused_decode=False`` opts out."""
+        from deepspeed_tpu.models.fused_decode import (inject_decode_params,
+                                                       supports_fused_decode)
+
+        self._dparams = None
+        cfg = getattr(self.module, "config", None)
+        if self._config.use_fused_decode is False:
+            return  # explicit opt-out wins, even over replace_with_kernel_inject
+        if cfg is None:
+            return
+        force = self._config.replace_with_kernel_inject
+        ok = supports_fused_decode(
+            cfg, quantized_weights=self._int8_weights,
+            quantized_kv=self._config.quantize_kv_cache,
+            tp=self.mesh.shape.get("tp", 1))
+        if not ok:
+            if force or self._config.use_fused_decode:
+                log_dist("kernel injection requested but unsupported for "
+                         "this model/config (MoE, int8, or tp>1): using the "
+                         "unfused decode path", ranks=[0])
+            return
+        # eager, not jitted: pass-through leaves (embed/final_norm/lm_head —
+        # the largest single tensors) stay ALIASED to self._params instead
+        # of being copied by a jit boundary.  The per-layer unstacked
+        # weights are genuinely new buffers (that is the injection), so
+        # layer weights are resident twice — prefill keeps the plain tree.
+        self._dparams = inject_decode_params(self._params, cfg)
 
     def load_checkpoint(self, path: str) -> None:
         from deepspeed_tpu.runtime.checkpoint_engine import (
@@ -179,15 +216,37 @@ class InferenceEngine:
     def _gen_loop(self, settings):
         """One compiled program for the WHOLE decode loop: lax.while_loop
         with on-device sampling and EOS reduction — no per-token host sync
-        or dispatch (VERDICT r2 weak #3 / item 8)."""
+        or dispatch (VERDICT r2 weak #3 / item 8).
+
+        The body generates ``decode_unroll`` tokens per loop iteration
+        (per-iteration loop overhead amortizes across them).  Sub-steps past
+        the (max-token, cache-bound, all-EOS) exit condition write to SPARE
+        slots — one extra buf column and the cache rows past ``max_len`` —
+        and don't advance ``pos``/``step``, so the unrolled tail is exact
+        without a ``lax.cond`` (profiled: a cond around the sub-step forces
+        a full KV-cache copy per branch).  With kernel injection active the
+        sub-step is the fused Pallas decode (models/fused_decode.py);
+        otherwise the reference-shaped unfused forward."""
         if settings in self._gen_fns:
             return self._gen_fns[settings]
         eos, do_sample, temperature, top_k, top_p, max_len = settings
         model = self.module
+        fused = self._dparams is not None
+        unroll = max(1, int(self._config.decode_unroll))
+
+        def step_fn(params, tokens, cache, pos):
+            if fused:
+                from deepspeed_tpu.models.fused_decode import decode_step
+
+                return decode_step(model.config, params, tokens, cache, pos)
+            logits, cache = forward_with_cache(model, params, tokens, cache,
+                                               pos)
+            return logits[:, -1], cache
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def loop(params, cache, buf, logits0, pos0, max_steps, rng):
-            B = buf.shape[0]
+            B, W = buf.shape
+            cache_len = cache["k"].shape[-2]
 
             def cond(st):
                 buf, cache, logits, pos, step, rng, finished = st
@@ -196,19 +255,39 @@ class InferenceEngine:
                     go = go & ~jnp.all(finished)
                 return go
 
-            def body(st):
+            def substep(st, guarded):
                 buf, cache, logits, pos, step, rng, finished = st
+                valid = cond(st) if guarded else None
                 rng, srng = jax.random.split(rng)
                 nxt = sample_token(logits, srng, temperature=temperature,
                                    top_k=top_k, top_p=top_p, do_sample=do_sample)
                 if eos >= 0:
                     nxt = jnp.where(finished, eos, nxt)
-                    finished = finished | (nxt == eos)
+                    hit = nxt == eos
+                    finished = finished | (hit if valid is None
+                                           else hit & valid)
+                buf_pos = pos if valid is None else jnp.where(valid, pos, W - 1)
                 buf = jax.lax.dynamic_update_slice(
-                    buf, nxt[:, None].astype(buf.dtype), (0, pos))
-                logits, cache = forward_with_cache(
-                    model, params, nxt[:, None].astype(jnp.int32), cache, pos)
-                return (buf, cache, logits[:, -1], pos + 1, step + 1, rng, finished)
+                    buf, nxt[:, None].astype(buf.dtype), (0, buf_pos))
+                fwd_pos = (pos if valid is None
+                           else jnp.where(valid, pos, cache_len - 1))
+                new_logits, cache = step_fn(
+                    params, nxt[:, None].astype(jnp.int32), cache, fwd_pos)
+                if valid is not None:
+                    new_logits = jnp.where(valid, new_logits, logits)
+                    adv = valid.astype(pos.dtype)
+                else:
+                    adv = 1
+                return (buf, cache, new_logits, pos + adv, step + adv, rng,
+                        finished)
+
+            def body(st):
+                # the first sub-step is covered by the while cond; later
+                # ones guard themselves via masked writes
+                st = substep(st, guarded=False)
+                for _ in range(unroll - 1):
+                    st = substep(st, guarded=True)
+                return st
 
             st = (buf, cache, logits0, pos0, jnp.zeros((), jnp.int32), rng,
                   jnp.zeros((B,), bool))
@@ -245,7 +324,9 @@ class InferenceEngine:
                 f"cache budget max_out_tokens={self._config.max_out_tokens} cannot "
                 f"cover min_out_tokens={self._config.min_out_tokens} after a "
                 f"{S}-token prompt")
-        self._ensure_compiled(B, max_len)
+        # +1: a spare cache row past max_len absorbs masked-off unrolled
+        # sub-step writes (never attended — valid rows stop at max_len)
+        self._ensure_compiled(B, max_len + 1)
         cache = self._cache
         self._cache = None  # donated below; invalidate the handle
 
@@ -255,15 +336,18 @@ class InferenceEngine:
         padded = jnp.pad(tokens, ((0, 0), (0, Sb - S))) if Sb > S else tokens
         logits, cache = self._prefill(self._params, cache, padded, 0, S - 1)
 
+        # +1 spare column: masked-off unrolled sub-steps land there; the
+        # returned slice stops at S + tokens-produced, so it is never seen
         buf = jnp.concatenate(
-            [tokens, jnp.zeros((B, max_new_tokens), tokens.dtype)], axis=1)
+            [tokens, jnp.zeros((B, max_new_tokens + 1), tokens.dtype)], axis=1)
         rng = rng if rng is not None else self._rng
         settings = (eos_token_id if eos_token_id is not None else -1,
                     bool(do_sample), float(temperature), int(top_k),
                     float(top_p), int(max_len))
         loop = self._gen_loop(settings)
+        loop_params = self._dparams if self._dparams is not None else self._params
         buf, cache, pos, step, rng = loop(
-            self._params, cache, buf, logits, jnp.asarray(S, jnp.int32),
+            loop_params, cache, buf, logits, jnp.asarray(S, jnp.int32),
             jnp.asarray(max_new_tokens, jnp.int32), rng)
         self._rng = rng
         self._cache = cache

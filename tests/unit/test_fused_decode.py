@@ -1,0 +1,203 @@
+"""Fused decode kernel parity (VERDICT r4 item 1: the Pallas decode path).
+
+Each kernel is checked in interpret mode against its jnp reference on the
+8-device CPU backend, over the feature matrix the model zoo exercises
+(layernorm/rmsnorm, bias/no-bias, GLU/plain MLP, GQA, parallel residual,
+position edge cases for the length-aware attention)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.decode import (
+    _flash_decode_ref, _mlp_ref, _norm_qkv_ref, _proj_norm_ref,
+    flash_decode, fused_mlp, fused_norm_qkv, fused_proj_norm)
+
+
+def _rand(key, *shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype) * 0.5
+
+
+@pytest.mark.parametrize("kind", ["layernorm", "rmsnorm"])
+@pytest.mark.parametrize("with_bias", [True, False])
+def test_norm_qkv_parity(kind, with_bias):
+    B, D, N = 2, 256, 768
+    x = _rand(0, B, D)
+    scale = 1.0 + 0.1 * _rand(1, D)
+    bias = _rand(2, D)
+    w = _rand(3, D, N)
+    bq = _rand(4, N) if with_bias else None
+    got = fused_norm_qkv(x, scale, bias, w, bq, kind=kind, eps=1e-5,
+                         impl="interpret")
+    want = _norm_qkv_ref(x, scale, bias, w, bq, kind=kind, eps=1e-5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_norm_qkv_blocked_grid():
+    """N large enough to split into several column blocks."""
+    B, D, N = 1, 2048, 6144
+    x = _rand(0, B, D, dtype=jnp.bfloat16)
+    scale = jnp.ones((D,), jnp.bfloat16)
+    bias = jnp.zeros((D,), jnp.bfloat16)
+    w = _rand(1, D, N, dtype=jnp.bfloat16)
+    got = fused_norm_qkv(x, scale, bias, w, None, kind="rmsnorm",
+                         impl="interpret")
+    want = _norm_qkv_ref(x, scale, bias, w, None, kind="rmsnorm", eps=1e-5)
+    np.testing.assert_allclose(np.float32(got), np.float32(want),
+                               rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("pos", [0, 5, 255, 256, 300, 767])
+@pytest.mark.parametrize("rep", [1, 4])
+def test_flash_decode_positions(pos, rep):
+    """Length-aware masking at block boundaries, GQA included."""
+    B, Hkv, Smax, Dh = 2, 3, 768, 64
+    H = Hkv * rep
+    q = _rand(0, B, H, Dh)
+    k = _rand(1, B, Hkv, Smax, Dh)
+    v = _rand(2, B, Hkv, Smax, Dh)
+    got = flash_decode(q, k, v, pos, impl="interpret")
+    want = _flash_decode_ref(q, k, v, jnp.int32(pos), scale=Dh ** -0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_odd_cache_falls_back():
+    """Cache lengths that are not a block multiple route to the dense
+    reference (a non-tile-aligned Pallas block would be handed to Mosaic
+    otherwise) — and still produce the right numbers."""
+    B, Hkv, Smax, Dh = 1, 2, 145, 64
+    q = _rand(0, B, Hkv, Dh)
+    k = _rand(1, B, Hkv, Smax, Dh)
+    v = _rand(2, B, Hkv, Smax, Dh)
+    got = flash_decode(q, k, v, 100, impl="interpret")
+    want = _flash_decode_ref(q, k, v, jnp.int32(100), scale=Dh ** -0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_short_generation_small_cache():
+    """A default-sized generate (cache under one decode block) works on the
+    fused path end-to-end (exercises the odd-Smax fallback in situ)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    model = causal_lm("llama-tiny", num_layers=2, vocab_size=256,
+                      max_seq_len=64)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model, config={"max_out_tokens": 64, "dtype": "float32"})
+    engine.set_params(params)
+    assert engine._dparams is not None
+    out = np.asarray(engine.generate(np.array([[3, 1, 4]]),
+                                     max_new_tokens=12, do_sample=False))
+    assert out.shape == (1, 15)
+
+
+def test_flash_decode_stacked_layer_offset():
+    """layer= reads the right slice of a stacked [L, B, Hkv, Smax, Dh]
+    cache through the index-map offset."""
+    L, B, Hkv, Smax, Dh = 3, 2, 2, 512, 64
+    q = _rand(0, B, 2 * Hkv, Dh)
+    k = _rand(1, L, B, Hkv, Smax, Dh)
+    v = _rand(2, L, B, Hkv, Smax, Dh)
+    for l in range(L):
+        got = flash_decode(q, k, v, 300, layer=l, impl="interpret")
+        want = _flash_decode_ref(q, k[l], v[l], jnp.int32(300),
+                                 scale=Dh ** -0.5)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("kind,parallel", [("layernorm", False),
+                                           ("rmsnorm", False),
+                                           ("layernorm", True)])
+def test_proj_norm_parity(kind, parallel):
+    B, M, D = 2, 192, 256
+    ctx = _rand(0, B, M)
+    resid = _rand(1, B, D)
+    wo = _rand(2, M, D)
+    bo = _rand(3, D)
+    scale = 1.0 + 0.1 * _rand(4, D)
+    bias = _rand(5, D)
+    got_r, got_h = fused_proj_norm(ctx, resid, wo, bo, scale, bias,
+                                   kind=kind, parallel=parallel,
+                                   impl="interpret")
+    want_r, want_h = _proj_norm_ref(ctx, resid, wo, bo, scale, bias,
+                                    kind=kind, eps=1e-5, parallel=parallel)
+    np.testing.assert_allclose(got_r, want_r, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(got_h, want_h, rtol=2e-5, atol=2e-5)
+
+
+def _generate(preset, fused, prompt, dtype="float32", unroll=4, **overrides):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    model = causal_lm(preset, **overrides)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model, config={"max_out_tokens": 128, "dtype": dtype,
+                       "use_fused_decode": fused, "decode_unroll": unroll})
+    engine.set_params(params)
+    if fused:
+        assert engine._dparams is not None, "injection should be active"
+    else:
+        assert engine._dparams is None
+    return np.asarray(engine.generate(prompt, max_new_tokens=24,
+                                      do_sample=False))
+
+
+@pytest.mark.parametrize("preset,overrides", [
+    ("gpt2-small", dict(num_layers=2, hidden_size=128, num_heads=4,
+                        vocab_size=512, max_seq_len=128)),
+    ("llama-tiny", dict(num_layers=2, vocab_size=512, max_seq_len=128)),
+])
+def test_fused_generation_matches_unfused(preset, overrides):
+    """Kernel-injected decode produces the same greedy tokens as the
+    reference-shaped unfused loop (end-to-end injection parity, the
+    containers-level check the other import families get)."""
+    prompt = np.array([[5, 17, 200, 3, 42, 7, 11, 23]])
+    plain = _generate(preset, False, prompt, **overrides)
+    fused = _generate(preset, True, prompt, **overrides)
+    np.testing.assert_array_equal(plain, fused)
+
+
+def test_unroll_tail_exact():
+    """decode_unroll > 1 must not change the produced token count or the
+    tokens themselves when max_new_tokens is not a multiple of the unroll."""
+    overrides = dict(num_layers=2, hidden_size=128, num_heads=4,
+                     vocab_size=512, max_seq_len=128)
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    outs = []
+    for unroll in (1, 3):
+        model = causal_lm("gpt2-small", **overrides)
+        params = jax.jit(model.init)(jax.random.PRNGKey(0))
+        engine = deepspeed_tpu.init_inference(
+            model, config={"max_out_tokens": 64, "dtype": "float32",
+                           "use_fused_decode": False,
+                           "decode_unroll": unroll})
+        engine.set_params(params)
+        outs.append(np.asarray(engine.generate(
+            np.array([[5, 17, 200]]), max_new_tokens=7, do_sample=False)))
+    assert outs[0].shape == outs[1].shape == (1, 10)
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+@pytest.mark.parametrize("glu", [True, False])
+@pytest.mark.parametrize("with_bias", [True, False])
+def test_mlp_parity(glu, with_bias):
+    B, D, F = 2, 256, 1024
+    h = _rand(0, B, D)
+    r = _rand(1, B, D)
+    w_up = _rand(2, D, F)
+    w_gate = _rand(3, D, F) if glu else None
+    w_down = _rand(4, F, D)
+    b_up = _rand(5, F) if with_bias else None
+    b_gate = _rand(6, F) if (glu and with_bias) else None
+    b_down = _rand(7, D) if with_bias else None
+    act = "silu" if glu else "gelu"
+    got = fused_mlp(h, r, w_up, w_down, w_gate, b_up, b_gate, b_down,
+                    act=act, impl="interpret")
+    want = _mlp_ref(h, r, w_up, w_gate, w_down, b_up, b_gate, b_down, act=act)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
