@@ -55,6 +55,10 @@ class ModelConfig:
     # training-time knobs
     sp_mode: str = "auto"                  # "auto" | "ulysses" | "ring" (sp>1)
     pp_microbatches: int = 0               # pipeline microbatches (0 -> pp size)
+    # "gpipe": fill-drain scan + autodiff (stashes M+pp-1 boundaries);
+    # "1f1b": fused fwd+bwd scan, circular buffer of 2pp-1 boundaries —
+    # the reference TrainSchedule's memory bound (training with labels only)
+    pp_schedule: str = "gpipe"
     # Activation checkpointing (ds_config "activation_checkpointing" section
     # overrides these at engine init). None = off: recompute-in-backward costs
     # ~1/3 extra FLOPs, so it must be opted into when the model doesn't fit,
@@ -81,6 +85,9 @@ class ModelConfig:
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
         assert self.num_heads % self.num_kv_heads == 0
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pp_schedule must be 'gpipe' or '1f1b', got "
+                             f"{self.pp_schedule!r}")
 
     @property
     def is_moe(self) -> bool:

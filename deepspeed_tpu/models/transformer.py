@@ -450,6 +450,33 @@ class CausalLM:
                     return {"nll": jnp.where(valid, nll, 0.0).sum(),
                             "cnt": valid.sum().astype(jnp.float32)}
 
+                if cfg.pp_schedule == "1f1b":
+                    # token count is data-only, so it can divide each
+                    # microbatch's contribution BEFORE the pipeline — the
+                    # fused schedule needs additive per-microbatch scalars
+                    from deepspeed_tpu.runtime.pipe.spmd import \
+                        spmd_pipeline_1f1b
+
+                    valid_all = labels[:, 1:] >= 0
+                    if has_mask:
+                        valid_all = valid_all & (mask_arg[:, 1:] > 0)
+                    cnt = jnp.maximum(valid_all.sum().astype(jnp.float32),
+                                      1.0)
+
+                    def loss_mb(y_mb, r_xs, consts):
+                        fnorm_c, head_c, cnt_c = consts
+                        d = reduce_mb(y_mb, r_xs, (fnorm_c, head_c))
+                        return d["nll"] / cnt_c
+
+                    return spmd_pipeline_1f1b(
+                        stage_fn, loss_mb, params["layers"], x, mesh,
+                        num_microbatches=cfg.pp_microbatches,
+                        broadcast_args=(cos, sin), scan_args=keys,
+                        loss_xs=(labels, mask_arg),
+                        loss_consts=(params["final_norm"], head_pp, cnt),
+                        aux_coef=(cfg.moe_aux_loss_coef if cfg.is_moe
+                                  else 0.0))
+
                 # When the model remats per layer (cfg.remat), the scan's
                 # per-step residuals are already bounded by the tuned layer
                 # policy — an outer save-nothing wrap would override it.

@@ -216,6 +216,7 @@ class DeepSpeedEngine:
 
         self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         self._apply_activation_checkpointing_config(model)
+        self._apply_pipeline_config(model)
         if self._param_offload:
             mcfg = getattr(model, "config", None)
             if mcfg is not None and hasattr(mcfg, "param_offload"):
@@ -389,6 +390,30 @@ class DeepSpeedEngine:
                     "policy", ac.policy)
             mcfg.remat_policy = ("offload_dots" if ac.cpu_checkpointing
                                  else ac.policy)
+
+    def _apply_pipeline_config(self, model) -> None:
+        """Push the ds_config ``pipeline`` section into the model: reference
+        ``PipelineEngine`` knobs mapped to the SPMD pipeline —
+        ``micro_batches`` (reference ``train_batch()`` microbatching) and
+        ``schedule`` ("gpipe" fill-drain with autodiff, or "1f1b" — the
+        reference TrainSchedule's in-flight-bounded fused schedule)."""
+        sec = self.config.pipeline or {}
+        mcfg = getattr(model, "config", None)
+        if mcfg is None or not hasattr(mcfg, "pp_schedule"):
+            if sec:
+                logger.warning(
+                    "ds_config pipeline section %s ignored: the model "
+                    "carries no ModelConfig with pipeline knobs", sec)
+            return
+        if "micro_batches" in sec:
+            mcfg.pp_microbatches = int(sec["micro_batches"])
+        sched = sec.get("schedule")
+        if sched is not None:
+            if sched not in ("gpipe", "1f1b"):
+                raise ValueError(
+                    f"pipeline.schedule must be 'gpipe' or '1f1b', got "
+                    f"{sched!r}")
+            mcfg.pp_schedule = sched
 
     @property
     def state(self) -> Optional["TrainState"]:

@@ -229,6 +229,251 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
                       red_arg, const_arg)
 
 
+# ---------------------------------------------------------------------------
+# 1F1B-equivalent fused schedule
+# ---------------------------------------------------------------------------
+
+def spmd_pipeline_1f1b(stage_fn: Callable, loss_mb_fn: Callable,
+                       layer_params: Any, x: jnp.ndarray, mesh: Mesh,
+                       num_microbatches: int = 0, broadcast_args: Tuple = (),
+                       scan_args: Any = None, axis: str = "pp",
+                       loss_xs: Any = None, loss_consts: Any = (),
+                       aux_coef: float = 0.0,
+                       boundary_fp32: Optional[bool] = None):
+    """1F1B-equivalent pipeline: ONE scan interleaves each step's forward
+    microbatch with the backward of the microbatch whose cotangent just
+    arrived, exactly the reference ``TrainSchedule``'s steady state
+    (``(R) runtime/pipe/schedule.py``), expressed SPMD.
+
+    Contract differences from :func:`spmd_pipeline`:
+
+    - ``loss_mb_fn(y_mb, loss_xs_mb, loss_consts) -> scalar``: each finished
+      microbatch's *additive* loss contribution (the caller divides by the
+      data-only token count BEFORE the pipeline, so contributions sum to the
+      final loss).  ``aux_coef`` folds the stage aux losses (MoE) into the
+      same scalar.
+    - Returns the summed scalar loss.  Differentiable via ``jax.custom_vjp``:
+      the fused scan computes the gradients alongside the loss (seeded with
+      1.0 — valid because the pipeline output enters the final loss
+      linearly), stores them as the VJP residual, and the backward pass just
+      scales them by the incoming cotangent.
+
+    Why it exists (VERDICT r4 item 2): autodiff over the GPipe scan stashes
+    one stage-boundary tensor per scan step — ``M + pp - 1`` live
+    microbatch boundaries between forward and backward.  Here backward of
+    microbatch ``m`` at stage ``s`` runs ``2*(pp-1-s)`` steps after its
+    forward, so a circular buffer of ``2*pp - 1`` slots suffices no matter
+    how large M grows; each backward step recomputes its stage forward from
+    the saved boundary (same recompute the GPipe path's ``remat_stage``
+    already pays).  Total steps ``M + 2*(pp-1)`` — the reference 1F1B
+    fill+drain length.
+
+    Cotangents are returned for ``layer_params``, ``x``, and
+    ``loss_consts``; ``scan_args`` (rng keys), ``broadcast_args`` (RoPE
+    tables), and ``loss_xs`` (labels/masks) get symbolic zeros — they carry
+    no trainable upstream in this framework's models.
+    """
+    if boundary_fp32 is None:
+        boundary_fp32 = mesh.devices.flat[0].platform == "cpu"
+    pp = axis_size(mesh, axis)
+    B = x.shape[0]
+    M = num_microbatches or pp
+    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    if scan_args is None:
+        leaves = jax.tree.leaves(layer_params)
+        scan_args = jnp.zeros((leaves[0].shape[0],), jnp.uint32)
+    static = _P1F1BStatic(stage_fn, loss_mb_fn, mesh, M, axis, float(aux_coef),
+                          bool(boundary_fp32))
+    return _p1f1b(static, layer_params, jnp.asarray(x),
+                  jax.tree.map(jnp.asarray, scan_args),
+                  tuple(jnp.asarray(a) for a in broadcast_args),
+                  jax.tree.map(jnp.asarray, loss_xs),
+                  jax.tree.map(jnp.asarray, loss_consts))
+
+
+class _P1F1BStatic:
+    """Hashable static bundle for the custom_vjp nondiff arg."""
+
+    def __init__(self, stage_fn, loss_mb_fn, mesh, M, axis, aux_coef,
+                 boundary_fp32):
+        self.stage_fn = stage_fn
+        self.loss_mb_fn = loss_mb_fn
+        self.mesh = mesh
+        self.M = M
+        self.axis = axis
+        self.aux_coef = aux_coef
+        self.boundary_fp32 = boundary_fp32
+        self._key = (stage_fn, loss_mb_fn, mesh, M, axis, aux_coef,
+                     boundary_fp32)
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _P1F1BStatic) and self._key == other._key
+
+
+def _zero_cot(a):
+    """Symbolic-zero cotangent (float0 for integer leaves)."""
+    import numpy as np
+
+    a = jnp.asarray(a)
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        return jnp.zeros_like(a)
+    return np.zeros(a.shape, jax.dtypes.float0)
+
+
+def _p1f1b_run(static, layer_params, x, scan_args, broadcast_args, loss_xs,
+               loss_consts):
+    """The fused 1F1B scan: returns (loss, (d_layers, d_x, d_consts))."""
+    mesh, axis, M = static.mesh, static.axis, static.M
+    stage_fn, loss_mb_fn = static.stage_fn, static.loss_mb_fn
+    aux_coef = static.aux_coef
+    pp = axis_size(mesh, axis)
+    B = x.shape[0]
+    mb = B // M
+    T2 = M + 2 * (pp - 1)
+    C = 2 * pp - 1
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+    x_dtype = x.dtype
+    b_dtypes = tuple(a.dtype for a in broadcast_args)
+    n_b = len(broadcast_args)
+    lc_dtypes = jax.tree.map(lambda a: a.dtype, loss_consts)
+    bf32 = static.boundary_fp32
+
+    def boundary_cast(a):
+        if not bf32:
+            return a
+        return (a.astype(jnp.float32)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(axis), P(), P(axis)) + (P(),) * n_b
+                       + (P(), P()),
+                       out_specs=(P(), P(axis), P(), P()),
+                       axis_names={axis}, check_vma=False)
+    def _fused(wl, xg32, sl, *bc_and_loss):
+        bc = tuple(a.astype(dt) for a, dt
+                   in zip(bc_and_loss[:n_b], b_dtypes))
+        l_xs = bc_and_loss[n_b]
+        l_consts = jax.tree.map(lambda a, dt: a.astype(dt),
+                                bc_and_loss[n_b + 1], lc_dtypes)
+        xg = xg32.astype(x_dtype)
+        stage = jax.lax.axis_index(axis)
+        is_last = stage == pp - 1
+        is_first = stage == 0
+        xmb = xg.reshape((M, mb) + xg.shape[1:])
+        l_mb = jax.tree.map(lambda a: a.reshape((M, mb) + a.shape[1:]), l_xs)
+
+        def fwd_f(w, i, keys):
+            return stage_fn(w, i, keys, *bc)
+
+        def step(carry, t):
+            fbuf, bbuf, circ, gw, gx, gc, loss_acc = carry
+            # ---- forward wavefront: stage s runs microbatch t - s --------
+            m_f = t - stage
+            valid_f = (m_f >= 0) & (m_f < M)
+            inp = jnp.where(is_first, xmb[jnp.clip(m_f, 0, M - 1)], fbuf)
+            circ = jax.lax.dynamic_update_slice(
+                circ, inp[None], (t % C,) + (0,) * inp.ndim)
+            out, aux = fwd_f(wl, inp, sl)
+            # last stage: loss contribution + the cotangent seed for its own
+            # backward (which runs THIS step: t_b(last, m) == t_f(last, m))
+            lx = jax.tree.map(lambda a: a[jnp.clip(m_f, 0, M - 1)], l_mb)
+            lval, vjp_loss = jax.vjp(loss_mb_fn, out, lx, l_consts)
+            mask_l = (is_last & valid_f).astype(jnp.float32)
+            loss_acc = loss_acc + mask_l * lval.astype(jnp.float32)
+            loss_acc = loss_acc + jnp.where(
+                valid_f, aux_coef / M * aux.astype(jnp.float32), 0.0)
+            dout_l, _dlx, dlc = vjp_loss(mask_l.astype(lval.dtype))
+            gc = jax.tree.map(lambda a, d: a + d.astype(jnp.float32), gc, dlc)
+            # ---- backward wavefront: stage s runs m = t - (2pp-2-s) ------
+            m_b = t - (2 * pp - 2 - stage)
+            valid_b = (m_b >= 0) & (m_b < M)
+            saved = jax.lax.dynamic_slice(
+                circ, (jnp.clip(m_b + stage, 0, T2) % C,) + (0,) * inp.ndim,
+                (1,) + inp.shape)[0]
+            dout = jnp.where(is_last, dout_l, bbuf)
+            dout = jnp.where(valid_b, dout, jnp.zeros_like(dout))
+            (_out_r, aux_r), vjp_stage = jax.vjp(
+                lambda w, i: fwd_f(w, i, sl), wl, saved)
+            daux = jnp.where(valid_b, aux_coef / M, 0.0).astype(aux_r.dtype)
+            dw, dinp = vjp_stage((dout.astype(x_dtype), daux))
+            gw = jax.tree.map(lambda a, d: a + d.astype(jnp.float32), gw, dw)
+            dinp = jnp.where(valid_b, dinp, jnp.zeros_like(dinp))
+            # unconditional write of the already-masked dinp (a lax.cond
+            # here would copy the whole gx buffer per branch).  Only stage
+            # 0's gx survives the psum mask below, and for stage 0 the
+            # clipped zero-writes all land in slot 0 before its real write
+            # (m_b there never exceeds M-1).
+            gx = jax.lax.dynamic_update_slice(
+                gx, dinp[None].astype(jnp.float32),
+                (jnp.clip(m_b, 0, M - 1),) + (0,) * dinp.ndim)
+            # ---- sends ---------------------------------------------------
+            fbuf = jax.lax.ppermute(out, axis, fwd_perm)
+            bbuf = jax.lax.ppermute(dinp, axis, bwd_perm)
+            return (fbuf, bbuf, circ, gw, gx, gc, loss_acc), None
+
+        carry0 = (
+            jnp.zeros((mb,) + xg.shape[1:], xg.dtype),
+            jnp.zeros((mb,) + xg.shape[1:], x_dtype),
+            jnp.zeros((C, mb) + xg.shape[1:], xg.dtype),
+            jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), wl),
+            jnp.zeros((M, mb) + xg.shape[1:], jnp.float32),
+            jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), l_consts),
+            jnp.zeros((), jnp.float32))
+        (fb, bb, circ, gw, gx, gc, loss), _ = jax.lax.scan(
+            step, carry0, jnp.arange(T2))
+        loss = jax.lax.psum(loss, axis)
+        gx = jax.lax.psum(jnp.where(is_first, gx, jnp.zeros_like(gx)), axis)
+        gc = jax.tree.map(
+            lambda a: jax.lax.psum(jnp.where(is_last, a, jnp.zeros_like(a)),
+                                   axis), gc)
+        return loss, gw, gx.reshape((B,) + xg.shape[1:]), gc
+
+    loss, gw, gx, gc = _fused(
+        layer_params, boundary_cast(x), scan_args,
+        *(boundary_cast(a) for a in broadcast_args),
+        jax.tree.map(jnp.asarray, loss_xs),
+        jax.tree.map(boundary_cast, loss_consts))
+    gw = jax.tree.map(lambda g, p: g.astype(p.dtype), gw, layer_params)
+    gx = gx.astype(x.dtype)
+    gc = jax.tree.map(lambda g, c: g.astype(c.dtype), gc, loss_consts)
+    return loss, (gw, gx, gc)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _p1f1b(static, layer_params, x, scan_args, broadcast_args, loss_xs,
+           loss_consts):
+    loss, _ = _p1f1b_run(static, layer_params, x, scan_args, broadcast_args,
+                         loss_xs, loss_consts)
+    return loss
+
+
+def _p1f1b_fwd(static, layer_params, x, scan_args, broadcast_args, loss_xs,
+               loss_consts):
+    loss, grads = _p1f1b_run(static, layer_params, x, scan_args,
+                             broadcast_args, loss_xs, loss_consts)
+    return loss, (grads, scan_args, broadcast_args, loss_xs)
+
+
+def _p1f1b_bwd(static, res, d):
+    (gw, gx, gc), scan_args, broadcast_args, loss_xs = res
+    scale = d.astype(jnp.float32)
+    return (jax.tree.map(lambda g: (scale * g.astype(jnp.float32)
+                                    ).astype(g.dtype), gw),
+            (scale * gx.astype(jnp.float32)).astype(gx.dtype),
+            jax.tree.map(_zero_cot, scan_args),
+            jax.tree.map(_zero_cot, broadcast_args),
+            jax.tree.map(_zero_cot, loss_xs),
+            jax.tree.map(lambda g: (scale * g.astype(jnp.float32)
+                                    ).astype(g.dtype), gc))
+
+
+_p1f1b.defvjp(_p1f1b_fwd, _p1f1b_bwd)
+
+
 def pp_layer_pspecs(pspecs: Any, mesh: Mesh, axis: str = "pp") -> Any:
     """Mark the stacked layer dim of every leaf spec with the ``pp`` axis
     (storage placement matches pipeline stage ownership)."""

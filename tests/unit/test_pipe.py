@@ -113,6 +113,110 @@ def test_pp_forward_matches_no_pp(devices, rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+def test_1f1b_matches_gpipe(devices, rng):
+    """VERDICT r4 item 2 done-criterion (parity): the fused 1F1B schedule
+    produces the same loss and parameter gradients as the autodiff GPipe
+    path on a real model at pp=4, M=16."""
+    from deepspeed_tpu.models import causal_lm
+
+    toks = jax.random.randint(rng, (16, 32), 0, 128)
+    kw = dict(num_layers=8, hidden_size=32, intermediate_size=64, num_heads=2,
+              num_kv_heads=2, vocab_size=128, remat=False, pp_microbatches=16)
+    mesh = build_mesh(pp=4, fsdp=2, devices=devices)
+    set_global_mesh(mesh)
+
+    m_g = causal_lm("llama-tiny", mesh=mesh, pp_schedule="gpipe", **kw)
+    params = m_g.init(rng, toks)
+    loss_g, grads_g = jax.jit(jax.value_and_grad(
+        lambda p: m_g.apply(p, toks, labels=toks)))(params)
+
+    m_f = causal_lm("llama-tiny", mesh=mesh, pp_schedule="1f1b", **kw)
+    loss_f, grads_f = jax.jit(jax.value_and_grad(
+        lambda p: m_f.apply(p, toks, labels=toks)))(params)
+
+    np.testing.assert_allclose(float(loss_f), float(loss_g),
+                               rtol=1e-5, atol=1e-6)
+    for (kg, gg), (_, gf) in zip(
+            jax.tree_util.tree_leaves_with_path(grads_g),
+            jax.tree_util.tree_leaves_with_path(grads_f)):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gg),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=jax.tree_util.keystr(kg))
+
+
+def test_engine_trains_with_1f1b_schedule(devices, rng):
+    """ds_config pipeline.schedule="1f1b" reaches the model and the engine
+    trains through the fused schedule (reference PipelineEngine +
+    TrainSchedule wiring)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    mesh = build_mesh(pp=2, fsdp=2, tp=2, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=4, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256)
+    ds_config = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+                 "zero_optimization": {"stage": 1},
+                 "pipeline": {"schedule": "1f1b", "micro_batches": 4},
+                 "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                 "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config,
+                                               mesh=mesh)
+    assert model.config.pp_schedule == "1f1b"
+    assert model.config.pp_microbatches == 4
+    toks = jax.random.randint(rng, (8, 64), 0, 256)
+    losses = []
+    for _ in range(4):
+        loss = engine.forward((toks, toks))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    with pytest.raises(ValueError, match="schedule"):
+        deepspeed_tpu.initialize(
+            model=causal_lm("llama-tiny", mesh=mesh, num_layers=4,
+                            hidden_size=64, intermediate_size=128,
+                            num_heads=4, num_kv_heads=2, vocab_size=256),
+            config={**ds_config, "pipeline": {"schedule": "interleaved"}},
+            mesh=mesh)
+
+
+def test_1f1b_bounds_inflight_boundaries(devices, rng):
+    """VERDICT r4 item 2 done-criterion (memory): at pp=4, M=16 the fused
+    1F1B program's live boundary stash is the 2pp-1 circular buffer, not
+    the GPipe scan's M+pp-1 saved steps — measured with the compiled
+    memory_analysis (the technique from test_param_offload.py)."""
+    from deepspeed_tpu.models import causal_lm
+
+    B, S, M = 32, 512, 32
+    toks = jax.random.randint(rng, (B, S), 0, 256)
+    # boundary-dominant shapes: each stashed boundary is 1x512x512 fp32
+    # (1MB), so the GPipe scan's 35 saved steps vs 1F1B's 7 circular slots
+    # is the dominant temp-pool difference
+    kw = dict(num_layers=4, hidden_size=512, intermediate_size=512,
+              num_heads=4, num_kv_heads=4, vocab_size=256, remat=False,
+              pp_microbatches=M)
+    mesh = build_mesh(pp=4, fsdp=2, devices=devices)
+    set_global_mesh(mesh)
+
+    def temp_bytes(schedule):
+        m = causal_lm("llama-tiny", mesh=mesh, pp_schedule=schedule, **kw)
+        params = jax.eval_shape(m.init, rng, toks)
+        params = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), params)
+        fn = jax.jit(jax.value_and_grad(lambda p: m.apply(p, toks,
+                                                          labels=toks)))
+        ma = fn.lower(params).compile().memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("backend exposes no memory analysis")
+        return ma.temp_size_in_bytes
+
+    gpipe, f1b = temp_bytes("gpipe"), temp_bytes("1f1b")
+    # the boundary stash shrinks (M+pp-1)=19 -> (2pp-1)=7 slots; overall
+    # temp memory must drop measurably (other pools are shared)
+    assert f1b < 0.8 * gpipe, (f1b, gpipe)
+
+
 def _walk_eqns(jaxpr, acc):
     for eqn in jaxpr.eqns:
         acc.append(eqn)
