@@ -34,11 +34,14 @@ class ModelConfig:
     norm_eps: float = 1e-5
     activation: str = "silu"               # "silu" (swiglu) | "gelu"
     glu: bool = True                       # gated MLP (llama) vs plain (gpt2)
-    position: str = "rope"                 # "rope" | "learned"
+    position: str = "rope"                 # "rope" | "learned" | "alibi"
     rope_theta: float = 10000.0
     tie_embeddings: bool = False
     use_bias: bool = False                 # attn/mlp projection biases (gpt2)
     qkv_bias: bool = False                 # biases on q/k/v only (qwen2)
+    mlp_bias: bool = False                 # biases on the MLP only (gpt-j)
+    lm_head_bias: bool = False             # bias on the LM head (gpt-j)
+    embed_norm: bool = False               # layernorm after token embed (bloom)
     # gpt-neox/pythia: x + attn(ln1(x)) + mlp(ln2(x)) — the MLP reads the
     # LAYER INPUT, not the post-attention stream
     parallel_residual: bool = False
@@ -88,6 +91,13 @@ class ModelConfig:
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"pp_schedule must be 'gpipe' or '1f1b', got "
                              f"{self.pp_schedule!r}")
+        if self.position not in ("rope", "learned", "alibi"):
+            raise ValueError(f"position must be 'rope', 'learned' or "
+                             f"'alibi', got {self.position!r}")
+
+    @property
+    def has_mlp_bias(self) -> bool:
+        return self.use_bias or self.mlp_bias
 
     @property
     def is_moe(self) -> bool:

@@ -35,7 +35,7 @@ def supports_fused_decode(cfg, *, quantized_weights: bool = False,
     """The fused path covers the dense model zoo; MoE MLPs, int8 weights,
     int8 KV caches, and tp>1 fall back to the reference-shaped loop."""
     return (not cfg.is_moe and not quantized_weights and not quantized_kv
-            and tp == 1 and cfg.position in ("rope", "learned"))
+            and tp == 1 and cfg.position in ("rope", "learned", "alibi"))
 
 
 def inject_decode_params(params: Any, cfg) -> Dict[str, Any]:
@@ -65,6 +65,7 @@ def inject_decode_params(params: Any, cfg) -> Dict[str, Any]:
                                           axis=-1)
     if cfg.use_bias:
         stacked["bo"] = attn["bo"]
+    if cfg.has_mlp_bias:
         stacked["b_up"] = mlp["b_up"]
         stacked["b_down"] = mlp["b_down"]
         if cfg.glu:
@@ -78,6 +79,8 @@ def inject_decode_params(params: Any, cfg) -> Dict[str, Any]:
            "layers": layers}
     if not cfg.tie_embeddings:
         out["lm_head"] = params["lm_head"]
+    if cfg.lm_head_bias:
+        out["lm_head_bias"] = params["lm_head_bias"]
     return out
 
 
@@ -97,6 +100,8 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
     x = jnp.take(dparams["embed"]["tok"], tokens[:, 0], axis=0)
     if cfg.position == "learned":
         x = x + jnp.take(dparams["embed"]["pos"], pos[None], axis=0)
+    if cfg.embed_norm:  # bloom word_embeddings_layernorm
+        x = norm(x, dparams["embed"]["norm"], "layernorm", cfg.norm_eps)
     dtype = cache["k"].dtype
     x = x.astype(dtype)
 
@@ -144,7 +149,7 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
             vc_all, v[None, :, :, None, :].astype(vc_all.dtype),
             (l, pos0, pos0, pos, pos0))
         ctx = flash_decode(q, kc_all, vc_all, pos, sm_scale=scale,
-                           layer=l, impl=impl)
+                           layer=l, alibi=cfg.position == "alibi", impl=impl)
         r, h = fused_proj_norm(ctx.reshape(B, M), x, lp["wo"], lp.get("bo"),
                                lp["n2_scale"], lp.get("n2_bias"), kind=kind,
                                eps=eps, parallel=cfg.parallel_residual,
@@ -158,4 +163,7 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
         head = dparams["embed"]["tok"].T.astype(x.dtype)
     else:
         head = dparams["lm_head"].astype(x.dtype)
-    return (x @ head).astype(jnp.float32), new_cache
+    logits = (x @ head).astype(jnp.float32)
+    if cfg.lm_head_bias:
+        logits = logits + dparams["lm_head_bias"].astype(jnp.float32)
+    return logits, new_cache

@@ -85,8 +85,36 @@ def _repeat_kv(k, n_rep: int):
     return jnp.broadcast_to(k[:, :, None], (b, h, n_rep, s, d)).reshape(b, h * n_rep, s, d)
 
 
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (Press et al.): geometric 2^(-8i/H) for
+    power-of-two H, with the standard interpolation for other head counts
+    (reference: ``(R) csrc/transformer/inference/csrc/softmax.cu`` alibi
+    path / HF ``build_alibi_tensor``)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    n = 2 ** math.floor(math.log2(num_heads))
+    slopes = pow2_slopes(n)
+    if n < num_heads:
+        extra = pow2_slopes(2 * n)
+        slopes += extra[0::2][: num_heads - n]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def alibi_bias(num_heads: int, q_pos, k_pos) -> jnp.ndarray:
+    """[H, |q|, |k|] additive attention bias: slope_h * (k - q) (non-positive
+    under the causal mask)."""
+    slopes = alibi_slopes(num_heads)
+    rel = k_pos[None, :].astype(jnp.float32) - q_pos[:, None].astype(jnp.float32)
+    return slopes[:, None, None] * rel[None]
+
+
 def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
-                   impl: Optional[str] = None, sp_mode: str = "auto"):
+                   impl: Optional[str] = None, sp_mode: str = "auto",
+                   alibi: bool = False):
     """Multi-head attention on [B, H, S, Dh] tensors.
 
     Dispatch (SURVEY.md §5.7):
@@ -99,9 +127,16 @@ def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
     - anything else → jnp reference under plain GSPMD.
     """
     impl = resolve_impl(impl)
-    if mesh is None or mesh.empty:
-        return mha_reference(q, k, v, causal=causal)
     b, h, s, d = q.shape
+
+    def ref_bias():
+        if not alibi:
+            return None
+        pos = jnp.arange(s)
+        return alibi_bias(h, pos, pos)[None]
+
+    if mesh is None or mesh.empty:
+        return mha_reference(q, k, v, causal=causal, bias=ref_bias())
     batch_ax = data_axes(mesh)
     nb = 1
     for a in batch_ax:
@@ -110,6 +145,10 @@ def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
     nsp = axis_size(mesh, "sp")
     divisible = b % nb == 0 and h % ntp == 0
     if nsp > 1 and divisible and s % nsp == 0:
+        if alibi:
+            raise NotImplementedError(
+                "alibi + sequence parallelism is not supported (the ring/"
+                "ulysses shards would need position-offset bias plumbing)")
         from deepspeed_tpu.sequence.layer import ring_attention, ulysses_attention
         local_heads = h // ntp
         if sp_mode == "ring" or local_heads % nsp != 0:
@@ -118,14 +157,18 @@ def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
         if impl == "pallas" and s % 128 == 0:
             inner = functools.partial(flash_attention, causal=causal)
         return ulysses_attention(q, k, v, mesh, attn_fn=inner, causal=causal)
+    if alibi and ntp > 1:
+        raise NotImplementedError(
+            "alibi + tensor parallelism needs per-shard head-slope offsets; "
+            "serve BLOOM-class models with tp=1 for now")
     if impl != "pallas" or nsp > 1 or not divisible or s % 128 != 0:
-        return mha_reference(q, k, v, causal=causal)
+        return mha_reference(q, k, v, causal=causal, bias=ref_bias())
     spec = P(batch_ax, "tp", None, None)
 
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def _sharded(qq, kk, vv):
-        return flash_attention(qq, kk, vv, causal=causal)
+        return flash_attention(qq, kk, vv, causal=causal, alibi=alibi)
 
     return _sharded(q, k, v)
 

@@ -112,7 +112,7 @@ class CausalLM:
             }
             if cfg.glu:
                 mlp["w_gate"] = linit(next(keys), (D, F), s_in)
-            if cfg.use_bias:
+            if cfg.has_mlp_bias:
                 mlp.update(b_up=jnp.zeros((L, F), dtype),
                            b_down=jnp.zeros((L, D), dtype))
                 if cfg.glu:
@@ -131,8 +131,13 @@ class CausalLM:
         if cfg.position == "learned":
             params["embed"]["pos"] = jax.random.normal(
                 next(keys), (cfg.max_seq_len, D), dtype) * 0.02
+        if cfg.embed_norm:  # bloom: layernorm right after the token embed
+            params["embed"]["norm"] = {"scale": jnp.ones((D,), dtype),
+                                       "bias": jnp.zeros((D,), dtype)}
         if not cfg.tie_embeddings:
             params["lm_head"] = jax.random.normal(next(keys), (D, V), dtype) * s_in
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = jnp.zeros((V,), dtype)
         return params
 
     def logical_pspecs(self) -> Dict[str, Any]:
@@ -164,7 +169,7 @@ class CausalLM:
             mlp = {"w_up": col, "w_down": row}
             if cfg.glu:
                 mlp["w_gate"] = col
-            if cfg.use_bias:
+            if cfg.has_mlp_bias:
                 mlp.update(b_up=P(None, "tp"), b_down=P(None, None))
                 if cfg.glu:
                     mlp["b_gate"] = P(None, "tp")
@@ -180,8 +185,12 @@ class CausalLM:
         }
         if cfg.position == "learned":
             specs["embed"]["pos"] = P(None, None)
+        if cfg.embed_norm:
+            specs["embed"]["norm"] = {"scale": P(None), "bias": P(None)}
         if not cfg.tie_embeddings:
             specs["lm_head"] = P(None, "tp")
+        if cfg.lm_head_bias:
+            specs["lm_head_bias"] = P("tp")
         mesh = self.mesh
         if mesh is not None and not mesh.empty:
             # pipeline parallelism: stage ownership = stacked-layer-dim shard
@@ -213,7 +222,8 @@ class CausalLM:
             k = apply_partial_rope(k, cos, sin)
         k = _repeat_kv(k, H // Hkv)
         v = _repeat_kv(v, H // Hkv)
-        o = attention_core(q, k, v, mesh, causal=True, sp_mode=cfg.sp_mode)
+        o = attention_core(q, k, v, mesh, causal=True, sp_mode=cfg.sp_mode,
+                           alibi=cfg.position == "alibi")
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
         o = o @ a["wo"]
         if cfg.use_bias:
@@ -238,17 +248,17 @@ class CausalLM:
             act = activation_fn(cfg.activation)
             m = lp["mlp"]
             up = h @ m["w_up"]
-            if cfg.use_bias:
+            if cfg.has_mlp_bias:
                 up = up + m["b_up"]
             if cfg.glu:
                 gate = h @ m["w_gate"]
-                if cfg.use_bias:
+                if cfg.has_mlp_bias:
                     gate = gate + m["b_gate"]
                 gated = act(gate) * up
             else:
                 gated = act(up)
             mlp_out = gated @ m["w_down"]
-            if cfg.use_bias:
+            if cfg.has_mlp_bias:
                 mlp_out = mlp_out + m["b_down"]
             aux = jnp.zeros((), jnp.float32)
         mlp_out = mlp_out.astype(x.dtype)
@@ -309,6 +319,8 @@ class CausalLM:
         if cfg.position == "learned":
             S = tokens.shape[1]
             x = x + params["embed"]["pos"][:S][None]
+        if cfg.embed_norm:  # bloom word_embeddings_layernorm
+            x = norm(x, params["embed"]["norm"], "layernorm", cfg.norm_eps)
         x = constrain(x, mesh, batch_ax, "sp", None)
 
         if cfg.position == "rope":
@@ -419,6 +431,11 @@ class CausalLM:
                 # replicated hidden-state buffer never exists
                 head_pp = (params["embed"]["tok"].T if cfg.tie_embeddings
                            else params["lm_head"])
+                # the consts tuple only grows a bias entry when the model
+                # has one (static): no zeros-add over the fp32 logits —
+                # the largest loss-tail tensor — for bias-free models
+                hb_pp = ((params["lm_head_bias"],) if cfg.lm_head_bias
+                         else ())
                 mask_arg = (loss_mask if loss_mask is not None
                             else jnp.ones(labels.shape, jnp.int32))
                 has_mask = loss_mask is not None
@@ -428,10 +445,12 @@ class CausalLM:
                     # blockwise CE's checkpoint+scan trips XLA CHECKs under
                     # the partial-manual region on CPU (jax 0.9)
                     lab_mb, m_mb = r_xs
-                    fnorm_c, head_c = consts
+                    fnorm_c, head_c, *hb_c = consts
                     h = norm(y_mb, fnorm_c, cfg.norm, cfg.norm_eps)
                     logits = (h[:, :-1] @ head_c.astype(h.dtype)
                               ).astype(jnp.float32)
+                    if hb_c:
+                        logits = logits + hb_c[0].astype(jnp.float32)
                     lab = lab_mb[:, 1:]
                     lse = jax.scipy.special.logsumexp(logits, axis=-1)
                     # one-hot contraction, not take_along_axis: XLA's SPMD
@@ -464,8 +483,8 @@ class CausalLM:
                                       1.0)
 
                     def loss_mb(y_mb, r_xs, consts):
-                        fnorm_c, head_c, cnt_c = consts
-                        d = reduce_mb(y_mb, r_xs, (fnorm_c, head_c))
+                        *red_c, cnt_c = consts
+                        d = reduce_mb(y_mb, r_xs, tuple(red_c))
                         return d["nll"] / cnt_c
 
                     return spmd_pipeline_1f1b(
@@ -473,7 +492,8 @@ class CausalLM:
                         num_microbatches=cfg.pp_microbatches,
                         broadcast_args=(cos, sin), scan_args=keys,
                         loss_xs=(labels, mask_arg),
-                        loss_consts=(params["final_norm"], head_pp, cnt),
+                        loss_consts=(params["final_norm"], head_pp) + hb_pp
+                        + (cnt,),
                         aux_coef=(cfg.moe_aux_loss_coef if cfg.is_moe
                                   else 0.0))
 
@@ -486,7 +506,7 @@ class CausalLM:
                     num_microbatches=cfg.pp_microbatches,
                     broadcast_args=(cos, sin), scan_args=keys,
                     reduce_fn=reduce_mb, reduce_xs=(labels, mask_arg),
-                    reduce_consts=(params["final_norm"], head_pp),
+                    reduce_consts=(params["final_norm"], head_pp) + hb_pp,
                     remat_stage=not bool(cfg.remat))
                 loss = red["nll"] / jnp.maximum(red["cnt"], 1.0)
                 return (loss + cfg.moe_aux_loss_coef * aux_loss
@@ -517,13 +537,16 @@ class CausalLM:
             head = (params["embed"]["tok"].T if cfg.tie_embeddings
                     else params["lm_head"]).astype(x.dtype)
             logits = x @ head
+            if cfg.lm_head_bias:
+                logits = logits + params["lm_head_bias"].astype(logits.dtype)
             return constrain(logits, mesh, batch_ax, "sp", "tp")
         head = (params["embed"]["tok"].T if cfg.tie_embeddings
                 else params["lm_head"])
-        loss = self._loss_tail(params["final_norm"], head, x, labels, loss_mask)
+        loss = self._loss_tail(params["final_norm"], head, x, labels, loss_mask,
+                               head_bias=params.get("lm_head_bias"))
         return loss + cfg.moe_aux_loss_coef * aux_loss if cfg.is_moe else loss
 
-    def _loss_tail(self, fnorm, head, x, labels, loss_mask):
+    def _loss_tail(self, fnorm, head, x, labels, loss_mask, head_bias=None):
         """Final norm + LM cross-entropy — the single implementation behind
         both ``apply`` and the streamed head segment (their numerical parity
         is load-bearing for the offload tests).  ``head`` is [D, V].
@@ -544,8 +567,11 @@ class CausalLM:
         if chunk:
             return blockwise_cross_entropy(h[:, :-1], head, shifted_labels,
                                            chunk=chunk, z_loss=cfg.z_loss,
-                                           mask=shifted_mask)
+                                           mask=shifted_mask,
+                                           head_bias=head_bias)
         logits = h[:, :-1] @ head
+        if head_bias is not None:
+            logits = logits + head_bias.astype(logits.dtype)
         logits = constrain(logits, mesh, batch_ax, "sp", "tp")
         return cross_entropy(logits, shifted_labels, z_loss=cfg.z_loss,
                              mask=shifted_mask)
@@ -580,6 +606,8 @@ class CausalLM:
             x = jnp.take(embed["tok"], toks, axis=0)
             if cfg.position == "learned":
                 x = x + embed["pos"][: toks.shape[1]][None]
+            if cfg.embed_norm:
+                x = norm(x, embed["norm"], "layernorm", cfg.norm_eps)
             return constrain(x, mesh, batch_ax, "sp", None)
 
         def layer_fwd(lp, x, key, cos, sin, use_drop):
@@ -590,7 +618,8 @@ class CausalLM:
             if cfg.tie_embeddings:  # head passed as the [V, D] tok table
                 head = head.T
             return self._loss_tail(head_tree["final_norm"], head, x, labels,
-                                   loss_mask)
+                                   loss_mask,
+                                   head_bias=head_tree.get("head_bias"))
 
         def rope(S, dtype):
             if cfg.position != "rope":
@@ -632,7 +661,8 @@ def cross_entropy(logits, labels, z_loss: float = 0.0, mask=None):
 
 
 def blockwise_cross_entropy(x, head, labels, chunk: int, z_loss: float = 0.0,
-                            mask=None, return_sums: bool = False):
+                            mask=None, return_sums: bool = False,
+                            head_bias=None):
     """LM loss without materializing the full [B, S, V] logits.
 
     The reference's fused-softmax CUDA kernels attack the same bandwidth
@@ -664,6 +694,8 @@ def blockwise_cross_entropy(x, head, labels, chunk: int, z_loss: float = 0.0,
         xc, lc = args[0], args[1]
         mc = args[2] if len(args) > 2 else None
         logits = (xc @ head).astype(jnp.float32)
+        if head_bias is not None:
+            logits = logits + head_bias.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, jnp.maximum(lc, 0)[:, None],
                                    axis=-1).squeeze(-1)

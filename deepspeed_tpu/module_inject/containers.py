@@ -1,5 +1,5 @@
 """HF checkpoint import: published GPT-2 / Llama / Mixtral / OPT / Qwen2 /
-GPT-NeoX(Pythia) weights -> the built-in models' param trees.
+GPT-NeoX(Pythia) / BLOOM / GPT-J weights -> the built-in models' param trees.
 
 Reference: ``deepspeed/module_inject/containers/`` (SURVEY.md §2.1 row 34) —
 the containers' real job is mapping public HuggingFace state dicts into the
@@ -13,6 +13,12 @@ Conventions handled:
   wq/wk/wv; biases mapped (our models carry biases when ``use_bias``).
 - Llama/Mixtral rotary uses the half-split pairing — identical to our RoPE
   kernel, so q/k import without permutation.
+- GPT-J rotary is INTERLEAVED; its q/k output columns are permuted at import
+  so the half-split kernel computes identical rotations (the q.k dot is
+  invariant to a permutation applied to both sides).  Its single shared
+  ln_1 is copied into both norm slots of the parallel-residual block.
+- BLOOM: fused per-head-interleaved QKV (like NeoX), ALiBi positions, and
+  the word_embeddings_layernorm (``embed_norm``).
 - Mixtral experts w1/w3/w2 -> w_gate/w_up/w_down stacked on a leading [E].
 """
 
@@ -66,7 +72,12 @@ def detect_arch(sd: Dict[str, np.ndarray]) -> str:
     keys = set(sd)
     if any("block_sparse_moe" in k for k in keys):
         return "mixtral"
+    if any("word_embeddings_layernorm" in k for k in keys):
+        return "bloom"
     if any("wte.weight" in k for k in keys):
+        # gpt-j has separate q/k/v projections; gpt2 a fused Conv1D c_attn
+        if any(".attn.q_proj." in k for k in keys):
+            return "gptj"
         return "gpt2"
     if any("decoder.embed_positions" in k for k in keys):
         return "opt"
@@ -136,6 +147,34 @@ def config_from_hf(path: str):
             rotary_pct=hf.get("rotary_pct", 1.0),
             parallel_residual=hf.get("use_parallel_residual", True),
             use_bias=True,
+            tie_embeddings=hf.get("tie_word_embeddings", False))
+    if mt == "bloom":
+        D = hf["hidden_size" if "hidden_size" in hf else "n_embed"]
+        return ModelConfig(
+            vocab_size=hf["vocab_size"], hidden_size=D,
+            intermediate_size=4 * D,
+            num_layers=hf["n_layer"], num_heads=hf["n_head"],
+            max_seq_len=hf.get("seq_length", 2048),
+            norm="layernorm", norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            # HF BloomGelu is the tanh approximation
+            activation="gelu", glu=False, position="alibi",
+            use_bias=True, embed_norm=True,
+            tie_embeddings=hf.get("tie_word_embeddings", True))
+    if mt == "gptj":
+        D = hf["n_embd"]
+        Dh = D // hf["n_head"]
+        return ModelConfig(
+            vocab_size=hf["vocab_size"], hidden_size=D,
+            intermediate_size=hf.get("n_inner") or 4 * D,
+            num_layers=hf["n_layer"], num_heads=hf["n_head"],
+            max_seq_len=hf.get("n_positions", 2048),
+            norm="layernorm", norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            activation="gelu", glu=False, position="rope",
+            rotary_pct=(hf.get("rotary_dim") or Dh) / Dh,
+            # gpt-j runs attention and MLP in parallel off ONE layernorm;
+            # the import copies ln_1 into both norm slots (identical math)
+            parallel_residual=True,
+            use_bias=False, mlp_bias=True, lm_head_bias=True,
             tie_embeddings=hf.get("tie_word_embeddings", False))
     if mt == "opt":
         D = hf["hidden_size"]
@@ -214,6 +253,98 @@ def hf_to_params(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
                 "attn": attn, "mlp": mlp,
             },
             "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        }
+        return params
+
+    if arch == "bloom":
+        H, Dh = cfg.num_heads, cfg.head_dim
+
+        def qkv_w(which):
+            # fused [3D, D], per-head [q,k,v] interleave (same as neox)
+            def split(i):
+                w = sd[f"h.{i}.self_attention.query_key_value.weight"]
+                part = w.reshape(H, 3, Dh, -1)[:, which]        # [H, Dh, D]
+                return np.ascontiguousarray(part.reshape(H * Dh, -1).T)
+            return np.stack([split(i) for i in range(L)])
+
+        def qkv_b(which):
+            def split(i):
+                b = sd[f"h.{i}.self_attention.query_key_value.bias"]
+                return b.reshape(H, 3, Dh)[:, which].reshape(H * Dh)
+            return np.stack([split(i) for i in range(L)])
+
+        attn = {
+            "wq": qkv_w(0), "wk": qkv_w(1), "wv": qkv_w(2),
+            "wo": _stack(sd, "h.{}.self_attention.dense.weight", L, T),
+            "bq": qkv_b(0), "bk": qkv_b(1), "bv": qkv_b(2),
+            "bo": _stack(sd, "h.{}.self_attention.dense.bias", L),
+        }
+        mlp = {
+            "w_up": _stack(sd, "h.{}.mlp.dense_h_to_4h.weight", L, T),
+            "b_up": _stack(sd, "h.{}.mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, "h.{}.mlp.dense_4h_to_h.weight", L, T),
+            "b_down": _stack(sd, "h.{}.mlp.dense_4h_to_h.bias", L),
+        }
+        return {
+            "embed": {"tok": sd["word_embeddings.weight"],
+                      "norm": {"scale": sd["word_embeddings_layernorm.weight"],
+                               "bias": sd["word_embeddings_layernorm.bias"]}},
+            "layers": {
+                "attn_norm": {
+                    "scale": _stack(sd, "h.{}.input_layernorm.weight", L),
+                    "bias": _stack(sd, "h.{}.input_layernorm.bias", L)},
+                "mlp_norm": {
+                    "scale": _stack(sd, "h.{}.post_attention_layernorm.weight", L),
+                    "bias": _stack(sd, "h.{}.post_attention_layernorm.bias", L)},
+                "attn": attn, "mlp": mlp,
+            },
+            "final_norm": {"scale": sd["ln_f.weight"],
+                           "bias": sd["ln_f.bias"]},
+        }
+
+    if arch == "gptj":
+        H, Dh = cfg.num_heads, cfg.head_dim
+        from deepspeed_tpu.models.layers import rope_dim as _rd
+        rd = _rd(cfg)
+        # HF GPT-J rotates interleaved pairs (2i, 2i+1); our kernel rotates
+        # half-split pairs (i, i+rd/2).  Permuting the q/k OUTPUT columns
+        # within each head maps one convention onto the other exactly (the
+        # q.k dot is invariant to a permutation applied to both sides).
+        perm = np.arange(Dh)
+        perm[:rd // 2] = np.arange(0, rd, 2)
+        perm[rd // 2:rd] = np.arange(1, rd, 2)
+
+        def rot_cols(w):
+            # w: HF [out=H*Dh, in=D] -> ours [D, H*Dh] with permuted heads
+            wt = w.T.reshape(-1, H, Dh)
+            return np.ascontiguousarray(wt[:, :, perm].reshape(-1, H * Dh))
+
+        attn = {
+            "wq": _stack(sd, "h.{}.attn.q_proj.weight", L, rot_cols),
+            "wk": _stack(sd, "h.{}.attn.k_proj.weight", L, rot_cols),
+            "wv": _stack(sd, "h.{}.attn.v_proj.weight", L, T),
+            "wo": _stack(sd, "h.{}.attn.out_proj.weight", L, T),
+        }
+        mlp = {
+            "w_up": _stack(sd, "h.{}.mlp.fc_in.weight", L, T),
+            "b_up": _stack(sd, "h.{}.mlp.fc_in.bias", L),
+            "w_down": _stack(sd, "h.{}.mlp.fc_out.weight", L, T),
+            "b_down": _stack(sd, "h.{}.mlp.fc_out.bias", L),
+        }
+        ln1_s = _stack(sd, "h.{}.ln_1.weight", L)
+        ln1_b = _stack(sd, "h.{}.ln_1.bias", L)
+        params = {
+            "embed": {"tok": sd["wte.weight"]},
+            "layers": {
+                # one shared LayerNorm in the HF block: both slots get it
+                "attn_norm": {"scale": ln1_s, "bias": ln1_b},
+                "mlp_norm": {"scale": ln1_s.copy(), "bias": ln1_b.copy()},
+                "attn": attn, "mlp": mlp,
+            },
+            "final_norm": {"scale": sd["ln_f.weight"],
+                           "bias": sd["ln_f.bias"]},
+            "lm_head": T(sd["lm_head.weight"]),
+            "lm_head_bias": sd["lm_head.bias"],
         }
         return params
 

@@ -148,7 +148,7 @@ def fused_norm_qkv(x, scale, bias, wqkv, bqkv=None, *, kind: str = "layernorm",
 # flash_decode: q [B, H, Dh] x cache [B, Hkv, Smax, Dh] -> [B, H, Dh]
 # ---------------------------------------------------------------------------
 
-def _flash_decode_ref(q, kcache, vcache, pos, *, scale):
+def _flash_decode_ref(q, kcache, vcache, pos, *, scale, alibi=False):
     """Masked dense attention over the whole cache (parity target)."""
     B, H, Dh = q.shape
     Hkv, Smax = kcache.shape[1], kcache.shape[2]
@@ -157,15 +157,23 @@ def _flash_decode_ref(q, kcache, vcache, pos, *, scale):
     kf = kcache.astype(jnp.float32)
     vf = vcache.astype(jnp.float32)
     s = jnp.einsum("bgrd,bgkd->bgrk", qf, kf) * scale
-    mask = jnp.arange(Smax) <= pos
+    key_pos = jnp.arange(Smax)
+    if alibi:
+        from deepspeed_tpu.models.layers import alibi_slopes
+
+        rel = (key_pos - pos).astype(jnp.float32)
+        s = s + (alibi_slopes(H).reshape(1, Hkv, rep, 1)
+                 * rel[None, None, None, :])
+    mask = key_pos <= pos
     s = jnp.where(mask[None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bgrk,bgkd->bgrd", p, vf)
     return o.reshape(B, H, Dh).astype(q.dtype)
 
 
-def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, scale, block, nb, rep):
+def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, slope_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, scale, block, nb, rep,
+                         alibi):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -184,6 +192,8 @@ def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         key_pos = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if alibi:
+            s = s + slope_ref[0] * (key_pos - pos).astype(jnp.float32)
         s = jnp.where(key_pos <= pos, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -202,7 +212,7 @@ def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
                  block: int = 256, layer: Optional[int] = None,
-                 impl: Optional[str] = None):
+                 alibi: bool = False, impl: Optional[str] = None):
     """Single-launch decode attention.  q: [B, H, Dh]; caches:
     [B, Hkv, Smax, Dh] — or, with ``layer=l``, stacked [L, B, Hkv, Smax, Dh]
     read at static layer offset ``l`` through the index map (no cache slice
@@ -226,12 +236,19 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
     # non-tile-aligned block — route them to the dense reference, the same
     # policy the unfused decode uses for small caches
     if impl == "xla" or Smax % block:
-        return _flash_decode_ref(q, kc, vc, pos, scale=scale)
+        return _flash_decode_ref(q, kc, vc, pos, scale=scale, alibi=alibi)
     B, H, Dh = q.shape
     Hkv = kc.shape[1]
     rep = H // Hkv
     blk = block
     nb = Smax // blk
+    if alibi:
+        from deepspeed_tpu.models.layers import alibi_slopes
+
+        slopes = jnp.tile(alibi_slopes(H).reshape(Hkv, rep, 1),
+                          (B, 1, 1)).reshape(B * Hkv, rep, 1)
+    else:
+        slopes = jnp.zeros((B * Hkv, rep, 1), jnp.float32)
     BG = B * Hkv
     q4 = q.reshape(BG, rep, Dh)
     if layer is None:
@@ -242,7 +259,7 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
         v3 = vcache.reshape(vcache.shape[0] * BG, Smax, Dh)
     base = off * BG
     kernel = functools.partial(_flash_decode_kernel, scale=scale, block=blk,
-                               nb=nb, rep=rep)
+                               nb=nb, rep=rep, alibi=alibi)
     # index maps see scalar-prefetch refs AFTER the grid indices (the kernel
     # body sees them first)
     clamp = lambda b, j, pos_ref: (base + b,
@@ -252,7 +269,8 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
         grid=(BG, nb),
         in_specs=[pl.BlockSpec((1, rep, Dh), lambda b, j, pos_ref: (b, 0, 0)),
                   pl.BlockSpec((1, blk, Dh), clamp),
-                  pl.BlockSpec((1, blk, Dh), clamp)],
+                  pl.BlockSpec((1, blk, Dh), clamp),
+                  pl.BlockSpec((1, rep, 1), lambda b, j, pos_ref: (b, 0, 0))],
         out_specs=pl.BlockSpec((1, rep, Dh), lambda b, j, pos_ref: (b, 0, 0)),
         scratch_shapes=[pltpu.VMEM((rep, 1), jnp.float32),
                         pltpu.VMEM((rep, 1), jnp.float32),
@@ -262,7 +280,7 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((BG, rep, Dh), q.dtype),
         interpret=interpret_flag(impl),
-    )(pos.reshape(1), q4, k3, v3)
+    )(pos.reshape(1), q4, k3, v3, slopes)
     return o.reshape(B, H, Dh)
 
 

@@ -75,8 +75,9 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, slope_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, alibi, block_q,
+                block_k, nk):
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -104,9 +105,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0]  # [BK, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-        if causal:
+        if causal or alibi:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if alibi:
+            s = s + slope_ref[0, 0] * (cols - rows).astype(jnp.float32)
+        if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_scr[:]                              # [BQ, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -130,7 +134,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_scr[:] + jnp.log(safe_l)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _head_slopes(B: int, H: int, alibi: bool):
+    """[B*H, 1] per-grid-row ALiBi slopes (zeros when off — the argument
+    shape must be static for the shared kernel signature)."""
+    if not alibi:
+        return jnp.zeros((B * H, 1), jnp.float32)
+    from deepspeed_tpu.models.layers import alibi_slopes
+
+    return jnp.tile(alibi_slopes(H), B).reshape(B * H, 1)
+
+
+def _flash_fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     bq = pick_block(S, block_q, minimum=8)
@@ -141,13 +155,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     k3 = k.reshape(BH, Sk, D)
     v3 = v.reshape(BH, Sk, D)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, nk=nk)
+                               alibi=alibi, block_q=bq, block_k=bk, nk=nk)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
         in_specs=[pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
                   pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-                  pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))],
+                  pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+                  pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))],
         out_specs=[pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -156,7 +171,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(q3, k3, v3, _head_slopes(B, H, alibi))
     return o.reshape(B, H, S, D), lse.reshape(B, H, S)
 
 
@@ -169,8 +184,9 @@ def _col(x_ref):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, nk):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slope_ref,
+                   dq_ref, dq_scr, *, scale, causal, alibi, block_q, block_k,
+                   nk):
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -193,9 +209,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or alibi:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if alibi:
+            s = s + slope_ref[0, 0] * (cols - rows).astype(jnp.float32)
+        if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -210,8 +229,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, nq):
+                    slope_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                    causal, alibi, block_q, block_k, nq):
     qb = pl.program_id(2)
 
     @pl.when(qb == 0)
@@ -236,9 +255,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or alibi:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if alibi:
+            s = s + slope_ref[0, 0] * (cols - rows).astype(jnp.float32)
+        if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                                     # [BQ, BK]
         dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
@@ -256,7 +278,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, causal, scale, block_q, block_k, interpret):
+def _flash_bwd(res, g, causal, alibi, scale, block_q, block_k, interpret):
     q, k, v, o, lse = res
     B, H, S, D = q.shape
     Sk = k.shape[2]
@@ -269,9 +291,11 @@ def _flash_bwd(res, g, causal, scale, block_q, block_k, interpret):
     do3 = g.reshape(BH, S, D)
     lse3 = lse.reshape(BH, S, 1)
     delta3 = delta.reshape(BH, S, 1)
+    slopes = _head_slopes(B, H, alibi)
+    slope_spec = pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                                  block_q=bq, block_k=bk, nk=nk)
+                                  alibi=alibi, block_q=bq, block_k=bk, nk=nk)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, nq, nk),
@@ -280,15 +304,16 @@ def _flash_bwd(res, g, causal, scale, block_q, block_k, interpret):
                   pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
                   pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
                   pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-                  pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))],
+                  pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+                  slope_spec],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(q3, k3, v3, do3, lse3, delta3, slopes)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                                   block_q=bq, block_k=bk, nq=nq)
+                                   alibi=alibi, block_q=bq, block_k=bk, nq=nq)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, nk, nq),
@@ -297,7 +322,8 @@ def _flash_bwd(res, g, causal, scale, block_q, block_k, interpret):
                   pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
                   pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
                   pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-                  pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))],
+                  pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+                  pl.BlockSpec((1, 1), lambda b, j, i: (b, 0))],
         out_specs=[pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
                    pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
@@ -305,7 +331,7 @@ def _flash_bwd(res, g, causal, scale, block_q, block_k, interpret):
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(q3, k3, v3, do3, lse3, delta3, slopes)
     return (dq.reshape(B, H, S, D), dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D))
 
 
@@ -313,38 +339,54 @@ def _flash_bwd(res, g, causal, scale, block_q, block_k, interpret):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _alibi_ref_bias(q, alibi):
+    if not alibi:
+        return None
+    from deepspeed_tpu.models.layers import alibi_bias
+
+    H, S, Sk = q.shape[1], q.shape[2], q.shape[2]
+    pos = jnp.arange(S)
+    return alibi_bias(H, pos, pos)[None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
-                    impl: Optional[str] = None):
-    """Memory-efficient attention.  q/k/v: [B, H, S, D] -> [B, H, S, D]."""
-    out, _ = _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, impl)
+                    impl: Optional[str] = None, alibi: bool = False):
+    """Memory-efficient attention.  q/k/v: [B, H, S, D] -> [B, H, S, D].
+
+    ``alibi=True`` adds the per-head linear position bias in-kernel
+    (slopes derived from H; reference ``(R) softmax.cu`` alibi mask path)."""
+    out, _ = _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, impl, alibi)
     return out
 
 
-def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, impl):
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, impl, alibi=False):
     impl = resolve_impl(impl)
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if impl == "xla":
-        out = mha_reference(q, k, v, causal=causal, sm_scale=scale)
+        out = mha_reference(q, k, v, causal=causal, sm_scale=scale,
+                            bias=_alibi_ref_bias(q, alibi))
         return out, (q, k, v, out, None)
-    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret_flag(impl))
+    o, lse = _flash_fwd(q, k, v, causal, alibi, scale, block_q, block_k,
+                        interpret_flag(impl))
     return o, (q, k, v, o, lse)
 
 
-def _fa_bwd(causal, sm_scale, block_q, block_k, impl, res, g):
+def _fa_bwd(causal, sm_scale, block_q, block_k, impl, alibi, res, g):
     impl = resolve_impl(impl)
     q, k, v, o, lse = res
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if impl == "xla" or lse is None:
         # jnp autodiff of the reference
         def f(q_, k_, v_):
-            return mha_reference(q_, k_, v_, causal=causal, sm_scale=scale)
+            return mha_reference(q_, k_, v_, causal=causal, sm_scale=scale,
+                                 bias=_alibi_ref_bias(q_, alibi))
 
         _, vjp = jax.vjp(f, q, k, v)
         return vjp(g)
-    return _flash_bwd((q, k, v, o, lse), g, causal, scale, block_q, block_k,
-                      interpret_flag(impl))
+    return _flash_bwd((q, k, v, o, lse), g, causal, alibi, scale, block_q,
+                      block_k, interpret_flag(impl))
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

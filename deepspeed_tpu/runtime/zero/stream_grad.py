@@ -60,6 +60,8 @@ class StreamedFwdBwd:
         head_specs = {"final_norm": specs["final_norm"],
                       "head": (specs["embed"]["tok"] if segments["tied"]
                                else specs["lm_head"])}
+        if "lm_head_bias" in specs:
+            head_specs["head_bias"] = specs["lm_head_bias"]
         return cls(segments, gas=gas,
                    layer_shardings=shardings_from_pspecs(layer_specs, mesh),
                    embed_shardings=shardings_from_pspecs(specs["embed"], mesh),
@@ -206,9 +208,10 @@ class StreamedFwdBwd:
         # ---- head: loss + first cotangent ----------------------------
         head_np = (np_params["embed"]["tok"] if self.tied
                    else np_params["lm_head"])
-        head_tree = jax.device_put(
-            {"final_norm": np_params["final_norm"], "head": head_np},
-            self._head_sh)
+        ht = {"final_norm": np_params["final_norm"], "head": head_np}
+        if "lm_head_bias" in np_params:
+            ht["head_bias"] = np_params["lm_head_bias"]
+        head_tree = jax.device_put(ht, self._head_sh)
         if "head_vag" not in self.probes:
             self.probes["head_vag"] = (
                 self._head_vag,
@@ -223,6 +226,8 @@ class StreamedFwdBwd:
             self._acc(acc_tree["embed"]["tok"], g_head["head"])
         else:
             self._acc(acc_tree["lm_head"], g_head["head"])
+        if "head_bias" in g_head:
+            self._acc(acc_tree["lm_head_bias"], g_head["head_bias"])
         del g_head
 
         if self.moe_coef:

@@ -49,6 +49,17 @@ def _save_tiny(tmp_path, kind: str) -> str:
             use_parallel_residual=True, tie_word_embeddings=False,
             hidden_dropout=0.0, attention_dropout=0.0)
         model = transformers.GPTNeoXForCausalLM(cfg)
+    elif kind == "bloom":
+        cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        model = transformers.BloomForCausalLM(cfg)
+    elif kind == "gptj":
+        cfg = transformers.GPTJConfig(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            rotary_dim=4, n_inner=64, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0, tie_word_embeddings=False)
+        model = transformers.GPTJForCausalLM(cfg)
     else:
         cfg = transformers.MixtralConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -57,6 +68,13 @@ def _save_tiny(tmp_path, kind: str) -> str:
             max_position_embeddings=64, tie_word_embeddings=False)
         model = transformers.MixtralForCausalLM(cfg)
     model.eval()
+    # HF _init_weights zeroes every Linear bias, which would make the
+    # bias-plumbing paths (gpt-j mlp/lm-head bias, qkv bias, bloom biases)
+    # vacuously "pass" even if a mapped bias were dropped — perturb them
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if name.endswith(".bias") and p.abs().sum() == 0:
+                p.add_(torch.randn_like(p) * 0.05)
     model.save_pretrained(out, safe_serialization=True)
     return out
 
@@ -68,7 +86,8 @@ def _hf_logits(path: str, toks: np.ndarray) -> np.ndarray:
         return model(torch.tensor(toks)).logits.numpy()
 
 
-@pytest.mark.parametrize("kind", ["gpt2", "llama", "opt", "qwen2", "gpt_neox"])
+@pytest.mark.parametrize("kind", ["gpt2", "llama", "opt", "qwen2",
+                                  "gpt_neox", "bloom", "gptj"])
 def test_logits_parity(tmp_path, kind, mesh8):
     path = _save_tiny(tmp_path, kind)
     assert is_hf_checkpoint(path)
@@ -109,7 +128,8 @@ def test_inference_engine_loads_hf(tmp_path, mesh8):
     assert out.shape == (1, 7)
 
 
-@pytest.mark.parametrize("kind", ["gpt_neox", "qwen2", "opt"])
+@pytest.mark.parametrize("kind", ["gpt_neox", "qwen2", "opt", "bloom",
+                                  "gptj"])
 def test_generate_parity(tmp_path, kind, mesh8):
     """The DECODE path re-implements the layer math (decoding.py), so the
     parallel-residual + partial-rope + bias branches need their own parity
@@ -125,8 +145,13 @@ def test_generate_parity(tmp_path, kind, mesh8):
     # NOT equivalent — it bans eos and changes the greedy argmax
     model_hf.generation_config.eos_token_id = None
     with torch.no_grad():
-        want = model_hf.generate(torch.tensor(toks), max_new_tokens=6,
-                                 do_sample=False).numpy()
+        # explicit full mask: generate() otherwise auto-masks prompt tokens
+        # equal to pad_token_id (OPT's pad is id 1, which the prompt holds),
+        # silently diverging from the plain-forward semantics we compare to
+        want = model_hf.generate(torch.tensor(toks),
+                                 attention_mask=torch.ones(toks.shape,
+                                                           dtype=torch.long),
+                                 max_new_tokens=6, do_sample=False).numpy()
     model, params = causal_lm_from_hf(path, mesh=mesh8)
     model.config.remat = False
     engine = deepspeed_tpu.init_inference(

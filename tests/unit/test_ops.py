@@ -135,6 +135,41 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out1[:, :, :S // 2]),
                                    np.asarray(out2[:, :, :S // 2]), rtol=1e-5, atol=1e-6)
 
+    def test_alibi_forward_parity(self):
+        """ALiBi in-kernel bias == jnp reference with the explicit bias
+        tensor (VERDICT r4 item 3: alibi in the flash kernels)."""
+        from deepspeed_tpu.models.layers import alibi_bias
+
+        B, H, S, D = 2, 6, 128, 32   # 6 heads: non-power-of-2 slope path
+        q, k, v = (rand(B, H, S, D, seed=i) for i in range(3))
+        pos = jnp.arange(S)
+        bias = alibi_bias(H, pos, pos)[None]
+        ref = mha_reference(q, k, v, causal=True, bias=bias)
+        out = flash_attention(q, k, v, True, None, 64, 64, "interpret", True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_alibi_backward_parity(self):
+        from deepspeed_tpu.models.layers import alibi_bias
+
+        B, H, S, D = 1, 4, 128, 32
+        q, k, v = (rand(B, H, S, D, seed=i + 20) for i in range(3))
+        pos = jnp.arange(S)
+        bias = alibi_bias(H, pos, pos)[None]
+
+        def loss_pallas(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, None, 64, 64,
+                                           "interpret", True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=True, bias=bias) ** 2)
+
+        got = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+        ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=5e-3, atol=5e-4)
+
 
 class TestSoftmax:
     def test_parity_with_mask(self):
