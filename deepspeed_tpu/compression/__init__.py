@@ -3,5 +3,6 @@ layer reduction, weight quantization (QAT + int8 export), pruning — as
 param-tree transforms over the functional models."""
 
 from deepspeed_tpu.compression.compress import (  # noqa: F401
-    CompressedParams, fake_quantize, init_compression, magnitude_mask,
-    quantize_weights, redundancy_clean, reduce_layers, row_mask)
+    CompressedParams, CompressionScheduler, fake_quantize,
+    head_pruning_masks, init_compression, magnitude_mask, quantize_weights,
+    redundancy_clean, reduce_layers, row_mask, row_pruning_masks)

@@ -52,6 +52,8 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    moe_drop_tokens: bool = True       # False -> capacity covers every token
+    moe_use_rts: bool = False          # random token selection for capacity
     # "scatter": O(N·k·D) scatter/gather dispatch (default);
     # "einsum": GShard one-hot [N,E,C] einsums (O(N²·k/E), parity reference)
     moe_dispatch: str = "scatter"

@@ -243,7 +243,7 @@ class CausalLM:
         h = norm(x, lp["mlp_norm"], cfg.norm, cfg.norm_eps)
         if cfg.is_moe:
             from deepspeed_tpu.moe.sharded_moe import moe_mlp
-            mlp_out, aux = moe_mlp(lp["mlp"], h, cfg, mesh)
+            mlp_out, aux = moe_mlp(lp["mlp"], h, cfg, mesh, rng=k_mlp)
         else:
             act = activation_fn(cfg.activation)
             m = lp["mlp"]

@@ -32,7 +32,8 @@ class MoE:
                  intermediate_size: Optional[int] = None, ep_size: int = 1,
                  capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
                  min_capacity: int = 4, activation: str = "silu", glu: bool = True,
-                 use_residual: bool = False, mesh=None):
+                 use_residual: bool = False, drop_tokens: bool = True,
+                 use_rts: bool = False, mesh=None):
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         self.mesh = mesh
@@ -43,7 +44,8 @@ class MoE:
             num_experts=num_experts, num_experts_per_tok=k,
             moe_capacity_factor=capacity_factor,
             moe_eval_capacity_factor=eval_capacity_factor,
-            moe_min_capacity=min_capacity, activation=activation, glu=glu)
+            moe_min_capacity=min_capacity, activation=activation, glu=glu,
+            moe_drop_tokens=drop_tokens, moe_use_rts=use_rts)
         self.intermediate_size = intermediate_size or 4 * hidden_size
         self.use_residual = use_residual
 
@@ -64,14 +66,15 @@ class MoE:
             params["res_coef"] = jnp.zeros((D, 2), jnp.float32)
         return params
 
-    def apply(self, params, x, training: bool = True):
+    def apply(self, params, x, training: bool = True, rng=None):
         """x: [B, S, D] -> (y, aux_loss).  ``training`` selects
-        capacity_factor vs eval_capacity_factor (reference TopKGate arg).
+        capacity_factor vs eval_capacity_factor (reference TopKGate arg);
+        ``rng`` feeds random token selection when ``use_rts``.
         (Reference MoE.forward also returns exp_counts, a profiling detail.)"""
         cfg = self.cfg
         factor = cfg.moe_capacity_factor if training else cfg.moe_eval_capacity_factor
         eff = SimpleNamespace(**{**vars(cfg), "moe_capacity_factor": factor})
-        y, aux = moe_mlp(params, x, eff, self.mesh)
+        y, aux = moe_mlp(params, x, eff, self.mesh, rng=rng)
         if self.use_residual:
             from deepspeed_tpu.models.layers import activation_fn
             act = activation_fn(cfg.activation)

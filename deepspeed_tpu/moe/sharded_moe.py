@@ -40,11 +40,23 @@ def compute_capacity(num_tokens: int, num_experts: int, k: int,
                int(math.ceil(k * num_tokens / num_experts * capacity_factor)))
 
 
-def topk_assignments(gates, k: int, capacity: int):
+def topk_assignments(gates, k: int, capacity: int, rng=None,
+                     use_rts: bool = False):
     """Compact top-k assignment: (expert_idx [N,k], pos [N,k], weight [N,k],
     aux scalar).  Same gating math as :func:`topk_gating` but without the
     [N, E, C] one-hot tensors — feeds the O(N·k·D) scatter/gather dispatch
-    (VERDICT r2 weak #9: the one-hot dispatch einsum is O(N²·k/E))."""
+    (VERDICT r2 weak #9: the one-hot dispatch einsum is O(N²·k/E)).
+
+    ``use_rts`` (reference ``top1gating(use_rts=True)`` Random Token
+    Selection): capacity slots are granted in a RANDOM token order instead
+    of sequence order, so truncation under overflow doesn't systematically
+    drop late-sequence tokens.  A no-op when nothing overflows."""
+    if use_rts and rng is not None:
+        N = gates.shape[0]
+        perm = jax.random.permutation(rng, N)
+        inv = jnp.argsort(perm)
+        e_idx, pos, w, aux = topk_assignments(gates[perm], k, capacity)
+        return e_idx[inv], pos[inv], w[inv], aux
     N, E = gates.shape
     C = capacity
     remaining = gates
@@ -75,13 +87,22 @@ def topk_assignments(gates, k: int, capacity: int):
     return (jnp.stack(idxs, axis=1), jnp.stack(poss, axis=1), weight, aux)
 
 
-def topk_gating(gates, k: int, capacity: int):
+def topk_gating(gates, k: int, capacity: int, rng=None,
+                use_rts: bool = False):
     """GShard top-k gating with fixed capacity.
 
     gates: [N, E] softmax router probabilities (fp32).
     Returns (combine [N, E, C], dispatch [N, E, C] bool, aux_loss scalar).
-    Reference: ``top1gating``/``top2gating`` in deepspeed/moe/sharded_moe.py.
+    Reference: ``top1gating``/``top2gating`` in deepspeed/moe/sharded_moe.py;
+    ``use_rts`` = the reference's Random Token Selection (see
+    :func:`topk_assignments`).
     """
+    if use_rts and rng is not None:
+        N = gates.shape[0]
+        perm = jax.random.permutation(rng, N)
+        inv = jnp.argsort(perm)
+        combine, dispatch, aux = topk_gating(gates[perm], k, capacity)
+        return combine[inv], dispatch[inv], aux
     N, E = gates.shape
     C = capacity
     remaining = gates
@@ -118,12 +139,20 @@ def topk_gating(gates, k: int, capacity: int):
     return combine, dispatch, aux
 
 
-def moe_mlp(params, x, cfg, mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def moe_mlp(params, x, cfg, mesh=None, rng=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One MoE feed-forward block on [B, S, D] hidden states.
 
     ``params``: {"gate_w" [D, E], "w_up" [E, D, F], ("w_gate" [E, D, F]),
     "w_down" [E, F, D]} — the per-layer slice of the model's stacked MoE
     weights.  Returns (output [B, S, D], aux_loss scalar).
+
+    ``cfg.moe_drop_tokens=False`` (reference ``drop_tokens=False``): the
+    capacity covers the worst-case expert load (C = N — XLA's static shapes
+    forbid the reference's runtime max-load capacity), so no token is ever
+    dropped.  ``cfg.moe_use_rts``: Random Token Selection for capacity
+    slots; the permutation key is ``rng`` (the layer's dropout key when the
+    model has one) or, failing that, derived from the batch content so it
+    still varies across batches inside one compiled step.
     """
     B, S, D = x.shape
     E, k = cfg.num_experts, cfg.num_experts_per_tok
@@ -132,13 +161,23 @@ def moe_mlp(params, x, cfg, mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
     logits = xt.astype(jnp.float32) @ params["gate_w"].astype(jnp.float32)
     gates = jax.nn.softmax(logits, axis=-1)
-    C = compute_capacity(N, E, k, cfg.moe_capacity_factor,
-                         getattr(cfg, "moe_min_capacity", 4))
+    drop = getattr(cfg, "moe_drop_tokens", True)
+    use_rts = bool(getattr(cfg, "moe_use_rts", False))
+    if use_rts and rng is None:
+        seed = jax.lax.bitcast_convert_type(
+            xt.astype(jnp.float32).sum(), jnp.int32)
+        rng = jax.random.fold_in(jax.random.PRNGKey(17), seed)
+    if drop:
+        C = compute_capacity(N, E, k, cfg.moe_capacity_factor,
+                             getattr(cfg, "moe_min_capacity", 4))
+    else:
+        C = N  # worst case: every token routed to the same expert
     use_scatter = getattr(cfg, "moe_dispatch", "scatter") == "scatter"
     if use_scatter:
         # O(N·k·D) scatter dispatch / gather combine (VERDICT r2 weak #9):
         # the [N, E, C] one-hot einsum is O(N²·k/E) because C ~ k·N/E.
-        e_idx, pos, weight, aux = topk_assignments(gates, k, C)   # [N, k]
+        e_idx, pos, weight, aux = topk_assignments(gates, k, C, rng,
+                                                   use_rts)     # [N, k]
         keep = pos < C
         safe_pos = jnp.clip(pos, 0, C - 1)
         contrib = jnp.where(keep.reshape(-1)[:, None],
@@ -146,7 +185,7 @@ def moe_mlp(params, x, cfg, mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
         expert_in = jnp.zeros((E, C, D), x.dtype).at[
             e_idx.reshape(-1), safe_pos.reshape(-1)].add(contrib)
     else:
-        combine, dispatch, aux = topk_gating(gates, k, C)
+        combine, dispatch, aux = topk_gating(gates, k, C, rng, use_rts)
         # dispatch: tokens (sharded over data axes) -> expert buffers
         # (sharded over ep) — GSPMD inserts the all-to-all here
         # (reference: _AllToAll).

@@ -339,14 +339,15 @@ def _flash_bwd(res, g, causal, alibi, scale, block_q, block_k, interpret):
 # public API
 # ---------------------------------------------------------------------------
 
-def _alibi_ref_bias(q, alibi):
+def _alibi_ref_bias(q, k, alibi):
     if not alibi:
         return None
     from deepspeed_tpu.models.layers import alibi_bias
 
-    H, S, Sk = q.shape[1], q.shape[2], q.shape[2]
-    pos = jnp.arange(S)
-    return alibi_bias(H, pos, pos)[None]
+    H, S, Sk = q.shape[1], q.shape[2], k.shape[2]
+    # cross-length calls: query i sits at absolute position i + (Sk - S),
+    # matching mha_reference's offset causal mask convention
+    return alibi_bias(H, jnp.arange(S) + (Sk - S), jnp.arange(Sk))[None]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -366,7 +367,7 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, impl, alibi=False):
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if impl == "xla":
         out = mha_reference(q, k, v, causal=causal, sm_scale=scale,
-                            bias=_alibi_ref_bias(q, alibi))
+                            bias=_alibi_ref_bias(q, k, alibi))
         return out, (q, k, v, out, None)
     o, lse = _flash_fwd(q, k, v, causal, alibi, scale, block_q, block_k,
                         interpret_flag(impl))
@@ -381,7 +382,7 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, impl, alibi, res, g):
         # jnp autodiff of the reference
         def f(q_, k_, v_):
             return mha_reference(q_, k_, v_, causal=causal, sm_scale=scale,
-                                 bias=_alibi_ref_bias(q_, alibi))
+                                 bias=_alibi_ref_bias(q_, k_, alibi))
 
         _, vjp = jax.vjp(f, q, k, v)
         return vjp(g)

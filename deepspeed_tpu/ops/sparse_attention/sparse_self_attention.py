@@ -21,12 +21,18 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5))
-def _bsa(q, k, v, gather_idx, block: int, causal: bool):
+@functools.partial(jax.jit, static_argnums=(4, 5, 9, 10))
+def _bsa(q, k, v, gather_idx, block: int, causal: bool, rpe=None,
+         key_padding_mask=None, attn_mask=None,
+         key_padding_mask_mode: str = "add", attn_mask_mode: str = "mul"):
     """q/k/v: [B, H, S, D]; gather_idx: [H, nq, deg] int32 (padded with -1).
 
     Computes, per query block, attention over its ``deg`` gathered KV blocks.
-    """
+    Optional score modifiers (reference ``sparse_self_attention.py`` /
+    ``softmax.py`` semantics, applied pre-softmax on the gathered blocks):
+    ``rpe`` [H, S, S] or [S, S] additive relative-position bias;
+    ``key_padding_mask`` [B, S] over keys; ``attn_mask`` [S, S] — each mask
+    "add"ed to or "mul"tiplied into the scores per its mode."""
     B, H, S, D = q.shape
     nq = S // block
     deg = gather_idx.shape[-1]
@@ -48,12 +54,36 @@ def _bsa(q, k, v, gather_idx, block: int, causal: bool):
     # scores: [B, H, nq, block, deg, block]
     s = jnp.einsum("bhqid,bhqkjd->bhqikj", qb.astype(jnp.float32),
                    kg.astype(jnp.float32)) * scale
+    qpos = (jnp.arange(nq)[:, None] * block
+            + jnp.arange(block)[None, :])                         # [nq, block]
+    kpos = (idx[..., None] * block
+            + jnp.arange(block)[None, None, None])                # [H,nq,deg,block]
+    if rpe is not None:
+        rpe = jnp.asarray(rpe, jnp.float32)
+        if rpe.ndim == 2:
+            r = rpe[qpos[None, :, :, None, None], kpos[:, :, None, :, :]]
+        else:                                                     # [H, S, S]
+            r = rpe[jnp.arange(H)[:, None, None, None, None],
+                    qpos[None, :, :, None, None], kpos[:, :, None, :, :]]
+        s = s + r[None]                                           # bias is additive
+    if key_padding_mask is not None:
+        kpm = jnp.asarray(key_padding_mask, jnp.float32)
+        kg_mask = kpm[:, kpos]                     # [B, H, nq, deg, block]
+        kg_mask = kg_mask[:, :, :, None, :, :]     # broadcast over q rows
+        if key_padding_mask_mode == "add":
+            s = s + kg_mask
+        else:
+            s = s * kg_mask
+    if attn_mask is not None:
+        am = jnp.asarray(attn_mask, jnp.float32)
+        amg = am[qpos[None, :, :, None, None],
+                 kpos[:, :, None, :, :]]           # [H, nq, block, deg, block]
+        if attn_mask_mode == "add":
+            s = s + amg[None]
+        else:
+            s = s * amg[None]
     s = jnp.where(valid[None, :, :, None, :, None], s, NEG_INF)
     if causal:
-        qpos = (jnp.arange(nq)[:, None] * block
-                + jnp.arange(block)[None, :])                     # [nq, block]
-        kpos = (idx[..., None] * block
-                + jnp.arange(block)[None, None, None])            # [H,nq,deg,block]
         mask = qpos[None, :, :, None, None] >= kpos[:, :, None, :, :]
         s = jnp.where(mask[None], s, NEG_INF)
     s_flat = s.reshape(B, H, nq, block, deg * block)
@@ -67,11 +97,16 @@ def _bsa(q, k, v, gather_idx, block: int, causal: bool):
 
 
 def block_sparse_attention(q, k, v, layout: np.ndarray, block: int,
-                           causal: bool = False):
+                           causal: bool = False, rpe=None,
+                           key_padding_mask=None, attn_mask=None,
+                           key_padding_mask_mode: str = "add",
+                           attn_mask_mode: str = "mul"):
     """Attention restricted to the layout's allowed blocks.
 
     layout: [H, nq, nk] (numpy, static).  Compute cost is
-    O(max_degree / nk) of dense attention.
+    O(max_degree / nk) of dense attention.  ``rpe`` /
+    ``key_padding_mask`` / ``attn_mask`` follow the reference's
+    pre-softmax add/mul semantics (see :func:`_bsa`).
     """
     H, nq, nk = layout.shape
     deg = max(1, int(layout.sum(axis=-1).max()))
@@ -80,7 +115,9 @@ def block_sparse_attention(q, k, v, layout: np.ndarray, block: int,
         for i in range(nq):
             cols = np.nonzero(layout[h, i])[0]
             gather[h, i, :len(cols)] = cols
-    return _bsa(q, k, v, jnp.asarray(gather), block, causal)
+    return _bsa(q, k, v, jnp.asarray(gather), block, causal, rpe,
+                key_padding_mask, attn_mask, key_padding_mask_mode,
+                attn_mask_mode)
 
 
 class SparseSelfAttention:
@@ -88,7 +125,15 @@ class SparseSelfAttention:
 
     def __init__(self, sparsity_config, key_padding_mask_mode: str = "add",
                  attn_mask_mode: str = "mul", max_seq_length: int = 2048):
+        if key_padding_mask_mode not in ("add", "mul"):
+            raise ValueError(f"key_padding_mask_mode must be 'add' or 'mul', "
+                             f"got {key_padding_mask_mode!r}")
+        if attn_mask_mode not in ("add", "mul"):
+            raise ValueError(f"attn_mask_mode must be 'add' or 'mul', got "
+                             f"{attn_mask_mode!r}")
         self.sparsity_config = sparsity_config
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
         self._layouts = {}
 
     def _layout(self, seq_len: int) -> np.ndarray:
@@ -98,13 +143,13 @@ class SparseSelfAttention:
 
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
                  attn_mask=None):
-        if rpe is not None or key_padding_mask is not None or attn_mask is not None:
-            raise NotImplementedError(
-                "SparseSelfAttention: rpe/key_padding_mask/attn_mask are not "
-                "supported yet — silently ignoring them would change results")
         S = query.shape[-2]
         layout = self._layout(S)
         causal = getattr(self.sparsity_config, "attention",
                          "bidirectional") == "unidirectional"
-        return block_sparse_attention(query, key, value, layout,
-                                      self.sparsity_config.block, causal=causal)
+        return block_sparse_attention(
+            query, key, value, layout, self.sparsity_config.block,
+            causal=causal, rpe=rpe, key_padding_mask=key_padding_mask,
+            attn_mask=attn_mask,
+            key_padding_mask_mode=self.key_padding_mask_mode,
+            attn_mask_mode=self.attn_mask_mode)

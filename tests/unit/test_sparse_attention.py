@@ -89,3 +89,75 @@ def test_gradients_flow(rng):
     g = jax.grad(f)(q)
     assert np.isfinite(np.asarray(g)).all()
     assert float(jnp.abs(g).sum()) > 0
+
+
+def test_masks_and_rpe_match_dense(rng):
+    """VERDICT r4 item 7: rpe / key_padding_mask / attn_mask on a dense
+    layout must reproduce plain softmax attention with the same score
+    modifiers, in every mode combination."""
+    from deepspeed_tpu.ops.sparse_attention import DenseSparsityConfig
+
+    B, H, S, D = 2, 2, 32, 8
+    q = jax.random.normal(rng, (B, H, S, D))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, H, S, D))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, H, S, D))
+    rpe = jax.random.normal(jax.random.fold_in(rng, 3), (H, S, S)) * 0.3
+    kpm_add = jnp.where(jnp.arange(S) >= S - 4, -1e9, 0.0)[None, :].repeat(B, 0)
+    kpm_mul = jnp.where(jnp.arange(S) >= S - 4, 0.0, 1.0)[None, :].repeat(B, 0)
+    am_add = jnp.triu(jnp.full((S, S), -1e9), k=1)        # causal via mask
+    am_mul = jnp.tril(jnp.ones((S, S)))
+
+    def dense(q, k, v, rpe=None, kpm=None, am=None, kpm_mode="add",
+              am_mode="mul"):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (D ** 0.5)
+        if rpe is not None:
+            s = s + rpe[None]
+        if kpm is not None:
+            s = (s + kpm[:, None, None, :] if kpm_mode == "add"
+                 else s * kpm[:, None, None, :])
+        if am is not None:
+            s = s + am[None, None] if am_mode == "add" else s * am[None, None]
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    cfg = DenseSparsityConfig(num_heads=H, block=8)
+    for kwargs, dkw in (
+        (dict(rpe=rpe), dict(rpe=rpe)),
+        (dict(key_padding_mask=kpm_add), dict(kpm=kpm_add)),
+        (dict(attn_mask=am_add), dict(am=am_add, am_mode="add")),
+        (dict(rpe=rpe, key_padding_mask=kpm_add, attn_mask=am_add),
+         dict(rpe=rpe, kpm=kpm_add, am=am_add, am_mode="add")),
+    ):
+        attn = SparseSelfAttention(
+            cfg, attn_mask_mode="add" if "attn_mask" in kwargs else "mul")
+        got = attn(q, k, v, **kwargs)
+        want = dense(q, k, v, **dkw)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5, err_msg=str(kwargs))
+    # mul modes: the reference multiplies raw scores (NOT a masked softmax);
+    # parity against the same literal semantics
+    attn = SparseSelfAttention(cfg, key_padding_mask_mode="mul",
+                               attn_mask_mode="mul")
+    got = attn(q, k, v, key_padding_mask=kpm_mul, attn_mask=am_mul)
+    want = dense(q, k, v, kpm=kpm_mul, am=am_mul, kpm_mode="mul",
+                 am_mode="mul")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_key_padding_isolates_padded_keys(rng):
+    """-inf key padding on a SPARSE layout: changing padded K/V content
+    must not change any output row."""
+    B, H, S, D = 1, 2, 64, 8
+    q = jax.random.normal(rng, (B, H, S, D))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, H, S, D))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, H, S, D))
+    cfg = FixedSparsityConfig(num_heads=H, block=8, num_local_blocks=2,
+                              num_global_blocks=1)
+    kpm = jnp.where(jnp.arange(S) >= S - 8, -1e9, 0.0)[None, :]
+    attn = SparseSelfAttention(cfg)
+    out1 = attn(q, k, v, key_padding_mask=kpm)
+    k2 = k.at[:, :, S - 8:, :].set(99.0)
+    v2 = v.at[:, :, S - 8:, :].set(-99.0)
+    out2 = attn(q, k2, v2, key_padding_mask=kpm)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-5, atol=1e-6)
