@@ -233,6 +233,12 @@ class CompressedParams:
             self.structured_masks["attn"] = head_pruning_masks(
                 ly["attn"], self.num_heads, self.cfg.hp_density)
         if self.cfg.rp_enabled and "mlp" in ly and "w_up" in ly["mlp"]:
+            if getattr(ly["mlp"]["w_up"], "ndim", 3) != 3:
+                raise ValueError(
+                    "row/channel pruning supports dense MLPs only (stacked "
+                    "[L, D, F] w_up); this tree's w_up has shape "
+                    f"{ly['mlp']['w_up'].shape} (MoE experts — prune via "
+                    "expert dropping instead)")
             self.structured_masks["mlp"] = row_pruning_masks(
                 ly["mlp"], self.cfg.rp_density)
 
@@ -313,6 +319,12 @@ class CompressionScheduler:
             return None
         ly = params.get("layers") if isinstance(params, dict) else None
         if ly is None:
+            if not getattr(self, "_warned_no_layers", False):
+                self._warned_no_layers = True
+                logger.warning(
+                    "compression scheduler: param tree has no 'layers' "
+                    "stack — pruning is configured but will NOT run for "
+                    "this model")
             return None
         comp = self.comp
         # masks snapshot from the CURRENT weights at first activation
@@ -360,6 +372,14 @@ def redundancy_clean(model, deepspeed_config: Dict[str, Any], params=None):
                               None))
     if params is None:
         return model
-    if comp.cfg.any_pruning and not (comp.masks or comp.structured_masks):
-        comp.init_masks(params)   # covers sparse AND structured masks
+    # per-method init: one method's masks existing (e.g. the scheduler built
+    # sparse masks mid-training) must not skip another's
+    if comp.cfg.sp_enabled and not comp.masks:
+        comp.masks = jax.tree.map(
+            lambda w: magnitude_mask(w, comp.cfg.sp_density)
+            if getattr(w, "ndim", 0) >= 2 else jnp.ones_like(w),
+            params["layers"])
+    if ((comp.cfg.hp_enabled or comp.cfg.rp_enabled)
+            and not comp.structured_masks):
+        comp.init_structured_masks(params)
     return comp.apply(params)

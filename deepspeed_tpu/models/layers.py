@@ -38,10 +38,18 @@ def constrain(x, mesh: Optional[Mesh], *spec):
 
     Axis names absent from ``mesh`` are dropped, so the built-in models'
     (dp/fsdp/tp/sp/ep) constraints degrade gracefully on custom meshes.
+    Axes that are MANUAL in the current trace context (the model running
+    inside a shard_map region, e.g. the ZeRO++ or 1-bit paths) are dropped
+    too — with_sharding_constraint rejects manual axes, and the data is
+    already device-local there.
     """
     if mesh is None or mesh.empty:
         return x
-    names = set(mesh.axis_names)
+    am = jax.sharding.get_abstract_mesh()
+    manual = set(getattr(am, "manual_axes", ()) or ())
+    names = set(mesh.axis_names) - manual
+    if not names:
+        return x  # fully-manual region: nothing left to constrain
 
     def keep(entry, dim_size):
         if entry is None:

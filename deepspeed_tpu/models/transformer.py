@@ -243,7 +243,12 @@ class CausalLM:
         h = norm(x, lp["mlp_norm"], cfg.norm, cfg.norm_eps)
         if cfg.is_moe:
             from deepspeed_tpu.moe.sharded_moe import moe_mlp
-            mlp_out, aux = moe_mlp(lp["mlp"], h, cfg, mesh, rng=k_mlp)
+            # split: the RTS permutation and the dropout mask below must not
+            # consume the same key
+            k_rts = None
+            if k_mlp is not None:
+                k_rts, k_mlp = jax.random.split(k_mlp)
+            mlp_out, aux = moe_mlp(lp["mlp"], h, cfg, mesh, rng=k_rts)
         else:
             act = activation_fn(cfg.activation)
             m = lp["mlp"]
