@@ -214,11 +214,15 @@ class CompressedParams:
 
     def init_masks(self, params) -> None:
         if self.cfg.sp_enabled:
-            self.masks = jax.tree.map(
-                lambda w: magnitude_mask(w, self.cfg.sp_density)
-                if getattr(w, "ndim", 0) >= 2 else jnp.ones_like(w),
-                params["layers"])
+            self.init_sparse_masks(params.get("layers", {}))
         self.init_structured_masks(params)
+
+    def init_sparse_masks(self, layers) -> None:
+        """Magnitude masks from the CURRENT weights (single construction
+        point for the scheduler, export, and init paths)."""
+        self.masks = jax.tree.map(
+            lambda w: magnitude_mask(w, self.cfg.sp_density)
+            if getattr(w, "ndim", 0) >= 2 else jnp.ones_like(w), layers)
 
     def init_structured_masks(self, params) -> None:
         """Head/row/channel masks on the stacked layer tree (built from the
@@ -329,9 +333,7 @@ class CompressionScheduler:
         comp = self.comp
         # masks snapshot from the CURRENT weights at first activation
         if act["sp"] and not comp.masks:
-            comp.masks = jax.tree.map(
-                lambda w: magnitude_mask(w, comp.cfg.sp_density)
-                if getattr(w, "ndim", 0) >= 2 else jnp.ones_like(w), ly)
+            comp.init_sparse_masks(ly)
         if (act["hp"] or act["rp"]) and not comp.structured_masks:
             comp.init_structured_masks(params)
         sp_m = comp.masks if act["sp"] else None
@@ -372,13 +374,15 @@ def redundancy_clean(model, deepspeed_config: Dict[str, Any], params=None):
                               None))
     if params is None:
         return model
+    if "layers" not in params:
+        if comp.cfg.any_pruning:
+            logger.warning("redundancy_clean: param tree has no 'layers' "
+                           "stack — pruning config ignored at export")
+        return comp.apply(params) if comp.cfg.wq_enabled else params
     # per-method init: one method's masks existing (e.g. the scheduler built
     # sparse masks mid-training) must not skip another's
     if comp.cfg.sp_enabled and not comp.masks:
-        comp.masks = jax.tree.map(
-            lambda w: magnitude_mask(w, comp.cfg.sp_density)
-            if getattr(w, "ndim", 0) >= 2 else jnp.ones_like(w),
-            params["layers"])
+        comp.init_sparse_masks(params["layers"])
     if ((comp.cfg.hp_enabled or comp.cfg.rp_enabled)
             and not comp.structured_masks):
         comp.init_structured_masks(params)

@@ -109,7 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slope_ref, o_ref, lse_ref,
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if alibi:
-            s = s + slope_ref[0, 0] * (cols - rows).astype(jnp.float32)
+            s = s + slope_ref[0, 0, 0] * (cols - rows).astype(jnp.float32)
         if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_scr[:]                              # [BQ, 1]
@@ -135,13 +135,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slope_ref, o_ref, lse_ref,
 
 
 def _head_slopes(B: int, H: int, alibi: bool):
-    """[B*H, 1] per-grid-row ALiBi slopes (zeros when off — the argument
-    shape must be static for the shared kernel signature)."""
+    """[B*H, 1, 1] per-grid-row ALiBi slopes (zeros when off — the argument
+    shape must be static for the shared kernel signature).  3-D so the
+    block's LAST TWO dims are full-size: Mosaic requires partial block dims
+    in the last two positions to be (8, 128)-tile aligned."""
     if not alibi:
-        return jnp.zeros((B * H, 1), jnp.float32)
+        return jnp.zeros((B * H, 1, 1), jnp.float32)
     from deepspeed_tpu.models.layers import alibi_slopes
 
-    return jnp.tile(alibi_slopes(H), B).reshape(B * H, 1)
+    return jnp.tile(alibi_slopes(H), B).reshape(B * H, 1, 1)
 
 
 def _flash_fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
@@ -162,7 +164,7 @@ def _flash_fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
         in_specs=[pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
                   pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
                   pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-                  pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))],
+                  pl.BlockSpec((1, 1, 1), lambda b, i, j: (b, 0, 0))],
         out_specs=[pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -213,7 +215,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slope_ref,
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if alibi:
-            s = s + slope_ref[0, 0] * (cols - rows).astype(jnp.float32)
+            s = s + slope_ref[0, 0, 0] * (cols - rows).astype(jnp.float32)
         if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -259,7 +261,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if alibi:
-            s = s + slope_ref[0, 0] * (cols - rows).astype(jnp.float32)
+            s = s + slope_ref[0, 0, 0] * (cols - rows).astype(jnp.float32)
         if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                                     # [BQ, BK]
@@ -292,7 +294,7 @@ def _flash_bwd(res, g, causal, alibi, scale, block_q, block_k, interpret):
     lse3 = lse.reshape(BH, S, 1)
     delta3 = delta.reshape(BH, S, 1)
     slopes = _head_slopes(B, H, alibi)
-    slope_spec = pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
+    slope_spec = pl.BlockSpec((1, 1, 1), lambda b, i, j: (b, 0, 0))
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                                   alibi=alibi, block_q=bq, block_k=bk, nk=nk)
@@ -323,7 +325,7 @@ def _flash_bwd(res, g, causal, alibi, scale, block_q, block_k, interpret):
                   pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
                   pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
                   pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-                  pl.BlockSpec((1, 1), lambda b, j, i: (b, 0))],
+                  pl.BlockSpec((1, 1, 1), lambda b, j, i: (b, 0, 0))],
         out_specs=[pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
                    pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
