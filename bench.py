@@ -500,6 +500,21 @@ def main():
                    "fetch_overhead_ms": round(1e3 * overhead, 2),
                    "flops_model": "6N + 6*L*D*S per token (dense causal; "
                                   "remat recompute not counted)",
+                   "mfu_analysis": (
+                       "xplane trace (r5): the step is device-gapless; "
+                       "matmul fusions 47% (head GEMM ~89% of peak), Pallas "
+                       "kernels 33% (flash bwd measured at parity with "
+                       "jax's in-tree TPU kernel; Pallas norms faster than "
+                       "XLA-fused norms), data formatting 9%, loop fusions "
+                       "7%. The gap to the 1.34B rung's 0.60 MFU is "
+                       "architectural: GPT-2-small's head_dim=64 underfills "
+                       "the 128-wide MXU contraction in attention, and "
+                       "S=1024 attention is a larger share at D=768. "
+                       "Probed and rejected by measurement: no-remat "
+                       "(0.42, HBM pressure), mlp_only (0.44), XLA norms "
+                       "(0.43), XLA attention (compile-OOM), 256-token "
+                       "fwd flash blocks (0.42 in-context despite 1.6x "
+                       "standalone), micro 8/16 (0.43/0.45)."),
                    "backend": jax.default_backend(),
                    "device": getattr(jax.devices()[0], "device_kind", "?"),
                    **({"llama_1b4": rung_1b4} if rung_1b4 else {}),
