@@ -236,6 +236,10 @@ def bench_decode(steps: int = 512) -> dict:
          {"dtype": "bfloat16", "use_fused_decode": False}),
         ("int8", "gpt2-small", {"vocab_size": 50304}, 1,
          {"dtype": "int8", "quantize_kv_cache": True}),
+        # int8 weights on the FUSED path (dequant in-kernel; bf16 KV) —
+        # halves the per-token weight reads of the kernel-injected decode
+        ("int8w_fused", "gpt2-small", {"vocab_size": 50304}, 1,
+         {"dtype": "int8"}),
         ("bf16_b8", "gpt2-small", {"vocab_size": 50304}, 8,
          {"dtype": "bfloat16"}),
         # >1B serving: 1.34B fits HBM as bf16 (2.7GB) with room for the
@@ -310,10 +314,11 @@ def bench_decode(steps: int = 512) -> dict:
                 import gc
 
                 gc.collect()
-    out["note"] = ("bf16/bf16_b8/llama1b4 run the kernel-injected fused "
-                   "Pallas decode (4 launches/layer); int8 runs the unfused "
-                   "fallback; steady_* differencing cancels the relay's "
-                   "fixed per-call cost (see bench_decode docstring)")
+    out["note"] = ("bf16/bf16_b8/int8w_fused/llama1b4 run the kernel-"
+                   "injected fused Pallas decode (4 launches/layer; "
+                   "int8w_fused dequantizes in-kernel); int8 (int8 KV) runs "
+                   "the unfused fallback; steady_* differencing cancels the "
+                   "relay's fixed per-call cost (see bench_decode docstring)")
     return out
 
 

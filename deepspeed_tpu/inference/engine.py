@@ -129,13 +129,13 @@ class InferenceEngine:
             return
         force = self._config.replace_with_kernel_inject
         ok = supports_fused_decode(
-            cfg, quantized_weights=self._int8_weights,
-            quantized_kv=self._config.quantize_kv_cache,
+            cfg, quantized_kv=self._config.quantize_kv_cache,
             tp=self.mesh.shape.get("tp", 1))
         if not ok:
             if force or self._config.use_fused_decode:
                 log_dist("kernel injection requested but unsupported for "
-                         "this model/config (MoE, int8, or tp>1): using the "
+                         "this model/config (MoE, int8 KV cache, or tp>1; "
+                         "int8 WEIGHTS alone are supported): using the "
                          "unfused decode path", ranks=[0])
             return
         # eager, not jitted: pass-through leaves (embed/final_norm/lm_head —

@@ -30,11 +30,12 @@ from deepspeed_tpu.ops.pallas.decode import (flash_decode, fused_mlp,
                                              fused_norm_qkv, fused_proj_norm)
 
 
-def supports_fused_decode(cfg, *, quantized_weights: bool = False,
-                          quantized_kv: bool = False, tp: int = 1) -> bool:
-    """The fused path covers the dense model zoo; MoE MLPs, int8 weights,
-    int8 KV caches, and tp>1 fall back to the reference-shaped loop."""
-    return (not cfg.is_moe and not quantized_weights and not quantized_kv
+def supports_fused_decode(cfg, *, quantized_kv: bool = False,
+                          tp: int = 1) -> bool:
+    """The fused path covers the dense model zoo including int8 weights
+    (dequant in-kernel); MoE MLPs, int8 KV caches, and tp>1 fall back to
+    the reference-shaped loop."""
+    return (not cfg.is_moe and not quantized_kv
             and tp == 1 and cfg.position in ("rope", "learned", "alibi"))
 
 
@@ -47,10 +48,19 @@ def inject_decode_params(params: Any, cfg) -> Dict[str, Any]:
     [L, ...] weight per layer inside the program re-materializes the
     slice (a full per-layer weight copy per token).  The QKV concat is the
     reference's fused-QKV injection transform."""
+    from deepspeed_tpu.models.quant import QTensor, is_qtensor
+
     ly = params["layers"]
     attn, mlp = ly["attn"], ly["mlp"]
+    if is_qtensor(attn["wq"]):  # int8 serving: concat payloads AND scales
+        wqkv = QTensor(
+            jnp.concatenate([attn["wq"].q, attn["wk"].q, attn["wv"].q], -1),
+            jnp.concatenate([attn["wq"].scale, attn["wk"].scale,
+                             attn["wv"].scale], -1))
+    else:
+        wqkv = jnp.concatenate([attn["wq"], attn["wk"], attn["wv"]], axis=-1)
     stacked: Dict[str, Any] = {
-        "wqkv": jnp.concatenate([attn["wq"], attn["wk"], attn["wv"]], axis=-1),
+        "wqkv": wqkv,
         "wo": attn["wo"],
         "n1_scale": ly["attn_norm"]["scale"],
         "n2_scale": ly["mlp_norm"]["scale"],
@@ -72,8 +82,13 @@ def inject_decode_params(params: Any, cfg) -> Dict[str, Any]:
             stacked["b_gate"] = mlp["b_gate"]
     if cfg.glu:
         stacked["w_gate"] = mlp["w_gate"]
+    def unstack(v, l):
+        if is_qtensor(v):
+            return QTensor(v.q[l], v.scale[l])
+        return v[l]
+
     layers = tuple(
-        {k: v[l] for k, v in stacked.items()}
+        {k: unstack(v, l) for k, v in stacked.items()}
         for l in range(cfg.num_layers))
     out = {"embed": params["embed"], "final_norm": params["final_norm"],
            "layers": layers}
@@ -135,10 +150,19 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
     # materializes either.
     kc_all, vc_all = cache["k"], cache["v"]
     pos0 = jnp.zeros((), jnp.int32)
+    from deepspeed_tpu.models.quant import is_qtensor
+
+    def wq_pair(w):
+        """(payload, per-out-channel scale | None) for dense or int8."""
+        if is_qtensor(w):
+            return w.q, w.scale
+        return w, None
+
     for l, lp in enumerate(dparams["layers"]):
+        wqkv, s_qkv = wq_pair(lp["wqkv"])
         qkv = fused_norm_qkv(x, lp["n1_scale"], lp.get("n1_bias"),
-                             lp["wqkv"], lp.get("bqkv"), kind=kind, eps=eps,
-                             impl=impl)
+                             wqkv, lp.get("bqkv"), kind=kind, eps=eps,
+                             wscale=s_qkv, impl=impl)
         q = rope_rows(qkv[:, :M].reshape(B, H, Dh))
         k = rope_rows(qkv[:, M:M + Mkv].reshape(B, Hkv, Dh))
         v = qkv[:, M + Mkv:].reshape(B, Hkv, Dh)
@@ -150,13 +174,18 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
             (l, pos0, pos0, pos, pos0))
         ctx = flash_decode(q, kc_all, vc_all, pos, sm_scale=scale,
                            layer=l, alibi=cfg.position == "alibi", impl=impl)
-        r, h = fused_proj_norm(ctx.reshape(B, M), x, lp["wo"], lp.get("bo"),
+        wo, s_wo = wq_pair(lp["wo"])
+        r, h = fused_proj_norm(ctx.reshape(B, M), x, wo, lp.get("bo"),
                                lp["n2_scale"], lp.get("n2_bias"), kind=kind,
                                eps=eps, parallel=cfg.parallel_residual,
-                               impl=impl)
-        x = fused_mlp(h, r, lp["w_up"], lp["w_down"], lp.get("w_gate"),
+                               wscale=s_wo, impl=impl)
+        wu, su = wq_pair(lp["w_up"])
+        wd, sd = wq_pair(lp["w_down"])
+        wg, sg = (wq_pair(lp["w_gate"]) if "w_gate" in lp else (None, None))
+        wscales = (su, sg, sd) if su is not None else None
+        x = fused_mlp(h, r, wu, wd, wg,
                       lp.get("b_up"), lp.get("b_gate"), lp.get("b_down"),
-                      act=cfg.activation, impl=impl)
+                      act=cfg.activation, wscales=wscales, impl=impl)
     new_cache = {"k": kc_all, "v": vc_all}
     x = norm(x, dparams["final_norm"], kind, eps)
     if cfg.tie_embeddings:

@@ -85,9 +85,17 @@ def _act(name: str, x):
 # fused_norm_qkv: x [B, D] -> norm -> @ wqkv [D, N] (+ bqkv) -> [B, N]
 # ---------------------------------------------------------------------------
 
-def _norm_qkv_ref(x, scale, bias, wqkv, bqkv, *, kind, eps):
+def _deq(w, ws, dtype):
+    """int8 payload * per-out-channel scale -> compute dtype (the in-kernel
+    form of ``QTensor.astype``; reference ``(R) dequantize.cu`` role)."""
+    return (w.astype(jnp.float32) * ws).astype(dtype)
+
+
+def _norm_qkv_ref(x, scale, bias, wqkv, bqkv, *, kind, eps, wscale=None):
     h = _normalize(x.astype(jnp.float32), scale.astype(jnp.float32),
                    bias.astype(jnp.float32), kind, eps).astype(x.dtype)
+    if wscale is not None:
+        wqkv = _deq(wqkv, wscale.reshape(1, -1), x.dtype)
     y = jax.lax.dot_general(h, wqkv, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if bqkv is not None:
@@ -95,8 +103,8 @@ def _norm_qkv_ref(x, scale, bias, wqkv, bqkv, *, kind, eps):
     return y.astype(x.dtype)
 
 
-def _norm_qkv_kernel(x_ref, s_ref, b_ref, w_ref, bq_ref, o_ref, h_scr,
-                     *, kind, eps, has_bias):
+def _norm_qkv_kernel(x_ref, s_ref, b_ref, w_ref, ws_ref, bq_ref, o_ref,
+                     h_scr, *, kind, eps, has_bias, quant):
     @pl.when(pl.program_id(0) == 0)
     def _norm():
         x32 = x_ref[:].astype(jnp.float32)
@@ -104,7 +112,8 @@ def _norm_qkv_kernel(x_ref, s_ref, b_ref, w_ref, bq_ref, o_ref, h_scr,
                        b_ref[:].astype(jnp.float32), kind, eps)
         h_scr[:] = h.astype(h_scr.dtype)
 
-    y = jax.lax.dot_general(h_scr[:], w_ref[:], (((1,), (0,)), ((), ())),
+    w = _deq(w_ref[:], ws_ref[:], h_scr.dtype) if quant else w_ref[:]
+    y = jax.lax.dot_general(h_scr[:], w, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if has_bias:
         y = y + bq_ref[:].astype(jnp.float32)
@@ -112,8 +121,9 @@ def _norm_qkv_kernel(x_ref, s_ref, b_ref, w_ref, bq_ref, o_ref, h_scr,
 
 
 def fused_norm_qkv(x, scale, bias, wqkv, bqkv=None, *, kind: str = "layernorm",
-                   eps: float = 1e-5, impl: Optional[str] = None):
-    """x: [B, D]; wqkv: [D, N]; returns [B, N] in x.dtype.
+                   eps: float = 1e-5, wscale=None, impl: Optional[str] = None):
+    """x: [B, D]; wqkv: [D, N]; returns [B, N] in x.dtype.  ``wscale``
+    [N]-broadcastable fp32 marks ``wqkv`` as int8 (dequant in-kernel).
 
     Reference: fused ln/rmsnorm + qkv_gemm of ``(R)
     csrc/transformer/inference`` (one launch instead of norm + 3 GEMVs)."""
@@ -121,14 +131,19 @@ def fused_norm_qkv(x, scale, bias, wqkv, bqkv=None, *, kind: str = "layernorm",
     if bias is None:
         bias = jnp.zeros_like(scale)
     if impl == "xla":
-        return _norm_qkv_ref(x, scale, bias, wqkv, bqkv, kind=kind, eps=eps)
+        return _norm_qkv_ref(x, scale, bias, wqkv, bqkv, kind=kind, eps=eps,
+                             wscale=wscale)
     B, D = x.shape
     N = wqkv.shape[1]
-    bn = _col_block(D, N, wqkv.dtype.itemsize)
+    quant = wscale is not None
+    # quant sizing counts the in-kernel fp32 dequant intermediate, not the
+    # int8 payload — a payload-sized block would overflow VMEM at 1B+ scale
+    bn = _col_block(D, N, 4 if quant else wqkv.dtype.itemsize)
     has_bias = bqkv is not None
     bq = (bqkv if has_bias else jnp.zeros((N,), x.dtype)).reshape(1, N)
+    ws = (wscale if quant else jnp.ones((N,), jnp.float32)).reshape(1, N)
     kernel = functools.partial(_norm_qkv_kernel, kind=kind, eps=eps,
-                               has_bias=has_bias)
+                               has_bias=has_bias, quant=quant)
     return pl.pallas_call(
         kernel,
         grid=(N // bn,),
@@ -136,12 +151,13 @@ def fused_norm_qkv(x, scale, bias, wqkv, bqkv=None, *, kind: str = "layernorm",
                   pl.BlockSpec((1, D), lambda j: (0, 0)),
                   pl.BlockSpec((1, D), lambda j: (0, 0)),
                   pl.BlockSpec((D, bn), lambda j: (0, j)),
+                  pl.BlockSpec((1, bn), lambda j: (0, j)),
                   pl.BlockSpec((1, bn), lambda j: (0, j))],
         out_specs=pl.BlockSpec((B, bn), lambda j: (0, j)),
         out_shape=jax.ShapeDtypeStruct((B, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((B, D), x.dtype)],
         interpret=interpret_flag(impl),
-    )(x, scale.reshape(1, D), bias.reshape(1, D), wqkv, bq)
+    )(x, scale.reshape(1, D), bias.reshape(1, D), wqkv, ws, bq)
 
 
 # ---------------------------------------------------------------------------
@@ -288,7 +304,10 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
 # fused_proj_norm: ctx @ wo (+bo) + resid -> r; norm(r | resid) -> h
 # ---------------------------------------------------------------------------
 
-def _proj_norm_ref(ctx, resid, wo, bo, scale, bias, *, kind, eps, parallel):
+def _proj_norm_ref(ctx, resid, wo, bo, scale, bias, *, kind, eps, parallel,
+                   wscale=None):
+    if wscale is not None:
+        wo = _deq(wo, wscale.reshape(1, -1), ctx.dtype)
     o = jax.lax.dot_general(ctx, wo, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if bo is not None:
@@ -300,9 +319,10 @@ def _proj_norm_ref(ctx, resid, wo, bo, scale, bias, *, kind, eps, parallel):
     return r32.astype(ctx.dtype), h.astype(ctx.dtype)
 
 
-def _proj_norm_kernel(ctx_ref, res_ref, wo_ref, bo_ref, s_ref, b_ref,
-                      r_ref, h_ref, *, kind, eps, parallel, has_bias):
-    o = jax.lax.dot_general(ctx_ref[:], wo_ref[:], (((1,), (0,)), ((), ())),
+def _proj_norm_kernel(ctx_ref, res_ref, wo_ref, ws_ref, bo_ref, s_ref, b_ref,
+                      r_ref, h_ref, *, kind, eps, parallel, has_bias, quant):
+    wo = _deq(wo_ref[:], ws_ref[:], ctx_ref.dtype) if quant else wo_ref[:]
+    o = jax.lax.dot_general(ctx_ref[:], wo, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if has_bias:
         o = o + bo_ref[:].astype(jnp.float32)
@@ -317,10 +337,12 @@ def _proj_norm_kernel(ctx_ref, res_ref, wo_ref, bo_ref, s_ref, b_ref,
 
 def fused_proj_norm(ctx, resid, wo, bo=None, scale=None, bias=None, *,
                     kind: str = "layernorm", eps: float = 1e-5,
-                    parallel: bool = False, impl: Optional[str] = None):
+                    parallel: bool = False, wscale=None,
+                    impl: Optional[str] = None):
     """ctx: [B, M]; wo: [M, D]; resid: [B, D].  Returns (r, h): the updated
     residual stream and the normed MLP input (``parallel=True`` norms the
-    layer input instead — gpt-neox parallel residual).
+    layer input instead — gpt-neox parallel residual).  ``wscale`` marks
+    ``wo`` as int8 (dequant in-kernel).
 
     Reference: ``(R) pt_binding.cpp`` residual+bias fusion after the
     attention out-GEMM plus the next block's norm."""
@@ -329,18 +351,23 @@ def fused_proj_norm(ctx, resid, wo, bo=None, scale=None, bias=None, *,
         bias = jnp.zeros_like(scale)
     if impl == "xla":
         return _proj_norm_ref(ctx, resid, wo, bo, scale, bias,
-                              kind=kind, eps=eps, parallel=parallel)
+                              kind=kind, eps=eps, parallel=parallel,
+                              wscale=wscale)
     B, M = ctx.shape
     D = wo.shape[1]
+    quant = wscale is not None
     has_bias = bo is not None
     bo2 = (bo if has_bias else jnp.zeros((D,), ctx.dtype)).reshape(1, D)
+    ws = (wscale if quant else jnp.ones((D,), jnp.float32)).reshape(1, D)
     kernel = functools.partial(_proj_norm_kernel, kind=kind, eps=eps,
-                               parallel=parallel, has_bias=has_bias)
+                               parallel=parallel, has_bias=has_bias,
+                               quant=quant)
     r, h = pl.pallas_call(
         kernel,
         in_specs=[pl.BlockSpec((B, M), lambda: (0, 0)),
                   pl.BlockSpec((B, D), lambda: (0, 0)),
                   pl.BlockSpec((M, D), lambda: (0, 0)),
+                  pl.BlockSpec((1, D), lambda: (0, 0)),
                   pl.BlockSpec((1, D), lambda: (0, 0)),
                   pl.BlockSpec((1, D), lambda: (0, 0)),
                   pl.BlockSpec((1, D), lambda: (0, 0))],
@@ -349,7 +376,7 @@ def fused_proj_norm(ctx, resid, wo, bo=None, scale=None, bias=None, *,
         out_shape=[jax.ShapeDtypeStruct((B, D), ctx.dtype),
                    jax.ShapeDtypeStruct((B, D), ctx.dtype)],
         interpret=interpret_flag(impl),
-    )(ctx, resid, wo, bo2, scale.reshape(1, D), bias.reshape(1, D))
+    )(ctx, resid, wo, ws, bo2, scale.reshape(1, D), bias.reshape(1, D))
     return r, h
 
 
@@ -357,7 +384,14 @@ def fused_proj_norm(ctx, resid, wo, bo=None, scale=None, bias=None, *,
 # fused_mlp: h @ w_up (* act(h @ w_gate)) @ w_down + r, blocked over FFN dim
 # ---------------------------------------------------------------------------
 
-def _mlp_ref(h, r, w_up, w_gate, w_down, b_up, b_gate, b_down, *, act):
+def _mlp_ref(h, r, w_up, w_gate, w_down, b_up, b_gate, b_down, *, act,
+             wscales=None):
+    if wscales is not None:
+        su, sg, sd = wscales
+        w_up = _deq(w_up, su.reshape(1, -1), h.dtype)
+        w_down = _deq(w_down, sd.reshape(1, -1), h.dtype)
+        if w_gate is not None:
+            w_gate = _deq(w_gate, sg.reshape(1, -1), h.dtype)
     up = jax.lax.dot_general(h, w_up, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     if b_up is not None:
@@ -378,8 +412,9 @@ def _mlp_ref(h, r, w_up, w_gate, w_down, b_up, b_gate, b_down, *, act):
     return (r.astype(jnp.float32) + y).astype(h.dtype)
 
 
-def _mlp_kernel(h_ref, r_ref, wu_ref, wg_ref, wd_ref, bu_ref, bg_ref,
-                bd_ref, o_ref, acc_scr, *, act, glu, has_bias, nf):
+def _mlp_kernel(h_ref, r_ref, wu_ref, wg_ref, wd_ref, su_ref, sg_ref,
+                sd_ref, bu_ref, bg_ref, bd_ref, o_ref, acc_scr, *, act, glu,
+                has_bias, nf, quant):
     j = pl.program_id(0)
 
     @pl.when(j == 0)
@@ -389,19 +424,22 @@ def _mlp_kernel(h_ref, r_ref, wu_ref, wg_ref, wd_ref, bu_ref, bg_ref,
             acc_scr[:] += bd_ref[:].astype(jnp.float32)
 
     h = h_ref[:]
-    up = jax.lax.dot_general(h, wu_ref[:], (((1,), (0,)), ((), ())),
+    wu = _deq(wu_ref[:], su_ref[:], h.dtype) if quant else wu_ref[:]
+    up = jax.lax.dot_general(h, wu, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     if has_bias:
         up = up + bu_ref[:].astype(jnp.float32)
     if glu:
-        g = jax.lax.dot_general(h, wg_ref[:], (((1,), (0,)), ((), ())),
+        wg = _deq(wg_ref[:], sg_ref[:], h.dtype) if quant else wg_ref[:]
+        g = jax.lax.dot_general(h, wg, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if has_bias:
             g = g + bg_ref[:].astype(jnp.float32)
         a = _act(act, g) * up
     else:
         a = _act(act, up)
-    acc_scr[:] += jax.lax.dot_general(a.astype(h.dtype), wd_ref[:],
+    wd = _deq(wd_ref[:], sd_ref[:], h.dtype) if quant else wd_ref[:]
+    acc_scr[:] += jax.lax.dot_general(a.astype(h.dtype), wd,
                                       (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
 
@@ -411,8 +449,11 @@ def _mlp_kernel(h_ref, r_ref, wu_ref, wg_ref, wd_ref, bu_ref, bg_ref,
 
 
 def fused_mlp(h, r, w_up, w_down, w_gate=None, b_up=None, b_gate=None,
-              b_down=None, *, act: str = "gelu", impl: Optional[str] = None):
+              b_down=None, *, act: str = "gelu", wscales=None,
+              impl: Optional[str] = None):
     """h: [B, D] (normed); r: [B, D] (residual).  Returns r + mlp(h).
+    ``wscales`` = (up, gate, down) per-out-channel fp32 scales marking the
+    weights as int8 (dequant in-kernel; gate entry ignored when no GLU).
 
     Blocked over the FFN dim: grid step j computes the partial product of
     FFN slice j and accumulates the down-projection into a VMEM scratch, so
@@ -421,30 +462,48 @@ def fused_mlp(h, r, w_up, w_down, w_gate=None, b_up=None, b_gate=None,
     impl = resolve_impl(impl)
     if impl == "xla":
         return _mlp_ref(h, r, w_up, w_gate, w_down, b_up, b_gate, b_down,
-                        act=act)
+                        act=act, wscales=wscales)
     B, D = h.shape
     F = w_up.shape[1]
+    quant = wscales is not None
     per = 3 if w_gate is not None else 2
-    bf = _col_block(D * per, F, w_up.dtype.itemsize)
+    # see fused_norm_qkv: quant blocks sized by the fp32 dequant intermediate
+    bf = _col_block(D * per, F, 4 if quant else w_up.dtype.itemsize)
     glu = w_gate is not None
     has_bias = b_up is not None
-    wg = w_gate if glu else jnp.zeros((D, bf), h.dtype)
+    wdt = h.dtype if not quant else jnp.int8
+    wg = w_gate if glu else jnp.zeros((D, bf), wdt)
     bu2 = (b_up if has_bias else jnp.zeros((F,), h.dtype)).reshape(1, F)
     bg2 = (b_gate if (glu and has_bias and b_gate is not None)
            else jnp.zeros((F,), h.dtype)).reshape(1, F)
     bd2 = (b_down if has_bias and b_down is not None
            else jnp.zeros((D,), h.dtype)).reshape(1, D)
+    if quant:
+        su, sg, sd = wscales
+        su2 = su.reshape(1, F)
+        sg2 = (sg.reshape(1, F) if glu else jnp.ones((1, bf), jnp.float32))
+        sd2 = sd.reshape(1, D)
+    else:
+        su2 = jnp.ones((1, F), jnp.float32)
+        sg2 = jnp.ones((1, F if glu else bf), jnp.float32)
+        sd2 = jnp.ones((1, D), jnp.float32)
     kernel = functools.partial(_mlp_kernel, act=act, glu=glu,
-                               has_bias=has_bias, nf=F // bf)
+                               has_bias=has_bias, nf=F // bf, quant=quant)
+    gate_spec = (pl.BlockSpec((D, bf), lambda j: (0, j)) if glu
+                 else pl.BlockSpec((D, bf), lambda j: (0, 0)))
+    gate_s_spec = (pl.BlockSpec((1, bf), lambda j: (0, j)) if glu
+                   else pl.BlockSpec((1, bf), lambda j: (0, 0)))
     return pl.pallas_call(
         kernel,
         grid=(F // bf,),
         in_specs=[pl.BlockSpec((B, D), lambda j: (0, 0)),
                   pl.BlockSpec((B, D), lambda j: (0, 0)),
                   pl.BlockSpec((D, bf), lambda j: (0, j)),
-                  (pl.BlockSpec((D, bf), lambda j: (0, j)) if glu
-                   else pl.BlockSpec((D, bf), lambda j: (0, 0))),
+                  gate_spec,
                   pl.BlockSpec((bf, D), lambda j: (j, 0)),
+                  pl.BlockSpec((1, bf), lambda j: (0, j)),
+                  gate_s_spec,
+                  pl.BlockSpec((1, D), lambda j: (0, 0)),
                   pl.BlockSpec((1, bf), lambda j: (0, j)),
                   pl.BlockSpec((1, bf), lambda j: (0, j)),
                   pl.BlockSpec((1, D), lambda j: (0, 0))],
@@ -452,4 +511,4 @@ def fused_mlp(h, r, w_up, w_down, w_gate=None, b_up=None, b_gate=None,
         out_shape=jax.ShapeDtypeStruct((B, D), h.dtype),
         scratch_shapes=[pltpu.VMEM((B, D), jnp.float32)],
         interpret=interpret_flag(impl),
-    )(h, r, w_up, wg, w_down, bu2, bg2, bd2)
+    )(h, r, w_up, wg, w_down, su2, sg2, sd2, bu2, bg2, bd2)

@@ -174,6 +174,68 @@ def test_fused_generation_matches_unfused(preset, overrides):
     np.testing.assert_array_equal(plain, fused)
 
 
+def test_int8_kernels_match_refs():
+    """In-kernel dequant (wscale=...) matches the reference path that
+    dequantizes before the matmul, for all three weight-bearing kernels."""
+    from deepspeed_tpu.models.quant import quantize_weight
+
+    B, D, N, F = 2, 256, 384, 512
+    x = _rand(0, B, D)
+    scale = 1.0 + 0.1 * _rand(1, D)
+    bias = _rand(2, D)
+    wq = quantize_weight(_rand(3, D, N))
+    got = fused_norm_qkv(x, scale, bias, wq.q, None, kind="layernorm",
+                         wscale=wq.scale, impl="interpret")
+    want = _norm_qkv_ref(x, scale, bias, wq.astype(x.dtype), None,
+                         kind="layernorm", eps=1e-5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    ctx = _rand(4, B, N)
+    wo = quantize_weight(_rand(5, N, D))
+    got_r, got_h = fused_proj_norm(ctx, x, wo.q, None, scale, bias,
+                                   kind="layernorm", wscale=wo.scale,
+                                   impl="interpret")
+    want_r, want_h = _proj_norm_ref(ctx, x, wo.astype(x.dtype), None, scale,
+                                    bias, kind="layernorm", eps=1e-5,
+                                    parallel=False)
+    np.testing.assert_allclose(got_r, want_r, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(got_h, want_h, rtol=2e-5, atol=2e-5)
+
+    wu = quantize_weight(_rand(6, D, F))
+    wg = quantize_weight(_rand(7, D, F))
+    wd = quantize_weight(_rand(8, F, D))
+    got = fused_mlp(x, x, wu.q, wd.q, wg.q, act="silu",
+                    wscales=(wu.scale, wg.scale, wd.scale),
+                    impl="interpret")
+    want = _mlp_ref(x, x, wu.astype(x.dtype), wg.astype(x.dtype),
+                    wd.astype(x.dtype), None, None, None, act="silu")
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_int8_weights_fused_generation():
+    """int8 weight serving rides the kernel-injected path (dequant
+    in-kernel) and matches the unfused int8 loop."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+
+    outs = {}
+    for fused in (True, False):
+        model = causal_lm("llama-tiny", num_layers=2, vocab_size=512,
+                          max_seq_len=512)
+        params = jax.jit(model.init)(jax.random.PRNGKey(0))
+        engine = deepspeed_tpu.init_inference(
+            model, config={"max_out_tokens": 512, "dtype": "int8",
+                           "use_fused_decode": fused})
+        engine.set_params(params)
+        assert (engine._dparams is not None) == fused
+        outs[fused] = np.asarray(engine.generate(
+            np.array([[5, 17, 200, 3]]), max_new_tokens=280,
+            do_sample=False))
+    agree = (outs[True] == outs[False]).mean()
+    assert agree > 0.9, agree                     # bf16 reorder tolerance
+    np.testing.assert_array_equal(outs[True][:, :12], outs[False][:, :12])
+
+
 def test_unroll_tail_exact():
     """decode_unroll > 1 must not change the produced token count or the
     tokens themselves when max_new_tokens is not a multiple of the unroll."""
