@@ -246,6 +246,10 @@ def bench_decode(steps: int = 512) -> dict:
         # decode transients
         ("llama1b4_bf16", "llama-1b4", {"remat": False}, 1,
          {"dtype": "bfloat16"}),
+        # the decode-bandwidth headline: 1.34B int8 weights on the fused
+        # path halve the per-token weight reads
+        ("llama1b4_int8w", "llama-1b4", {"remat": False}, 1,
+         {"dtype": "int8"}),
     )
     short = steps // 4
     for name, preset, model_over, batch, cfg_over in rows:
