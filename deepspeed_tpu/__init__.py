@@ -54,13 +54,43 @@ def init_inference(model=None, config=None, **kwargs):
     # weights arrive separately from the module (torch bundles them)
     params = kwargs.pop("params", None)
     mesh = kwargs.pop("mesh", None)
+    config = _merge_inference_config(config, kwargs, DeepSpeedInferenceConfig)
+    return InferenceEngine(model, config, params=params, mesh=mesh)
+
+
+def _merge_inference_config(config, kwargs, cls):
+    """Overlay config-key kwargs on ``config`` (dict, model instance, or
+    None) without dropping the instance's settings."""
     if config is None:
         config = kwargs
     elif kwargs:
-        config = {**(config if isinstance(config, dict) else {}), **kwargs}
-    if not isinstance(config, DeepSpeedInferenceConfig):
-        config = DeepSpeedInferenceConfig(**config)
-    return InferenceEngine(model, config, params=params, mesh=mesh)
+        base = config.model_dump() if isinstance(config, cls) else dict(config)
+        config = {**base, **kwargs}
+    if not isinstance(config, cls):
+        config = cls(**config)
+    return config
+
+
+def init_serving(model=None, config=None, **kwargs):
+    """Create a continuous-batching :class:`~deepspeed_tpu.serving.engine.
+    ServingEngine` (the MII / DeepSpeed-FastGen dynamic-batching role):
+    slot-based KV cache, iteration-level scheduling, chunked prefill
+    interleaved with per-row-position decode."""
+    from deepspeed_tpu.serving.engine import ServingEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    params = kwargs.pop("params", None)
+    mesh = kwargs.pop("mesh", None)
+    engine_kw = {k: kwargs.pop(k) for k in
+                 ("engine", "num_slots", "prefill_chunk",
+                  "decode_block_tokens", "do_sample", "temperature",
+                  "top_k", "top_p") if k in kwargs}
+    if config is not None or kwargs:
+        # only materialize a config when one was actually given —
+        # ServingEngine rejects engine= combined with config/model args
+        config = _merge_inference_config(config, kwargs,
+                                         DeepSpeedInferenceConfig)
+    return ServingEngine(model, config, params=params, mesh=mesh, **engine_kw)
 
 
 def init_distributed(dist_backend: str = "xla", **kwargs):

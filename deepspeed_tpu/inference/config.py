@@ -49,6 +49,19 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     checkpoint: Optional[Any] = None
     enable_cuda_graph: bool = False      # accepted for parity; XLA always "graphs"
     seed: int = 0
+    # Continuous-batching serving knobs (serving/engine.py — the
+    # MII / DeepSpeed-FastGen dynamic-batching role):
+    # num_slots = KV-cache slot pool size (max concurrently-decoding
+    # requests; the compiled batch); prefill_chunk = max prompt tokens
+    # prefilled per scheduler iteration per slot (bounds the decode stall
+    # a long prompt causes); decode_block_tokens = decode steps per
+    # compiled block per host sync (0 = follow decode_unroll);
+    # max_prefill_chunks = prefill chunks advanced per iteration across
+    # slots (decode-latency vs admission-latency trade).
+    num_slots: int = 8
+    prefill_chunk: int = 64
+    decode_block_tokens: int = 0
+    max_prefill_chunks: int = 2
 
     def __init__(self, **kwargs):
         # legacy alias: mp_size -> tensor_parallel.tp_size

@@ -33,6 +33,17 @@ from deepspeed_tpu.runtime.zero.partition import params_pspecs, shardings_from_p
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def pow2_bucket(n: int, lo: int = 1, cap: Optional[int] = None) -> int:
+    """Next power-of-two >= n, floored at ``lo`` and capped at ``cap`` —
+    the single bucketing rule behind prompt-length / batch / serving-chunk
+    buckets (compiled programs are keyed to bucket sizes, not exact
+    sizes)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b if cap is None else min(b, cap)
+
+
 class InferenceEngine:
     def __init__(self, model, config: DeepSpeedInferenceConfig, params: Any = None,
                  mesh=None):
@@ -59,6 +70,12 @@ class InferenceEngine:
         self._prefill_fns = {}
         self._rng = jax.random.PRNGKey(config.seed)
         self._forward_fn = None
+        # generate() is NOT reentrant (see generate); the flag is
+        # test-and-set under a lock so the cross-thread race raises
+        # instead of slipping two callers past the check
+        import threading
+        self._generating = False
+        self._gen_lock = threading.Lock()
         if params is not None:
             self.set_params(params)
         elif getattr(config, "checkpoint", None):
@@ -178,20 +195,40 @@ class InferenceEngine:
     def _bucket(n: int, cap: int) -> int:
         """Next power-of-two >= n (min 16), capped — prefill compiles once
         per bucket instead of once per distinct prompt length."""
-        b = 16
-        while b < n:
-            b *= 2
-        return min(b, cap)
+        return pow2_bucket(n, lo=16, cap=cap)
+
+    def _bucket_batch(self, batch: int) -> int:
+        """Next power-of-two >= batch (capped at max_batch_size when set):
+        the cache/compiled fns are keyed to the bucketed batch, so a batch-3
+        call after a batch-8 call reuses the batch-8 allocation and programs
+        (padded rows masked out) instead of reallocating + recompiling."""
+        b = pow2_bucket(batch, lo=1, cap=self._config.max_batch_size or None)
+        return max(b, batch)
 
     def _ensure_compiled(self, batch: int, max_len: int):
+        """Returns the RUN batch (the allocated cache's batch dim, >= the
+        request batch — callers pad rows up to it).
+
+        Both cache dims are bucketed so mixed-size traffic reuses one
+        allocation (and the compiled fns keyed to its shapes) instead of
+        reallocating + recompiling per exact size: batch rounds up to a
+        power of two, length to a power-of-two bucket capped at the
+        ``max_out_tokens`` budget; neither ever shrinks."""
         cfg = self.module.config
-        if self._cache is None or self._cache["k"].shape[1] != batch or \
-                self._cache["k"].shape[3] < max_len:
+        need_b = self._bucket_batch(batch)
+        need_len = self._bucket(max_len, self._config.max_out_tokens + 1)
+        cur = self._cache
+        if cur is None or cur["k"].shape[1] < need_b or \
+                cur["k"].shape[3] < need_len:
+            if cur is not None:
+                need_b = max(need_b, cur["k"].shape[1])
+                need_len = max(need_len, cur["k"].shape[3])
             self._cache = init_kv_cache(
-                cfg, batch, max_len, dtype=self.dtype,
+                cfg, need_b, need_len, dtype=self.dtype,
                 quantized=self._config.quantize_kv_cache)
             self._prefill_fns = {}
             self._gen_fns = {}
+        return self._cache["k"].shape[1]
 
     def _prefill(self, params, cache, tokens, pos, last_idx):
         """Returns (last-position logits [B, V], cache).  ``last_idx`` (the
@@ -229,7 +266,7 @@ class InferenceEngine:
         otherwise the reference-shaped unfused forward."""
         if settings in self._gen_fns:
             return self._gen_fns[settings]
-        eos, do_sample, temperature, top_k, top_p, max_len = settings
+        eos, do_sample, temperature, top_k, top_p = settings
         model = self.module
         fused = self._dparams is not None
         unroll = max(1, int(self._config.decode_unroll))
@@ -244,13 +281,18 @@ class InferenceEngine:
             return logits[:, -1], cache
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def loop(params, cache, buf, logits0, pos0, max_steps, rng):
+        def loop(params, cache, buf, logits0, pos0, max_steps, max_pos,
+                 nrows, rng):
+            # rows >= nrows are batch-bucket padding: they start finished,
+            # so the all-EOS early exit is governed by the real rows only.
+            # max_pos (= the request's cache budget) is TRACED so mixed
+            # request sizes share one compiled program.
             B, W = buf.shape
             cache_len = cache["k"].shape[-2]
 
             def cond(st):
                 buf, cache, logits, pos, step, rng, finished = st
-                go = (step < max_steps) & (pos < max_len)
+                go = (step < max_steps) & (pos < max_pos)
                 if eos >= 0:
                     go = go & ~jnp.all(finished)
                 return go
@@ -290,7 +332,7 @@ class InferenceEngine:
                 return st
 
             st = (buf, cache, logits0, pos0, jnp.zeros((), jnp.int32), rng,
-                  jnp.zeros((B,), bool))
+                  jnp.arange(B) >= nrows)
             buf, cache, _, pos, step, rng, _ = jax.lax.while_loop(cond, body, st)
             return buf, cache, pos, step, rng
 
@@ -307,26 +349,59 @@ class InferenceEngine:
         The decode loop is a single jitted ``lax.while_loop`` — sampling and
         the EOS all-finished reduction run on device; the host is involved
         only at prefill and at the final fetch.  Prompts are right-padded to
-        power-of-two buckets so prefill compiles per bucket, not per length.
+        power-of-two buckets so prefill compiles per bucket, not per length;
+        the batch is likewise padded up to the allocated cache's (power-of-
+        two-bucketed) batch so shrinking batches reuse programs.
+
+        NOT reentrant: the KV cache is donated through the jitted programs
+        and ``self._cache`` is nulled for the duration of the call, so a
+        second concurrent ``generate()`` (another thread, or a callback
+        re-entering mid-flight) would race on freed buffers.  Re-entry
+        raises ``RuntimeError`` immediately instead of crashing confusingly
+        inside XLA.  For concurrent request serving use
+        ``deepspeed_tpu.serving.ServingEngine``.
         """
         if self._params is None:
             raise RuntimeError("no weights: pass params=, config.checkpoint, or set_params()")
-        tokens = jnp.asarray(input_ids)
-        if tokens.ndim == 1:
-            tokens = tokens[None]
-        B, S = tokens.shape
-        max_len = min(self._config.max_out_tokens, S + max_new_tokens)
-        if self._config.max_batch_size and B > self._config.max_batch_size:
-            raise ValueError(
-                f"batch {B} exceeds max_batch_size {self._config.max_batch_size}")
-        if S + max(1, self._config.min_out_tokens) > self._config.max_out_tokens:
-            raise ValueError(
-                f"cache budget max_out_tokens={self._config.max_out_tokens} cannot "
-                f"cover min_out_tokens={self._config.min_out_tokens} after a "
-                f"{S}-token prompt")
+        with self._gen_lock:
+            if self._generating:
+                raise RuntimeError(
+                    "InferenceEngine.generate() is not reentrant: the KV "
+                    "cache is donated to the running decode program. "
+                    "Serialize calls, or use deepspeed_tpu.serving."
+                    "ServingEngine for concurrent requests.")
+            self._generating = True
+        try:
+            tokens = jnp.asarray(input_ids)
+            if tokens.ndim == 1:
+                tokens = tokens[None]
+            B, S = tokens.shape
+            max_len = min(self._config.max_out_tokens, S + max_new_tokens)
+            if self._config.max_batch_size and B > self._config.max_batch_size:
+                raise ValueError(
+                    f"batch {B} exceeds max_batch_size "
+                    f"{self._config.max_batch_size}")
+            if S + max(1, self._config.min_out_tokens) > \
+                    self._config.max_out_tokens:
+                raise ValueError(
+                    f"cache budget max_out_tokens="
+                    f"{self._config.max_out_tokens} cannot cover "
+                    f"min_out_tokens={self._config.min_out_tokens} after a "
+                    f"{S}-token prompt")
+            return self._generate(tokens, B, S, max_len, max_new_tokens,
+                                  do_sample, temperature, top_k, top_p,
+                                  eos_token_id, rng)
+        finally:
+            with self._gen_lock:
+                self._generating = False
+
+    def _generate(self, tokens, B, S, max_len, max_new_tokens, do_sample,
+                  temperature, top_k, top_p, eos_token_id, rng):
         # +1: a spare cache row past max_len absorbs masked-off unrolled
         # sub-step writes (never attended — valid rows stop at max_len)
-        self._ensure_compiled(B, max_len + 1)
+        run_b = self._ensure_compiled(B, max_len + 1)
+        if run_b > B:  # pad rows up to the bucketed cache batch
+            tokens = jnp.pad(tokens, ((0, run_b - B), (0, 0)))
         cache = self._cache
         self._cache = None  # donated below; invalidate the handle
 
@@ -336,23 +411,33 @@ class InferenceEngine:
         padded = jnp.pad(tokens, ((0, 0), (0, Sb - S))) if Sb > S else tokens
         logits, cache = self._prefill(self._params, cache, padded, 0, S - 1)
 
-        # +1 spare column: masked-off unrolled sub-steps land there; the
-        # returned slice stops at S + tokens-produced, so it is never seen
+        # The token buffer is FULLY bucketed (prompt bucket Sb + pow2
+        # output bucket + 1 spare column) so mixed (S, max_new) requests
+        # share one compiled loop; generation writes at absolute column
+        # ``pos`` (starting at the exact S), overwriting the prompt-bucket
+        # padding first, and the loop still stops at the exact traced
+        # max_steps.  Masked-off unrolled sub-steps land in the spare last
+        # column; the returned slice stops at S + tokens-produced, so
+        # neither padding nor spare is ever seen.
+        nb = self._bucket(max_new_tokens, self._config.max_out_tokens)
         buf = jnp.concatenate(
-            [tokens, jnp.zeros((B, max_new_tokens + 1), tokens.dtype)], axis=1)
+            [padded.astype(tokens.dtype),
+             jnp.zeros((run_b, nb + 1), tokens.dtype)], axis=1)
         rng = rng if rng is not None else self._rng
         settings = (eos_token_id if eos_token_id is not None else -1,
                     bool(do_sample), float(temperature), int(top_k),
-                    float(top_p), int(max_len))
+                    float(top_p))
         loop = self._gen_loop(settings)
         loop_params = self._dparams if self._dparams is not None else self._params
         buf, cache, pos, step, rng = loop(
             loop_params, cache, buf, logits, jnp.asarray(S, jnp.int32),
-            jnp.asarray(max_new_tokens, jnp.int32), rng)
+            jnp.asarray(max_new_tokens, jnp.int32),
+            jnp.asarray(max_len, jnp.int32),
+            jnp.asarray(B, jnp.int32), rng)
         self._rng = rng
         self._cache = cache
         n_done = int(step)  # single host sync for the whole generation
-        return buf[:, : S + n_done]
+        return buf[:B, : S + n_done]
 
 
     def __call__(self, tokens):

@@ -84,13 +84,23 @@ _WARNED_ODD_CACHE = False
 _WARNED_ROUNDED_CACHE = False
 
 
+def _as_row_pos(q_pos):
+    """Normalize query positions to [Bq, s]: a [s] vector is shared across
+    the batch (Bq=1 broadcasts); a [B, s] matrix is per-row (continuous
+    batching, where every sequence sits at its own depth)."""
+    q_pos = jnp.asarray(q_pos)
+    return q_pos[None] if q_pos.ndim == 1 else q_pos
+
+
 def _cached_attention_dense(q, kcache, vcache, q_pos, scale, k_scale=None,
                             v_scale=None, slopes=None):
     """Masked attention over the whole static cache (prefill path, s > 1);
     int8 caches are dequantized on the fly (fused into the einsum reads);
-    ``slopes`` [H] adds the ALiBi per-head linear position bias."""
+    ``slopes`` [H] adds the ALiBi per-head linear position bias.  ``q_pos``
+    is [s] (batch-shared) or [B, s] (per-row positions)."""
     B, H, s, Dh = q.shape
     Hkv = kcache.shape[1]
+    q_pos = _as_row_pos(q_pos)                         # [Bq, s]
     kf = kcache.astype(jnp.float32)
     vf = vcache.astype(jnp.float32)
     if k_scale is not None:
@@ -102,10 +112,10 @@ def _cached_attention_dense(q, kcache, vcache, q_pos, scale, k_scale=None,
     logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k) * scale
     key_pos = jnp.arange(k.shape[-2])
     if slopes is not None:
-        rel = (key_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
-        logits = logits + slopes[None, :, None, None] * rel[None, None]
-    mask = key_pos[None, :] <= q_pos[:, None]          # causal vs absolute pos
-    logits = jnp.where(mask[None, None], logits, NEG_INF)
+        rel = (key_pos[None, None, :] - q_pos[:, :, None]).astype(jnp.float32)
+        logits = logits + slopes[None, :, None, None] * rel[:, None]
+    mask = key_pos[None, None, :] <= q_pos[:, :, None]  # causal vs absolute pos
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return out.astype(q.dtype)
@@ -124,8 +134,12 @@ def _cached_attention_flash_decode(q, kcache, vcache, q_pos, scale,
     Hkv = kcache.shape[1]
     Smax = kcache.shape[2]
     rep = H // Hkv
+    q_pos = _as_row_pos(q_pos)                         # [Bq, s]
     qf = q.astype(jnp.float32)
     # visit blocks [0, n_blocks): everything at or before the newest query
+    # (per-row positions: the deepest row bounds the loop; shallower rows'
+    # extra blocks are fully masked, and exp(NEG_INF - m) underflows to an
+    # exact 0 contribution, so per-row outputs match a per-row-bounded scan)
     n_blocks = jnp.max(q_pos) // block + 1
 
     def body(carry):
@@ -146,10 +160,11 @@ def _cached_attention_flash_decode(q, kcache, vcache, q_pos, scale,
         logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale
         key_pos = start + jnp.arange(block)
         if slopes is not None:
-            rel = (key_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
-            logits = logits + slopes[None, :, None, None] * rel[None, None]
-        mask = key_pos[None, :] <= q_pos[:, None]      # [s, block]
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+            rel = (key_pos[None, None, :]
+                   - q_pos[:, :, None]).astype(jnp.float32)
+            logits = logits + slopes[None, :, None, None] * rel[:, None]
+        mask = key_pos[None, None, :] <= q_pos[:, :, None]  # [Bq, s, block]
+        logits = jnp.where(mask[:, None], logits, NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         correction = jnp.exp(m - m_new)
         p = jnp.exp(logits - m_new[..., None])
@@ -169,10 +184,11 @@ def _cached_attention_flash_decode(q, kcache, vcache, q_pos, scale,
 
 def _cached_attention(q, kcache, vcache, q_pos, scale, k_scale=None,
                       v_scale=None, slopes=None):
-    """q: [B, H, s, Dh]; caches: [B, Hkv, Smax, Dh]; q_pos: [s] absolute
-    positions of the queries.  Decode (s == 1, cache larger than one
-    block) takes the length-aware flash-decode path; prefill stays dense.
-    ``slopes`` [H] = ALiBi bias."""
+    """q: [B, H, s, Dh]; caches: [B, Hkv, Smax, Dh]; q_pos: absolute
+    positions of the queries — [s] (batch-shared) or [B, s] (per-row, the
+    continuous-batching decode where every sequence is at its own depth).
+    Decode (s == 1, cache larger than one block) takes the length-aware
+    flash-decode path; prefill stays dense.  ``slopes`` [H] = ALiBi bias."""
     s = q.shape[2]
     Smax = kcache.shape[2]
     if s == 1 and Smax > DECODE_BLOCK:
@@ -196,12 +212,45 @@ def _cached_attention(q, kcache, vcache, q_pos, scale, k_scale=None,
                                    k_scale, v_scale, slopes)
 
 
+def _rope_rows(t, cos, sin):
+    """Per-row partial RoPE: t [B, Hx, s, Dh]; cos/sin [B, s, half] carry
+    each row's own absolute positions (continuous-batching decode)."""
+    rot = 2 * cos.shape[-1]
+    half = cos.shape[-1]
+    c = cos[:, None].astype(jnp.float32)               # [B, 1, s, half]
+    sn = sin[:, None].astype(jnp.float32)
+    x1 = t[..., :half].astype(jnp.float32)
+    x2 = t[..., half:rot].astype(jnp.float32)
+    r = jnp.concatenate([x1 * c - x2 * sn, x2 * c + x1 * sn],
+                        axis=-1).astype(t.dtype)
+    return (jnp.concatenate([r, t[..., rot:]], axis=-1)
+            if rot < t.shape[-1] else r)
+
+
+def _scatter_rows(buf, rows, start_pos):
+    """Write ``rows`` [B, Hx, s, D] into ``buf`` [B, Hx, Smax, D] at
+    per-row start positions ``start_pos`` [B] (each batch row lands at its
+    own cache depth) as ONE batched scatter — measured much faster than a
+    per-row dynamic_update_slice loop, whose per-row dynamic start index
+    defeats XLA's in-place aliasing and copies the buffer per write."""
+    B, _, s, _ = rows.shape
+    bidx = jnp.arange(B)[:, None]                      # [B, 1]
+    pidx = start_pos[:, None] + jnp.arange(s)[None, :]  # [B, s]
+    return buf.at[bidx, :, pidx, :].set(
+        rows.transpose(0, 2, 1, 3).astype(buf.dtype))
+
+
 def forward_with_cache(model, params, tokens, cache, start_pos):
     """Run the model over ``tokens`` [B, s] starting at absolute position
-    ``start_pos`` (scalar), reading/updating the KV cache.
+    ``start_pos``, reading/updating the KV cache.
 
-    Returns (logits [B, s, V], new_cache).  Used for both prefill (s = prompt
-    length, start_pos=0) and decode (s = 1).
+    ``start_pos`` is a scalar (the whole batch at one depth — static-batch
+    prefill/decode) or an int32 [B] vector of per-row positions (the
+    continuous-batching decode, where every slot sits at its own depth).
+
+    Returns (logits [B, s, V], new_cache).  Used for prefill (s = prompt
+    length, start_pos=0), decode (s = 1), and chunked per-slot prefill
+    (s = chunk, scalar start_pos = chunk offset).
     """
     cfg = model.config
     mesh = model.mesh
@@ -209,15 +258,24 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
     B, s = tokens.shape
     H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     quant_kv = "k_scale" in cache
+    start_pos = jnp.asarray(start_pos, jnp.int32)
+    per_row = start_pos.ndim == 1                      # [B] vector of depths
     x = jnp.take(params["embed"]["tok"], tokens, axis=0)
     if cfg.position == "learned":
-        pos_idx = start_pos + jnp.arange(s)
-        x = x + jnp.take(params["embed"]["pos"], pos_idx, axis=0)[None]
+        if per_row:
+            pos_idx = start_pos[:, None] + jnp.arange(s)       # [B, s]
+            x = x + jnp.take(params["embed"]["pos"], pos_idx, axis=0)
+        else:
+            pos_idx = start_pos + jnp.arange(s)
+            x = x + jnp.take(params["embed"]["pos"], pos_idx, axis=0)[None]
     if cfg.embed_norm:  # bloom word_embeddings_layernorm
         x = norm(x, params["embed"]["norm"], "layernorm", cfg.norm_eps)
     x = x.astype(cache["x_dtype"].dtype if quant_kv else cache["k"].dtype)
     x = constrain(x, mesh, batch_ax, None, None)
-    q_pos = start_pos + jnp.arange(s)
+    if per_row:
+        q_pos = start_pos[:, None] + jnp.arange(s)             # [B, s]
+    else:
+        q_pos = start_pos + jnp.arange(s)                      # [s]
     if cfg.position == "alibi":
         from deepspeed_tpu.models.layers import alibi_slopes
         slopes = alibi_slopes(H)
@@ -228,8 +286,14 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         # angles for the whole cache window once; gather the query slice
         cos_all, sin_all = rope_angles(jnp.arange(cache["k"].shape[-2]),
                                        rope_dim(cfg), theta=cfg.rope_theta)
-        cos = jax.lax.dynamic_slice_in_dim(cos_all, start_pos, s).astype(x.dtype)
-        sin = jax.lax.dynamic_slice_in_dim(sin_all, start_pos, s).astype(x.dtype)
+        if per_row:
+            cos = cos_all[q_pos].astype(x.dtype)               # [B, s, half]
+            sin = sin_all[q_pos].astype(x.dtype)
+        else:
+            cos = jax.lax.dynamic_slice_in_dim(cos_all, start_pos,
+                                               s).astype(x.dtype)
+            sin = jax.lax.dynamic_slice_in_dim(sin_all, start_pos,
+                                               s).astype(x.dtype)
     else:
         cos = sin = jnp.zeros((), x.dtype)
     scale = 1.0 / (Dh ** 0.5)
@@ -255,15 +319,30 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         k = k.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
         v = v.reshape(B, s, Hkv, Dh).transpose(0, 2, 1, 3)
         if cfg.position == "rope":
-            q = apply_partial_rope(q, cos, sin)
-            k = apply_partial_rope(k, cos, sin)
+            if per_row:
+                q = _rope_rows(q, cos, sin)
+                k = _rope_rows(k, cos, sin)
+            else:
+                q = apply_partial_rope(q, cos, sin)
+                k = apply_partial_rope(k, cos, sin)
         if quant_kv:
             kq, ks = _quantize_kv_rows(k)
             vq, vs = _quantize_kv_rows(v)
-            kc = jax.lax.dynamic_update_slice(kc, kq, (0, 0, start_pos, 0))
-            vc = jax.lax.dynamic_update_slice(vc, vq, (0, 0, start_pos, 0))
-            ksc = jax.lax.dynamic_update_slice(ksc, ks, (0, 0, start_pos, 0))
-            vsc = jax.lax.dynamic_update_slice(vsc, vs, (0, 0, start_pos, 0))
+            if per_row:
+                kc = _scatter_rows(kc, kq, start_pos)
+                vc = _scatter_rows(vc, vq, start_pos)
+                ksc = _scatter_rows(ksc, ks, start_pos)
+                vsc = _scatter_rows(vsc, vs, start_pos)
+            else:
+                kc = jax.lax.dynamic_update_slice(kc, kq, (0, 0, start_pos, 0))
+                vc = jax.lax.dynamic_update_slice(vc, vq, (0, 0, start_pos, 0))
+                ksc = jax.lax.dynamic_update_slice(ksc, ks,
+                                                   (0, 0, start_pos, 0))
+                vsc = jax.lax.dynamic_update_slice(vsc, vs,
+                                                   (0, 0, start_pos, 0))
+        elif per_row:
+            kc = _scatter_rows(kc, k, start_pos)
+            vc = _scatter_rows(vc, v, start_pos)
         else:
             kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
                                               (0, 0, start_pos, 0))

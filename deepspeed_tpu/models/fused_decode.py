@@ -102,19 +102,26 @@ def inject_decode_params(params: Any, cfg) -> Dict[str, Any]:
 def decode_step(cfg, dparams, tokens, cache, pos, *,
                 impl: Optional[str] = None):
     """One generation step: ``tokens`` [B, 1] at absolute position ``pos``
-    (traced scalar) -> (logits [B, V] fp32, cache).
+    -> (logits [B, V] fp32, cache).
+
+    ``pos`` is a traced scalar (static batch: every row at the same depth)
+    or an int32 [B] vector of per-row positions (continuous batching: each
+    slot sits at its own depth; cache appends scatter per row and the
+    flash-decode kernel masks per row).
 
     Four kernel launches per layer: norm+QKV, flash-decode attention,
     out-proj+residual+norm, MLP+residual (ops/pallas/decode.py); the cache
-    row appends stay XLA ``dynamic_update_slice`` (in-place on the donated
-    cache)."""
+    row appends stay XLA in-place updates (on the donated cache)."""
     B = tokens.shape[0]
     H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     M, Mkv = H * Dh, Hkv * Dh
     kind, eps = cfg.norm, cfg.norm_eps
+    pos = jnp.asarray(pos, jnp.int32)
+    per_row = pos.ndim == 1                  # [B] per-slot depths
     x = jnp.take(dparams["embed"]["tok"], tokens[:, 0], axis=0)
     if cfg.position == "learned":
-        x = x + jnp.take(dparams["embed"]["pos"], pos[None], axis=0)
+        x = x + jnp.take(dparams["embed"]["pos"],
+                         pos if per_row else pos[None], axis=0)
     if cfg.embed_norm:  # bloom word_embeddings_layernorm
         x = norm(x, dparams["embed"]["norm"], "layernorm", cfg.norm_eps)
     dtype = cache["k"].dtype
@@ -122,7 +129,9 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
 
     if cfg.position == "rope":
         rd = rope_dim(cfg)
-        cos, sin = rope_angles(pos[None], rd, theta=cfg.rope_theta)  # [1, rd/2]
+        # scalar: [1, rd/2] broadcast over the batch; per-row: [B, rd/2]
+        cos, sin = rope_angles(pos if per_row else pos[None], rd,
+                               theta=cfg.rope_theta)
     else:
         cos = sin = None
 
@@ -131,8 +140,12 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
         if cos is None:
             return t
         half = rd // 2
-        c = cos[0].astype(jnp.float32)
-        s = sin[0].astype(jnp.float32)
+        if per_row:
+            c = cos[:, None].astype(jnp.float32)     # [B, 1, rd/2]
+            s = sin[:, None].astype(jnp.float32)
+        else:
+            c = cos[0].astype(jnp.float32)
+            s = sin[0].astype(jnp.float32)
         x1 = t[..., :half].astype(jnp.float32)
         x2 = t[..., half:rd].astype(jnp.float32)
         rot = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
@@ -166,12 +179,24 @@ def decode_step(cfg, dparams, tokens, cache, pos, *,
         q = rope_rows(qkv[:, :M].reshape(B, H, Dh))
         k = rope_rows(qkv[:, M:M + Mkv].reshape(B, Hkv, Dh))
         v = qkv[:, M + Mkv:].reshape(B, Hkv, Dh)
-        kc_all = jax.lax.dynamic_update_slice(
-            kc_all, k[None, :, :, None, :].astype(kc_all.dtype),
-            (l, pos0, pos0, pos, pos0))
-        vc_all = jax.lax.dynamic_update_slice(
-            vc_all, v[None, :, :, None, :].astype(vc_all.dtype),
-            (l, pos0, pos0, pos, pos0))
+        if per_row:
+            # per-slot append: row b writes at its own depth pos[b], as ONE
+            # batched scatter.  Measured (CPU, 16-step scan, donated
+            # cache): scatter 37ms vs a per-row dynamic_update_slice loop
+            # 432ms — the per-row-index DUS defeats XLA's in-place
+            # aliasing and copies the cache per write.
+            bidx = jnp.arange(B)
+            kc_all = kc_all.at[l, bidx, :, pos, :].set(
+                k.astype(kc_all.dtype))
+            vc_all = vc_all.at[l, bidx, :, pos, :].set(
+                v.astype(vc_all.dtype))
+        else:
+            kc_all = jax.lax.dynamic_update_slice(
+                kc_all, k[None, :, :, None, :].astype(kc_all.dtype),
+                (l, pos0, pos0, pos, pos0))
+            vc_all = jax.lax.dynamic_update_slice(
+                vc_all, v[None, :, :, None, :].astype(vc_all.dtype),
+                (l, pos0, pos0, pos, pos0))
         ctx = flash_decode(q, kc_all, vc_all, pos, sm_scale=scale,
                            layer=l, alibi=cfg.position == "alibi", impl=impl)
         wo, s_wo = wq_pair(lp["wo"])

@@ -45,7 +45,13 @@ def constrain(x, mesh: Optional[Mesh], *spec):
     """
     if mesh is None or mesh.empty:
         return x
-    am = jax.sharding.get_abstract_mesh()
+    # jax < 0.4.36 has no jax.sharding.get_abstract_mesh; fall back to the
+    # private accessor, else assume no manual axes (pre-shard_map-manual jax)
+    get_am = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_am is None:
+        from jax._src import mesh as _mesh_lib
+        get_am = getattr(_mesh_lib, "get_abstract_mesh", None)
+    am = get_am() if get_am is not None else None
     manual = set(getattr(am, "manual_axes", ()) or ())
     names = set(mesh.axis_names) - manual
     if not names:

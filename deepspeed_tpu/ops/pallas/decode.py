@@ -165,10 +165,12 @@ def fused_norm_qkv(x, scale, bias, wqkv, bqkv=None, *, kind: str = "layernorm",
 # ---------------------------------------------------------------------------
 
 def _flash_decode_ref(q, kcache, vcache, pos, *, scale, alibi=False):
-    """Masked dense attention over the whole cache (parity target)."""
+    """Masked dense attention over the whole cache (parity target).
+    ``pos`` is a scalar or a per-row [B] vector of depths."""
     B, H, Dh = q.shape
     Hkv, Smax = kcache.shape[1], kcache.shape[2]
     rep = H // Hkv
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     qf = q.astype(jnp.float32).reshape(B, Hkv, rep, Dh)
     kf = kcache.astype(jnp.float32)
     vf = vcache.astype(jnp.float32)
@@ -177,11 +179,11 @@ def _flash_decode_ref(q, kcache, vcache, pos, *, scale, alibi=False):
     if alibi:
         from deepspeed_tpu.models.layers import alibi_slopes
 
-        rel = (key_pos - pos).astype(jnp.float32)
+        rel = (key_pos[None, :] - pos[:, None]).astype(jnp.float32)
         s = s + (alibi_slopes(H).reshape(1, Hkv, rep, 1)
-                 * rel[None, None, None, :])
-    mask = key_pos <= pos
-    s = jnp.where(mask[None, None, None, :], s, NEG_INF)
+                 * rel[:, None, None, :])
+    mask = key_pos[None, :] <= pos[:, None]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bgrk,bgkd->bgrd", p, vf)
     return o.reshape(B, H, Dh).astype(q.dtype)
@@ -189,7 +191,7 @@ def _flash_decode_ref(q, kcache, vcache, pos, *, scale, alibi=False):
 
 def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, slope_ref, o_ref,
                          m_scr, l_scr, acc_scr, *, scale, block, nb, rep,
-                         alibi):
+                         hkv, alibi):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -198,7 +200,9 @@ def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, slope_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    pos = pos_ref[0]
+    # grid axis 0 walks (batch, kv-head) pairs; each batch row has its own
+    # position (continuous batching) — the scalar-prefetch buffer holds [B]
+    pos = pos_ref[pl.program_id(0) // hkv]
 
     @pl.when(j * block <= pos)
     def _compute():
@@ -232,15 +236,18 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
     """Single-launch decode attention.  q: [B, H, Dh]; caches:
     [B, Hkv, Smax, Dh] — or, with ``layer=l``, stacked [L, B, Hkv, Smax, Dh]
     read at static layer offset ``l`` through the index map (no cache slice
-    materializes); ``pos`` the (traced) absolute position of the query.
+    materializes); ``pos`` the (traced) absolute position of the query — a
+    scalar shared by the batch, or an int32 [B] vector of per-row depths
+    (continuous batching: each slot masks and clamps independently).
 
-    The block index map clamps to the position's block, so cache blocks past
-    ``pos`` are neither fetched nor computed — the single-kernel form of the
-    length-aware flash-decode loop (reference: ``(R) softmax.cu`` +
-    attention in the inference workspace)."""
+    The block index map clamps to the position's block PER ROW, so cache
+    blocks past each row's ``pos`` are neither fetched nor computed — the
+    single-kernel form of the length-aware flash-decode loop (reference:
+    ``(R) softmax.cu`` + attention in the inference workspace)."""
     impl = resolve_impl(impl)
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    pos = jnp.asarray(pos, jnp.int32)
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1),
+                           (q.shape[0],))
     if layer is None:
         kc, vc = kcache, vcache
         off = 0
@@ -275,11 +282,12 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
         v3 = vcache.reshape(vcache.shape[0] * BG, Smax, Dh)
     base = off * BG
     kernel = functools.partial(_flash_decode_kernel, scale=scale, block=blk,
-                               nb=nb, rep=rep, alibi=alibi)
+                               nb=nb, rep=rep, hkv=Hkv, alibi=alibi)
     # index maps see scalar-prefetch refs AFTER the grid indices (the kernel
-    # body sees them first)
+    # body sees them first); b // Hkv recovers the batch row, whose own
+    # position bounds the DMA clamp (per-row length awareness)
     clamp = lambda b, j, pos_ref: (base + b,
-                                   jnp.minimum(j, pos_ref[0] // blk), 0)
+                                   jnp.minimum(j, pos_ref[b // Hkv] // blk), 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(BG, nb),
@@ -296,7 +304,7 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((BG, rep, Dh), q.dtype),
         interpret=interpret_flag(impl),
-    )(pos.reshape(1), q4, k3, v3, slopes)
+    )(pos, q4, k3, v3, slopes)
     return o.reshape(B, H, Dh)
 
 

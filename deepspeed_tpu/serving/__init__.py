@@ -1,0 +1,22 @@
+"""Continuous-batching serving layer (Orca-style iteration-level
+scheduling over a slot-based KV cache; the role DeepSpeed ships as
+MII / DeepSpeed-FastGen's dynamic batching on top of the reference
+inference engine).
+
+- :mod:`deepspeed_tpu.serving.scheduler` — request queue + iteration-level
+  scheduler: finished sequences free their slot immediately; queued
+  requests are admitted mid-flight.
+- :mod:`deepspeed_tpu.serving.engine` — :class:`ServingEngine`: a fixed
+  pool of KV-cache slots decoding in lock-step with PER-ROW positions
+  (every slot at its own depth), chunked per-slot prefill interleaved with
+  decode so decode latency stays bounded, and an active-slot mask so the
+  compiled step keeps a static shape while occupancy varies.
+"""
+
+from deepspeed_tpu.serving.scheduler import (FINISHED, PREFILLING, QUEUED,
+                                             RUNNING, IterationScheduler,
+                                             Request)
+from deepspeed_tpu.serving.engine import ServingEngine
+
+__all__ = ["Request", "IterationScheduler", "ServingEngine",
+           "QUEUED", "PREFILLING", "RUNNING", "FINISHED"]

@@ -1,0 +1,432 @@
+"""Continuous-batching serving engine over a slot-based KV cache.
+
+The static-batch :class:`~deepspeed_tpu.inference.engine.InferenceEngine`
+decodes the whole batch in lock-step on one scalar position: no request can
+join or leave until the slowest row finishes, and mixed-length traffic
+burns most of the batch on padding and head-of-line blocking.  This engine
+is the Orca / DeepSpeed-FastGen answer, mapped onto the existing fused
+Pallas decode stack:
+
+- a fixed pool of ``num_slots`` KV-cache slots (the batch dim of ONE
+  preallocated [L, num_slots, Hkv, Smax, Dh] cache, donated through every
+  jitted program so XLA updates it in place);
+- PER-ROW decode positions: every slot sits at its own depth, threaded
+  through ``forward_with_cache`` / ``decode_step`` / the flash-decode
+  kernel (which masks and DMA-clamps per row);
+- iteration-level scheduling: each :meth:`step` admits queued requests
+  into freed slots, advances at most ``max_prefill_chunks`` prompt chunks
+  (chunked per-slot prefill, interleaved with decode so decode latency
+  stays bounded), then decodes ``decode_block_tokens`` tokens for every
+  active slot in one compiled program;
+- a traced active-slot mask: compiled shapes stay static while occupancy
+  varies, so there is exactly ONE decode program regardless of how many
+  slots are live.
+
+Slot-reuse safety (why freed slots need no cache zeroing): a query at
+position p only attends cache rows <= p, and every row <= p has been
+written by the CURRENT occupant before it is first attended — prefill
+writes [0, S) before the first decode, and each decode step writes its own
+row before attending it.  Inactive slots are "parked": they still run in
+the compiled step (static shapes) but write their junk K/V at their own
+frozen position, which the next occupant's prefill/decode overwrites
+before any query can see it.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine, pow2_bucket
+from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
+                                           sample_token)
+from deepspeed_tpu.serving.scheduler import (RUNNING, IterationScheduler,
+                                             Request)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ServingEngine:
+    """Continuous-batching serving over an :class:`InferenceEngine`'s
+    weights (plain + kernel-injected views, dtype, mesh all reused).
+
+    Parameters
+    ----------
+    model / config / params / mesh:
+        As :func:`deepspeed_tpu.init_inference`; alternatively pass an
+        existing ``engine=`` to share its weights.
+    num_slots:
+        KV-cache slots = max concurrently-decoding requests (the compiled
+        batch).  Defaults to ``config.num_slots``.
+    prefill_chunk:
+        Max prompt tokens prefilled per scheduler iteration per slot
+        (chunked prefill; bounds the decode stall a long prompt causes).
+    decode_block_tokens:
+        Decode steps per compiled block (per host sync) — the serving
+        analog of ``decode_unroll``.
+    """
+
+    def __init__(self, model=None, config=None, *, engine: Optional[InferenceEngine] = None,
+                 num_slots: int = 0, prefill_chunk: int = 0,
+                 decode_block_tokens: int = 0, params: Any = None, mesh=None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0):
+        if engine is None:
+            if config is None:
+                config = {}
+            if not isinstance(config, DeepSpeedInferenceConfig):
+                config = DeepSpeedInferenceConfig(**config)
+            engine = InferenceEngine(model, config, params=params, mesh=mesh)
+        elif any(a is not None for a in (model, config, params, mesh)):
+            # silently preferring engine.config over a passed config would
+            # discard the caller's settings with no indication
+            raise ValueError(
+                "pass EITHER engine= (its model/config/params/mesh are "
+                "reused) OR model/config/params/mesh, not both")
+        self.engine = engine
+        self.module = engine.module
+        self._config = engine.config
+        self.num_slots = int(num_slots or self._config.num_slots)
+        self.prefill_chunk = int(prefill_chunk or self._config.prefill_chunk)
+        self._K = int(decode_block_tokens or self._config.decode_block_tokens
+                      or max(1, self._config.decode_unroll))
+        self.max_prefill_chunks = max(1, int(self._config.max_prefill_chunks))
+        self._sample = (bool(do_sample), float(temperature), int(top_k),
+                        float(top_p))
+        self.scheduler = IterationScheduler(self.num_slots)
+
+        cfg = self.module.config
+        self._cache = init_kv_cache(
+            cfg, self.num_slots, self._config.max_out_tokens,
+            dtype=engine.dtype, quantized=self._config.quantize_kv_cache)
+        # cache_len is the PHYSICAL depth (init_kv_cache rounds up to a
+        # flash-decode block multiple); max_out is the configured LOGICAL
+        # budget — generation bounds use max_out so serving stays
+        # token-identical to generate(), which never sees the rounding
+        self.cache_len = int(self._cache["k"].shape[-2])
+        self.max_out = int(self._config.max_out_tokens)
+        # host-owned per-slot scheduling state, passed into every compiled
+        # block; the cache and the last-sampled-token vector are the only
+        # device-resident state (last stays on device so the no-EOS fast
+        # path never syncs per block — see _decode_block)
+        self._pos = np.zeros(self.num_slots, np.int32)      # cache depth
+        self._active = np.zeros(self.num_slots, bool)       # decoding now
+        self._limit = np.zeros(self.num_slots, np.int32)    # pos decode bound
+        self._eos = np.full(self.num_slots, -1, np.int32)
+        self._last_dev = jnp.zeros(self.num_slots, jnp.int32)
+        self._rng = jax.random.PRNGKey(self._config.seed + 1)
+        self._block_fn = None
+        self._prefill_fns = {}
+        # deferred token blocks: device [K, B] arrays kept un-fetched until
+        # a participating request finishes (refcounted)
+        self._blocks = {}       # idx -> device toks [K, B]
+        self._block_np = {}     # idx -> host copy (memoized at first fetch)
+        self._block_refs = {}   # idx -> pending request references
+        self._next_block = 0
+        self.steps = 0
+        from deepspeed_tpu.models.fused_decode import supports_fused_decode
+        fused_ok = (self._config.use_fused_decode is not False
+                    and supports_fused_decode(
+                        cfg, quantized_kv=self._config.quantize_kv_cache,
+                        tp=engine.mesh.shape.get("tp", 1)))
+        log_dist(f"serving engine: {self.num_slots} slots x "
+                 f"{self.cache_len} tokens, prefill_chunk="
+                 f"{self.prefill_chunk}, decode_block={self._K}, "
+                 f"{'fused' if fused_ok else 'unfused'} decode", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def set_params(self, params: Any) -> None:
+        self.engine.set_params(params)
+        self._block_fn = None
+        self._prefill_fns = {}
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 128,
+               eos_token_id: Optional[int] = None) -> Request:
+        """Enqueue one request; returns the live Request handle (its
+        ``output_tokens`` fill in as the scheduler serves it)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size > self.max_out:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the per-slot cache "
+                f"budget max_out_tokens={self.max_out}")
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      eos_token_id=(-1 if eos_token_id is None
+                                    else int(eos_token_id)))
+        return self.scheduler.submit(req)
+
+    # ------------------------------------------------------------------
+    def step(self) -> List[Request]:
+        """One scheduler iteration: admit → prefill chunk(s) → decode
+        block.  Returns the requests that finished during this iteration."""
+        if self.engine._params is None:
+            raise RuntimeError("no weights: set_params() first")
+        done_before = len(self.scheduler.finished)
+        # 1. admission: freed slots pick up the oldest queued requests
+        for req in self.scheduler.admit():
+            self._pos[req.slot] = 0
+            self._active[req.slot] = False
+            self._limit[req.slot] = 0
+        # 2. chunked prefill, oldest admissions first (bounded per
+        #    iteration so running slots' decode latency stays bounded)
+        for req in self.scheduler.prefilling()[: self.max_prefill_chunks]:
+            self._prefill_one_chunk(req)
+        # 3. decode one block for every active slot
+        if self._active.any():
+            self._decode_block()
+        self.steps += 1
+        return self.scheduler.finished[done_before:]
+
+    def run(self) -> List[Request]:
+        """Drain: iterate until queue and slots are empty; returns finished
+        requests in completion order."""
+        while self.scheduler.has_work:
+            self.step()
+        return self.scheduler.finished
+
+    # ------------------------------------------------------------------
+    def _prefill_one_chunk(self, req: Request) -> None:
+        slot, off = req.slot, req.prefill_pos
+        c = min(self.prefill_chunk, req.prompt_len - off)
+        cb = pow2_bucket(c, lo=8, cap=self.cache_len - off)  # pow2 bucket
+        chunk = np.zeros((1, cb), np.int32)
+        chunk[0, :c] = req.prompt[off:off + c]
+        self._rng, srng = jax.random.split(self._rng)
+        tok_dev, self._cache = self._prefill_fn(cb)(
+            self.engine._params, self._cache, jnp.asarray(chunk),
+            jnp.asarray(slot, jnp.int32), jnp.asarray(off, jnp.int32),
+            jnp.asarray(c - 1, jnp.int32), srng)
+        req.prefill_pos += c
+        # parked rows write junk at their own pos; keeping pos = prefill
+        # progress means the NEXT chunk overwrites that row before any
+        # query attends it
+        self._pos[slot] = req.prefill_pos
+        if req.prefill_pos < req.prompt_len:
+            return
+        # prompt fully resident: the first generated token came out of the
+        # final chunk's program.  Its VALUE is only fetched when scheduling
+        # depends on it (EOS) — otherwise it stays on device and the
+        # pipeline keeps flowing.
+        req.t_first_token = time.perf_counter()
+        S = req.prompt_len
+        # limit <= S: the cache budget is already exhausted by the prompt
+        # (prompt length >= max_out_tokens - 1) — the prefill-sampled token
+        # is the only one this request can emit.  The bound is the LOGICAL
+        # max_out_tokens, not the block-rounded physical cache depth, so a
+        # request emits exactly the tokens generate() would
+        limit = min(S + req.max_new_tokens - 1, self.max_out - 1)
+        if req.eos_token_id >= 0 or req.max_new_tokens == 1 or limit <= S:
+            first = int(tok_dev)
+            req.output_tokens.append(first)
+            if (req.eos_token_id >= 0 and first == req.eos_token_id) \
+                    or req.max_new_tokens == 1 or limit <= S:
+                self._release(req)
+                return
+        else:
+            req.pending_blocks.append(("tok", tok_dev))
+        req.state = RUNNING
+        self._last_dev = self._last_dev.at[slot].set(tok_dev)
+        self._pos[slot] = S
+        self._limit[slot] = limit
+        self._eos[slot] = req.eos_token_id
+        self._active[slot] = True
+
+    def _prefill_fn(self, cb: int):
+        """Per-slot chunked prefill, compiled once per pow2 chunk bucket:
+        slice the slot's cache rows out, run the standard (batch-1) prefill
+        forward at the chunk's absolute offset, write the slot back, and
+        sample the next token from the last real position's logits — the
+        token stays a DEVICE scalar so admission never syncs the host (its
+        value is only fetched when scheduling needs it: EOS requests, or
+        output materialization at finish).  Pad rows in [off+c, off+cb)
+        hold junk K/V but are only ever attended AFTER being overwritten by
+        the next chunk / decode step (queries attend key_pos <= q_pos, and
+        every row <= q_pos has been rewritten by then — same invariant as
+        the engine's bucketed prefill)."""
+        if cb in self._prefill_fns:
+            return self._prefill_fns[cb]
+        model = self.module
+        do_sample, temperature, top_k, top_p = self._sample
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill(params, cache, chunk, slot, start, last_idx, srng):
+            sub = {k: (jax.lax.dynamic_slice_in_dim(v, slot, 1, axis=1)
+                       if v.ndim == 5 else v) for k, v in cache.items()}
+            logits, sub = forward_with_cache(model, params, chunk, sub, start)
+            out = {k: (jax.lax.dynamic_update_slice_in_dim(cache[k], sub[k],
+                                                           slot, axis=1)
+                       if cache[k].ndim == 5 else sub[k])
+                   for k in cache}
+            last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1,
+                                                keepdims=False)
+            tok = sample_token(last, srng, temperature=temperature,
+                               top_k=top_k, top_p=top_p,
+                               do_sample=do_sample)[0].astype(jnp.int32)
+            return tok, out
+
+        self._prefill_fns[cb] = prefill
+        return prefill
+
+    # ------------------------------------------------------------------
+    def _decode_block(self) -> None:
+        """Dispatch one compiled decode block.
+
+        No-EOS fast path: without EOS stops, completion is pure position
+        arithmetic (a row emits exactly min(K, limit - pos) tokens), so the
+        host scheduler runs AHEAD of the device — blocks are dispatched
+        back-to-back with NO per-block sync, slot frees/admissions happen
+        on deterministic host state, and the sampled tokens are fetched
+        lazily when a request finishes (by which time later blocks are
+        already queued, so the fetch RTT overlaps device work).  On a
+        tunneled/remote runner this is the difference between goodput
+        bounded by host RTT and goodput bounded by the chip.
+
+        With any active EOS request, token VALUES gate scheduling, so the
+        block is fetched synchronously and processed token-by-token."""
+        running = self.scheduler.running()
+        toks, valid, self._last_dev, self._cache, self._rng = self._block()(
+            self._loop_params(), self._cache, self._last_dev,
+            jnp.asarray(self._pos), jnp.asarray(self._active),
+            jnp.asarray(self._limit), jnp.asarray(self._eos), self._rng)
+        if all(r.eos_token_id < 0 for r in running):
+            idx = self._next_block
+            self._next_block += 1
+            refs = 0
+            for req in running:
+                b = req.slot
+                n = int(min(self._K, self._limit[b] - self._pos[b]))
+                req.pending_blocks.append((idx, n))
+                refs += 1
+                self._pos[b] += n
+                if self._pos[b] >= self._limit[b]:
+                    self._active[b] = False
+            if refs:
+                self._blocks[idx] = toks
+                self._block_refs[idx] = refs
+            for req in running:           # finish AFTER refs registered
+                if not self._active[req.slot] and req.state == RUNNING:
+                    self._materialize(req)
+                    self._release(req)
+            return
+        # synchronous path: flush any deferred output first so token order
+        # is preserved, then walk the fetched block
+        for req in running:
+            self._materialize(req)
+        toks = np.asarray(toks)    # [K, num_slots]
+        valid = np.asarray(valid)
+        for req in running:
+            b = req.slot
+            for k in range(self._K):
+                if not valid[k, b]:
+                    break  # valid is monotone within a block
+                t = int(toks[k, b])
+                req.output_tokens.append(t)
+                self._pos[b] += 1
+                if (req.eos_token_id >= 0 and t == req.eos_token_id) or \
+                        len(req.output_tokens) >= req.max_new_tokens:
+                    self._release(req)
+                    break
+            if req.state == RUNNING and self._pos[b] >= self._limit[b]:
+                # cache-budget truncation (prompt near max_out_tokens)
+                self._release(req)
+
+    def _release(self, req: Request) -> None:
+        """Finish the request and park its slot at depth 0: the parked
+        row's junk writes land on row 0 (overwritten by the next
+        occupant's first prefill chunk before it can be attended), and —
+        on the unfused path — the slot's stale depth no longer inflates
+        the flash-decode block loop bound (max over q_pos) for everyone
+        else."""
+        self._active[req.slot] = False
+        self._pos[req.slot] = 0
+        self.scheduler.finish(req)
+
+    def _materialize(self, req: Request) -> None:
+        """Fetch this request's deferred tokens (the prefill-sampled first
+        token + its share of each decode block) into output_tokens, in
+        order.  Blocks are refcounted: a device block is dropped once every
+        participating request has drained it."""
+        for entry in req.pending_blocks:
+            if entry[0] == "tok":                 # prefill-sampled token
+                req.output_tokens.append(int(entry[1]))
+                continue
+            idx, n = entry
+            arr = self._block_np.get(idx)
+            if arr is None:
+                arr = self._block_np[idx] = np.asarray(self._blocks[idx])
+            req.output_tokens.extend(int(t) for t in arr[:n, req.slot])
+            self._block_refs[idx] -= 1
+            if self._block_refs[idx] == 0:
+                del self._blocks[idx], self._block_np[idx], \
+                    self._block_refs[idx]
+        req.pending_blocks.clear()
+
+    def _loop_params(self):
+        return (self.engine._dparams if self.engine._dparams is not None
+                else self.engine._params)
+
+    # ------------------------------------------------------------------
+    def _step_fn(self):
+        """One decode micro-step at per-row positions: (params, tokens
+        [B, 1], cache, pos [B]) -> (logits [B, V], cache)."""
+        model = self.module
+        if self.engine._dparams is not None:
+            from deepspeed_tpu.models.fused_decode import decode_step
+
+            def fused(params, tok, cache, pos):
+                return decode_step(model.config, params, tok, cache, pos)
+            return fused
+
+        def unfused(params, tok, cache, pos):
+            logits, cache = forward_with_cache(model, params, tok, cache, pos)
+            return logits[:, -1], cache
+        return unfused
+
+    def _block(self):
+        """ONE compiled program decoding ``decode_block_tokens`` tokens for
+        all slots: lax.scan of per-row-position decode micro-steps with the
+        active mask traced (static shapes at any occupancy).  Rows stop
+        advancing when they hit their own EOS or position limit inside the
+        block; parked rows keep static shapes alive at their frozen pos."""
+        if self._block_fn is not None:
+            return self._block_fn
+        step_fn = self._step_fn()
+        do_sample, temperature, top_k, top_p = self._sample
+        K = self._K
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def block(params, cache, last, pos, active, limit, eos, rng):
+            def sub(carry, _):
+                cache, last, pos, act, rng = carry
+                valid = act & (pos < limit)
+                rng, srng = jax.random.split(rng)
+                logits, cache = step_fn(params, last[:, None], cache, pos)
+                nxt = sample_token(logits, srng, temperature=temperature,
+                                   top_k=top_k, top_p=top_p,
+                                   do_sample=do_sample).astype(last.dtype)
+                nxt = jnp.where(valid, nxt, last)
+                hit = valid & (eos >= 0) & (nxt == eos)
+                act = act & ~hit
+                pos = pos + valid.astype(pos.dtype)
+                return (cache, nxt, pos, act, rng), (nxt, valid)
+
+            (cache, last, pos, act, rng), (toks, valid) = jax.lax.scan(
+                sub, (cache, last, pos, active, rng), None, length=K)
+            return toks, valid, last, cache, rng
+
+        self._block_fn = block
+        return block
+
+    # ------------------------------------------------------------------
+    @property
+    def config(self):
+        return self._config

@@ -1,0 +1,161 @@
+"""Request queue + iteration-level scheduler (Orca / DeepSpeed-FastGen
+dynamic-batching role).
+
+The scheduler is pure host bookkeeping — no jax.  It owns the FIFO wait
+queue and the slot table; the :class:`~deepspeed_tpu.serving.engine.
+ServingEngine` drives it one *iteration* at a time (admit → prefill chunk →
+decode block), so requests join and leave the running batch at token
+granularity instead of batch granularity:
+
+- a finished sequence frees its slot at the end of the iteration that
+  finished it (early EOS included — no head-of-line blocking on the
+  slowest row);
+- a queued request is admitted the moment a slot frees, and its prompt is
+  prefilled in chunks interleaved with everyone else's decode steps.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+import numpy as np
+
+QUEUED = "queued"          # waiting for a slot
+PREFILLING = "prefilling"  # owns a slot; prompt partially in the KV cache
+RUNNING = "running"        # decoding
+FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One generation request and its lifecycle bookkeeping."""
+
+    prompt: np.ndarray                  # 1-D int token ids
+    max_new_tokens: int
+    request_id: int = -1
+    eos_token_id: int = -1              # -1 = no EOS stop
+    state: str = QUEUED
+    slot: int = -1
+    prefill_pos: int = 0                # prompt tokens already in the cache
+    output_tokens: List[int] = field(default_factory=list)
+    # deferred-output refs [(block_idx, n_tokens), ...]: on the no-EOS fast
+    # path the engine defers fetching sampled tokens until finish; these
+    # name the device token blocks (in order) this request's output spans
+    pending_blocks: List = field(default_factory=list)
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
+    t_finish: float = 0.0
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def done(self) -> bool:
+        return self.state == FINISHED
+
+    @property
+    def latency(self) -> float:
+        """Submit -> finish wall seconds (0 until finished)."""
+        return (self.t_finish - self.t_submit) if self.done else 0.0
+
+
+class IterationScheduler:
+    """FIFO admission over a fixed pool of KV-cache slots.
+
+    ``submit`` enqueues; ``admit`` assigns every free slot to the oldest
+    queued requests (called once per engine iteration); ``finish`` frees
+    the slot immediately so the next ``admit`` can reuse it.  Completion
+    order is recorded in ``finished`` (drain ordering is by finish time,
+    not submit time — early-EOS rows drain first).
+    """
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self._queue: Deque[Request] = deque()
+        self._slots: List[Optional[Request]] = [None] * num_slots
+        self.finished: List[Request] = []
+        self._ids = itertools.count()
+
+    # -- admission -----------------------------------------------------
+    def submit(self, req: Request) -> Request:
+        if req.request_id < 0:
+            req.request_id = next(self._ids)
+        req.state = QUEUED
+        req.t_submit = time.perf_counter()
+        self._queue.append(req)
+        return req
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slots) if r is None]
+
+    def admit(self) -> List[Request]:
+        """Assign free slots to the oldest queued requests (FIFO); returns
+        the newly-admitted requests, now in PREFILLING state."""
+        admitted = []
+        for slot in self.free_slots():
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            req.slot = slot
+            req.state = PREFILLING
+            req.prefill_pos = 0
+            self._slots[slot] = req
+            admitted.append(req)
+        return admitted
+
+    # -- lifecycle -----------------------------------------------------
+    def request_in(self, slot: int) -> Optional[Request]:
+        return self._slots[slot]
+
+    def prefilling(self) -> List[Request]:
+        """Prefilling requests in ADMISSION order (request ids are
+        assigned FIFO at submit) — the engine advances a bounded number of
+        chunks per iteration, and slot-index order would starve
+        high-index slots under churn."""
+        return sorted((r for r in self._slots
+                       if r is not None and r.state == PREFILLING),
+                      key=lambda r: r.request_id)
+
+    def running(self) -> List[Request]:
+        return [r for r in self._slots if r is not None and r.state == RUNNING]
+
+    def finish(self, req: Request) -> None:
+        """Mark finished and free the slot NOW (iteration-level release:
+        the next admit() hands this slot to the head of the queue)."""
+        if req.state == FINISHED:
+            return
+        req.state = FINISHED
+        req.t_finish = time.perf_counter()
+        if req.slot >= 0 and self._slots[req.slot] is req:
+            self._slots[req.slot] = None
+        self.finished.append(req)
+
+    def drain_finished(self) -> List[Request]:
+        """Return-and-clear the finished list.  Long-lived serving loops
+        MUST call this (or process the slice ``ServingEngine.step``
+        returns and drain between steps): ``finished`` retains every
+        completed request — prompt and output included — and grows without
+        bound otherwise.  Call between engine iterations, not mid-step."""
+        out = self.finished
+        self.finished = []
+        return out
+
+    # -- introspection -------------------------------------------------
+    @property
+    def num_queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def num_occupied(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or self.num_occupied > 0

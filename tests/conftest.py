@@ -25,6 +25,26 @@ if not os.environ.get("DSTPU_TEST_ON_TPU"):
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Persistent XLA compilation cache: the suite compiles many IDENTICAL
+# tiny-model programs (every engine instance re-jits the same decode loop /
+# prefill shapes), and compiles dominate tier-1 wall time on small hosts.
+# The cache dedupes by HLO hash within a run and persists across runs.
+if not os.environ.get("DSTPU_TEST_ON_TPU"):
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("DSTPU_XLA_CACHE_DIR",
+                                         "/tmp/dstpu_xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # older jax without the persistent cache: no-op
+        pass
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; register the marker so slow-marked
+    # benches (tests/perf/test_serving_bench.py) don't warn
+    config.addinivalue_line("markers",
+                            "slow: long benchmark; excluded from tier-1")
+
 if not os.environ.get("DSTPU_TEST_ON_TPU"):
     # jax may already be imported by the interpreter's sitecustomize (with
     # JAX_PLATFORMS pinned to the TPU tunnel); the backend is not yet

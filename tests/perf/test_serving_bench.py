@@ -1,0 +1,29 @@
+"""Serving benchmark scenario (slow): Poisson arrivals, mixed lengths,
+continuous batching vs the static-batch baseline at equal slot count.
+Marked ``slow`` — excluded from tier-1; the fast tier-1 serving coverage is
+``tests/unit/test_serving.py``.  On the CPU mesh this validates the
+scenario mechanics and reports the measured speedup; the ≥2x goodput
+acceptance target is for the 125M config on real TPU (``bench.py``)."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_serving_bench_scenario(capsys):
+    from bench import bench_serving
+
+    out = bench_serving(num_requests=12, num_slots=4, qps=200.0, tiny=True)
+    for side in ("continuous", "static"):
+        assert out[side]["goodput_tok_s"] > 0
+        assert out[side]["p99_latency_s"] >= out[side]["p50_latency_s"]
+    assert out["continuous"]["tokens"] == out["static"]["tokens"], \
+        "goodput must count the same requested tokens on both sides"
+    assert out["goodput_speedup"] > 0
+    with capsys.disabled():
+        print(f"\nserving bench (tiny/CPU): continuous "
+              f"{out['continuous']['goodput_tok_s']} tok/s vs static "
+              f"{out['static']['goodput_tok_s']} tok/s "
+              f"({out['goodput_speedup']}x); p99 "
+              f"{out['continuous']['p99_latency_s']}s vs "
+              f"{out['static']['p99_latency_s']}s")

@@ -131,6 +131,46 @@ def test_generate_bucketed_prefill_matches_exact(tiny_model, rng):
                                   np.asarray(jnp.stack(want, axis=1)))
 
 
+def test_batch_bucket_reuse_and_reentrancy_guard(tiny_model, rng):
+    """(a) A batch-3 call after a batch-8 call must REUSE the batch-8
+    cache allocation and compiled programs (batch pads up to the bucket)
+    instead of reallocating + recompiling — and produce the same rows (row
+    independence: padding rows cannot perturb real rows).  (b) generate()
+    donates + nulls the cache mid-call; re-entry must raise a clear
+    RuntimeError instead of crashing inside XLA."""
+    toks = jax.random.randint(rng, (8, 8), 0, 256)
+    params = tiny_model.init(rng, toks)
+    engine = deepspeed_tpu.init_inference(
+        tiny_model, config={"dtype": "float32", "max_out_tokens": 64})
+    engine.set_params(params)
+    out8 = np.asarray(engine.generate(toks, max_new_tokens=4))
+    assert engine._cache["k"].shape[1] == 8
+    fns = engine._gen_fns
+    prefills = engine._prefill_fns
+    out3 = np.asarray(engine.generate(toks[:3], max_new_tokens=4))
+    assert engine._cache["k"].shape[1] == 8, "batch-3 reallocated the cache"
+    assert engine._gen_fns is fns and engine._prefill_fns is prefills, \
+        "batch-3 dropped the batch-8 compiled fns"
+    assert out3.shape == (3, 12)
+    np.testing.assert_array_equal(out3, out8[:3])
+
+    # (b) simulate re-entry from inside the running call (e.g. another
+    # thread) by hooking the point where the cache has been donated
+    real = engine._gen_loop
+
+    def reenter(settings):
+        with pytest.raises(RuntimeError, match="not reentrant"):
+            engine.generate(toks, max_new_tokens=4)
+        return real(settings)
+
+    engine._gen_loop = reenter
+    out = engine.generate(toks, max_new_tokens=4)
+    assert out.shape == (8, 12)
+    engine._gen_loop = real
+    # and the flag must reset even after an inner failure
+    assert engine.generate(toks, max_new_tokens=4).shape == (8, 12)
+
+
 def test_generate_single_dispatch(tiny_model, rng, monkeypatch):
     """The whole decode loop must be ONE compiled call — count dispatches."""
     toks = jax.random.randint(rng, (1, 8), 0, 256)
