@@ -185,6 +185,11 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
         model, config={"dtype": "bfloat16", "max_out_tokens": max_out},
         num_slots=num_slots, decode_block_tokens=8)
     serve.set_params(params)
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    registry = get_registry()
+    was_enabled = registry.enabled
+    registry.enable()
 
     def run_continuous():
         t0 = time.perf_counter()
@@ -203,9 +208,28 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
         toks = sum(len(r.output_tokens) for r in reqs)
         return toks, makespan, lat
 
-    run_continuous()                        # compile-warm passes
-    run_continuous()
-    toks_c, span_c, lat_c = run_continuous()
+    try:
+        run_continuous()                    # compile-warm passes
+        run_continuous()
+        registry.reset()                    # warm passes out of the record
+        toks_c, span_c, lat_c = run_continuous()
+        # serving-health metrics from the lifecycle registry (host-side
+        # histograms over the RECORDED pass only) — tracked per BENCH row
+        # so a goodput regression is attributable to admission vs prefill
+        # vs decode, not just visible in the aggregate
+        snap = registry.snapshot()
+        serving_metrics = {
+            "ttft_p50_s": round(snap["ds_serve_ttft_seconds"]["p50"], 4),
+            "ttft_p99_s": round(snap["ds_serve_ttft_seconds"]["p99"], 4),
+            "queue_wait_p99_s":
+                round(snap["ds_serve_queue_wait_seconds"]["p99"], 4),
+            "tpot_p50_s": round(snap["ds_serve_tpot_seconds"]["p50"], 5),
+            "mean_slot_occupancy":
+                round(snap["ds_serve_occupancy_ratio"]["mean"], 3),
+        }
+    finally:
+        if not was_enabled:                 # a mid-bench raise must not
+            registry.disable()              # leave the registry hot
 
     # -- static-batch baseline ----------------------------------------
     engine = deepspeed_tpu.init_inference(
@@ -248,6 +272,7 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
         "continuous": {"goodput_tok_s": round(toks_c / span_c, 1),
                        "tokens": toks_c, "makespan_s": round(span_c, 3),
                        "p50_latency_s": p50_c, "p99_latency_s": p99_c},
+        "metrics": serving_metrics,
         "static": {"goodput_tok_s": round(toks_s / span_s, 1),
                    "tokens": toks_s, "makespan_s": round(span_s, 3),
                    "p50_latency_s": p50_s, "p99_latency_s": p99_s},
@@ -674,9 +699,17 @@ def main():
                       else {})},
     })
     print(json.dumps(record))
-    # machine-readable single-line summary for automated perf tracking
-    # (the harness greps for the BENCH_JSON: prefix; keep it LAST and on
-    # one line)
+    for line in summary_lines(record, rung_serving):
+        print(line)
+
+
+def summary_lines(record: dict, rung_serving) -> list:
+    """The machine-readable tail of the bench stdout: a human-greppable
+    ``BENCH_JSON:``-prefixed line followed by the SAME summary as a bare
+    JSON object on the FINAL line — the runner ``json.loads``-parses the
+    last stdout line into its ``parsed`` field (a prefixed final line
+    parses to nothing, which is exactly the BENCH_r05 ``"parsed": null``
+    bug).  tests/unit/test_metrics.py round-trips the last line."""
     summary = {"metric": record["metric"], "value": record["value"],
                "unit": record["unit"], "vs_baseline": record["vs_baseline"],
                "mfu": record["detail"]["mfu"],
@@ -687,7 +720,12 @@ def main():
         summary["serving_goodput_speedup"] = rung_serving["goodput_speedup"]
         summary["serving_p99_latency_s"] = \
             rung_serving["continuous"]["p99_latency_s"]
-    print("BENCH_JSON: " + json.dumps(summary, separators=(",", ":")))
+        # serving-health row (TTFT/queue-wait/occupancy from the metrics
+        # registry) so BENCH_r*.json tracks latency attribution, not just
+        # aggregate goodput
+        summary["serving_metrics"] = rung_serving.get("metrics")
+    line = json.dumps(summary, separators=(",", ":"))
+    return ["BENCH_JSON: " + line, line]
 
 
 if __name__ == "__main__":

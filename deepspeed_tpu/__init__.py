@@ -12,7 +12,13 @@ from __future__ import annotations
 __version__ = "0.1.0"
 __git_branch__ = "main"
 
-from deepspeed_tpu import comm  # noqa: F401
+# before any submodule import: modules reference jax.shard_map at call time,
+# and users' own code may too, as soon as deepspeed_tpu is imported
+from deepspeed_tpu.utils.compat import install_jax_compat  # noqa: E402
+
+install_jax_compat()
+
+from deepspeed_tpu import comm  # noqa: F401,E402
 from deepspeed_tpu.runtime import zero  # noqa: F401
 from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
@@ -75,12 +81,20 @@ def init_serving(model=None, config=None, **kwargs):
     """Create a continuous-batching :class:`~deepspeed_tpu.serving.engine.
     ServingEngine` (the MII / DeepSpeed-FastGen dynamic-batching role):
     slot-based KV cache, iteration-level scheduling, chunked prefill
-    interleaved with per-row-position decode."""
+    interleaved with per-row-position decode.
+
+    ``metrics_port=`` (optional) enables the process-global metrics
+    registry and serves it over HTTP for the engine's lifetime:
+    ``GET /metrics`` (Prometheus text) + ``GET /statz`` (JSON snapshot).
+    Pass ``0`` for an ephemeral port — read it back from
+    ``engine.metrics_server.port``.  See docs/OBSERVABILITY.md.
+    """
     from deepspeed_tpu.serving.engine import ServingEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 
     params = kwargs.pop("params", None)
     mesh = kwargs.pop("mesh", None)
+    metrics_port = kwargs.pop("metrics_port", None)
     engine_kw = {k: kwargs.pop(k) for k in
                  ("engine", "num_slots", "prefill_chunk",
                   "decode_block_tokens", "do_sample", "temperature",
@@ -90,7 +104,21 @@ def init_serving(model=None, config=None, **kwargs):
         # ServingEngine rejects engine= combined with config/model args
         config = _merge_inference_config(config, kwargs,
                                          DeepSpeedInferenceConfig)
-    return ServingEngine(model, config, params=params, mesh=mesh, **engine_kw)
+    serve = ServingEngine(model, config, params=params, mesh=mesh, **engine_kw)
+    if metrics_port is not None:
+        import weakref
+
+        from deepspeed_tpu.monitor.metrics import get_registry
+        from deepspeed_tpu.monitor.server import MetricsServer
+
+        get_registry().enable()
+        server = MetricsServer(get_registry(), port=int(metrics_port)).start()
+        serve.metrics_server = server
+        # "for the engine's lifetime": a discarded engine must not leak its
+        # bound port + exporter thread — engine.close() stops it
+        # deterministically, this finalizer catches the GC path
+        weakref.finalize(serve, server.stop)
+    return serve
 
 
 def init_distributed(dist_backend: str = "xla", **kwargs):

@@ -19,6 +19,7 @@ machinery maps onto TPU as:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Optional
 
 import jax
@@ -29,6 +30,7 @@ from deepspeed_tpu.comm.mesh import build_mesh, get_global_mesh, set_global_mesh
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
                                            sample_token)
+from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.runtime.zero.partition import params_pspecs, shardings_from_pspecs
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -76,6 +78,23 @@ class InferenceEngine:
         import threading
         self._generating = False
         self._gen_lock = threading.Lock()
+        # inference metrics (one-branch no-ops while the registry is
+        # disabled): generate() latency + volume, cache-bucket rebinds
+        # (reallocation drops compiled fns), and program compiles — the
+        # counters that attribute a latency regression to recompilation
+        reg = get_registry()
+        self._m_gen_s = reg.histogram(
+            "ds_infer_generate_seconds", "one generate() call, wall time")
+        self._m_gen = reg.counter(
+            "ds_infer_generate_total", "generate() calls")
+        self._m_gen_toks = reg.counter(
+            "ds_infer_generated_tokens_total", "tokens returned by generate()")
+        self._m_rebinds = reg.counter(
+            "ds_infer_cache_rebinds_total",
+            "KV-cache reallocations (bucket growth; drops compiled fns)")
+        self._m_compiles = reg.counter(
+            "ds_infer_compiles_total",
+            "programs built (prefill buckets + decode loops)")
         if params is not None:
             self.set_params(params)
         elif getattr(config, "checkpoint", None):
@@ -223,6 +242,7 @@ class InferenceEngine:
             if cur is not None:
                 need_b = max(need_b, cur["k"].shape[1])
                 need_len = max(need_len, cur["k"].shape[3])
+                self._m_rebinds.inc()   # growth realloc: compiled fns drop
             self._cache = init_kv_cache(
                 cfg, need_b, need_len, dtype=self.dtype,
                 quantized=self._config.quantize_kv_cache)
@@ -237,6 +257,7 @@ class InferenceEngine:
         materialize GBs just to keep one row."""
         s = tokens.shape[1]
         if s not in self._prefill_fns:
+            self._m_compiles.inc()
             model = self.module
 
             @functools.partial(jax.jit, donate_argnums=(1,))
@@ -266,6 +287,7 @@ class InferenceEngine:
         otherwise the reference-shaped unfused forward."""
         if settings in self._gen_fns:
             return self._gen_fns[settings]
+        self._m_compiles.inc()
         eos, do_sample, temperature, top_k, top_p = settings
         model = self.module
         fused = self._dparams is not None
@@ -372,6 +394,7 @@ class InferenceEngine:
                     "ServingEngine for concurrent requests.")
             self._generating = True
         try:
+            t0 = time.perf_counter()
             tokens = jnp.asarray(input_ids)
             if tokens.ndim == 1:
                 tokens = tokens[None]
@@ -388,9 +411,13 @@ class InferenceEngine:
                     f"{self._config.max_out_tokens} cannot cover "
                     f"min_out_tokens={self._config.min_out_tokens} after a "
                     f"{S}-token prompt")
-            return self._generate(tokens, B, S, max_len, max_new_tokens,
-                                  do_sample, temperature, top_k, top_p,
-                                  eos_token_id, rng)
+            out = self._generate(tokens, B, S, max_len, max_new_tokens,
+                                 do_sample, temperature, top_k, top_p,
+                                 eos_token_id, rng)
+            self._m_gen_s.record(time.perf_counter() - t0)
+            self._m_gen.inc()
+            self._m_gen_toks.inc(B * (out.shape[1] - S))
+            return out
         finally:
             with self._gen_lock:
                 self._generating = False

@@ -1,0 +1,12 @@
+"""Monitoring: event-stream backends (monitor.py — the reference's
+``deepspeed/monitor`` role: CSV/TensorBoard/W&B fan-out) plus the
+request-lifecycle metrics registry (metrics.py) and its Prometheus/JSON
+HTTP exporter (server.py).  See docs/OBSERVABILITY.md."""
+
+from deepspeed_tpu.monitor.metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                                           MetricsRegistry, get_registry)
+from deepspeed_tpu.monitor.monitor import MonitorMaster  # noqa: F401
+from deepspeed_tpu.monitor.server import MetricsServer  # noqa: F401
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "MetricsServer", "MonitorMaster"]

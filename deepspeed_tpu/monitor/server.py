@@ -1,0 +1,97 @@
+"""Stdlib HTTP exporter for the metrics registry.
+
+``MetricsServer(registry, port=0).start()`` serves two endpoints on a
+daemon thread:
+
+- ``GET /metrics`` — Prometheus text exposition (scrape target);
+- ``GET /statz``  — the same registry as a JSON snapshot (humans, tests,
+  and ``tools/metrics_dump.py``).
+
+``port=0`` binds an ephemeral port (read it back from ``server.port``) —
+the shape tests and multi-engine hosts need.  Zero dependencies: plain
+``http.server`` over the registry's lock-free snapshot reads, so a scrape
+never blocks the serving loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deepspeed_tpu.monitor.metrics import MetricsRegistry, get_registry
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["MetricsServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry  # set by the server subclass
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.registry.prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path in ("/statz", "/statz/"):
+            body = self.registry.statz_json().encode()
+            ctype = "application/json"
+        elif path == "/":
+            body = json.dumps({"endpoints": ["/metrics", "/statz"]}).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes are not log lines
+        pass
+
+
+class MetricsServer:
+    """Serve ``/metrics`` + ``/statz`` for a registry on a daemon thread."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.registry = registry if registry is not None else get_registry()
+        self._requested_port = port
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        """The BOUND port (differs from the requested one when port=0)."""
+        return self._httpd.server_address[1] if self._httpd else \
+            self._requested_port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        handler = type("Handler", (_Handler,), {"registry": self.registry})
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="ds-metrics-http", daemon=True)
+        self._thread.start()
+        logger.info("metrics server: %s/metrics (Prometheus), %s/statz "
+                    "(JSON)", self.url, self.url)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        self._thread = None

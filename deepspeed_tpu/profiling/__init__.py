@@ -1,4 +1,6 @@
 """Profiling subsystem (reference: ``deepspeed/profiling/``, SURVEY.md §5.1):
-the FLOPS profiler built on XLA cost analysis lives in ``flops_profiler``."""
+the FLOPS profiler built on XLA cost analysis lives in ``flops_profiler``;
+``trace`` adds xplane trace capture + host-side TraceAnnotation ranges."""
 
 from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler, get_model_profile  # noqa: F401
+from deepspeed_tpu.profiling.trace import TraceCapture, annotate  # noqa: F401

@@ -13,12 +13,29 @@ device ops carry the ``ds_fwd_bwd`` / ``ds_optimizer_step``
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Optional
 
 import jax
 
 from deepspeed_tpu.utils.logging import logger
+
+
+def annotate(name: str):
+    """Host-timeline named range in the xplane trace (the NVTX-range
+    analog): ``with annotate("ds_serve_decode"): ...``.
+
+    Used by the serving loop for its per-phase ranges (``ds_serve_admit`` /
+    ``ds_serve_prefill`` / ``ds_serve_decode``) so the xplane device
+    timeline lines up with the host-side ``ds_serve_*`` histograms
+    (monitor/metrics.py) phase for phase.  Near-free when no trace is being
+    captured; degrades to a no-op on jax builds without TraceAnnotation.
+    """
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - jax without profiler support
+        return contextlib.nullcontext()
 
 
 class TraceCapture:

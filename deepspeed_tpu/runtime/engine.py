@@ -1675,6 +1675,11 @@ class DeepSpeedEngine:
             self.monitor.write_events([("Train/loss", loss, steps),
                                        ("Train/lr", lr, steps),
                                        ("Train/loss_scale", self.loss_scale, steps)])
+            # same-schema bridge: the ds_* registry (serving/inference/
+            # timer metrics) fans out to the CSV/TensorBoard backends too
+            from deepspeed_tpu.monitor.metrics import get_registry
+
+            get_registry().publish(self.monitor, steps)
 
     def deepspeed_io(self, dataset, batch_size=None, **kwargs):
         gas_batch = batch_size or self.config.train_micro_batch_size_per_gpu * \

@@ -46,6 +46,8 @@ from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine, pow2_bucket
 from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
                                            sample_token)
+from deepspeed_tpu.monitor.metrics import get_registry
+from deepspeed_tpu.profiling.trace import annotate
 from deepspeed_tpu.serving.scheduler import (RUNNING, IterationScheduler,
                                              Request)
 from deepspeed_tpu.utils.logging import log_dist
@@ -129,6 +131,40 @@ class ServingEngine:
         self._block_refs = {}   # idx -> pending request references
         self._next_block = 0
         self.steps = 0
+        self.metrics_server = None   # attached by init_serving(metrics_port=)
+        # compute-side lifecycle metrics (queue-side spans live in the
+        # scheduler; all are one-branch no-ops while the registry is
+        # disabled — see docs/OBSERVABILITY.md for the schema)
+        reg = get_registry()
+        self._m_ttft = reg.histogram(
+            "ds_serve_ttft_seconds", "submit -> first-token dispatch")
+        self._m_tpot = reg.histogram(
+            "ds_serve_tpot_seconds",
+            "per-output-token latency (first token -> finish)")
+        self._m_prefill_s = reg.histogram(
+            "ds_serve_prefill_chunk_seconds", "one chunked-prefill dispatch")
+        self._m_decode_s = reg.histogram(
+            "ds_serve_decode_block_seconds",
+            "one compiled decode-block dispatch (host side)")
+        self._m_prefill_chunks = reg.counter(
+            "ds_serve_prefill_chunks_total", "prefill chunks dispatched")
+        self._m_prefill_toks = reg.counter(
+            "ds_serve_prefill_tokens_total", "prompt tokens prefilled")
+        self._m_decode_toks = reg.counter(
+            "ds_serve_decode_tokens_total", "decode tokens scheduled")
+        self._m_steps = reg.counter(
+            "ds_serve_steps_total", "scheduler iterations")
+        self._m_compiles = reg.counter(
+            "ds_serve_compiles_total",
+            "serving programs compiled (prefill buckets + decode block)")
+        self._m_active = reg.gauge(
+            "ds_serve_active_slots", "slots decoding right now")
+        self._m_occupancy = reg.histogram(
+            "ds_serve_occupancy_ratio",
+            "per-step occupied-slot fraction (mean = avg occupancy)",
+            buckets=tuple(i / 16 for i in range(1, 17)))
+        self._m_step_finished = reg.gauge(
+            "ds_serve_step_finished", "requests drained by the last step")
         from deepspeed_tpu.models.fused_decode import supports_fused_decode
         fused_ok = (self._config.use_fused_decode is not False
                     and supports_fused_decode(
@@ -172,19 +208,27 @@ class ServingEngine:
             raise RuntimeError("no weights: set_params() first")
         done_before = len(self.scheduler.finished)
         # 1. admission: freed slots pick up the oldest queued requests
-        for req in self.scheduler.admit():
-            self._pos[req.slot] = 0
-            self._active[req.slot] = False
-            self._limit[req.slot] = 0
+        with annotate("ds_serve_admit"):
+            for req in self.scheduler.admit():
+                self._pos[req.slot] = 0
+                self._active[req.slot] = False
+                self._limit[req.slot] = 0
         # 2. chunked prefill, oldest admissions first (bounded per
         #    iteration so running slots' decode latency stays bounded)
-        for req in self.scheduler.prefilling()[: self.max_prefill_chunks]:
-            self._prefill_one_chunk(req)
+        with annotate("ds_serve_prefill"):
+            for req in self.scheduler.prefilling()[: self.max_prefill_chunks]:
+                self._prefill_one_chunk(req)
         # 3. decode one block for every active slot
         if self._active.any():
-            self._decode_block()
+            with annotate("ds_serve_decode"):
+                self._decode_block()
         self.steps += 1
-        return self.scheduler.finished[done_before:]
+        self._m_steps.inc()
+        self._m_active.set(int(self._active.sum()))
+        self._m_occupancy.record(self.scheduler.num_occupied / self.num_slots)
+        finished = self.scheduler.finished[done_before:]
+        self._m_step_finished.set(len(finished))
+        return finished
 
     def run(self) -> List[Request]:
         """Drain: iterate until queue and slots are empty; returns finished
@@ -195,6 +239,7 @@ class ServingEngine:
 
     # ------------------------------------------------------------------
     def _prefill_one_chunk(self, req: Request) -> None:
+        t0 = time.perf_counter()
         slot, off = req.slot, req.prefill_pos
         c = min(self.prefill_chunk, req.prompt_len - off)
         cb = pow2_bucket(c, lo=8, cap=self.cache_len - off)  # pow2 bucket
@@ -206,6 +251,9 @@ class ServingEngine:
             jnp.asarray(slot, jnp.int32), jnp.asarray(off, jnp.int32),
             jnp.asarray(c - 1, jnp.int32), srng)
         req.prefill_pos += c
+        self._m_prefill_s.record(time.perf_counter() - t0)
+        self._m_prefill_chunks.inc()
+        self._m_prefill_toks.inc(c)
         # parked rows write junk at their own pos; keeping pos = prefill
         # progress means the NEXT chunk overwrites that row before any
         # query attends it
@@ -217,19 +265,29 @@ class ServingEngine:
         # depends on it (EOS) — otherwise it stays on device and the
         # pipeline keeps flowing.
         req.t_first_token = time.perf_counter()
+        # dispatch-time TTFT: on the sync-free path the token VALUE is still
+        # on device, but it exists and later work is ordered behind it
+        self._m_ttft.record(req.t_first_token - req.t_submit)
         S = req.prompt_len
         # limit <= S: the cache budget is already exhausted by the prompt
         # (prompt length >= max_out_tokens - 1) — the prefill-sampled token
         # is the only one this request can emit.  The bound is the LOGICAL
         # max_out_tokens, not the block-rounded physical cache depth, so a
         # request emits exactly the tokens generate() would
-        limit = min(S + req.max_new_tokens - 1, self.max_out - 1)
+        req_bound = S + req.max_new_tokens - 1
+        limit = min(req_bound, self.max_out - 1)
+        req.limit_reason = "length" if limit == req_bound else "cache_budget"
         if req.eos_token_id >= 0 or req.max_new_tokens == 1 or limit <= S:
             first = int(tok_dev)
             req.output_tokens.append(first)
-            if (req.eos_token_id >= 0 and first == req.eos_token_id) \
-                    or req.max_new_tokens == 1 or limit <= S:
-                self._release(req)
+            if req.eos_token_id >= 0 and first == req.eos_token_id:
+                self._release(req, "eos")
+                return
+            if req.max_new_tokens == 1:
+                self._release(req, "length")
+                return
+            if limit <= S:
+                self._release(req, req.limit_reason)
                 return
         else:
             req.pending_blocks.append(("tok", tok_dev))
@@ -254,6 +312,7 @@ class ServingEngine:
         the engine's bucketed prefill)."""
         if cb in self._prefill_fns:
             return self._prefill_fns[cb]
+        self._m_compiles.inc()
         model = self.module
         do_sample, temperature, top_k, top_p = self._sample
 
@@ -292,11 +351,13 @@ class ServingEngine:
 
         With any active EOS request, token VALUES gate scheduling, so the
         block is fetched synchronously and processed token-by-token."""
+        t0 = time.perf_counter()
         running = self.scheduler.running()
         toks, valid, self._last_dev, self._cache, self._rng = self._block()(
             self._loop_params(), self._cache, self._last_dev,
             jnp.asarray(self._pos), jnp.asarray(self._active),
             jnp.asarray(self._limit), jnp.asarray(self._eos), self._rng)
+        self._m_decode_s.record(time.perf_counter() - t0)
         if all(r.eos_token_id < 0 for r in running):
             idx = self._next_block
             self._next_block += 1
@@ -307,6 +368,7 @@ class ServingEngine:
                 req.pending_blocks.append((idx, n))
                 refs += 1
                 self._pos[b] += n
+                self._m_decode_toks.inc(n)
                 if self._pos[b] >= self._limit[b]:
                     self._active[b] = False
             if refs:
@@ -315,7 +377,7 @@ class ServingEngine:
             for req in running:           # finish AFTER refs registered
                 if not self._active[req.slot] and req.state == RUNNING:
                     self._materialize(req)
-                    self._release(req)
+                    self._release(req, req.limit_reason)
             return
         # synchronous path: flush any deferred output first so token order
         # is preserved, then walk the fetched block
@@ -331,15 +393,19 @@ class ServingEngine:
                 t = int(toks[k, b])
                 req.output_tokens.append(t)
                 self._pos[b] += 1
-                if (req.eos_token_id >= 0 and t == req.eos_token_id) or \
-                        len(req.output_tokens) >= req.max_new_tokens:
-                    self._release(req)
+                self._m_decode_toks.inc()
+                if req.eos_token_id >= 0 and t == req.eos_token_id:
+                    self._release(req, "eos")
+                    break
+                if len(req.output_tokens) >= req.max_new_tokens:
+                    self._release(req, "length")
                     break
             if req.state == RUNNING and self._pos[b] >= self._limit[b]:
-                # cache-budget truncation (prompt near max_out_tokens)
-                self._release(req)
+                # position-limit stop (in practice the cache-budget bound:
+                # a length-bound request releases in-loop at max_new)
+                self._release(req, req.limit_reason)
 
-    def _release(self, req: Request) -> None:
+    def _release(self, req: Request, reason: str) -> None:
         """Finish the request and park its slot at depth 0: the parked
         row's junk writes land on row 0 (overwritten by the next
         occupant's first prefill chunk before it can be attended), and —
@@ -348,6 +414,11 @@ class ServingEngine:
         else."""
         self._active[req.slot] = False
         self._pos[req.slot] = 0
+        req.finish_reason = reason
+        n = len(req.output_tokens)
+        if n > 1 and req.t_first_token:
+            self._m_tpot.record((time.perf_counter() - req.t_first_token)
+                                / (n - 1))
         self.scheduler.finish(req)
 
     def _materialize(self, req: Request) -> None:
@@ -399,6 +470,7 @@ class ServingEngine:
         block; parked rows keep static shapes alive at their frozen pos."""
         if self._block_fn is not None:
             return self._block_fn
+        self._m_compiles.inc()
         step_fn = self._step_fn()
         do_sample, temperature, top_k, top_p = self._sample
         K = self._K
@@ -427,6 +499,16 @@ class ServingEngine:
         return block
 
     # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Release host-side resources: stops the attached metrics HTTP
+        server (if ``init_serving(metrics_port=...)`` started one).  The
+        device-side state (cache, programs) is freed by GC as usual; a
+        dropped engine's server is also stopped by a GC finalizer, so
+        ``close()`` is for deterministic shutdown, not a leak guard."""
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+
     @property
     def config(self):
         return self._config

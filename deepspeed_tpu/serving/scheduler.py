@@ -20,9 +20,11 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
+
+from deepspeed_tpu.monitor.metrics import get_registry
 
 QUEUED = "queued"          # waiting for a slot
 PREFILLING = "prefilling"  # owns a slot; prompt partially in the KV cache
@@ -47,8 +49,14 @@ class Request:
     # name the device token blocks (in order) this request's output spans
     pending_blocks: List = field(default_factory=list)
     t_submit: float = 0.0
+    t_admit: float = 0.0                # slot assignment (queue wait ends)
     t_first_token: float = 0.0
     t_finish: float = 0.0
+    finish_reason: str = ""             # "eos" | "length" | "cache_budget"
+    # which bound produced the engine's position limit (min of request
+    # budget and cache budget) — recorded WHERE the limit is computed so
+    # finish attribution can't drift from the limit formula
+    limit_reason: str = ""
 
     @property
     def prompt_len(self) -> int:
@@ -82,6 +90,25 @@ class IterationScheduler:
         self._slots: List[Optional[Request]] = [None] * num_slots
         self.finished: List[Request] = []
         self._ids = itertools.count()
+        # lifecycle metrics (no-ops while the registry is disabled; the
+        # scheduler owns the queue-side spans, the engine owns the
+        # compute-side ones — see docs/OBSERVABILITY.md)
+        reg = get_registry()
+        self._m_submitted = reg.counter(
+            "ds_serve_submitted_total", "requests enqueued")
+        self._m_admitted = reg.counter(
+            "ds_serve_admitted_total", "requests assigned a KV slot")
+        self._m_queue_wait = reg.histogram(
+            "ds_serve_queue_wait_seconds", "submit -> slot admission wait")
+        self._m_latency = reg.histogram(
+            "ds_serve_request_latency_seconds", "submit -> finish wall time")
+        self._m_queue_depth = reg.gauge(
+            "ds_serve_queue_depth", "requests waiting for a slot")
+        self._m_finished: Dict[str, object] = {
+            r: reg.counter("ds_serve_finished_total",
+                           "finished requests by reason",
+                           labels={"reason": r})
+            for r in ("eos", "length", "cache_budget", "unknown")}
 
     # -- admission -----------------------------------------------------
     def submit(self, req: Request) -> Request:
@@ -90,6 +117,8 @@ class IterationScheduler:
         req.state = QUEUED
         req.t_submit = time.perf_counter()
         self._queue.append(req)
+        self._m_submitted.inc()
+        self._m_queue_depth.set(len(self._queue))
         return req
 
     def free_slots(self) -> List[int]:
@@ -106,8 +135,13 @@ class IterationScheduler:
             req.slot = slot
             req.state = PREFILLING
             req.prefill_pos = 0
+            req.t_admit = time.perf_counter()
             self._slots[slot] = req
             admitted.append(req)
+            self._m_admitted.inc()
+            self._m_queue_wait.record(req.t_admit - req.t_submit)
+        if admitted:
+            self._m_queue_depth.set(len(self._queue))
         return admitted
 
     # -- lifecycle -----------------------------------------------------
@@ -136,6 +170,12 @@ class IterationScheduler:
         if req.slot >= 0 and self._slots[req.slot] is req:
             self._slots[req.slot] = None
         self.finished.append(req)
+        self._m_latency.record(req.t_finish - req.t_submit)
+        # an unset/novel reason lands in the explicit "unknown" series —
+        # a nonzero count there means a release path forgot to attribute,
+        # which silent folding into "length" would hide
+        self._m_finished.get(req.finish_reason,
+                             self._m_finished["unknown"]).inc()
 
     def drain_finished(self) -> List[Request]:
         """Return-and-clear the finished list.  Long-lived serving loops

@@ -10,9 +10,11 @@ TPU-native analog of the reference's ``deepspeed/utils/timer.py`` (SURVEY.md
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Dict, List, Optional
 
+from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -37,6 +39,14 @@ class _Timer:
         self._elapsed = 0.0
         self._records: List[float] = []
         self.started = False
+        # bridge into the metrics registry: every stop() records into
+        # ds_train_<name>_seconds, so training phase timings share one
+        # schema (and one /metrics endpoint) with serving/inference.  A
+        # one-branch no-op while the registry is disabled.
+        slug = re.sub(r"[^a-z0-9_]", "_", name.lower())
+        self._metric = get_registry().histogram(
+            f"ds_train_{slug}_seconds",
+            f"wall-clock '{name}' phase (engine timers)")
 
     def start(self) -> None:
         if self.started:
@@ -68,6 +78,7 @@ class _Timer:
         self._elapsed += elapsed
         if record:
             self._records.append(elapsed)
+        self._metric.record(elapsed)
         self.started = False
 
     def reset(self) -> None:

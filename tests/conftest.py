@@ -25,6 +25,12 @@ if not os.environ.get("DSTPU_TEST_ON_TPU"):
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# jax-version shims (jax.shard_map on jax <= 0.4.x) BEFORE any test module
+# does `from jax import shard_map`
+from deepspeed_tpu.utils.compat import install_jax_compat  # noqa: E402
+
+install_jax_compat()
+
 # Persistent XLA compilation cache: the suite compiles many IDENTICAL
 # tiny-model programs (every engine instance re-jits the same decode loop /
 # prefill shapes), and compiles dominate tier-1 wall time on small hosts.

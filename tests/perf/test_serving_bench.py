@@ -20,6 +20,11 @@ def test_serving_bench_scenario(capsys):
     assert out["continuous"]["tokens"] == out["static"]["tokens"], \
         "goodput must count the same requested tokens on both sides"
     assert out["goodput_speedup"] > 0
+    # serving-health sub-object (BENCH_r*.json rows track these)
+    m = out["metrics"]
+    assert m["ttft_p99_s"] >= m["ttft_p50_s"] > 0
+    assert m["queue_wait_p99_s"] >= 0
+    assert 0 < m["mean_slot_occupancy"] <= 1
     with capsys.disabled():
         print(f"\nserving bench (tiny/CPU): continuous "
               f"{out['continuous']['goodput_tok_s']} tok/s vs static "

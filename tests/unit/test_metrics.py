@@ -1,0 +1,319 @@
+"""Metrics registry (monitor/metrics.py): histogram bucket/quantile
+correctness, snapshot consistency under concurrent writes, the Prometheus
+exposition golden format, the disabled-path cost contract (one branch, no
+allocation), the MonitorMaster bridge, the bench BENCH_JSON handshake, and
+the tier-1 NAMESPACE GUARD — every metric the suite registers must live in
+the ``ds_`` namespace and be documented in docs/OBSERVABILITY.md."""
+
+import json
+import os
+import re
+import sys
+import threading
+
+import pytest
+
+from deepspeed_tpu.monitor.metrics import MetricsRegistry, get_registry
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_basic():
+    reg = MetricsRegistry().enable()
+    c = reg.counter("ds_t_reqs_total")
+    g = reg.gauge("ds_t_depth")
+    c.inc()
+    c.inc(4)
+    g.set(3)
+    g.set(7.5)
+    assert c.value == 5
+    assert g.value == 7.5
+    # create-or-return: same (name, labels) is the same instrument
+    assert reg.counter("ds_t_reqs_total") is c
+    reg.reset()
+    assert c.value == 0 and g.value == 0.0
+
+
+def test_histogram_bucket_assignment():
+    reg = MetricsRegistry().enable()
+    h = reg.histogram("ds_t_lat_seconds", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 3.0, 5.0):   # le semantics: 1.0 -> first bucket
+        h.record(v)
+    assert h._counts == [2, 1, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(11.0)
+
+
+def test_histogram_quantiles_land_in_the_right_bucket():
+    reg = MetricsRegistry().enable()
+    h = reg.histogram("ds_t_lat_seconds")   # default log buckets 1us..100s
+    for _ in range(100):
+        h.record(0.01)
+    for _ in range(100):
+        h.record(1.0)
+    # p50 must fall inside the bucket containing 0.01, p90 inside the one
+    # containing 1.0 (log buckets at 4/decade: bucket width <= ~78%)
+    assert 0.005 <= h.quantile(0.5) <= 0.02
+    assert 0.5 <= h.quantile(0.9) <= 1.0 + 1e-9
+    assert h.mean == pytest.approx(0.505)
+    s = h.snapshot()
+    assert s["count"] == 200 and s["p99"] <= 1.0 + 1e-9
+    # all mass past the last bound: the overflow bucket reports the bound
+    h2 = reg.histogram("ds_t_over_seconds", buckets=(1.0, 2.0))
+    h2.record(100.0)
+    assert h2.quantile(0.5) == 2.0
+
+
+def test_histogram_snapshot_consistent_under_writes():
+    """Reader thread sees count == sum(buckets) on EVERY snapshot while a
+    writer hammers record() — the lock-free single-writer contract."""
+    reg = MetricsRegistry().enable()
+    h = reg.histogram("ds_t_lat_seconds")
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            h.record(0.37)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        last = 0
+        for _ in range(300):
+            s = h.snapshot()
+            assert s["count"] == sum(s["buckets"])
+            assert s["count"] >= last      # monotone under a single writer
+            last = s["count"]
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert h.count > 0
+
+
+def test_disabled_path_records_nothing_and_allocates_nothing():
+    reg = MetricsRegistry()                 # disabled by default
+    c = reg.counter("ds_t_total")
+    h = reg.histogram("ds_t_lat_seconds")
+    v = 0.125
+    c.inc()
+    h.record(v)                             # warm any lazy machinery
+    vals = [v] * 5000
+    before = sys.getallocatedblocks()
+    for x in vals:
+        h.record(x)
+        c.inc()
+    delta = sys.getallocatedblocks() - before
+    assert c.value == 0 and h.count == 0
+    # one branch, no allocation per record: the block count may wiggle a
+    # few blocks from interpreter internals, never per-call
+    assert delta < 100
+
+
+def test_duplicate_name_different_kind_raises():
+    reg = MetricsRegistry()
+    reg.counter("ds_t_thing")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("ds_t_thing")
+    # a name is uniformly labeled or uniformly bare: mixing would make the
+    # snapshot shape ambiguous (crash/drop at scrape time otherwise)
+    with pytest.raises(ValueError, match="without labels"):
+        reg.counter("ds_t_thing", labels={"reason": "eos"})
+    reg.counter("ds_t_fam", labels={"reason": "eos"})
+    reg.counter("ds_t_fam", labels={"reason": "length"})  # fine: one kind
+    with pytest.raises(ValueError, match="with labels"):
+        reg.counter("ds_t_fam")
+    # ...and the name still cannot cross kinds through a labeled variant
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("ds_t_fam", labels={"reason": "x"})
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+GOLDEN = """\
+# TYPE ds_t_depth gauge
+ds_t_depth 2
+# HELP ds_t_finished_total by reason
+# TYPE ds_t_finished_total counter
+ds_t_finished_total{reason="eos"} 2
+ds_t_finished_total{reason="length"} 1
+# HELP ds_t_lat_seconds latency
+# TYPE ds_t_lat_seconds histogram
+ds_t_lat_seconds_bucket{le="0.1"} 1
+ds_t_lat_seconds_bucket{le="1"} 2
+ds_t_lat_seconds_bucket{le="10"} 3
+ds_t_lat_seconds_bucket{le="+Inf"} 4
+ds_t_lat_seconds_sum 55.55
+ds_t_lat_seconds_count 4
+# HELP ds_t_reqs_total help text
+# TYPE ds_t_reqs_total counter
+ds_t_reqs_total 3
+"""
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry().enable()
+    reg.counter("ds_t_reqs_total", "help text").inc(3)
+    reg.gauge("ds_t_depth").set(2)
+    h = reg.histogram("ds_t_lat_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.record(v)
+    reg.counter("ds_t_finished_total", "by reason",
+                labels={"reason": "eos"}).inc(2)
+    reg.counter("ds_t_finished_total", labels={"reason": "length"}).inc()
+    assert reg.prometheus_text() == GOLDEN
+
+
+def test_statz_json_roundtrip():
+    reg = MetricsRegistry().enable()
+    reg.counter("ds_t_reqs_total").inc(2)
+    reg.histogram("ds_t_lat_seconds", buckets=(1.0,)).record(0.5)
+    reg.counter("ds_t_finished_total", labels={"reason": "eos"}).inc()
+    snap = json.loads(reg.statz_json())
+    assert snap["enabled"] is True
+    m = snap["metrics"]
+    assert m["ds_t_reqs_total"] == 2
+    assert m["ds_t_lat_seconds"]["count"] == 1
+    assert m["ds_t_finished_total"]['{reason="eos"}'] == 1
+
+
+def test_monitor_master_bridge():
+    """registry.publish fans counters/gauges/histogram summaries out as
+    MonitorMaster events (CSV/TensorBoard backends see the same schema)."""
+    reg = MetricsRegistry().enable()
+    reg.counter("ds_t_reqs_total").inc(4)
+    reg.gauge("ds_t_depth").set(3)
+    h = reg.histogram("ds_t_lat_seconds", buckets=(1.0, 2.0))
+    h.record(0.5)
+    h.record(1.5)
+
+    class FakeMonitor:
+        enabled = True
+
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, events):
+            self.events.extend(events)
+
+    mon = FakeMonitor()
+    reg.publish(mon, step=7)
+    ev = {name: (value, step) for name, value, step in mon.events}
+    assert ev["ds_t_reqs_total"] == (4, 7)
+    assert ev["ds_t_depth"] == (3, 7)
+    assert ev["ds_t_lat_seconds/count"][0] == 2
+    assert ev["ds_t_lat_seconds/mean"][0] == pytest.approx(1.0)
+    # disabled monitor: no events
+    mon2 = FakeMonitor()
+    mon2.enabled = False
+    reg.publish(mon2, step=8)
+    assert mon2.events == []
+
+
+# ---------------------------------------------------------------------------
+# bench handshake (satellite: BENCH_r05 "parsed": null)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_summary_last_line_roundtrips_json():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    record = {"metric": "m", "value": 1.5, "unit": "tok/s",
+              "vs_baseline": 0.5, "detail": {"mfu": 0.4, "backend": "cpu"}}
+    serving = {"goodput_speedup": 2.0,
+               "continuous": {"goodput_tok_s": 100.0, "p99_latency_s": 0.5},
+               "metrics": {"ttft_p50_s": 0.01, "ttft_p99_s": 0.05,
+                           "queue_wait_p99_s": 0.2,
+                           "mean_slot_occupancy": 0.9}}
+    lines = bench.summary_lines(record, serving)
+    # the runner parses the LAST stdout line: it must be the bare object
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"] == "m"
+    assert parsed["serving_metrics"]["queue_wait_p99_s"] == 0.2
+    # the human-greppable prefixed line stays, directly above it
+    assert lines[-2] == "BENCH_JSON: " + lines[-1]
+    # no serving rung (CPU smoke): still a parseable bare last line
+    parsed = json.loads(bench.summary_lines(record, None)[-1])
+    assert "serving_metrics" not in parsed
+
+
+def test_metrics_dump_renders_snapshot_and_csv(tmp_path):
+    """tools/metrics_dump.py renders /statz snapshots and csvMonitor dirs
+    as terminal tables (stdlib-only; used against live ports in ops)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    try:
+        import metrics_dump
+    finally:
+        sys.path.pop(0)
+    reg = MetricsRegistry().enable()
+    reg.counter("ds_t_reqs_total").inc(5)
+    reg.histogram("ds_t_lat_seconds", buckets=(1.0, 2.0)).record(0.5)
+    reg.counter("ds_t_finished_total", labels={"reason": "eos"}).inc(2)
+    snap = tmp_path / "statz.json"
+    snap.write_text(reg.statz_json())
+    table = metrics_dump.render(metrics_dump.rows_from_snapshot(
+        metrics_dump.load_snapshot(str(snap))))
+    assert "ds_t_reqs_total" in table and "5" in table
+    assert 'ds_t_finished_total{reason="eos"}' in table
+    # csvMonitor dir: last value per series
+    mon = tmp_path / "mon"
+    mon.mkdir()
+    (mon / "Train_loss.csv").write_text("step,Train/loss\n1,2.5\n2,2.25\n")
+    table = metrics_dump.render(metrics_dump.rows_from_snapshot(
+        metrics_dump.load_snapshot(str(mon))))
+    assert "Train_loss" in table and "2.25 @ step 2" in table
+
+
+# ---------------------------------------------------------------------------
+# tier-1 namespace guard
+# ---------------------------------------------------------------------------
+
+_DOC = os.path.join(os.path.dirname(__file__), "..", "..", "docs",
+                    "OBSERVABILITY.md")
+
+
+def test_namespace_guard_all_metrics_documented(devices):
+    """Fails the suite if ANY registered metric leaves the ``ds_``
+    namespace or is missing from docs/OBSERVABILITY.md (docs drift =
+    red).  Registers the full engine surface first so the guard holds
+    regardless of test order."""
+    from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.serving.engine import ServingEngine
+    from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+    # instantiate every instrument owner (no weights/compiles needed)
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=1, hidden_size=32,
+                      intermediate_size=64, num_heads=2, num_kv_heads=1,
+                      vocab_size=64, remat=False)
+    InferenceEngine(model, DeepSpeedInferenceConfig(dtype="float32"))
+    ServingEngine(model, {"dtype": "float32", "max_out_tokens": 32},
+                  num_slots=1)
+    timers = SynchronizedWallClockTimer()
+    for n in (timers.FORWARD, timers.BACKWARD, timers.STEP, timers.BATCH):
+        timers(n)
+
+    with open(_DOC) as fh:
+        documented = set(re.findall(r"ds_[a-z0-9_]+", fh.read()))
+    name_re = re.compile(r"^ds_[a-z0-9_]+$")
+    train_re = re.compile(r"^ds_train_[a-z0-9_]+_seconds$")
+    names = get_registry().names()
+    assert names, "no metrics registered — instrumentation went missing?"
+    bad_ns = [n for n in names if not name_re.match(n)]
+    assert not bad_ns, f"metrics outside the ds_ namespace: {bad_ns}"
+    undoc = [n for n in names
+             if n not in documented and not train_re.match(n)]
+    assert not undoc, (f"metrics not documented in docs/OBSERVABILITY.md: "
+                       f"{undoc} (the ds_train_*_seconds family is exempt "
+                       f"— it is documented as a pattern)")

@@ -202,6 +202,70 @@ def test_serving_smoke_single_program(served):
     assert not serve.scheduler.has_work
 
 
+def test_serving_metrics_enabled_parity_and_live_endpoints(served, rng):
+    """The acceptance loop for the observability layer: with the metrics
+    registry ENABLED and the HTTP exporter LIVE (init_serving(
+    metrics_port=0) -> ephemeral port), a mixed request wave must (a) stay
+    token-identical to sequential generate(), (b) fill the TTFT /
+    queue-wait / per-token-decode histograms, and (c) serve /metrics
+    (Prometheus text) + /statz (JSON) mid-loop while requests are still
+    in flight."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    import deepspeed_tpu
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    _, _, ref, _ = served
+    reg = get_registry()
+    reg.enable()
+    # share the fixture InferenceEngine's weights; the ephemeral-port
+    # exporter comes up with the engine
+    serve = deepspeed_tpu.init_serving(
+        engine=ref, num_slots=2, prefill_chunk=4,
+        decode_block_tokens=3, metrics_port=0)
+    try:
+        reg.reset()                   # this wave only
+        prompts, news = _mixed_requests(rng)
+        want = [np.asarray(ref.generate(p[None], max_new_tokens=n,
+                                        do_sample=False))[0, len(p):]
+                for p, n in zip(prompts, news)]
+        reqs = [serve.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        # scrape MID-LOOP: step until something is in flight, then GET
+        serve.step()
+        url = serve.metrics_server.url
+        prom = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "# TYPE ds_serve_ttft_seconds histogram" in prom
+        assert "ds_serve_queue_wait_seconds_bucket" in prom
+        serve.run()
+        statz = json.loads(
+            urllib.request.urlopen(url + "/statz").read().decode())
+        m = statz["metrics"]
+        n = len(reqs)
+        assert m["ds_serve_ttft_seconds"]["count"] == n
+        assert m["ds_serve_queue_wait_seconds"]["count"] == n
+        assert m["ds_serve_tpot_seconds"]["count"] == n   # all multi-token
+        assert m["ds_serve_decode_tokens_total"] > 0
+        assert m["ds_serve_submitted_total"] == n
+        reasons = m["ds_serve_finished_total"]
+        assert sum(reasons.values()) == n
+        assert reasons['{reason="length"}'] == n          # no EOS stops here
+        # 404 for anything else
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(url + "/nope")
+        # (a) token parity with metrics enabled + exporter live
+        for i, (req, w) in enumerate(zip(reqs, want)):
+            np.testing.assert_array_equal(
+                np.asarray(req.output_tokens), w,
+                err_msg=f"request {i} diverged with metrics enabled")
+    finally:
+        serve.close()                 # stops the exporter (port released)
+        assert serve.metrics_server is None
+        reg.disable()
+
+
 @pytest.mark.parametrize("position,fused", [("learned", False),
                                             ("rope", False),
                                             ("alibi", True)])

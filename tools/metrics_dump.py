@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Pretty-print a metrics snapshot as a terminal table.
+
+Sources (auto-detected from the one positional argument):
+
+- a live ``/statz`` endpoint:   ``python tools/metrics_dump.py http://127.0.0.1:9100/statz``
+  (a bare ``host:port`` or ``/metrics`` URL is normalized to ``/statz``)
+- a saved snapshot file:        ``python tools/metrics_dump.py statz.json``
+- a csvMonitor output dir:      ``python tools/metrics_dump.py ./csv_monitor/job``
+  (one ``<event>.csv`` per series; the table shows each series' last value)
+
+Zero dependencies — stdlib only, same as the metrics layer it reads.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+from typing import Dict, List
+
+
+def load_snapshot(src: str) -> Dict[str, object]:
+    """Return the ``{name: value-or-dict}`` metrics mapping from a URL,
+    JSON file, or csvMonitor directory."""
+    if src.startswith(("http://", "https://")) or (
+            ":" in src and not os.path.exists(src)):
+        import urllib.request
+
+        url = src if src.startswith("http") else f"http://{src}"
+        url = url.rstrip("/")
+        if url.endswith("/metrics"):
+            url = url[: -len("/metrics")] + "/statz"
+        if not url.endswith("/statz"):
+            url = url.rstrip("/") + "/statz"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.load(resp)["metrics"]
+    if os.path.isdir(src):
+        out: Dict[str, object] = {}
+        for fn in sorted(os.listdir(src)):
+            if not fn.endswith(".csv"):
+                continue
+            with open(os.path.join(src, fn)) as fh:
+                rows = list(csv.reader(fh))
+            if len(rows) >= 2:       # header + at least one event
+                step, value = rows[-1][0], rows[-1][1]
+                out[fn[: -len(".csv")]] = {"last": float(value),
+                                           "step": int(step),
+                                           "events": len(rows) - 1}
+        return out
+    with open(src) as fh:
+        data = json.load(fh)
+    return data.get("metrics", data)     # accept bare or /statz-shaped
+
+
+def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
+    """Flatten the snapshot into [name, count, mean, p50, p99, value]
+    display rows (histograms fill the quantile columns, scalars the value
+    column, labeled families one row per label set)."""
+    rows = []
+
+    def fmt(v):
+        if isinstance(v, float):
+            return f"{v:.6g}"
+        return str(v)
+
+    def emit(name, v):
+        if isinstance(v, dict) and "p50" in v:          # histogram
+            rows.append([name, str(v["count"]), fmt(v["mean"]),
+                         fmt(v["p50"]), fmt(v["p99"]), ""])
+        elif isinstance(v, dict) and "last" in v:       # csvMonitor series
+            rows.append([name, str(v["events"]), "", "", "",
+                         f"{fmt(v['last'])} @ step {v['step']}"])
+        elif isinstance(v, dict):                       # labeled family
+            for labels, sub in sorted(v.items()):
+                emit(f"{name}{labels}", sub)
+        else:
+            rows.append([name, "", "", "", "", fmt(v)])
+
+    for name, v in sorted(metrics.items()):
+        emit(name, v)
+    return rows
+
+
+def render(rows: List[List[str]]) -> str:
+    header = ["metric", "count", "mean", "p50", "p99", "value"]
+    table = [header] + rows
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if len(argv) == 2 else 2
+    metrics = load_snapshot(argv[1])
+    if not metrics:
+        print("(no metrics found)")
+        return 1
+    print(render(rows_from_snapshot(metrics)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
