@@ -25,20 +25,38 @@ import jax.numpy as jnp
 import deepspeed_tpu
 from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
 from deepspeed_tpu.models import causal_lm
-
-PEAK_FLOPS = {  # bf16 peak per chip
-    "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5": 459e12,
-    "tpu v4": 275e12, "tpu v6 lite": 918e12, "cpu": 1e12,
-}
+# one table for the bench headline and the live ds_train_mfu gauge
+from deepspeed_tpu.profiling.flops import PEAK_FLOPS, peak_flops  # noqa: F401
 
 
-def peak_flops() -> float:
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "cpu").lower()
-    for k, v in PEAK_FLOPS.items():
-        if kind.startswith(k):
-            return v
-    return 197e12
+def collect_train_metrics(registry) -> dict:
+    """Training-health sub-object for the BENCH_JSON record (the serving
+    record's ``metrics`` analog): achieved tflops/mfu gauges, peak HBM, and
+    the top-3 collectives by attributed time from the ``ds_comm_*`` series."""
+    snap = registry.snapshot()
+    out = {}
+    if snap.get("ds_train_tflops"):
+        out["tflops"] = snap["ds_train_tflops"]
+    if snap.get("ds_train_mfu"):
+        out["mfu"] = snap["ds_train_mfu"]
+    if snap.get("ds_mem_peak_bytes"):
+        out["peak_hbm_gb"] = round(snap["ds_mem_peak_bytes"] / 1e9, 3)
+    colls = []
+    for name, v in snap.items():
+        if not (name.startswith("ds_comm_") and name.endswith("_seconds")):
+            continue
+        if not isinstance(v, dict) or not v.get("count"):
+            continue
+        op = name[len("ds_comm_"): -len("_seconds")]
+        byt = snap.get(f"ds_comm_{op}_bytes_total", 0)
+        if isinstance(byt, dict):               # {dtype=} labeled family
+            byt = sum(b for b in byt.values() if isinstance(b, (int, float)))
+        colls.append({"op": op, "time_s": round(v["sum"], 4),
+                      "calls": v["count"], "bytes": int(byt)})
+    colls.sort(key=lambda c: -c["time_s"])
+    if colls:
+        out["top_collectives"] = colls[:3]
+    return out
 
 
 def sync(x) -> None:
@@ -324,8 +342,12 @@ def bench_1b4_rung(policy: str, micro: int, steps: int = 6, warmup: int = 2):
                           "params": {"lr": 2e-4, "weight_decay": 0.1}},
             "gradient_clipping": 1.0,
             "activation_checkpointing": {"enabled": True, "policy": policy},
+            "comms_logger": {"enabled": True},
             "steps_per_print": 10**9,
         }
+        from deepspeed_tpu.monitor.metrics import get_registry
+
+        registry = get_registry()
         engine, _, _, _ = deepspeed_tpu.initialize(model=model,
                                                    config=ds_config,
                                                    mesh=mesh)
@@ -335,6 +357,8 @@ def bench_1b4_rung(policy: str, micro: int, steps: int = 6, warmup: int = 2):
         for _ in range(warmup):
             engine.train_step(batch)
         sync(engine.state.params)
+        registry.reset()
+        engine._flops_meter.reset_clock()
         t1 = time.perf_counter()
         for _ in range(steps):
             engine.train_step(batch)
@@ -348,6 +372,7 @@ def bench_1b4_rung(policy: str, micro: int, steps: int = 6, warmup: int = 2):
                 "mfu": round(mfu, 4), "params_b": round(n_params / 1e9, 3),
                 "micro_batch": micro, "grad_accum": accum, "seq": seq,
                 "steps": steps, "step_ms": round(dt * 1e3, 1),
+                "metrics": collect_train_metrics(registry),
                 "remat_policy": policy,
                 "recipe": "bf16 state + stochastic rounding (no fp32 "
                           "master), Adam8bit int8 m/v, bf16 grad accum",
@@ -581,8 +606,14 @@ def main():
         "activation_checkpointing": {"enabled": True, "policy": "mlp_dots"},
         # model profile printed once during warmup (XLA cost analysis)
         "flops_profiler": {"enabled": True, "profile_step": 2},
+        # training-side telemetry: ds_comm_* per-collective accounting +
+        # ds_train_tflops/mfu + ds_mem_* (collect_train_metrics reads these)
+        "comms_logger": {"enabled": True},
         "steps_per_print": 10**9,
     }
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    registry = get_registry()
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config, mesh=mesh)
 
     rng = jax.random.PRNGKey(0)
@@ -605,6 +636,8 @@ def main():
     for _ in range(warmup):
         one_step()
     sync(engine.state.params)
+    registry.reset()            # warm passes (compiles included) off the record
+    engine._flops_meter.reset_clock()
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -613,6 +646,7 @@ def main():
     # Raw wall time (conservative); the measured fetch round-trip is reported
     # separately in detail for comparison.
     dt = time.perf_counter() - t0
+    train_metrics = collect_train_metrics(registry)
 
     # The 8B rung is opt-in (DSTPU_BENCH_8B=1): on this runner the 16GB
     # host-tiered param tree must travel through the remote-device relay,
@@ -692,6 +726,9 @@ def main():
                        "standalone), micro 8/16 (0.43/0.45)."),
                    "backend": jax.default_backend(),
                    "device": getattr(jax.devices()[0], "device_kind", "?"),
+                   # training-health metrics (the serving record's analog):
+                   # live tflops/mfu gauges, peak HBM, top collectives
+                   **({"metrics": train_metrics} if train_metrics else {}),
                    **({"llama_1b4": rung_1b4} if rung_1b4 else {}),
                    **({"llama3_8b": rung_8b} if rung_8b else {}),
                    **({"decode_125m": rung_decode} if rung_decode else {}),
@@ -714,6 +751,8 @@ def summary_lines(record: dict, rung_serving) -> list:
                "unit": record["unit"], "vs_baseline": record["vs_baseline"],
                "mfu": record["detail"]["mfu"],
                "backend": record["detail"]["backend"]}
+    if record["detail"].get("metrics"):
+        summary["train_metrics"] = record["detail"]["metrics"]
     if rung_serving and "goodput_speedup" in rung_serving:
         summary["serving_goodput_tok_s"] = \
             rung_serving["continuous"]["goodput_tok_s"]

@@ -121,6 +121,43 @@ def init_serving(model=None, config=None, **kwargs):
     return serve
 
 
+def init_telemetry(metrics_port=None, comms: bool = True,
+                   flight_recorder: bool = False, flight_capacity: int = 512,
+                   flight_dump_dir=None, on_signal: bool = False):
+    """Turn on the training-side telemetry stack without a ds_config
+    (the ``init_serving(metrics_port=...)`` analog for training loops):
+
+    - enables the process-global metrics registry (``ds_*`` series record);
+    - ``comms=True`` enables per-collective accounting (``ds_comm_*``);
+    - ``metrics_port=`` additionally serves ``/metrics`` + ``/statz`` on an
+      HTTP exporter (``0`` = ephemeral port; read ``server.port``);
+    - ``flight_recorder=True`` arms the event ring
+      (``monitor/flight_recorder.py``), with a SIGUSR2 dump handler only
+      when ``on_signal=True``.
+
+    Returns the started :class:`~deepspeed_tpu.monitor.server.MetricsServer`
+    (or None when no port was requested).  Equivalent ds_config blocks:
+    ``comms_logger`` and ``flight_recorder`` — see docs/OBSERVABILITY.md.
+    """
+    from deepspeed_tpu.monitor.comms import comm_metrics
+    from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    get_registry().enable()
+    if comms:
+        comm_metrics.configure(enabled=True)
+    if flight_recorder:
+        rec = get_flight_recorder().enable(capacity=flight_capacity,
+                                           dump_dir=flight_dump_dir)
+        if on_signal:
+            rec.install_signal_handler()
+    if metrics_port is None:
+        return None
+    from deepspeed_tpu.monitor.server import MetricsServer
+
+    return MetricsServer(get_registry(), port=int(metrics_port)).start()
+
+
 def init_distributed(dist_backend: str = "xla", **kwargs):
     """Bootstrap multi-host + mesh (reference: ``deepspeed.init_distributed``)."""
     return comm.init_distributed(dist_backend=dist_backend, **kwargs)

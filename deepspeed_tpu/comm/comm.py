@@ -31,8 +31,7 @@ from __future__ import annotations
 
 import datetime
 import os
-from collections import defaultdict
-from typing import Any, Dict, Optional, Sequence, Union
+from typing import Any, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -40,58 +39,17 @@ from jax import lax
 
 from deepspeed_tpu.comm.mesh import (MESH_AXES, build_mesh, get_global_mesh, mesh_from_config,
                                      set_global_mesh)
+# Per-collective accounting (monitor/comms.py): the old in-file CommsLogger
+# grew into CommMetrics — same trace-time counts/bytes/log_summary surface,
+# now also feeding the ds_comm_* registry series (docs/OBSERVABILITY.md).
+from deepspeed_tpu.monitor.comms import CommMetrics as CommsLogger  # noqa: F401
+from deepspeed_tpu.monitor.comms import comm_metrics as comms_logger
+from deepspeed_tpu.profiling.trace import scope as _scope
 from deepspeed_tpu.utils.logging import logger
 
 _INITIALIZED = False
 
 ReduceOp = type("ReduceOp", (), {"SUM": "sum", "AVG": "avg", "MAX": "max", "MIN": "min", "PRODUCT": "prod"})
-
-
-class CommsLogger:
-    """Trace-time collective accounting (reference: ``@timed_op`` + log_summary).
-
-    Inside jit we cannot wall-clock individual collectives, so we record
-    (op, axis, shape, bytes) at trace time and leave latency to the XLA
-    profiler; ``log_summary()`` prints counts and volumes per op.
-    """
-
-    def __init__(self):
-        self.enabled = False
-        self.verbose = False
-        self.counts: Dict[str, int] = defaultdict(int)
-        self.bytes: Dict[str, int] = defaultdict(int)
-
-    def configure(self, enabled: bool = False, verbose: bool = False, **_: Any) -> None:
-        self.enabled = enabled
-        self.verbose = verbose
-
-    def record(self, op: str, axis: Any, x: Any) -> None:
-        if not self.enabled:
-            return
-        try:
-            nbytes = int(x.size) * x.dtype.itemsize
-        except Exception:
-            nbytes = 0
-        key = f"{op}@{axis}"
-        self.counts[key] += 1
-        self.bytes[key] += nbytes
-        if self.verbose:
-            logger.info("comm trace: %s shape=%s bytes=%d", key, getattr(x, "shape", None), nbytes)
-
-    def log_summary(self) -> str:
-        lines = ["Comms summary (trace-time counts; use jax.profiler for latency):"]
-        for key in sorted(self.counts):
-            lines.append(f"  {key}: count={self.counts[key]} bytes={self.bytes[key]:,}")
-        text = "\n".join(lines)
-        logger.info("%s", text)
-        return text
-
-    def reset(self) -> None:
-        self.counts.clear()
-        self.bytes.clear()
-
-
-comms_logger = CommsLogger()
 
 
 def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = False,
@@ -223,39 +181,44 @@ def get_process_count() -> int:
 def all_reduce(x, axis: Union[str, Sequence[str]] = ("dp", "fsdp"), op: str = "sum"):
     """psum/pmax/pmin over a named mesh axis (reference: dist.all_reduce)."""
     comms_logger.record("all_reduce", axis, x)
-    if op in ("sum", ReduceOp.SUM):
-        return lax.psum(x, axis)
-    if op in ("avg", ReduceOp.AVG):
-        return lax.pmean(x, axis)
-    if op in ("max", ReduceOp.MAX):
-        return lax.pmax(x, axis)
-    if op in ("min", ReduceOp.MIN):
-        return lax.pmin(x, axis)
+    with _scope("ds_comm_all_reduce"):
+        if op in ("sum", ReduceOp.SUM):
+            return lax.psum(x, axis)
+        if op in ("avg", ReduceOp.AVG):
+            return lax.pmean(x, axis)
+        if op in ("max", ReduceOp.MAX):
+            return lax.pmax(x, axis)
+        if op in ("min", ReduceOp.MIN):
+            return lax.pmin(x, axis)
     raise ValueError(f"unsupported reduce op {op}")
 
 
 def all_gather(x, axis: Union[str, Sequence[str]], gather_dim: int = 0, tiled: bool = True):
     """all_gather along a named axis (reference: all_gather_into_tensor)."""
     comms_logger.record("all_gather", axis, x)
-    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+    with _scope("ds_comm_all_gather"):
+        return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x, axis: Union[str, Sequence[str]], scatter_dim: int = 0):
     """psum_scatter (reference: reduce_scatter_tensor) — the ZeRO-2/3 grad op."""
     comms_logger.record("reduce_scatter", axis, x)
-    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+    with _scope("ds_comm_reduce_scatter"):
+        return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
 
 
 def all_to_all_single(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
     """all_to_all (reference: all_to_all_single) — MoE dispatch / Ulysses."""
     comms_logger.record("all_to_all", axis, x)
-    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
+    with _scope("ds_comm_all_to_all"):
+        return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
 
 
 def ppermute(x, axis: str, perm):
     """Point-to-point ring shift (reference: send/recv pairs in pipe/p2p.py)."""
     comms_logger.record("ppermute", axis, x)
-    return lax.ppermute(x, axis, perm)
+    with _scope("ds_comm_ppermute"):
+        return lax.ppermute(x, axis, perm)
 
 
 def axis_index(axis: str):
@@ -404,7 +367,8 @@ def barrier(group: Any = None) -> None:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+        with comms_logger.span("barrier", 0, world=jax.process_count()):
+            multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
 
 
 def broadcast(x, src: int = 0, group: Any = None):
@@ -412,7 +376,15 @@ def broadcast(x, src: int = 0, group: Any = None):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        return multihost_utils.broadcast_one_to_all(x, is_source=jax.process_index() == src)
+        try:
+            nbytes = int(x.size) * x.dtype.itemsize
+            dtype = x.dtype.name
+        except Exception:
+            nbytes, dtype = 0, "unknown"
+        with comms_logger.span("broadcast", nbytes, dtype,
+                               world=jax.process_count()):
+            return multihost_utils.broadcast_one_to_all(
+                x, is_source=jax.process_index() == src)
     return x
 
 
@@ -433,7 +405,9 @@ def broadcast_object_list(objects, src: int = 0, group: Any = None):
             buf = np.frombuffer(payload, dtype=np.uint8)
         else:
             buf = np.zeros(n, dtype=np.uint8)
-        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+        with comms_logger.span("broadcast_object", n, "uint8",
+                               world=jax.process_count()):
+            out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
         return pickle.loads(bytes(bytearray(out))[:n])
     return objects
 
@@ -444,7 +418,12 @@ def log_summary() -> str:
 
 def configure(deepspeed_config=None, **kwargs) -> None:
     if deepspeed_config is not None and getattr(deepspeed_config, "comms_logger", None):
+        # Config can only turn accounting ON: every engine __init__ routes
+        # through here, and a config without a comms_logger block must not
+        # silently undo an explicit init_telemetry(comms=True) that came
+        # first (disable programmatically via comms_logger.configure()).
         c = deepspeed_config.comms_logger
-        comms_logger.configure(enabled=c.enabled, verbose=c.verbose)
+        comms_logger.configure(enabled=c.enabled or comms_logger.enabled,
+                               verbose=c.verbose or comms_logger.verbose)
     elif kwargs:
         comms_logger.configure(**kwargs)

@@ -3,10 +3,16 @@
 request-lifecycle metrics registry (metrics.py) and its Prometheus/JSON
 HTTP exporter (server.py).  See docs/OBSERVABILITY.md."""
 
+from deepspeed_tpu.monitor.comms import CommMetrics, busbw_factor, comm_metrics  # noqa: F401
+from deepspeed_tpu.monitor.flight_recorder import (FlightRecorder,  # noqa: F401
+                                                   get_flight_recorder)
+from deepspeed_tpu.monitor.memory import MemoryTelemetry  # noqa: F401
 from deepspeed_tpu.monitor.metrics import (Counter, Gauge, Histogram,  # noqa: F401
                                            MetricsRegistry, get_registry)
 from deepspeed_tpu.monitor.monitor import MonitorMaster  # noqa: F401
 from deepspeed_tpu.monitor.server import MetricsServer  # noqa: F401
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "MetricsServer", "MonitorMaster"]
+__all__ = ["CommMetrics", "Counter", "FlightRecorder", "Gauge", "Histogram",
+           "MemoryTelemetry", "MetricsRegistry", "MetricsServer",
+           "MonitorMaster", "busbw_factor", "comm_metrics",
+           "get_flight_recorder", "get_registry"]

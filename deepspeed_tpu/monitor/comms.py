@@ -1,0 +1,269 @@
+"""Per-collective communication accounting (the training-side half of
+docs/OBSERVABILITY.md).
+
+Successor of the old ``comm/comm.py`` ``CommsLogger`` (the reference's
+``deepspeed/comms/logging.py`` role): the same trace-time op/bytes dicts and
+``log_summary()`` API, now also feeding the process-global metrics registry
+(monitor/metrics.py) so per-collective traffic is scrapable under the ``ds_``
+schema next to the serving/inference/training series.
+
+Three feed paths, with honest and distinct semantics:
+
+- :meth:`CommMetrics.record` — **trace-time** accounting for in-jit
+  collectives (the ``comm.all_reduce``/``all_gather``/... wrappers and the
+  quantized ZeRO++ variants).  Inside jit a collective cannot be
+  wall-clocked individually, so this records (op, dtype, bytes) once per
+  *trace* of the enclosing program — re-executions of a compiled program do
+  not re-count.  Latency for these ops lives in the xplane trace, where the
+  ``ds_comm_<op>`` ``jax.named_scope`` ranges emitted by the wrappers name
+  the device ops.
+- :meth:`CommMetrics.commit` — **per-execution** accounting for paths where
+  the host knows what a dispatched program moved (the engine's analytic
+  ZeRO comm plan: what GSPMD *must* transfer for the configured stage).
+  Advances the same counters per step, and records the measured host
+  dispatch-window time into the latency histograms (byte-weighted across
+  the ops sharing one window); derived algorithmic/bus bandwidth gauges
+  follow.  Device-measured per-op truth still lives in the xplane trace —
+  the committed latency attributes the *host window* that contained the
+  collective.
+- :meth:`CommMetrics.span` — wall-clocked **eager** collectives (the
+  control-plane broadcast/barrier tier): full count/bytes/latency/bandwidth
+  per call, the only tier where per-op host latency is exact.
+
+Schema (see docs/OBSERVABILITY.md):
+
+- ``ds_comm_<op>_calls_total``                 counter
+- ``ds_comm_<op>_bytes_total{dtype=...}``      counter (payload bytes)
+- ``ds_comm_<op>_seconds``                     histogram (commit/span feeds)
+- ``ds_comm_<op>_algbw_gbps``                  gauge (bytes / seconds)
+- ``ds_comm_<op>_busbw_gbps``                  gauge (algbw x collective
+                                               factor, NCCL-tests style)
+
+Disabled is free: ``record``/``commit``/``span`` are one attribute-load +
+branch while ``enabled`` is False, and the registry instruments themselves
+no-op while the registry is disabled — instrument unconditionally, pay only
+when observing.  Enable via the ds_config ``comms_logger`` block, the
+``deepspeed_tpu.init_telemetry()`` API, or ``comm_metrics.configure()``.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.metrics import MetricsRegistry, get_registry
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["CommMetrics", "comm_metrics", "busbw_factor", "KNOWN_OPS"]
+
+
+# Every op slug the framework records today; ensure_registered() registers
+# the full family so the docs namespace-guard covers series that only
+# materialize on multi-axis meshes.
+KNOWN_OPS = (
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
+    "broadcast", "broadcast_object", "barrier",
+    "q_all_gather", "q_reduce_scatter",
+    "compressed_allreduce", "compressed_allgather",
+    "zpp_q_all_gather", "zpp_all_gather", "zpp_reduce_scatter",
+    "zpp_q_all_gather_hpz", "zpp_all_gather_hpz",
+)
+
+
+def _slug(op: str) -> str:
+    """Metric-safe op name: 'zpp_q_all_gather(hpz)' -> 'zpp_q_all_gather_hpz'."""
+    return re.sub(r"[^a-z0-9_]+", "_", op.lower()).strip("_")
+
+
+def busbw_factor(op: str, world: int) -> float:
+    """NCCL-tests style bus-bandwidth factor: the ratio of bytes a link
+    actually carries to the logical payload, for a ring implementation.
+
+    - all_reduce (incl. the 1-bit compressed form): ``2(P-1)/P``
+    - all_gather / reduce_scatter / all_to_all (incl. quantized): ``(P-1)/P``
+    - point-to-point / broadcast / barrier: ``1``
+    """
+    if world <= 1:
+        return 1.0
+    op = _slug(op)
+    if "all_reduce" in op or "allreduce" in op:
+        return 2.0 * (world - 1) / world
+    if ("all_gather" in op or "allgather" in op or "reduce_scatter" in op
+            or "all_to_all" in op):
+        return (world - 1) / world
+    return 1.0
+
+
+def _dtype_name(x: Any) -> str:
+    dt = getattr(x, "dtype", None)
+    return getattr(dt, "name", str(dt)) if dt is not None else "unknown"
+
+
+class CommMetrics:
+    """Per-collective accounting: trace-time dicts (back-compat CommsLogger
+    surface) + registry series + flight-recorder breadcrumbs."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._registry = registry if registry is not None else get_registry()
+        self.enabled = False
+        self.verbose = False
+        # back-compat CommsLogger surface (tests and log_summary read these)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.bytes: Dict[str, int] = defaultdict(int)
+        # lazily-built registry instruments, keyed by op slug (+ dtype)
+        self._calls: Dict[str, Any] = {}
+        self._bytes_c: Dict[Tuple[str, str], Any] = {}
+        self._hists: Dict[str, Any] = {}
+        self._algbw: Dict[str, Any] = {}
+        self._busbw: Dict[str, Any] = {}
+
+    # -- switches -------------------------------------------------------
+    def configure(self, enabled: bool = False, verbose: bool = False,
+                  **_: Any) -> None:
+        self.enabled = enabled
+        self.verbose = verbose
+
+    @property
+    def active(self) -> bool:
+        """Comm accounting on AND the registry recording."""
+        return self.enabled and self._registry._enabled
+
+    # -- instrument plumbing (cold path; registration takes the registry
+    # lock once per (op, dtype)) ---------------------------------------
+    def _ins_calls(self, op: str):
+        c = self._calls.get(op)
+        if c is None:
+            c = self._registry.counter(
+                f"ds_comm_{op}_calls_total",
+                f"{op} collective calls (trace-time records count per "
+                f"compilation; commits count per execution)")
+            self._calls[op] = c
+        return c
+
+    def _ins_bytes(self, op: str, dtype: str):
+        key = (op, dtype)
+        c = self._bytes_c.get(key)
+        if c is None:
+            c = self._registry.counter(
+                f"ds_comm_{op}_bytes_total",
+                f"{op} payload bytes by dtype", labels={"dtype": dtype})
+            self._bytes_c[key] = c
+        return c
+
+    def _ins_hist(self, op: str):
+        h = self._hists.get(op)
+        if h is None:
+            h = self._registry.histogram(
+                f"ds_comm_{op}_seconds",
+                f"host-measured {op} latency (eager spans: exact per call; "
+                f"engine commits: byte-weighted share of the dispatch "
+                f"window — device truth is in the xplane trace)")
+            self._hists[op] = h
+        return h
+
+    def _ins_bw(self, op: str):
+        a = self._algbw.get(op)
+        if a is None:
+            a = self._registry.gauge(f"ds_comm_{op}_algbw_gbps",
+                                     f"last observed {op} algorithmic "
+                                     f"bandwidth (payload GB/s)")
+            b = self._registry.gauge(f"ds_comm_{op}_busbw_gbps",
+                                     f"last observed {op} bus bandwidth "
+                                     f"(algbw x collective factor)")
+            self._algbw[op], self._busbw[op] = a, b
+        return self._algbw[op], self._busbw[op]
+
+    def ensure_registered(self, dtypes: Iterable[str] = ("float32",)) -> None:
+        """Register the full known-op instrument family (namespace-guard and
+        exporter warm-up; recording still no-ops while disabled)."""
+        for op in KNOWN_OPS:
+            self._ins_calls(op)
+            self._ins_hist(op)
+            self._ins_bw(op)
+            for dt in dtypes:
+                self._ins_bytes(op, dt)
+
+    # -- feed paths -----------------------------------------------------
+    def record(self, op: str, axis: Any, x: Any) -> None:
+        """Trace-time record for an in-jit collective (see module doc)."""
+        if not self.enabled:
+            return
+        try:
+            nbytes = int(x.size) * x.dtype.itemsize
+        except Exception:
+            nbytes = 0
+        key = f"{op}@{axis}"
+        self.counts[key] += 1
+        self.bytes[key] += nbytes
+        if self._registry._enabled:
+            slug = _slug(op)
+            self._ins_calls(slug).inc()
+            self._ins_bytes(slug, _dtype_name(x)).inc(nbytes)
+        if self.verbose:
+            logger.info("comm trace: %s shape=%s bytes=%d", key,
+                        getattr(x, "shape", None), nbytes)
+
+    def commit(self, entries, seconds: float) -> None:
+        """Per-execution commit: ``entries`` is a list of
+        ``(op, calls, nbytes, dtype, world)`` tuples describing what one
+        dispatched program moved; ``seconds`` is the measured host window
+        that contained them (latency attribution is byte-weighted)."""
+        if not self.active or not entries:
+            return
+        total = sum(e[2] for e in entries)
+        rec = get_flight_recorder()
+        for op, calls, nbytes, dtype, world in entries:
+            slug = _slug(op)
+            self._ins_calls(slug).inc(calls)
+            self._ins_bytes(slug, dtype).inc(nbytes)
+            # byte-weighted window attribution; a zero-byte commit (barrier
+            # spans) must still keep its measured wall time — a 5s straggler
+            # barrier showing p99=0 would hide exactly the hang signal
+            share = (seconds * (nbytes / total) if total > 0
+                     else seconds / len(entries))
+            self._ins_hist(slug).record(share)
+            if share > 0 and nbytes > 0:
+                alg = nbytes / share / 1e9
+                algg, busg = self._ins_bw(slug)
+                algg.set(alg)
+                busg.set(alg * busbw_factor(slug, world))
+            rec.record("collective", op=slug, calls=calls, bytes=nbytes,
+                       dtype=dtype, world=world, seconds=round(share, 6))
+
+    @contextmanager
+    def span(self, op: str, nbytes: int, dtype: str = "unknown",
+             world: int = 1):
+        """Wall-clock an eager collective; caller wraps the op body."""
+        if not self.active:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.commit([(op, 1, nbytes, dtype, world)],
+                        time.perf_counter() - t0)
+
+    # -- back-compat CommsLogger surface --------------------------------
+    def log_summary(self) -> str:
+        lines = ["Comms summary (trace-time counts; use jax.profiler for "
+                 "latency):"]
+        for key in sorted(self.counts):
+            lines.append(f"  {key}: count={self.counts[key]} "
+                         f"bytes={self.bytes[key]:,}")
+        text = "\n".join(lines)
+        logger.info("%s", text)
+        return text
+
+    def reset(self) -> None:
+        """Clear the trace-time dicts (registry series reset via
+        ``get_registry().reset()`` like every other ``ds_`` metric)."""
+        self.counts.clear()
+        self.bytes.clear()
+
+
+comm_metrics = CommMetrics()

@@ -1,0 +1,181 @@
+"""Flight recorder: a fixed-size ring of structured runtime events, dumped
+as JSON (plus stack snapshots of every thread) when something goes wrong.
+
+Long training runs die in ways the metrics registry cannot explain after
+the fact: an unhandled exception mid-step loses the collective that
+preceded it; a hang leaves nothing at all.  The flight recorder keeps the
+last N events — step begin/end, collective commits, checkpoint saves,
+program builds — in a preallocated ring (O(1) per event, no growth), and
+serializes them in order:
+
+- on an unhandled exception inside the engine's ``forward``/``step``/
+  ``train_step`` (the engine dumps before re-raising);
+- on ``SIGUSR2``, when :meth:`FlightRecorder.install_signal_handler` was
+  explicitly requested (``kill -USR2 <pid>`` on a hung run) — the handler
+  is never installed implicitly;
+- on demand via :meth:`FlightRecorder.dump`.
+
+The dump is a single JSON object: ``{"reason", "time_unix", "pid",
+"events": [...oldest->newest...], "threads": {thread_name: [frames...]}}``.
+Events carry a monotonically increasing ``seq`` so ordering survives the
+ring wraparound.  Disabled (the default) every ``record()`` is one
+attribute-load + branch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["FlightRecorder", "get_flight_recorder"]
+
+DEFAULT_CAPACITY = 512
+_UNSET = object()   # enable(): "dump_dir not mentioned" vs "reset to cwd"
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self.enabled = False
+        self.dump_dir: Optional[str] = None
+        self._buf: List[Optional[Dict[str, Any]]] = [None] * self.capacity
+        self._n = 0                      # total events ever recorded
+        self._installed_signal = None    # signum once installed
+        self._prev_handler = None
+        self._dump_count = 0
+
+    # -- switches -------------------------------------------------------
+    def enable(self, capacity: Optional[int] = None,
+               dump_dir=_UNSET) -> "FlightRecorder":
+        """Arm the ring.  ``dump_dir`` accepts an explicit ``None`` to
+        reset to the default (cwd) — omitting it keeps the current
+        setting, so a config-driven enable can't silently inherit a stale
+        directory from an earlier caller."""
+        if capacity is not None and int(capacity) != self.capacity:
+            self.capacity = max(1, int(capacity))
+            self._buf = [None] * self.capacity
+            self._n = 0
+        if dump_dir is not _UNSET:
+            self.dump_dir = dump_dir
+        self.enabled = True
+        return self
+
+    def disable(self) -> "FlightRecorder":
+        self.enabled = False
+        return self
+
+    # -- hot path -------------------------------------------------------
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one event; one branch + no work while disabled."""
+        if not self.enabled:
+            return
+        ev = {"seq": self._n, "t": time.time(), "kind": kind}
+        if fields:
+            ev.update(fields)
+        self._buf[self._n % self.capacity] = ev
+        self._n += 1
+
+    # -- reads ----------------------------------------------------------
+    def events(self) -> List[Dict[str, Any]]:
+        """Ring contents oldest -> newest."""
+        if self._n <= self.capacity:
+            return [e for e in self._buf[: self._n] if e is not None]
+        i = self._n % self.capacity
+        return [e for e in self._buf[i:] + self._buf[:i] if e is not None]
+
+    @staticmethod
+    def _thread_stacks() -> Dict[str, List[str]]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for ident, frame in sys._current_frames().items():
+            name = names.get(ident, f"thread-{ident}")
+            out[name] = [ln.rstrip("\n")
+                         for ln in traceback.format_stack(frame)]
+        return out
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> str:
+        """Serialize the ring + all thread stacks to ``path`` (default:
+        ``<dump_dir or cwd>/ds_flight_<pid>_<n>.json``); returns the path."""
+        if path is None:
+            self._dump_count += 1
+            path = os.path.join(
+                self.dump_dir or ".",
+                f"ds_flight_{os.getpid()}_{self._dump_count}.json")
+        payload = {"reason": reason, "time_unix": time.time(),
+                   "pid": os.getpid(), "total_events": self._n,
+                   "capacity": self.capacity, "events": self.events(),
+                   "threads": self._thread_stacks()}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=1, default=str)
+        logger.warning("flight recorder: dumped %d events -> %s (%s)",
+                       len(payload["events"]), path, reason)
+        return path
+
+    # -- signal trigger (install ONLY on request) -----------------------
+    def install_signal_handler(self, signum: Optional[int] = None) -> bool:
+        """Install a dump-on-signal handler (default SIGUSR2).  Returns
+        False on platforms without the signal.  Never called implicitly —
+        a library must not take over process signals unasked."""
+        import signal as _signal
+
+        if signum is None:
+            signum = getattr(_signal, "SIGUSR2", None)
+        if signum is None:
+            return False
+        if self._installed_signal == signum:
+            return True
+
+        def _handler(_sig, _frame):
+            self.record("signal", signum=signum)
+            try:
+                self.dump(reason=f"signal {signum}")
+            except Exception as exc:  # a broken disk must not kill the run
+                logger.error("flight recorder: dump-on-signal failed: %s",
+                             exc)
+
+        try:
+            self._prev_handler = _signal.signal(signum, _handler)
+        except (ValueError, OSError):   # non-main thread / unsupported
+            return False
+        self._installed_signal = signum
+        return True
+
+    def uninstall_signal_handler(self) -> None:
+        if self._installed_signal is None:
+            return
+        import signal as _signal
+
+        try:
+            _signal.signal(self._installed_signal,
+                           self._prev_handler or _signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        self._installed_signal = None
+        self._prev_handler = None
+
+    @property
+    def signal_installed(self) -> bool:
+        return self._installed_signal is not None
+
+    def reset(self) -> None:
+        self._buf = [None] * self.capacity
+        self._n = 0
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-global recorder every subsystem appends to."""
+    return _RECORDER

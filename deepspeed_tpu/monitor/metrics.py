@@ -39,7 +39,7 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "DEFAULT_BUCKETS"]
+           "get_registry", "window_delta", "DEFAULT_BUCKETS"]
 
 
 def _render_labels(labels: Optional[Tuple[Tuple[str, str], ...]]) -> str:
@@ -390,6 +390,16 @@ class MetricsRegistry:
                 out[name] = v
         return out
 
+    def typed_snapshot(self) -> Dict[Tuple[str, str], Tuple[str, object]]:
+        """Flat kind-tagged snapshot ``{(name, label_str): (kind, value)}``
+        — the ``/statz?window=`` delta endpoint needs kinds to know whether
+        to difference (counter/histogram) or report as-is (gauge); the
+        plain :meth:`snapshot` erases them."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {(name, _render_labels(labels)): (m.kind, m._snapshot())
+                for (name, labels), m in items}
+
     def statz_json(self) -> str:
         return json.dumps({"enabled": self._enabled,
                            "metrics": self.snapshot()},
@@ -425,6 +435,52 @@ class MetricsRegistry:
             events.extend(m._events(step))
         if events:
             monitor.write_events(events)
+
+
+def window_delta(prev: Dict[Tuple[str, str], Tuple[str, object]],
+                 cur: Dict[Tuple[str, str], Tuple[str, object]],
+                 dt: float) -> Dict[str, object]:
+    """Difference two :meth:`MetricsRegistry.typed_snapshot` results taken
+    ``dt`` seconds apart into the ``/statz?window=`` response shape:
+
+    - counters   -> ``{"delta", "per_sec"}``
+    - histograms -> ``{"count_delta", "per_sec", "window_mean"}`` (mean of
+      the values recorded *inside* the window)
+    - gauges     -> ``{"value"}`` (last observed; deltas are meaningless)
+
+    A series absent from ``prev`` (registered mid-window) baselines at
+    zero, so its whole current value is the delta.  A current value BELOW
+    the baseline means the registry was reset mid-window (``reset()`` is a
+    public API the bench uses between passes) — Prometheus counter
+    semantics apply: the baseline clamps to zero rather than emitting a
+    negative rate.  Labeled families nest the same way
+    :meth:`MetricsRegistry.snapshot` does.
+    """
+    rate = (1.0 / dt) if dt > 0 else 0.0
+    out: Dict[str, object] = {}
+    for (name, ls), (kind, v) in cur.items():
+        if kind == "counter":
+            base = prev.get((name, ls))
+            d = v - (base[1] if base else 0)
+            if d < 0:                      # reset between scrapes
+                d = v
+            entry = {"delta": d, "per_sec": d * rate}
+        elif kind == "histogram":
+            base = prev.get((name, ls))
+            pc = base[1] if base else {"count": 0, "sum": 0.0}
+            dc = v["count"] - pc["count"]
+            ds = v["sum"] - pc["sum"]
+            if dc < 0 or ds < 0:           # reset between scrapes
+                dc, ds = v["count"], v["sum"]
+            entry = {"count_delta": dc, "per_sec": dc * rate,
+                     "window_mean": (ds / dc) if dc else 0.0}
+        else:
+            entry = {"value": v}
+        if ls:
+            out.setdefault(name, {})[ls] = entry
+        else:
+            out[name] = entry
+    return out
 
 
 _REGISTRY = MetricsRegistry()

@@ -5,7 +5,13 @@ daemon thread:
 
 - ``GET /metrics`` — Prometheus text exposition (scrape target);
 - ``GET /statz``  — the same registry as a JSON snapshot (humans, tests,
-  and ``tools/metrics_dump.py``).
+  and ``tools/metrics_dump.py``);
+- ``GET /statz?window=<key>`` — rate-windowed deltas: each distinct
+  ``window`` key remembers the snapshot of its previous scrape, and a
+  request returns counter/histogram deltas (plus per-second rates) over
+  the *actual* elapsed time since then — long-lived serving gets rates
+  without a Prometheus server.  The first scrape of a key primes it
+  (``"primed": true``, no deltas); scrape again after your window.
 
 ``port=0`` binds an ephemeral port (read it back from ``server.port``) —
 the shape tests and multi-engine hosts need.  Zero dependencies: plain
@@ -17,10 +23,13 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
-from deepspeed_tpu.monitor.metrics import MetricsRegistry, get_registry
+from deepspeed_tpu.monitor.metrics import (MetricsRegistry, get_registry,
+                                           window_delta)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["MetricsServer"]
@@ -30,12 +39,17 @@ class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry  # set by the server subclass
 
     def do_GET(self):  # noqa: N802 - http.server API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             body = self.registry.prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path in ("/statz", "/statz/"):
-            body = self.registry.statz_json().encode()
+            window = parse_qs(query).get("window", [None])[0]
+            if window is not None:
+                body = json.dumps(self._windowed(window),
+                                  sort_keys=True).encode()
+            else:
+                body = self.registry.statz_json().encode()
             ctype = "application/json"
         elif path == "/":
             body = json.dumps({"endpoints": ["/metrics", "/statz"]}).encode()
@@ -48,6 +62,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    MAX_WINDOW_KEYS = 64
+
+    def _windowed(self, key: str) -> dict:
+        """Delta snapshot vs the previous scrape of the same ``window``
+        key (state lives on the HTTP server object, shared across the
+        handler instances it spawns per request).  Each key stores one
+        full snapshot, and the key space is CLIENT-supplied — cap it and
+        evict the least-recently-scraped key so a scraper that appends a
+        timestamp (or a hostile client) cannot grow memory unboundedly."""
+        now = time.monotonic()
+        snap = self.registry.typed_snapshot()
+        srv = self.server
+        with srv.window_lock:
+            prev = srv.window_state.get(key)
+            srv.window_state[key] = (now, snap)
+            while len(srv.window_state) > self.MAX_WINDOW_KEYS:
+                oldest = min(srv.window_state,
+                             key=lambda k: srv.window_state[k][0])
+                del srv.window_state[oldest]
+        if prev is None:
+            return {"window": key, "primed": True, "window_s": 0.0,
+                    "metrics": {}}
+        dt = now - prev[0]
+        return {"window": key, "primed": False,
+                "window_s": round(dt, 6),
+                "metrics": window_delta(prev[1], snap, dt)}
 
     def log_message(self, fmt, *args):  # scrapes are not log lines
         pass
@@ -81,6 +122,9 @@ class MetricsServer:
         self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
                                           handler)
         self._httpd.daemon_threads = True
+        # per-window-key previous snapshots for /statz?window= deltas
+        self._httpd.window_state = {}
+        self._httpd.window_lock = threading.Lock()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="ds-metrics-http", daemon=True)
         self._thread.start()

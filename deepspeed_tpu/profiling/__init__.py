@@ -2,5 +2,7 @@
 the FLOPS profiler built on XLA cost analysis lives in ``flops_profiler``;
 ``trace`` adds xplane trace capture + host-side TraceAnnotation ranges."""
 
+from deepspeed_tpu.profiling.flops import (TrainFlopsMeter, lm_flops_per_token,  # noqa: F401
+                                           lm_layer_flops, peak_flops)
 from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler, get_model_profile  # noqa: F401
-from deepspeed_tpu.profiling.trace import TraceCapture, annotate  # noqa: F401
+from deepspeed_tpu.profiling.trace import TraceCapture, annotate, scope  # noqa: F401

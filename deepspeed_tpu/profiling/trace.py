@@ -38,6 +38,20 @@ def annotate(name: str):
         return contextlib.nullcontext()
 
 
+def scope(name: str):
+    """Device-timeline named range: ``with scope("ds_comm_all_gather"): ...``
+    around ops *inside* jit, so the emitted HLO carries the name and the
+    xplane device rows line up with the host-side ``ds_comm_*`` series.
+    (``annotate`` is the host-timeline analog for eager regions; inside a
+    trace it would time tracing, not execution.)  Trace-time metadata only —
+    zero runtime cost, and applied unconditionally so toggling telemetry
+    never changes the compiled program."""
+    try:
+        return jax.named_scope(name)
+    except Exception:  # pragma: no cover - ancient jax
+        return contextlib.nullcontext()
+
+
 class TraceCapture:
     """Start/stop a ``jax.profiler`` trace over steps
     ``[start_step, start_step + num_steps)``.  ``after_step(completed)`` is

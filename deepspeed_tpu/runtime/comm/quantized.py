@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.comm import comm as comm_api
+from deepspeed_tpu.profiling.trace import scope as _scope
 
 DEFAULT_BLOCK = 256
 
@@ -89,8 +90,9 @@ def quantized_all_gather(x, axis: str, block: int = DEFAULT_BLOCK):
     local x; result is the dequantized concatenation along dim 0."""
     q, scale, pad = block_quantize(x, block)
     comm_api.comms_logger.record("q_all_gather", axis, q)
-    qg = lax.all_gather(q, axis, axis=0, tiled=False)       # [P, nb, block]
-    sg = lax.all_gather(scale, axis, axis=0, tiled=False)   # [P, nb, 1]
+    with _scope("ds_comm_q_all_gather"):
+        qg = lax.all_gather(q, axis, axis=0, tiled=False)       # [P, nb, block]
+        sg = lax.all_gather(scale, axis, axis=0, tiled=False)   # [P, nb, 1]
     P = qg.shape[0]
     parts = (qg.astype(jnp.float32) * sg).reshape(P, -1)
     if pad:
@@ -116,8 +118,9 @@ def quantized_reduce_scatter(x, axis: str, block: int = DEFAULT_BLOCK):
     # boundaries and scales travel with their blocks
     q, scale, _ = jax.vmap(_ft.partial(block_quantize, block=block))(xs)
     comm_api.comms_logger.record("q_reduce_scatter", axis, q)
-    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
-    st = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
+    with _scope("ds_comm_q_reduce_scatter"):
+        qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+        st = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
     parts = (qt.astype(jnp.float32) * st).sum(axis=0)       # [nb, block]
     flat = parts.reshape(-1)[:shard_elems]
     return flat.reshape((shard,) + x.shape[1:]).astype(x.dtype)
@@ -147,10 +150,11 @@ def compressed_allreduce(x, error, server_error, axis: str):
     packed = jax.vmap(pack_signs)(chunks)                            # [P, chunk//8]
     comm_api.comms_logger.record("compressed_allreduce", axis, packed)
     # exchange: rank r receives chunk r from every rank
-    recv = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0,
-                          tiled=False)                               # [P, chunk//8]
-    recv_scale = lax.all_to_all(scale_w, axis, split_axis=0, concat_axis=0,
-                                tiled=False)                         # [P, 1]
+    with _scope("ds_comm_compressed_allreduce"):
+        recv = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0,
+                              tiled=False)                           # [P, chunk//8]
+        recv_scale = lax.all_to_all(scale_w, axis, split_axis=0, concat_axis=0,
+                                    tiled=False)                     # [P, 1]
     decoded = jax.vmap(lambda p: unpack_signs(p, chunk))(recv)       # [P, chunk]
     avg = (decoded * recv_scale).mean(axis=0)                        # [chunk]
     # server compression of the averaged chunk, with server error feedback
@@ -160,8 +164,9 @@ def compressed_allreduce(x, error, server_error, axis: str):
     new_server_error = avg_comp - scale_s * signs_s
     packed_s = pack_signs(avg_comp)[None]                            # [1, chunk//8]
     comm_api.comms_logger.record("compressed_allgather", axis, packed_s)
-    gathered = lax.all_gather(packed_s[0], axis, axis=0, tiled=False)  # [P, chunk//8]
-    gathered_scale = lax.all_gather(scale_s, axis, axis=0)           # [P]
+    with _scope("ds_comm_compressed_allgather"):
+        gathered = lax.all_gather(packed_s[0], axis, axis=0, tiled=False)  # [P, chunk//8]
+        gathered_scale = lax.all_gather(scale_s, axis, axis=0)       # [P]
     out = (jax.vmap(lambda p: unpack_signs(p, chunk))(gathered)
            * gathered_scale[:, None]).reshape(-1)[:n]
     return out.reshape(shape).astype(x.dtype), new_error, new_server_error

@@ -239,6 +239,19 @@ class CommsLoggerConfig(DeepSpeedConfigModel):
     prof_ops: List[str] = Field(default_factory=list)
 
 
+class FlightRecorderConfig(DeepSpeedConfigModel):
+    """``flight_recorder`` section (TPU extension; docs/OBSERVABILITY.md):
+    a fixed-size ring of structured runtime events (step/collective/
+    checkpoint/compile), dumped as JSON + all-thread stacks on an unhandled
+    engine exception, and on SIGUSR2 when ``on_signal`` is set — the
+    post-mortem for long-run crashes and hangs."""
+
+    enabled: bool = False
+    capacity: int = 512
+    dump_dir: Optional[str] = None   # default: current directory
+    on_signal: bool = False          # install the SIGUSR2 dump handler
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"
     load_universal: bool = False
@@ -424,6 +437,7 @@ class DeepSpeedConfig:
         self.wandb = WandbConfig(**d.get("wandb", {}))
         self.csv_monitor = CSVConfig(**d.get("csv_monitor", {}))
         self.comms_logger = CommsLoggerConfig(**d.get("comms_logger", {}))
+        self.flight_recorder = FlightRecorderConfig(**d.get("flight_recorder", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
         self.elasticity = ElasticityConfig(**d.get("elasticity", {}))
         self.tensor_parallel = TensorParallelConfig(**d.get("tensor_parallel", {}))

@@ -27,9 +27,11 @@ prefetcher (SURVEY.md §3.3 TPU note).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+import time
+from typing import Any, Callable, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +40,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import comm
 from deepspeed_tpu.comm.mesh import batch_sharding, get_global_mesh, mesh_from_config
+from deepspeed_tpu.monitor.comms import comm_metrics
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.memory import MemoryTelemetry, device_resident_bytes
+from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.monitor.monitor import MonitorMaster
+from deepspeed_tpu.profiling.flops import TrainFlopsMeter, lm_flops_per_token
 from deepspeed_tpu.runtime import optimizer as opt_builder
 from deepspeed_tpu.runtime.checkpoint_engine import (MsgpackCheckpointEngine,
                                                      ShardedCheckpointEngine)
@@ -62,6 +69,111 @@ class TrainState(NamedTuple):
     grad_acc: Any
     global_steps: jnp.ndarray  # i32: optimizer steps actually applied
     scaler: scaler_lib.LossScaleState
+
+
+def _spec_world(spec, mesh) -> int:
+    """Product of the mesh-axis extents a PartitionSpec shards over."""
+    axes = []
+    for part in spec:
+        if part is None:
+            continue
+        axes.extend(part if isinstance(part, (tuple, list)) else (part,))
+    w = 1
+    for a in axes:
+        w *= mesh.shape.get(a, 1)
+    return max(1, w)
+
+
+def _build_comm_plan(params, param_specs, acc_specs, mesh, zero_stage,
+                     compute_dtype, acc_dtype):
+    """Analytic per-step collective volumes for the GSPMD ZeRO path.
+
+    GSPMD inserts the ZeRO collectives implicitly (sharded accumulator ->
+    reduce-scatter, sharded params -> all-gather), so there is no wrapper
+    call site to count at.  What the schedule MUST move is still fully
+    determined by the partitioning specs, so the engine commits this plan
+    into the ``ds_comm_*`` series once per executed micro-batch/boundary:
+
+    - stage 3: every sharded param all-gathers twice per micro-batch
+      (forward + backward — the reference ZeRO-3 schedule);
+    - stage >= 2: gradients reduce-scatter into the sharded accumulator
+      once per micro-batch; stages 0/1 all-reduce them instead;
+    - stages 1/2: the boundary update on sharded optimizer state implies
+      one param all-gather back to the replicated layout.
+
+    Returns ``{"micro": [entries], "boundary": [entries]}`` with entries
+    shaped for :meth:`CommMetrics.commit`; empty lists when the mesh has no
+    extent to communicate over.  Device-measured truth lives in the xplane
+    trace — this is the byte ledger, not a timer.
+    """
+    dp_world = 1
+    for a in ("dp", "fsdp", "ep"):
+        dp_world *= mesh.shape.get(a, 1)
+    c_item = jnp.dtype(compute_dtype).itemsize
+    a_item = jnp.dtype(acc_dtype).itemsize
+    cname = jnp.dtype(compute_dtype).name
+    aname = jnp.dtype(acc_dtype).name
+
+    p_leaves = jax.tree_util.tree_leaves(params)
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda s: isinstance(s, P))
+    acc_spec_leaves = jax.tree_util.tree_leaves(
+        acc_specs, is_leaf=lambda s: isinstance(s, P))
+
+    gather_bytes = gather_calls = 0
+    gather_world = 1
+    total_bytes = 0
+    for leaf, spec in zip(p_leaves, spec_leaves):
+        nbytes = int(np.prod(leaf.shape)) * c_item if leaf.shape else c_item
+        total_bytes += nbytes
+        w = _spec_world(spec, mesh)
+        if w > 1:
+            gather_bytes += nbytes
+            gather_calls += 1
+            gather_world = max(gather_world, w)
+
+    rs_bytes = rs_calls = 0
+    rs_world = 1
+    for leaf, spec in zip(p_leaves, acc_spec_leaves):
+        nbytes = int(np.prod(leaf.shape)) * a_item if leaf.shape else a_item
+        w = _spec_world(spec, mesh)
+        if w > 1:
+            rs_bytes += nbytes
+            rs_calls += 1
+            rs_world = max(rs_world, w)
+
+    micro: List[Tuple[str, int, int, str, int]] = []
+    boundary: List[Tuple[str, int, int, str, int]] = []
+    if zero_stage == 3 and gather_bytes:
+        micro.append(("all_gather", 2 * gather_calls, 2 * gather_bytes,
+                      cname, gather_world))
+    if zero_stage >= 2 and rs_bytes:
+        micro.append(("reduce_scatter", rs_calls, rs_bytes, aname, rs_world))
+    elif dp_world > 1 and total_bytes:
+        # replicated accumulator: each micro-batch's grads all-reduce over
+        # the data axes (bytes in the accumulation dtype)
+        micro.append(("all_reduce", len(p_leaves),
+                      total_bytes * a_item // c_item, aname, dp_world))
+    if zero_stage in (1, 2) and dp_world > 1 and total_bytes:
+        # sharded-optimizer update -> updated params gather back replicated
+        boundary.append(("all_gather", len(p_leaves), total_bytes, cname,
+                         dp_world))
+    return {"micro": micro, "boundary": boundary}
+
+
+def _flight_guard(fn):
+    """Dump the flight recorder (once) before re-raising an unhandled
+    exception out of an engine entry point."""
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        try:
+            return fn(self, *args, **kwargs)
+        except Exception as exc:
+            self._flight_crash(exc)
+            raise
+
+    return wrapped
 
 
 class DeepSpeedEngine:
@@ -269,6 +381,25 @@ class DeepSpeedEngine:
 
         self.checkpoint_engine = ShardedCheckpointEngine(self.config.checkpoint_config)
         self.monitor = MonitorMaster(self.config)
+
+        # -- training-side telemetry (docs/OBSERVABILITY.md) ------------
+        # comms_logger block = the telemetry master switch for training:
+        # turns on the registry so ds_comm_*/ds_mem_*/ds_train_* record.
+        if self.config.comms_logger.enabled:
+            get_registry().enable()
+        self._comm_plan = None            # set by _setup_state_telemetry
+        self._flops_per_step_fn = None    # (micro, seq) -> train FLOPs
+        self._flops_since_boundary = 0.0
+        self._flops_meter = TrainFlopsMeter()
+        self._mem_telemetry = MemoryTelemetry()
+        self._flight = get_flight_recorder()
+        self._flight_dumped = False
+        frc = self.config.flight_recorder
+        if frc.enabled:
+            self._flight.enable(capacity=frc.capacity, dump_dir=frc.dump_dir)
+            if frc.on_signal:
+                self._flight.install_signal_handler()
+
         self.flops_profiler = None
         self._profile_probes = {}
         if self.config.flops_profiler.enabled:
@@ -650,6 +781,7 @@ class DeepSpeedEngine:
                  f"qw={self._zpp_cfg.q_weights} qg={self._zpp_cfg.q_grads} "
                  f"hpz={self._zpp_cfg.hpz}, mesh {dict(self.mesh.shape)}",
                  ranks=[0])
+        self._setup_state_telemetry(n)
 
     def _init_state(self, params: Any) -> None:
         """Build shardings for the full state and compile the step functions."""
@@ -811,6 +943,7 @@ class DeepSpeedEngine:
                  f"dtype {self.compute_dtype.__name__}, mesh {dict(self.mesh.shape)}", ranks=[0])
         if self.zero_stage == 3:
             logger.info(describe_partitioning(params, self._param_specs))
+        self._setup_state_telemetry(n)
 
     def _acc_dtype(self, param_dtype):
         # data_types.grad_accum_dtype (reference key): bf16 halves the
@@ -896,6 +1029,8 @@ class DeepSpeedEngine:
     # jitted step functions
     # ------------------------------------------------------------------
     def _compile_steps(self) -> None:
+        self._flight.record("compile", what="train step functions",
+                            zero_stage=self.zero_stage)
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         compute_dtype = self.compute_dtype
@@ -1250,6 +1385,93 @@ class DeepSpeedEngine:
                    out_specs=P()))
 
     # ------------------------------------------------------------------
+    # training-side telemetry (docs/OBSERVABILITY.md)
+    # ------------------------------------------------------------------
+    def _setup_state_telemetry(self, n_params: int) -> None:
+        """Once per state init: the static FLOPs estimator (model config),
+        the analytic GSPMD comm plan, and the measured ZeRO shard-group
+        memory breakdown.  Failures here must never break training."""
+        mcfg = getattr(self.module, "config", None)
+        L = getattr(mcfg, "num_layers", 0) or 0
+        D = getattr(mcfg, "hidden_size", 0) or 0
+        if L and D and n_params:
+            self._flops_per_step_fn = (
+                lambda tokens, seq, n=n_params, L=L, D=D:
+                tokens * lm_flops_per_token(n, L, D, seq))
+        if not (self._zeropp or self._onebit or self._param_offload):
+            try:
+                plan = _build_comm_plan(
+                    self.state.params, self._param_specs, self._acc_specs,
+                    self.mesh, self.zero_stage, self.compute_dtype,
+                    self._acc_dtype(jnp.float32))
+                if self._offload:
+                    # the host optimizer step replaces the boundary
+                    # gather with per-leaf device_puts — not a collective
+                    plan["boundary"] = []
+                self._comm_plan = plan if (plan["micro"] or plan["boundary"]) \
+                    else None
+            except Exception as exc:
+                logger.warning("telemetry: comm plan unavailable (%s)", exc)
+        if get_registry().enabled:
+            try:
+                st = self.state
+                pb = device_resident_bytes(st.params)
+                gb = device_resident_bytes(st.grad_acc)
+                ob = device_resident_bytes(st.opt_state)
+                self._mem_telemetry.set_state_bytes(pb, gb, ob)
+                log_dist(
+                    f"ZeRO stage {self.zero_stage} per-device state bytes: "
+                    f"params={pb/1e6:.2f}MB grads={gb/1e6:.2f}MB "
+                    f"optimizer={ob/1e6:.2f}MB "
+                    f"(mesh {dict(self.mesh.shape)})", ranks=[0])
+                self._mem_telemetry.sample()
+            except Exception as exc:
+                logger.warning("telemetry: state-bytes breakdown "
+                               "unavailable (%s)", exc)
+
+    def _micro_telemetry(self, batch) -> None:
+        """Per-micro-batch accounting: FLOPs accrual for the MFU gauge and
+        a flight-recorder breadcrumb.  One branch each while disabled."""
+        if self._flight.enabled:
+            self._flight.record("micro_end", step=self._host_steps + 1,
+                                micro=self._micro_count + 1)
+        if self._flops_per_step_fn is not None and get_registry().enabled:
+            for leaf in jax.tree_util.tree_leaves(batch):
+                shape = getattr(leaf, "shape", ())
+                if len(shape) >= 2:
+                    self._flops_since_boundary += self._flops_per_step_fn(
+                        int(shape[0]) * int(shape[1]), int(shape[1]))
+                    break
+
+    def _boundary_telemetry(self) -> None:
+        """Optimizer-boundary accounting: MFU/TFLOPS gauges off the
+        boundary-to-boundary wall clock (anchored on the step's loss
+        output — dispatch is async, so the meter blocks on it before
+        reading the clock; telemetry users pay that boundary bubble, the
+        ``wall_clock_breakdown`` trade), and an HBM sample."""
+        flops = self._flops_since_boundary
+        self._flops_since_boundary = 0.0
+        if not get_registry().enabled:
+            return
+        self._flops_meter.observe_boundary(flops or None,
+                                           anchor=self._last_loss)
+        self._mem_telemetry.sample()
+
+    def _flight_crash(self, exc: Exception) -> None:
+        """Dump the event ring once, before the exception propagates."""
+        if not self._flight.enabled or self._flight_dumped:
+            return
+        self._flight_dumped = True
+        self._flight.record("exception", type=type(exc).__name__,
+                            message=str(exc)[:300],
+                            step=self._host_steps + 1)
+        try:
+            self._flight.dump(
+                reason=f"unhandled {type(exc).__name__} in engine")
+        except Exception as dump_exc:
+            logger.error("flight recorder: crash dump failed: %s", dump_exc)
+
+    # ------------------------------------------------------------------
     # reference-parity imperative API (SURVEY.md §3.3)
     # ------------------------------------------------------------------
     def train(self, mode: bool = True):
@@ -1267,6 +1489,7 @@ class DeepSpeedEngine:
             return None
         return self.curriculum_scheduler.update_difficulty(self._host_steps)
 
+    @_flight_guard
     def forward(self, batch):
         """One micro-batch forward (+backward: gradients are produced in the
         same XLA program and accumulated — see module docstring)."""
@@ -1307,8 +1530,15 @@ class DeepSpeedEngine:
             if self.flops_profiler is not None:
                 self._profile_probes["accum"] = (self._accum_fn,
                                                  (self.state, batch, rng))
+            t0 = (time.perf_counter()
+                  if self._comm_plan is not None and comm_metrics.active
+                  else 0.0)
             self.state, loss = self._accum_fn(self.state, batch, rng)
+            if t0:
+                comm_metrics.commit(self._comm_plan["micro"],
+                                    time.perf_counter() - t0)
         self.timers(SynchronizedWallClockTimer.FORWARD).stop()
+        self._micro_telemetry(batch)
         self._micro_count += 1
         self._last_loss = loss
         return loss
@@ -1390,10 +1620,15 @@ class DeepSpeedEngine:
         """Manual boundary control (reference API, used by HF Accelerate)."""
         self._boundary_override = is_boundary
 
+    @_flight_guard
     def step(self):
         if not self.is_gradient_accumulation_boundary():
             return
+        self._flight.record("step_begin", step=self._host_steps + 1)
         self.timers(SynchronizedWallClockTimer.STEP).start()
+        t0 = (time.perf_counter()
+              if self._comm_plan is not None and comm_metrics.active
+              else 0.0)
         if self._param_offload:
             gnorm, overflow = self._step_param_offload()
         elif self._offload:
@@ -1401,6 +1636,9 @@ class DeepSpeedEngine:
         else:
             self.state, gnorm, overflow = self._apply_fn(self.state)
         self.timers(SynchronizedWallClockTimer.STEP).stop()
+        if t0 and self._comm_plan["boundary"]:
+            comm_metrics.commit(self._comm_plan["boundary"],
+                                time.perf_counter() - t0)
         self._last_grad_norm = gnorm
         self._last_overflow = overflow
         if self.lr_scheduler is not None:
@@ -1411,6 +1649,8 @@ class DeepSpeedEngine:
         # which only matters for print cadence; checkpoint tags still read
         # the authoritative device count).
         self._host_steps += 1
+        self._boundary_telemetry()
+        self._flight.record("step_end", step=self._host_steps)
         self._maybe_apply_compression()
         if self._host_steps % self.config.steps_per_print == 0:
             self._report(self.global_steps)
@@ -1538,6 +1778,7 @@ class DeepSpeedEngine:
                                     global_steps=steps, scaler=scaler)
         return gnorm, overflow
 
+    @_flight_guard
     def train_step(self, batch):
         """One full optimizer step from a stacked batch in a single dispatch.
 
@@ -1589,9 +1830,30 @@ class DeepSpeedEngine:
                                                   (self.state, stacked, rng))
         if self._trace is not None:
             self._trace.maybe_start(self._host_steps + 1)
+        self._flight.record("step_begin", step=self._host_steps + 1,
+                            fused=True)
         self.timers(SynchronizedWallClockTimer.STEP).start()
+        t0 = (time.perf_counter()
+              if self._comm_plan is not None and comm_metrics.active
+              else 0.0)
         self.state, loss, gnorm, overflow = self._fused_fn(self.state, stacked, rng)
         self.timers(SynchronizedWallClockTimer.STEP).stop()
+        if t0:
+            # the fused program runs gas micro-batches + the boundary in one
+            # dispatch: commit the whole step's plan against its one window
+            entries = [(op, calls * gas, nbytes * gas, dtype, world)
+                       for op, calls, nbytes, dtype, world
+                       in self._comm_plan["micro"]]
+            entries += self._comm_plan["boundary"]
+            comm_metrics.commit(entries, time.perf_counter() - t0)
+        if self._flops_per_step_fn is not None and get_registry().enabled:
+            for leaf in jax.tree_util.tree_leaves(stacked):
+                shape = getattr(leaf, "shape", ())
+                if len(shape) >= 3:
+                    self._flops_since_boundary += self._flops_per_step_fn(
+                        int(shape[0]) * int(shape[1]) * int(shape[2]),
+                        int(shape[2]))
+                    break
         self._last_loss = loss
         self._last_grad_norm = gnorm
         self._last_overflow = overflow
@@ -1599,6 +1861,8 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._host_steps += 1
+        self._boundary_telemetry()
+        self._flight.record("step_end", step=self._host_steps, fused=True)
         self._maybe_apply_compression()
         if self._host_steps % self.config.steps_per_print == 0:
             self._report(self.global_steps)
@@ -1728,6 +1992,7 @@ class DeepSpeedEngine:
                     fh.write(str(tag))
         comm.barrier()
         self.checkpoint_engine.commit(str(tag))
+        self._flight.record("checkpoint", tag=str(tag), dir=ckpt_dir)
         log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir
 

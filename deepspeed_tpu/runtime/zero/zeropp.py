@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 
 from deepspeed_tpu.comm import comm as comm_api
+from deepspeed_tpu.profiling.trace import scope as _scope
 from deepspeed_tpu.runtime.comm.quantized import (block_dequantize,
                                                   block_quantize,
                                                   quantized_reduce_scatter)
@@ -75,9 +76,11 @@ def q_all_gather_flat(local: jnp.ndarray, axis: str,
     (over the whole axis, or each subgroup when ``groups`` is given)."""
     q, scale, pad = block_quantize(local, block)
     comm_api.comms_logger.record("zpp_q_all_gather", axis, q)
-    qg = lax.all_gather(q, axis, axis=0, tiled=False, axis_index_groups=groups)
-    sg = lax.all_gather(scale, axis, axis=0, tiled=False,
-                        axis_index_groups=groups)
+    with _scope("ds_comm_zpp_q_all_gather"):
+        qg = lax.all_gather(q, axis, axis=0, tiled=False,
+                            axis_index_groups=groups)
+        sg = lax.all_gather(scale, axis, axis=0, tiled=False,
+                            axis_index_groups=groups)
     G = qg.shape[0]
     parts = (qg.astype(jnp.float32) * sg).reshape(G, -1)
     if pad:
@@ -87,8 +90,9 @@ def q_all_gather_flat(local: jnp.ndarray, axis: str,
 
 def dense_all_gather_flat(local: jnp.ndarray, axis: str, groups=None) -> jnp.ndarray:
     comm_api.comms_logger.record("zpp_all_gather", axis, local)
-    return lax.all_gather(local, axis, axis=0, tiled=True,
-                          axis_index_groups=groups)
+    with _scope("ds_comm_zpp_all_gather"):
+        return lax.all_gather(local, axis, axis=0, tiled=True,
+                              axis_index_groups=groups)
 
 
 def reduce_scatter_flat(full: jnp.ndarray, axis: str, quantized: bool,
@@ -97,7 +101,8 @@ def reduce_scatter_flat(full: jnp.ndarray, axis: str, quantized: bool,
     if quantized:
         return quantized_reduce_scatter(full, axis, block=block)
     comm_api.comms_logger.record("zpp_reduce_scatter", axis, full)
-    return lax.psum_scatter(full, axis, scatter_dimension=0, tiled=True)
+    with _scope("ds_comm_zpp_reduce_scatter"):
+        return lax.psum_scatter(full, axis, scatter_dimension=0, tiled=True)
 
 
 class ZeroPPConfig(NamedTuple):
@@ -133,10 +138,11 @@ def gather_param_tree(zp: ZeroPPParams, cfg: ZeroPPConfig, shapes: Any):
             if cfg.q_weights:
                 comm_api.comms_logger.record("zpp_q_all_gather(hpz)",
                                              cfg.axis, sec_q)
-                qg = lax.all_gather(sec_q, cfg.axis, axis=0, tiled=False,
-                                    axis_index_groups=groups)
-                sg = lax.all_gather(sec_s, cfg.axis, axis=0, tiled=False,
-                                    axis_index_groups=groups)
+                with _scope("ds_comm_zpp_q_all_gather_hpz"):
+                    qg = lax.all_gather(sec_q, cfg.axis, axis=0, tiled=False,
+                                        axis_index_groups=groups)
+                    sg = lax.all_gather(sec_s, cfg.axis, axis=0, tiled=False,
+                                        axis_index_groups=groups)
                 parts = (qg.astype(jnp.float32) * sg[..., None]
                          ).reshape(cfg.hpz, -1)
                 # strip each rank's quant-block padding before concatenating
@@ -146,9 +152,10 @@ def gather_param_tree(zp: ZeroPPParams, cfg: ZeroPPConfig, shapes: Any):
             else:
                 comm_api.comms_logger.record("zpp_all_gather(hpz)",
                                              cfg.axis, sec_q)
-                full = lax.all_gather(sec_q, cfg.axis, axis=0, tiled=True,
-                                      axis_index_groups=groups
-                                      ).astype(jnp.float32)
+                with _scope("ds_comm_zpp_all_gather_hpz"):
+                    full = lax.all_gather(sec_q, cfg.axis, axis=0, tiled=True,
+                                          axis_index_groups=groups
+                                          ).astype(jnp.float32)
         elif cfg.q_weights:
             full = q_all_gather_flat(flat_local.astype(cfg.compute_dtype),
                                      cfg.axis, block=cfg.block)

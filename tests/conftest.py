@@ -69,6 +69,23 @@ def _restore_global_mesh():
     mesh_mod._GLOBAL_MESH = prev
 
 
+@pytest.fixture(autouse=True)
+def _restore_metrics_registry_enabled():
+    """The disabled-by-default metrics registry is process-global, and an
+    engine built with ``comms_logger.enabled`` flips it on (PR 3) — a test
+    doing so must not leave later tests recording into shared counters
+    (the serving suite's unknown-finish-reason guard depends on a clean
+    enabled-state baseline)."""
+    from deepspeed_tpu.monitor.comms import comm_metrics
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    reg = get_registry()
+    prev_reg, prev_comms = reg.enabled, comm_metrics.enabled
+    yield
+    reg._enabled = prev_reg
+    comm_metrics.enabled = prev_comms
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,153 @@
+"""Flight recorder (monitor/flight_recorder.py): ring wraparound ordering,
+the dump-on-exception path through the engine (the acceptance criterion:
+an injected mid-step exception dumps the preceding collective and step
+events in order), signal-handler hygiene (installed only on request), and
+thread-stack capture."""
+
+import json
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.comms import comm_metrics
+from deepspeed_tpu.monitor.flight_recorder import (FlightRecorder,
+                                                   get_flight_recorder)
+from deepspeed_tpu.monitor.metrics import get_registry
+
+
+def test_ring_wraparound_keeps_order():
+    rec = FlightRecorder(capacity=4).enable()
+    for i in range(10):
+        rec.record("tick", i=i)
+    ev = rec.events()
+    assert len(ev) == 4
+    assert [e["i"] for e in ev] == [6, 7, 8, 9]
+    assert [e["seq"] for e in ev] == [6, 7, 8, 9]   # oldest -> newest
+    assert rec._n == 10
+
+
+def test_disabled_records_nothing():
+    rec = FlightRecorder(capacity=4)
+    rec.record("tick")
+    assert rec.events() == []
+    rec.enable()
+    rec.record("tick")
+    rec.disable()
+    rec.record("tock")
+    assert [e["kind"] for e in rec.events()] == ["tick"]
+
+
+def test_dump_contains_events_and_thread_stacks(tmp_path):
+    rec = FlightRecorder(capacity=8).enable(dump_dir=str(tmp_path))
+    rec.record("step_begin", step=1)
+    rec.record("step_end", step=1)
+    path = rec.dump(reason="unit test")
+    with open(path) as fh:
+        payload = json.load(fh)
+    assert payload["reason"] == "unit test"
+    assert [e["kind"] for e in payload["events"]] == ["step_begin",
+                                                      "step_end"]
+    # every dump carries all-thread stacks (hang diagnosis); the main
+    # thread's stack includes this test function
+    assert payload["threads"]
+    assert any("test_dump_contains_events" in "\n".join(fr)
+               for fr in payload["threads"].values())
+
+
+def test_signal_handler_installed_only_on_request(tmp_path):
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("no SIGUSR2 on this platform")
+    before = signal.getsignal(signal.SIGUSR2)
+    rec = FlightRecorder(capacity=4)
+    rec.enable(dump_dir=str(tmp_path))          # enabling does NOT install
+    assert not rec.signal_installed
+    assert signal.getsignal(signal.SIGUSR2) is before
+    try:
+        assert rec.install_signal_handler()
+        assert rec.signal_installed
+        assert signal.getsignal(signal.SIGUSR2) is not before
+        rec.record("alive", step=7)
+        signal.raise_signal(signal.SIGUSR2)     # delivered synchronously
+        dumps = list(tmp_path.glob("ds_flight_*.json"))
+        assert dumps, "SIGUSR2 did not produce a dump"
+        payload = json.loads(dumps[0].read_text())
+        kinds = [e["kind"] for e in payload["events"]]
+        assert kinds[-1] == "signal" and "alive" in kinds
+    finally:
+        rec.uninstall_signal_handler()
+    assert signal.getsignal(signal.SIGUSR2) is before
+
+
+# ---------------------------------------------------------------------------
+# engine integration: dump on an injected mid-step exception
+# ---------------------------------------------------------------------------
+
+
+def test_engine_dumps_on_mid_step_exception(tmp_path, mesh8):
+    """Acceptance: poisoning the boundary update mid-step produces a dump
+    whose event ring still holds the preceding collective and step events,
+    in seq order."""
+    from deepspeed_tpu.models import causal_lm
+
+    reg = get_registry()
+    was = reg.enabled
+    reg.reset()
+    rec = get_flight_recorder()
+    model = causal_lm("llama-tiny", mesh=mesh8, num_layers=1, hidden_size=32,
+                      intermediate_size=64, num_heads=2, num_kv_heads=1,
+                      vocab_size=128, remat=False)
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3,
+                                 "stage3_param_persistence_threshold": 0},
+           "comms_logger": {"enabled": True},
+           "flight_recorder": {"enabled": True, "capacity": 64,
+                               "dump_dir": str(tmp_path)},
+           "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, mesh=mesh8, rng=jax.random.PRNGKey(5))
+    try:
+        assert rec.enabled
+        assert not rec.signal_installed     # on_signal defaults to False
+        tokens = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(0), (8, 16), 0, 128), dtype=np.int32)
+        engine.forward((tokens, tokens))
+        engine.step()                       # one clean step first
+        engine.forward((tokens, tokens))    # records the collective commit
+
+        def boom(state):
+            raise RuntimeError("injected mid-step fault")
+
+        engine._apply_fn = boom
+        with pytest.raises(RuntimeError, match="injected"):
+            engine.step()
+        dumps = sorted(tmp_path.glob("ds_flight_*.json"))
+        assert dumps, "engine did not dump on the injected exception"
+        payload = json.loads(dumps[-1].read_text())
+        kinds = [e["kind"] for e in payload["events"]]
+        # the dump ends with the exception, preceded (in order) by the
+        # poisoned step's begin, which follows the micro-batch's collective
+        assert kinds[-1] == "exception"
+        assert "collective" in kinds and "step_begin" in kinds
+        i_coll = max(i for i, k in enumerate(kinds) if k == "collective")
+        i_begin = max(i for i, k in enumerate(kinds) if k == "step_begin")
+        assert i_coll < i_begin < len(kinds) - 1
+        seqs = [e["seq"] for e in payload["events"]]
+        assert seqs == sorted(seqs)
+        # a second failure does not dump again (once per engine)
+        with pytest.raises(RuntimeError):
+            engine.step()
+        assert len(sorted(tmp_path.glob("ds_flight_*.json"))) == len(dumps)
+    finally:
+        rec.disable()
+        rec.reset()
+        comm_metrics.configure(enabled=False)
+        comm_metrics.reset()
+        reg.reset()
+        if not was:
+            reg.disable()

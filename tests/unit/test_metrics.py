@@ -214,6 +214,62 @@ def test_monitor_master_bridge():
 
 
 # ---------------------------------------------------------------------------
+# /statz?window= rate deltas (two scrapes -> rates without Prometheus)
+# ---------------------------------------------------------------------------
+
+
+def test_statz_window_two_scrapes():
+    """First scrape of a window key primes it; the second returns
+    counter/histogram deltas + per-second rates over the real elapsed
+    time.  Distinct keys keep independent baselines."""
+    import time
+    import urllib.request
+
+    from deepspeed_tpu.monitor.server import MetricsServer
+
+    reg = MetricsRegistry().enable()
+    c = reg.counter("ds_t_reqs_total")
+    h = reg.histogram("ds_t_lat_seconds", buckets=(1.0, 2.0))
+    g = reg.gauge("ds_t_depth")
+    c.inc(5)
+    h.record(0.5)
+    server = MetricsServer(reg, port=0).start()
+    try:
+        def scrape(q):
+            with urllib.request.urlopen(f"{server.url}/statz?{q}",
+                                        timeout=5) as r:
+                return json.load(r)
+
+        first = scrape("window=5")
+        assert first["primed"] is True and first["metrics"] == {}
+        c.inc(7)
+        h.record(1.5)
+        h.record(1.5)
+        g.set(3)
+        time.sleep(0.05)
+        second = scrape("window=5")
+        assert second["primed"] is False
+        assert second["window_s"] > 0
+        m = second["metrics"]
+        assert m["ds_t_reqs_total"]["delta"] == 7
+        assert m["ds_t_reqs_total"]["per_sec"] == pytest.approx(
+            7 / second["window_s"], rel=0.2)
+        assert m["ds_t_lat_seconds"]["count_delta"] == 2
+        assert m["ds_t_lat_seconds"]["window_mean"] == pytest.approx(1.5)
+        assert m["ds_t_depth"]["value"] == 3
+        # a different key has its own baseline: full values as the delta
+        other = scrape("window=60")
+        assert other["primed"] is True
+        c.inc(1)
+        assert scrape("window=60")["metrics"]["ds_t_reqs_total"]["delta"] == 1
+        # plain /statz is unchanged by windowed scrapes
+        with urllib.request.urlopen(f"{server.url}/statz", timeout=5) as r:
+            assert json.load(r)["metrics"]["ds_t_reqs_total"] == 13
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # bench handshake (satellite: BENCH_r05 "parsed": null)
 # ---------------------------------------------------------------------------
 
@@ -288,6 +344,9 @@ def test_namespace_guard_all_metrics_documented(devices):
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
     from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.comms import comm_metrics
+    from deepspeed_tpu.monitor.memory import MemoryTelemetry
+    from deepspeed_tpu.profiling.flops import TrainFlopsMeter
     from deepspeed_tpu.serving.engine import ServingEngine
     from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
 
@@ -303,17 +362,39 @@ def test_namespace_guard_all_metrics_documented(devices):
     timers = SynchronizedWallClockTimer()
     for n in (timers.FORWARD, timers.BACKWARD, timers.STEP, timers.BATCH):
         timers(n)
+    # PR 3 families: the full comm-op instrument surface, HBM gauges, and
+    # the FLOPs/MFU gauges — all must be documented too (guard EXTENDED,
+    # not weakened)
+    comm_metrics.ensure_registered()
+    MemoryTelemetry()
+    TrainFlopsMeter()
 
     with open(_DOC) as fh:
         documented = set(re.findall(r"ds_[a-z0-9_]+", fh.read()))
     name_re = re.compile(r"^ds_[a-z0-9_]+$")
     train_re = re.compile(r"^ds_train_[a-z0-9_]+_seconds$")
+    # ds_comm_<op>_<suffix>: the suffix schema is documented as a table;
+    # every OP SLUG must additionally appear in the documented op list
+    # (written there as `ds_comm_<op>_` tokens)
+    comm_re = re.compile(r"^ds_comm_([a-z0-9_]+?)_"
+                         r"(calls_total|bytes_total|seconds|algbw_gbps|"
+                         r"busbw_gbps)$")
     names = get_registry().names()
     assert names, "no metrics registered — instrumentation went missing?"
     bad_ns = [n for n in names if not name_re.match(n)]
     assert not bad_ns, f"metrics outside the ds_ namespace: {bad_ns}"
-    undoc = [n for n in names
-             if n not in documented and not train_re.match(n)]
+    undoc = []
+    for n in names:
+        if train_re.match(n):
+            continue
+        m = comm_re.match(n)
+        if m:
+            if f"ds_comm_{m.group(1)}_" not in documented:
+                undoc.append(n)
+            continue
+        if n not in documented:
+            undoc.append(n)
     assert not undoc, (f"metrics not documented in docs/OBSERVABILITY.md: "
                        f"{undoc} (the ds_train_*_seconds family is exempt "
-                       f"— it is documented as a pattern)")
+                       f"— it is documented as a pattern; ds_comm op slugs "
+                       f"must appear in the documented op list)")

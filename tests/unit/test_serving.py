@@ -20,6 +20,23 @@ from deepspeed_tpu.serving import (FINISHED, IterationScheduler, Request,
                                    ServingEngine)
 
 
+@pytest.fixture(autouse=True)
+def _no_unknown_finish_reasons():
+    """Tier-1 assertion: ``ds_serve_finished_total{reason="unknown"}`` must
+    stay ZERO across the whole serving suite — a nonzero count means a
+    release path finished a request without attributing why (a scheduler
+    bug signal, per docs/OBSERVABILITY.md), and it must fail loudly here
+    rather than ship as a mystery series in production scrapes."""
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    yield
+    c = get_registry().get("ds_serve_finished_total",
+                           labels={"reason": "unknown"})
+    assert c is None or c.value == 0, (
+        f"{c.value} request(s) finished with reason='unknown' — some "
+        "release path forgot to set finish_reason (unattributed release)")
+
+
 # ---------------------------------------------------------------------------
 # scheduler unit tests (pure host logic, no jax)
 # ---------------------------------------------------------------------------
@@ -44,6 +61,9 @@ def test_scheduler_early_finish_frees_slot_immediately():
     s = IterationScheduler(2)
     reqs = [s.submit(_req()) for _ in range(3)]
     s.admit()
+    # the engine contract: finish_reason is attributed BEFORE finish()
+    # (an unset reason lands in the "unknown" bug-signal series)
+    reqs[0].finish_reason = "eos"
     s.finish(reqs[0])              # early EOS on slot 0
     assert s.free_slots() == [0]
     nxt = s.admit()
@@ -55,9 +75,9 @@ def test_scheduler_drain_ordering_by_finish_time():
     s = IterationScheduler(3)
     reqs = [s.submit(_req()) for _ in range(3)]
     s.admit()
-    s.finish(reqs[1])
-    s.finish(reqs[2])
-    s.finish(reqs[0])
+    for r in (reqs[1], reqs[2], reqs[0]):
+        r.finish_reason = "length"
+        s.finish(r)
     assert [r.request_id for r in s.finished] == \
         [reqs[1].request_id, reqs[2].request_id, reqs[0].request_id]
     assert not s.has_work

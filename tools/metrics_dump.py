@@ -9,6 +9,11 @@ Sources (auto-detected from the one positional argument):
 - a csvMonitor output dir:      ``python tools/metrics_dump.py ./csv_monitor/job``
   (one ``<event>.csv`` per series; the table shows each series' last value)
 
+``--comms`` additionally prints the per-collective summary (count / bytes /
+p50 / p99 / busbw from the ``ds_comm_*`` family — the training-side comm
+ledger, docs/OBSERVABILITY.md).  ``ds_mem_*`` byte gauges render humanized
+(GiB/MiB) in the value column; ``ds_train_mfu`` renders as a percentage.
+
 Zero dependencies — stdlib only, same as the metrics layer it reads.
 """
 
@@ -54,6 +59,49 @@ def load_snapshot(src: str) -> Dict[str, object]:
     return data.get("metrics", data)     # accept bare or /statz-shaped
 
 
+def human_bytes(n: float) -> str:
+    for unit, scale in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f} {unit}"
+    return f"{int(n)} B"
+
+
+def comms_rows(metrics: Dict[str, object]) -> List[List[str]]:
+    """Per-collective summary rows [op, calls, bytes, p50, p99, busbw]
+    from the ``ds_comm_*`` family (one row per op that recorded traffic)."""
+    ops = {}
+    for name in metrics:
+        if name.startswith("ds_comm_") and name.endswith("_calls_total"):
+            ops[name[len("ds_comm_"): -len("_calls_total")]] = None
+    rows = []
+    for op in sorted(ops):
+        calls = metrics.get(f"ds_comm_{op}_calls_total", 0)
+        byt = metrics.get(f"ds_comm_{op}_bytes_total", 0)
+        if isinstance(byt, dict):           # {dtype=...} labeled family
+            byt = sum(v for v in byt.values() if isinstance(v, (int, float)))
+        if not calls and not byt:
+            continue
+        hist = metrics.get(f"ds_comm_{op}_seconds") or {}
+        busbw = metrics.get(f"ds_comm_{op}_busbw_gbps", 0)
+        rows.append([op, str(calls), human_bytes(float(byt)),
+                     f"{hist.get('p50', 0):.6g}" if hist.get("count") else "",
+                     f"{hist.get('p99', 0):.6g}" if hist.get("count") else "",
+                     f"{busbw:.3g} GB/s" if busbw else ""])
+    return rows
+
+
+def render_comms(rows: List[List[str]]) -> str:
+    header = ["collective", "calls", "bytes", "p50_s", "p99_s", "busbw"]
+    table = [header] + rows
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
     """Flatten the snapshot into [name, count, mean, p50, p99, value]
     display rows (histograms fill the quantile columns, scalars the value
@@ -64,6 +112,13 @@ def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
         if isinstance(v, float):
             return f"{v:.6g}"
         return str(v)
+
+    def fmt_scalar(name, v):
+        if name.endswith("_bytes") and isinstance(v, (int, float)) and v:
+            return f"{fmt(v)} ({human_bytes(float(v))})"
+        if name == "ds_train_mfu" and isinstance(v, (int, float)) and v:
+            return f"{fmt(v)} ({100 * v:.2f}%)"
+        return fmt(v)
 
     def emit(name, v):
         if isinstance(v, dict) and "p50" in v:          # histogram
@@ -76,7 +131,7 @@ def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
             for labels, sub in sorted(v.items()):
                 emit(f"{name}{labels}", sub)
         else:
-            rows.append([name, "", "", "", "", fmt(v)])
+            rows.append([name, "", "", "", "", fmt_scalar(name, v)])
 
     for name, v in sorted(metrics.items()):
         emit(name, v)
@@ -96,14 +151,21 @@ def render(rows: List[List[str]]) -> str:
 
 
 def main(argv: List[str]) -> int:
-    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    flags = {a for a in argv[1:] if a.startswith("--")}
+    if len(args) != 1 or "--help" in flags or "-h" in argv[1:]:
         print(__doc__.strip())
-        return 0 if len(argv) == 2 else 2
-    metrics = load_snapshot(argv[1])
+        return 0 if len(args) == 1 else 2
+    metrics = load_snapshot(args[0])
     if not metrics:
         print("(no metrics found)")
         return 1
     print(render(rows_from_snapshot(metrics)))
+    if "--comms" in flags:
+        rows = comms_rows(metrics)
+        print()
+        print(render_comms(rows) if rows
+              else "(no ds_comm_* traffic recorded)")
     return 0
 
 
