@@ -148,18 +148,27 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
                   seed: int = 0, tiny: bool = False) -> dict:
     """Continuous-batching serving scenario: Poisson arrivals, mixed
     prompt/output lengths, reporting goodput tok/s and p50/p99 per-request
-    latency for the slot-based ``ServingEngine`` against the static-batch
-    baseline at EQUAL slot count (the same ``InferenceEngine`` batching
-    ``num_slots`` requests FIFO, padded to the batch max prompt and decoded
-    to the batch max output — the head-of-line + padding waste the
-    continuous scheduler removes).
+    latency for THREE systems replaying the identical arrival trace:
 
-    Goodput counts only the tokens each request ASKED for; the static
-    baseline's padding rows / overshoot decode steps are (correctly)
-    unpaid work.  Both systems replay the identical arrival trace; each
-    trace is warmed with TWO passes before the recorded third — the static
-    engine's grow-only cache reallocation drops compiled fns mid-first-
-    pass, so one warm pass still leaves compiles in the record.
+    - ``continuous`` — the PAGED ``ServingEngine`` at an HBM budget EQUAL
+      to the fixed-slot layout (``kv_pool_tokens = num_slots * max_out``)
+      but DOUBLE the slots: pages are allocated on demand, so the same KV
+      memory admits ~2x concurrently-decoding requests, backed by LIFO
+      preempt-and-requeue if the bimodal tail ever fills the pool — the
+      paged-vs-fixed comparison is equal-HBM, not equal-slots;
+    - ``fixed_slot`` — the PR 1 contiguous per-slot cache at ``num_slots``
+      (each slot reserves the worst-case ``max_out`` whether used or not);
+    - ``static`` — the static-batch ``InferenceEngine`` baseline at equal
+      slot count (padded to the batch max prompt, decoded to the batch max
+      output — the head-of-line + padding waste iteration-level
+      scheduling removes).
+
+    Goodput counts only the tokens each request ASKED for.  Each trace is
+    warmed with TWO passes before the recorded third — grow-only cache
+    reallocation drops compiled fns mid-first-pass, so one warm pass still
+    leaves compiles in the record.  The ``metrics`` sub-object carries the
+    paged engine's lifecycle histograms plus {kv_util, preemptions, pages}
+    so the goodput delta lands with its memory attribution.
     """
     import numpy as np
 
@@ -185,7 +194,9 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
                for n in rng.integers(p_lo, p_hi + 1, size=num_requests)]
     # bimodal output lengths (chat-like: mostly short answers, a heavy
     # long tail) — the head-of-line + padding regime static batching pays
-    # for and iteration-level scheduling does not
+    # for and iteration-level scheduling does not; ALSO the regime where
+    # fixed per-slot reservations are mostly dead weight (a 30-token reply
+    # pins the same KV as a 2k one), which is the paged pool's win
     long_mask = rng.random(num_requests) < 0.25
     news = np.where(long_mask,
                     rng.integers(n_long[0], n_long[1] + 1, num_requests),
@@ -198,18 +209,20 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
         return (round(float(np.percentile(lat, 50)), 4),
                 round(float(np.percentile(lat, 99)), 4))
 
-    # -- continuous batching ------------------------------------------
-    serve = deepspeed_tpu.init_serving(
-        model, config={"dtype": "bfloat16", "max_out_tokens": max_out},
-        num_slots=num_slots, decode_block_tokens=8)
-    serve.set_params(params)
-    from deepspeed_tpu.monitor.metrics import get_registry
+    # -- continuous batching: paged (equal HBM) vs fixed-slot ----------
+    kv_budget = num_slots * max_out          # the fixed layout's KV tokens
 
-    registry = get_registry()
-    was_enabled = registry.enabled
-    registry.enable()
+    def make_serve(paged: bool, slots: int):
+        cfg = {"dtype": "bfloat16", "max_out_tokens": max_out,
+               "paged_kv_cache": paged}
+        if paged:
+            cfg["kv_pool_tokens"] = kv_budget
+        s = deepspeed_tpu.init_serving(model, config=cfg, num_slots=slots,
+                                       decode_block_tokens=8)
+        s.set_params(params)
+        return s
 
-    def run_continuous():
+    def run_continuous(serve):
         t0 = time.perf_counter()
         reqs, i = [], 0
         while i < num_requests or serve.scheduler.has_work:
@@ -224,27 +237,63 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
         makespan = time.perf_counter() - t0
         lat = [r.t_finish - (t0 + arrivals[j]) for j, r in enumerate(reqs)]
         toks = sum(len(r.output_tokens) for r in reqs)
+        serve.scheduler.drain_finished()
         return toks, makespan, lat
 
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    registry = get_registry()
+    was_enabled = registry.enabled
+    registry.enable()
+    sides = {}
+    serving_metrics = {}
     try:
-        run_continuous()                    # compile-warm passes
-        run_continuous()
-        registry.reset()                    # warm passes out of the record
-        toks_c, span_c, lat_c = run_continuous()
-        # serving-health metrics from the lifecycle registry (host-side
-        # histograms over the RECORDED pass only) — tracked per BENCH row
-        # so a goodput regression is attributable to admission vs prefill
-        # vs decode, not just visible in the aggregate
-        snap = registry.snapshot()
-        serving_metrics = {
-            "ttft_p50_s": round(snap["ds_serve_ttft_seconds"]["p50"], 4),
-            "ttft_p99_s": round(snap["ds_serve_ttft_seconds"]["p99"], 4),
-            "queue_wait_p99_s":
-                round(snap["ds_serve_queue_wait_seconds"]["p99"], 4),
-            "tpot_p50_s": round(snap["ds_serve_tpot_seconds"]["p50"], 5),
-            "mean_slot_occupancy":
-                round(snap["ds_serve_occupancy_ratio"]["mean"], 3),
-        }
+        # engines are built lazily per side so only ONE KV cache (paged
+        # pool or fixed layout, each a full num_slots*max_out budget) is
+        # resident at a time — the equal-HBM bench must not itself hold 2x
+        for side, build in (("continuous",
+                             lambda: make_serve(True, 2 * num_slots)),
+                            ("fixed_slot",
+                             lambda: make_serve(False, num_slots))):
+            serve = build()
+            run_continuous(serve)           # compile-warm passes
+            run_continuous(serve)
+            registry.reset()                # warm passes out of the record
+            toks_c, span_c, lat_c = run_continuous(serve)
+            p50_c, p99_c = percentiles(lat_c)
+            snap = registry.snapshot()
+            util = snap.get("ds_serve_kv_cache_util_ratio") or {}
+            sides[side] = {
+                "goodput_tok_s": round(toks_c / span_c, 1),
+                "tokens": toks_c, "makespan_s": round(span_c, 3),
+                "p50_latency_s": p50_c, "p99_latency_s": p99_c,
+                "slots": serve.num_slots,
+                "kv_util": round(util.get("mean", 0.0), 3),
+            }
+            if side == "continuous":
+                # serving-health metrics from the lifecycle registry
+                # (host-side histograms over the RECORDED pass only) —
+                # tracked per BENCH row so a goodput regression is
+                # attributable to admission vs prefill vs decode vs pool
+                # pressure, not just visible in the aggregate
+                serving_metrics = {
+                    "ttft_p50_s":
+                        round(snap["ds_serve_ttft_seconds"]["p50"], 4),
+                    "ttft_p99_s":
+                        round(snap["ds_serve_ttft_seconds"]["p99"], 4),
+                    "queue_wait_p99_s":
+                        round(snap["ds_serve_queue_wait_seconds"]["p99"], 4),
+                    "tpot_p50_s":
+                        round(snap["ds_serve_tpot_seconds"]["p50"], 5),
+                    "mean_slot_occupancy":
+                        round(snap["ds_serve_occupancy_ratio"]["mean"], 3),
+                    "kv_util": round(util.get("mean", 0.0), 3),
+                    "preemptions":
+                        int(snap.get("ds_serve_preempted_total", 0)),
+                    "pages": {"pool": serve.pool.num_pages - 1,
+                              "page_tokens": serve.pool.page,
+                              "budget_tokens": kv_budget},
+                }
     finally:
         if not was_enabled:                 # a mid-bench raise must not
             registry.disable()              # leave the registry hot
@@ -279,23 +328,26 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
     run_static()                            # still recompiles: cache growth
     toks_s, span_s, lat_s = run_static()    # drops compiled fns mid-pass)
 
-    p50_c, p99_c = percentiles(lat_c)
     p50_s, p99_s = percentiles(lat_s)
+    goodput_c = sides["continuous"]["goodput_tok_s"]
+    goodput_f = sides["fixed_slot"]["goodput_tok_s"]
     return {
         "workload": {"num_requests": num_requests, "num_slots": num_slots,
+                     "paged_slots": 2 * num_slots,
+                     "kv_budget_tokens": kv_budget,
                      "qps": qps, "prompt_len": [p_lo, p_hi],
                      "new_tokens": {"short": list(n_short),
                                     "long": list(n_long), "p_long": 0.25},
                      "arrivals": "poisson", "seed": seed},
-        "continuous": {"goodput_tok_s": round(toks_c / span_c, 1),
-                       "tokens": toks_c, "makespan_s": round(span_c, 3),
-                       "p50_latency_s": p50_c, "p99_latency_s": p99_c},
+        "continuous": sides["continuous"],
         "metrics": serving_metrics,
+        "fixed_slot": sides["fixed_slot"],
         "static": {"goodput_tok_s": round(toks_s / span_s, 1),
                    "tokens": toks_s, "makespan_s": round(span_s, 3),
                    "p50_latency_s": p50_s, "p99_latency_s": p99_s},
-        "goodput_speedup": round((toks_c / span_c) / max(toks_s / span_s,
-                                                         1e-9), 2),
+        "goodput_speedup": round(goodput_c / max(toks_s / span_s, 1e-9), 2),
+        # the tentpole attribution: same KV HBM, 2x slots via paging
+        "paged_vs_fixed_speedup": round(goodput_c / max(goodput_f, 1e-9), 2),
     }
 
 
@@ -759,6 +811,10 @@ def summary_lines(record: dict, rung_serving) -> list:
         summary["serving_goodput_speedup"] = rung_serving["goodput_speedup"]
         summary["serving_p99_latency_s"] = \
             rung_serving["continuous"]["p99_latency_s"]
+        # equal-HBM paged-vs-fixed attribution (the paged-KV tentpole row)
+        if rung_serving.get("paged_vs_fixed_speedup") is not None:
+            summary["serving_paged_vs_fixed"] = \
+                rung_serving["paged_vs_fixed_speedup"]
         # serving-health row (TTFT/queue-wait/occupancy from the metrics
         # registry) so BENCH_r*.json tracks latency attribution, not just
         # aggregate goodput

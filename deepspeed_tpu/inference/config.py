@@ -62,6 +62,19 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     prefill_chunk: int = 64
     decode_block_tokens: int = 0
     max_prefill_chunks: int = 2
+    # Paged KV cache (serving/paged_kv.py — the vLLM/PagedAttention-style
+    # block allocator): slots draw fixed-size token pages from ONE shared
+    # pool instead of reserving max_out_tokens each, so HBM tracks the
+    # tokens actually live and the slot count is no longer bounded by the
+    # worst-case request.  kv_page_tokens = page granularity (0 = auto:
+    # the flash-decode block, capped at the per-slot budget);
+    # kv_pool_tokens = total pool capacity in tokens (0 = num_slots *
+    # per-slot budget — same HBM as the fixed layout; set it LOWER to
+    # oversubscribe slots against a fixed HBM budget, backed by LIFO
+    # preempt-and-requeue when the pool runs dry).
+    paged_kv_cache: bool = True
+    kv_page_tokens: int = 0
+    kv_pool_tokens: int = 0
 
     def __init__(self, **kwargs):
         # legacy alias: mp_size -> tensor_parallel.tp_size

@@ -227,6 +227,33 @@ def _rope_rows(t, cos, sin):
             if rot < t.shape[-1] else r)
 
 
+def paged_logical_view(buf, page_table):
+    """Gather a slot-contiguous LOGICAL cache view out of the paged pool:
+    ``buf`` [P, Hkv, page, D] physical pages, ``page_table`` [B, maxp]
+    int32 -> [B, Hkv, maxp*page, D].  The XLA fallback/reference read path
+    for the paged cache (the Pallas flash-decode kernel instead indirects
+    its DMA index map through the table, so no gather materializes);
+    unallocated table entries gather the junk page, whose rows sit beyond
+    every live position and are masked like any other padding."""
+    g = buf[page_table]                                 # [B, maxp, page...]
+    B, mp, Hkv, pg, D = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, mp * pg, D)
+
+
+def _scatter_paged_rows(buf, rows, pos, page_table):
+    """Per-row single-token append through the page table: ``rows``
+    [B, Hkv, 1, D] written at logical positions ``pos`` [B] into the
+    physical pool ``buf`` [P, Hkv, page, D] (row b lands at row
+    ``pos[b] % page`` of page ``page_table[b, pos[b] // page]``).  One
+    batched scatter, same aliasing argument as :func:`_scatter_rows`;
+    parked rows (table pointing at junk page 0) scatter junk harmlessly."""
+    B = rows.shape[0]
+    page = buf.shape[2]
+    pp = page_table[jnp.arange(B), pos // page]         # physical page [B]
+    po = pos % page
+    return buf.at[pp, :, po, :].set(rows[:, :, 0, :].astype(buf.dtype))
+
+
 def _scatter_rows(buf, rows, start_pos):
     """Write ``rows`` [B, Hx, s, D] into ``buf`` [B, Hx, Smax, D] at
     per-row start positions ``start_pos`` [B] (each batch row lands at its
@@ -240,13 +267,22 @@ def _scatter_rows(buf, rows, start_pos):
         rows.transpose(0, 2, 1, 3).astype(buf.dtype))
 
 
-def forward_with_cache(model, params, tokens, cache, start_pos):
+def forward_with_cache(model, params, tokens, cache, start_pos,
+                       page_table=None):
     """Run the model over ``tokens`` [B, s] starting at absolute position
     ``start_pos``, reading/updating the KV cache.
 
     ``start_pos`` is a scalar (the whole batch at one depth — static-batch
     prefill/decode) or an int32 [B] vector of per-row positions (the
     continuous-batching decode, where every slot sits at its own depth).
+
+    ``page_table`` [B, maxp] switches the cache to the PAGED layout
+    (``serving/paged_kv.py``: [L, num_pages, Hkv, page, Dh] pools shared
+    by all slots): appends scatter through the table and reads gather a
+    logical view per layer — the dense XLA fallback/reference for the
+    Pallas paged kernel.  Paged mode is decode-only (``s == 1``, per-row
+    positions); serving prefill gathers the slot's pages around this
+    function instead.
 
     Returns (logits [B, s, V], new_cache).  Used for prefill (s = prompt
     length, start_pos=0), decode (s = 1), and chunked per-slot prefill
@@ -260,6 +296,10 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
     quant_kv = "k_scale" in cache
     start_pos = jnp.asarray(start_pos, jnp.int32)
     per_row = start_pos.ndim == 1                      # [B] vector of depths
+    paged = page_table is not None
+    if paged and (not per_row or s != 1):
+        raise ValueError("paged KV decode requires per-row positions and "
+                         "s == 1 (prefill runs on a gathered slot view)")
     x = jnp.take(params["embed"]["tok"], tokens, axis=0)
     if cfg.position == "learned":
         if per_row:
@@ -282,9 +322,12 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
     else:
         slopes = None
 
+    # logical sequence capacity: the paged pool's per-slot window is the
+    # page table width x page depth, not the physical buffer's last dim
+    s_max = cache["k"].shape[-2] * (page_table.shape[1] if paged else 1)
     if cfg.position == "rope":
         # angles for the whole cache window once; gather the query slice
-        cos_all, sin_all = rope_angles(jnp.arange(cache["k"].shape[-2]),
+        cos_all, sin_all = rope_angles(jnp.arange(s_max),
                                        rope_dim(cfg), theta=cfg.rope_theta)
         if per_row:
             cos = cos_all[q_pos].astype(x.dtype)               # [B, s, half]
@@ -328,7 +371,12 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
         if quant_kv:
             kq, ks = _quantize_kv_rows(k)
             vq, vs = _quantize_kv_rows(v)
-            if per_row:
+            if paged:
+                kc = _scatter_paged_rows(kc, kq, start_pos, page_table)
+                vc = _scatter_paged_rows(vc, vq, start_pos, page_table)
+                ksc = _scatter_paged_rows(ksc, ks, start_pos, page_table)
+                vsc = _scatter_paged_rows(vsc, vs, start_pos, page_table)
+            elif per_row:
                 kc = _scatter_rows(kc, kq, start_pos)
                 vc = _scatter_rows(vc, vq, start_pos)
                 ksc = _scatter_rows(ksc, ks, start_pos)
@@ -340,6 +388,9 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
                                                    (0, 0, start_pos, 0))
                 vsc = jax.lax.dynamic_update_slice(vsc, vs,
                                                    (0, 0, start_pos, 0))
+        elif paged:
+            kc = _scatter_paged_rows(kc, k, start_pos, page_table)
+            vc = _scatter_paged_rows(vc, v, start_pos, page_table)
         elif per_row:
             kc = _scatter_rows(kc, k, start_pos)
             vc = _scatter_rows(vc, v, start_pos)
@@ -348,7 +399,18 @@ def forward_with_cache(model, params, tokens, cache, start_pos):
                                               (0, 0, start_pos, 0))
             vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                               (0, 0, start_pos, 0))
-        o = _cached_attention(q, kc, vc, q_pos, scale, ksc, vsc, slopes)
+        if paged:
+            # XLA fallback read: gather the logical per-slot view through
+            # the table (junk-page rows sit past every live position and
+            # mask out); the Pallas kernel path never materializes this
+            o = _cached_attention(
+                q, paged_logical_view(kc, page_table),
+                paged_logical_view(vc, page_table), q_pos, scale,
+                paged_logical_view(ksc, page_table) if quant_kv else None,
+                paged_logical_view(vsc, page_table) if quant_kv else None,
+                slopes)
+        else:
+            o = _cached_attention(q, kc, vc, q_pos, scale, ksc, vsc, slopes)
         o = o.transpose(0, 2, 1, 3).reshape(B, s, H * Dh)
         o = o @ a["wo"].astype(h.dtype)
         if cfg.use_bias:

@@ -230,15 +230,104 @@ def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, slope_ref, o_ref,
         o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
+def _alibi_or_zero_slopes(B, H, Hkv, rep, alibi):
+    if alibi:
+        from deepspeed_tpu.models.layers import alibi_slopes
+
+        return jnp.tile(alibi_slopes(H).reshape(Hkv, rep, 1),
+                        (B, 1, 1)).reshape(B * Hkv, rep, 1)
+    return jnp.zeros((B * Hkv, rep, 1), jnp.float32)
+
+
+def _flash_decode_paged_kernel(pos_ref, pt_ref, q_ref, k_ref, v_ref,
+                               slope_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
+    # the page table is consumed by the index maps (it picks WHICH physical
+    # page each block fetch DMAs); the in-kernel math is position-logical
+    # and identical to the contiguous kernel
+    del pt_ref
+    _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, slope_ref, o_ref,
+                         m_scr, l_scr, acc_scr, **kw)
+
+
+def _flash_decode_paged(q, kcache, vcache, pos, page_table, *, scale,
+                        layer: Optional[int], alibi: bool, impl: str):
+    """Decode attention over the PAGED pool (``serving/paged_kv.py``):
+    caches [P, Hkv, page, Dh] (or stacked [L, P, Hkv, page, Dh] with
+    ``layer=l``), ``page_table`` [B, maxp] int32 naming each row's
+    physical page per logical block.  The kernel's DMA block IS the page:
+    the block index map indirects through the scalar-prefetched table
+    (``pt_ref[row, min(j, pos // page)]``), so each block-sized fetch
+    lands on the right physical page and — exactly as in the contiguous
+    kernel — blocks past each row's ``pos`` are neither fetched nor
+    computed.  The XLA path gathers the logical per-slot view and runs
+    the dense reference (the fallback for CPU tests and non-tile-aligned
+    page sizes)."""
+    B, H, Dh = q.shape
+    kc = kcache if layer is None else kcache[layer]
+    vc = vcache if layer is None else vcache[layer]
+    Hkv, page = kc.shape[1], kc.shape[2]
+    if impl == "xla" or page % 128:
+        from deepspeed_tpu.models.decoding import paged_logical_view
+
+        return _flash_decode_ref(q, paged_logical_view(kc, page_table),
+                                 paged_logical_view(vc, page_table), pos,
+                                 scale=scale, alibi=alibi)
+    rep = H // Hkv
+    maxp = page_table.shape[1]
+    BG = B * Hkv
+    q4 = q.reshape(BG, rep, Dh)
+    if layer is None:
+        P = kcache.shape[0]
+        k3 = kcache.reshape(P * Hkv, page, Dh)
+        v3 = vcache.reshape(P * Hkv, page, Dh)
+        base = 0
+    else:
+        P = kcache.shape[1]
+        k3 = kcache.reshape(kcache.shape[0] * P * Hkv, page, Dh)
+        v3 = vcache.reshape(vcache.shape[0] * P * Hkv, page, Dh)
+        base = layer * P * Hkv
+    slopes = _alibi_or_zero_slopes(B, H, Hkv, rep, alibi)
+    kernel = functools.partial(_flash_decode_paged_kernel, scale=scale,
+                               block=page, nb=maxp, rep=rep, hkv=Hkv,
+                               alibi=alibi)
+
+    def page_map(b, j, pos_ref, pt_ref):
+        row = b // Hkv
+        jl = jnp.minimum(j, pos_ref[row] // page)   # per-row DMA clamp
+        return base + pt_ref[row, jl] * Hkv + b % Hkv, 0, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(BG, maxp),
+        in_specs=[pl.BlockSpec((1, rep, Dh), lambda b, j, p, t: (b, 0, 0)),
+                  pl.BlockSpec((1, page, Dh), page_map),
+                  pl.BlockSpec((1, page, Dh), page_map),
+                  pl.BlockSpec((1, rep, 1), lambda b, j, p, t: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, rep, Dh), lambda b, j, p, t: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((rep, 1), jnp.float32),
+                        pltpu.VMEM((rep, 1), jnp.float32),
+                        pltpu.VMEM((rep, Dh), jnp.float32)],
+    )
+    o = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((BG, rep, Dh), q.dtype),
+        interpret=interpret_flag(impl),
+    )(pos, page_table.astype(jnp.int32), q4, k3, v3, slopes)
+    return o.reshape(B, H, Dh)
+
+
 def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
                  block: int = 256, layer: Optional[int] = None,
-                 alibi: bool = False, impl: Optional[str] = None):
+                 alibi: bool = False, impl: Optional[str] = None,
+                 page_table=None):
     """Single-launch decode attention.  q: [B, H, Dh]; caches:
     [B, Hkv, Smax, Dh] — or, with ``layer=l``, stacked [L, B, Hkv, Smax, Dh]
     read at static layer offset ``l`` through the index map (no cache slice
     materializes); ``pos`` the (traced) absolute position of the query — a
     scalar shared by the batch, or an int32 [B] vector of per-row depths
     (continuous batching: each slot masks and clamps independently).
+    ``page_table`` [B, maxp] switches to the paged pool layout
+    ([P, Hkv, page, Dh] physical pages; see :func:`_flash_decode_paged`).
 
     The block index map clamps to the position's block PER ROW, so cache
     blocks past each row's ``pos`` are neither fetched nor computed — the
@@ -248,6 +337,10 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1),
                            (q.shape[0],))
+    if page_table is not None:
+        return _flash_decode_paged(q, kcache, vcache, pos, page_table,
+                                   scale=scale, layer=layer, alibi=alibi,
+                                   impl=impl)
     if layer is None:
         kc, vc = kcache, vcache
         off = 0
@@ -265,13 +358,7 @@ def flash_decode(q, kcache, vcache, pos, *, sm_scale: Optional[float] = None,
     rep = H // Hkv
     blk = block
     nb = Smax // blk
-    if alibi:
-        from deepspeed_tpu.models.layers import alibi_slopes
-
-        slopes = jnp.tile(alibi_slopes(H).reshape(Hkv, rep, 1),
-                          (B, 1, 1)).reshape(B * Hkv, rep, 1)
-    else:
-        slopes = jnp.zeros((B * Hkv, rep, 1), jnp.float32)
+    slopes = _alibi_or_zero_slopes(B, H, Hkv, rep, alibi)
     BG = B * Hkv
     q4 = q.reshape(BG, rep, Dh)
     if layer is None:

@@ -161,6 +161,32 @@ def _build_comm_plan(params, param_specs, acc_specs, mesh, zero_stage,
     return {"micro": micro, "boundary": boundary}
 
 
+@functools.lru_cache(maxsize=None)
+def _owned_copy(sharding):
+    # memoized per sharding — a fresh jit(lambda) per call would re-trace
+    # (dispatch cache keys on function identity); same pattern as the
+    # make_array compat shim
+    return jax.jit(lambda x: x.copy(), out_shardings=sharding)
+
+
+def _owned_device_put(x, sharding):
+    """``device_put`` that returns RUNTIME-OWNED buffers.
+
+    The CPU runtime zero-copies aligned host numpy arrays, so the returned
+    jax Array ALIASES the caller's buffer — and donating such an aliased
+    array into a persistent-cache-DESERIALIZED executable corrupts it (the
+    jaxlib bug the ``make_array_from_callback`` compat shim works around;
+    reproduced here as the offload + grad-accumulation train going NaN
+    from step 2 exactly when ``/tmp/dstpu_xla_cache`` is warm — the accum
+    fn donates ``state.params``, which ``_step_offload`` rebuilds from
+    host optimizer output every boundary).  Real accelerators copy H2D, so
+    the extra device-side copy is CPU-only."""
+    arr = jax.device_put(x, sharding)
+    if jax.default_backend() != "cpu":
+        return arr
+    return _owned_copy(sharding)(arr)
+
+
 def _flight_guard(fn):
     """Dump the flight recorder (once) before re-raising an unhandled
     exception out of an engine entry point."""
@@ -1766,9 +1792,13 @@ class DeepSpeedEngine:
                     master = opt.step_leaf(
                         i, np.ascontiguousarray(g, np.float32).reshape(-1))
                     out = master.astype(np_dtype)
-                # per-leaf async H2D overlaps with the next leaf's host step
-                new_leaves.append(jax.device_put(out.reshape(opt._shapes[i]),
-                                                 shardings[i]))
+                # per-leaf async H2D overlaps with the next leaf's host
+                # step; the OWNED put matters: these params are donated
+                # into the accum fn next micro-batch, and donating a
+                # zero-copy numpy-aliased buffer into a cache-deserialized
+                # executable corrupts it (see _owned_device_put)
+                new_leaves.append(_owned_device_put(
+                    out.reshape(opt._shapes[i]), shardings[i]))
             opt.end_step()
             new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         else:

@@ -6,17 +6,24 @@ inference engine).
 - :mod:`deepspeed_tpu.serving.scheduler` — request queue + iteration-level
   scheduler: finished sequences free their slot immediately; queued
   requests are admitted mid-flight.
-- :mod:`deepspeed_tpu.serving.engine` — :class:`ServingEngine`: a fixed
-  pool of KV-cache slots decoding in lock-step with PER-ROW positions
-  (every slot at its own depth), chunked per-slot prefill interleaved with
-  decode so decode latency stays bounded, and an active-slot mask so the
-  compiled step keeps a static shape while occupancy varies.
+- :mod:`deepspeed_tpu.serving.paged_kv` — :class:`PagedKVPool`: block
+  allocator over one shared pool of fixed-size KV token pages (per-slot
+  page tables, alloc-on-append, free-on-finish, LIFO preempt-and-requeue
+  under pool pressure) — the vLLM/PagedAttention role, on by default.
+- :mod:`deepspeed_tpu.serving.engine` — :class:`ServingEngine`: KV-cache
+  slots decoding in lock-step with PER-ROW positions (every slot at its
+  own depth), chunked per-slot prefill interleaved with decode so decode
+  latency stays bounded, an active-slot mask so the compiled step keeps a
+  static shape while occupancy varies, and device-resident pos/active
+  carries so neither no-EOS nor EOS workloads sync the host per step.
 """
 
 from deepspeed_tpu.serving.scheduler import (FINISHED, PREFILLING, QUEUED,
                                              RUNNING, IterationScheduler,
                                              Request)
+from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
 from deepspeed_tpu.serving.engine import ServingEngine
 
-__all__ = ["Request", "IterationScheduler", "ServingEngine",
-           "QUEUED", "PREFILLING", "RUNNING", "FINISHED"]
+__all__ = ["Request", "IterationScheduler", "ServingEngine", "PagedKVPool",
+           "init_paged_kv_cache", "QUEUED", "PREFILLING", "RUNNING",
+           "FINISHED"]

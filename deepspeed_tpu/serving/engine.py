@@ -1,4 +1,4 @@
-"""Continuous-batching serving engine over a slot-based KV cache.
+"""Continuous-batching serving engine over a paged (or fixed-slot) KV cache.
 
 The static-batch :class:`~deepspeed_tpu.inference.engine.InferenceEngine`
 decodes the whole batch in lock-step on one scalar position: no request can
@@ -7,12 +7,16 @@ burns most of the batch on padding and head-of-line blocking.  This engine
 is the Orca / DeepSpeed-FastGen answer, mapped onto the existing fused
 Pallas decode stack:
 
-- a fixed pool of ``num_slots`` KV-cache slots (the batch dim of ONE
-  preallocated [L, num_slots, Hkv, Smax, Dh] cache, donated through every
-  jitted program so XLA updates it in place);
+- a KV cache shared by ``num_slots`` slots — by default a PAGED pool
+  (``serving/paged_kv.py``: fixed-size token pages, per-slot page tables,
+  alloc-on-append, free-on-finish, LIFO preempt-and-requeue under pool
+  pressure), so HBM tracks the tokens actually live instead of reserving
+  ``max_out_tokens`` per slot; ``paged_kv_cache=False`` keeps the PR 1
+  contiguous per-slot layout ([L, num_slots, Hkv, Smax, Dh]);
 - PER-ROW decode positions: every slot sits at its own depth, threaded
   through ``forward_with_cache`` / ``decode_step`` / the flash-decode
-  kernel (which masks and DMA-clamps per row);
+  kernel (which masks, DMA-clamps, and — paged — page-table-indirects per
+  row);
 - iteration-level scheduling: each :meth:`step` admits queued requests
   into freed slots, advances at most ``max_prefill_chunks`` prompt chunks
   (chunked per-slot prefill, interleaved with decode so decode latency
@@ -22,20 +26,36 @@ Pallas decode stack:
   varies, so there is exactly ONE decode program regardless of how many
   slots are live.
 
+Sync-free scheduling: the per-slot position AND active mask are
+DEVICE-RESIDENT carries of the compiled decode block (EOS termination —
+sampled-token-vs-eos — is folded into the compiled step), so the host
+scheduler never blocks on the block it just dispatched:
+
+- no-EOS requests: completion is pure position arithmetic; the host runs
+  AHEAD of the device, blocks dispatch back-to-back, and sampled tokens
+  are fetched lazily (refcounted) when a request finishes;
+- EOS requests: token values gate slot turnover, but the device already
+  stopped the row the step its EOS appeared — the host merely LEARNS of
+  it from a DEFERRED drain: after dispatching block ``i`` it fetches
+  block ``i-1``'s (tokens, valid) pair, so the fetch RTT overlaps live
+  device work and the only per-request sync left is the prefill
+  first-token check.  Slot frees land at most one decode block late.
+
 Slot-reuse safety (why freed slots need no cache zeroing): a query at
 position p only attends cache rows <= p, and every row <= p has been
 written by the CURRENT occupant before it is first attended — prefill
 writes [0, S) before the first decode, and each decode step writes its own
 row before attending it.  Inactive slots are "parked": they still run in
 the compiled step (static shapes) but write their junk K/V at their own
-frozen position, which the next occupant's prefill/decode overwrites
-before any query can see it.
+frozen position — their own rows in the fixed layout, the reserved junk
+page 0 in the paged layout (a released slot's page table points there).
 """
 
 from __future__ import annotations
 
 import functools
 import time
+from collections import deque
 from typing import Any, List, Optional
 
 import jax
@@ -48,8 +68,9 @@ from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
                                            sample_token)
 from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.profiling.trace import annotate
-from deepspeed_tpu.serving.scheduler import (RUNNING, IterationScheduler,
-                                             Request)
+from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
+from deepspeed_tpu.serving.scheduler import (PREFILLING, RUNNING,
+                                             IterationScheduler, Request)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -69,8 +90,14 @@ class ServingEngine:
         Max prompt tokens prefilled per scheduler iteration per slot
         (chunked prefill; bounds the decode stall a long prompt causes).
     decode_block_tokens:
-        Decode steps per compiled block (per host sync) — the serving
+        Decode steps per compiled block (per host dispatch) — the serving
         analog of ``decode_unroll``.
+
+    Paged-KV knobs ride on the config: ``paged_kv_cache`` (default on),
+    ``kv_page_tokens`` (page granularity), ``kv_pool_tokens`` (total pool
+    capacity — set it below ``num_slots * max_out_tokens`` to oversubscribe
+    slots against a fixed HBM budget; pool pressure preempts the
+    youngest-admitted slot LIFO and requeues it at the queue head).
     """
 
     def __init__(self, model=None, config=None, *, engine: Optional[InferenceEngine] = None,
@@ -103,32 +130,70 @@ class ServingEngine:
         self.scheduler = IterationScheduler(self.num_slots)
 
         cfg = self.module.config
-        self._cache = init_kv_cache(
-            cfg, self.num_slots, self._config.max_out_tokens,
-            dtype=engine.dtype, quantized=self._config.quantize_kv_cache)
-        # cache_len is the PHYSICAL depth (init_kv_cache rounds up to a
-        # flash-decode block multiple); max_out is the configured LOGICAL
-        # budget — generation bounds use max_out so serving stays
-        # token-identical to generate(), which never sees the rounding
-        self.cache_len = int(self._cache["k"].shape[-2])
+        self.paged = bool(self._config.paged_kv_cache)
+        if self.paged:
+            self.pool = PagedKVPool(
+                self.num_slots, self._config.max_out_tokens,
+                page_tokens=self._config.kv_page_tokens,
+                pool_tokens=self._config.kv_pool_tokens)
+            self._cache = init_paged_kv_cache(
+                cfg, self.pool.num_pages, self.pool.page,
+                dtype=engine.dtype,
+                quantized=self._config.quantize_kv_cache)
+            # per-slot LOGICAL window (page-table depth x page); the
+            # PHYSICAL pool may hold fewer tokens than num_slots windows
+            self.cache_len = self.pool.cache_len
+        else:
+            self.pool = None
+            self._cache = init_kv_cache(
+                cfg, self.num_slots, self._config.max_out_tokens,
+                dtype=engine.dtype, quantized=self._config.quantize_kv_cache)
+            # cache_len is the PHYSICAL depth (init_kv_cache rounds up to a
+            # flash-decode block multiple)
+            self.cache_len = int(self._cache["k"].shape[-2])
+        # max_out is the configured LOGICAL budget — generation bounds use
+        # max_out so serving stays token-identical to generate(), which
+        # never sees the physical rounding
         self.max_out = int(self._config.max_out_tokens)
-        # host-owned per-slot scheduling state, passed into every compiled
-        # block; the cache and the last-sampled-token vector are the only
-        # device-resident state (last stays on device so the no-EOS fast
-        # path never syncs per block — see _decode_block)
+        # Host-side SCHEDULE view of per-slot state.  pos/active mirror the
+        # device-resident carries below; for EOS rows the host view is an
+        # upper bound (the device may stop a row early — the host learns
+        # from the deferred drain), which only ever OVER-allocates pages.
         self._pos = np.zeros(self.num_slots, np.int32)      # cache depth
         self._active = np.zeros(self.num_slots, bool)       # decoding now
         self._limit = np.zeros(self.num_slots, np.int32)    # pos decode bound
         self._eos = np.full(self.num_slots, -1, np.int32)
+        self._drained_pos = np.zeros(self.num_slots, np.int32)
+        # device-resident decode state: last sampled token, per-row
+        # position, per-row active mask — carried (donated) block to block
+        # so neither no-EOS nor EOS scheduling ever syncs per step
         self._last_dev = jnp.zeros(self.num_slots, jnp.int32)
+        self._pos_dev = jnp.zeros(self.num_slots, jnp.int32)
+        self._act_dev = jnp.zeros(self.num_slots, bool)
+        self._wake_fn = jax.jit(
+            lambda pos, act, slot, s: (pos.at[slot].set(s),
+                                       act.at[slot].set(True)),
+            donate_argnums=(0, 1))
+        self._park_fn = jax.jit(
+            lambda pos, act, slot: (pos.at[slot].set(0),
+                                    act.at[slot].set(False)),
+            donate_argnums=(0, 1))
+        self._setpos_fn = jax.jit(lambda pos, slot, s: pos.at[slot].set(s),
+                                  donate_argnums=(0,))
         self._rng = jax.random.PRNGKey(self._config.seed + 1)
         self._block_fn = None
         self._prefill_fns = {}
         # deferred token blocks: device [K, B] arrays kept un-fetched until
-        # a participating request finishes (refcounted)
-        self._blocks = {}       # idx -> device toks [K, B]
-        self._block_np = {}     # idx -> host copy (memoized at first fetch)
-        self._block_refs = {}   # idx -> pending request references
+        # scheduling needs their values.  No-EOS requests hold refcounted
+        # (idx, n) refs resolved at finish; EOS requests are drain
+        # PARTICIPANTS — their share is appended when the block's
+        # (toks, valid) pair is drained, one block behind dispatch.
+        self._blocks = {}        # idx -> device toks [K, B]
+        self._block_valid = {}   # idx -> device valid [K, B] (drain blocks)
+        self._block_np = {}      # idx -> (toks np, valid np | None)
+        self._block_refs = {}    # idx -> pending consumers (refs + drains)
+        self._outstanding = deque()   # [(idx, [eos Request, ...])]
+        self._drain_lag = 1
         self._next_block = 0
         self.steps = 0
         self.metrics_server = None   # attached by init_serving(metrics_port=)
@@ -165,13 +230,31 @@ class ServingEngine:
             buckets=tuple(i / 16 for i in range(1, 17)))
         self._m_step_finished = reg.gauge(
             "ds_serve_step_finished", "requests drained by the last step")
+        # paged-KV pool health (registered unconditionally so the metrics
+        # namespace guard covers them; zero-valued on fixed-slot engines)
+        self._m_pages_used = reg.gauge(
+            "ds_serve_kv_pages_used", "KV pool pages allocated to slots")
+        self._m_pages_free = reg.gauge(
+            "ds_serve_kv_pages_free", "KV pool pages on the free list")
+        self._m_preempted = reg.counter(
+            "ds_serve_preempted_total",
+            "requests preempted (pages reclaimed, requeued at queue head)")
+        self._m_kv_util = reg.histogram(
+            "ds_serve_kv_cache_util_ratio",
+            "per-step live-tokens / allocated-page-tokens (paged pool)",
+            buckets=tuple(i / 16 for i in range(1, 17)))
         from deepspeed_tpu.models.fused_decode import supports_fused_decode
         fused_ok = (self._config.use_fused_decode is not False
                     and supports_fused_decode(
                         cfg, quantized_kv=self._config.quantize_kv_cache,
                         tp=engine.mesh.shape.get("tp", 1)))
-        log_dist(f"serving engine: {self.num_slots} slots x "
-                 f"{self.cache_len} tokens, prefill_chunk="
+        if self.paged:
+            layout = (f"paged pool: {self.pool.num_pages - 1} x "
+                      f"{self.pool.page}-token pages, "
+                      f"{self.num_slots} slots x {self.cache_len} window")
+        else:
+            layout = f"{self.num_slots} slots x {self.cache_len} tokens"
+        log_dist(f"serving engine: {layout}, prefill_chunk="
                  f"{self.prefill_chunk}, decode_block={self._K}, "
                  f"{'fused' if fused_ok else 'unfused'} decode", ranks=[0])
 
@@ -203,7 +286,8 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def step(self) -> List[Request]:
         """One scheduler iteration: admit → prefill chunk(s) → decode
-        block.  Returns the requests that finished during this iteration."""
+        block → drain deferred finish events.  Returns the requests that
+        finished during this iteration."""
         if self.engine._params is None:
             raise RuntimeError("no weights: set_params() first")
         done_before = len(self.scheduler.finished)
@@ -222,10 +306,24 @@ class ServingEngine:
         if self._active.any():
             with annotate("ds_serve_decode"):
                 self._decode_block()
+        elif self._outstanding:
+            # nothing left to dispatch: flush pending finish events so the
+            # final EOS slots free and the loop can drain
+            self._flush_outstanding()
         self.steps += 1
         self._m_steps.inc()
         self._m_active.set(int(self._active.sum()))
         self._m_occupancy.record(self.scheduler.num_occupied / self.num_slots)
+        # cache utilization = live tokens / ALLOCATED tokens: pages actually
+        # granted on the paged pool, the full per-slot reservation on the
+        # fixed layout — the bench's paged-vs-fixed attribution series
+        if self.paged:
+            if self.pool.pages_used:
+                self._m_kv_util.record(
+                    self.pool.utilization(int(self._pos.sum())))
+        elif self.scheduler.num_occupied:
+            self._m_kv_util.record(
+                int(self._pos.sum()) / (self.num_slots * self.cache_len))
         finished = self.scheduler.finished[done_before:]
         self._m_step_finished.set(len(finished))
         return finished
@@ -238,18 +336,88 @@ class ServingEngine:
         return self.scheduler.finished
 
     # ------------------------------------------------------------------
+    # paged-pool allocation + preemption
+    # ------------------------------------------------------------------
+    def _ensure_pages(self, req: Request, tokens: int) -> bool:
+        """Allocate pages so ``req``'s slot covers ``tokens`` tokens.
+        Under pool pressure, first drain any deferred finish events (a
+        pending EOS release may free pages for free), then preempt the
+        YOUNGEST-admitted occupant (LIFO — possibly ``req`` itself, in
+        which case False is returned and the caller skips this dispatch)
+        and requeue it at the queue head.  The oldest request always keeps
+        its pages, so progress is guaranteed and the pool cannot
+        livelock."""
+        while not self.pool.ensure(req.slot, tokens):
+            if self._outstanding:
+                self._flush_outstanding()
+                continue
+            victim = self._youngest_victim()
+            if victim is None:
+                # unreachable by construction: the pool holds >= one full
+                # slot window, and a lone occupant owns every page it needs
+                raise RuntimeError(
+                    f"KV page pool exhausted with no preemptible slot "
+                    f"(slot {req.slot} needs {tokens} tokens)")
+            self._preempt(victim)
+            if victim is req:
+                return False
+        self._m_pages_used.set(self.pool.pages_used)
+        self._m_pages_free.set(self.pool.pages_free)
+        return True
+
+    def _youngest_victim(self) -> Optional[Request]:
+        cands = self.scheduler.running() + self.scheduler.prefilling()
+        return max(cands, key=lambda r: r.t_admit, default=None)
+
+    def _preempt(self, victim: Request) -> None:
+        """Reclaim every page the victim holds and send it back to the
+        queue head.  Its produced tokens are materialized first (they
+        become part of the resume prefix: re-prefilling prompt + outputs
+        rebuilds the identical KV state, so greedy continuations are
+        token-identical across the preempt-resume cycle)."""
+        self._flush_outstanding()        # retire in-flight blocks first
+        if victim.state == RUNNING:
+            self._materialize(victim)
+        b = victim.slot
+        self._active[b] = False
+        self._pos[b] = 0
+        self._limit[b] = 0
+        self._eos[b] = -1
+        self._pos_dev, self._act_dev = self._park_fn(
+            self._pos_dev, self._act_dev, jnp.asarray(b, jnp.int32))
+        self.pool.release(b)
+        victim.preemptions += 1
+        self.scheduler.requeue_front(victim)
+        self._m_preempted.inc()
+        self._m_pages_used.set(self.pool.pages_used)
+        self._m_pages_free.set(self.pool.pages_free)
+
+    # ------------------------------------------------------------------
     def _prefill_one_chunk(self, req: Request) -> None:
+        if req.state != PREFILLING:      # preempted mid-iteration
+            return
         t0 = time.perf_counter()
         slot, off = req.slot, req.prefill_pos
-        c = min(self.prefill_chunk, req.prompt_len - off)
+        prefix = req.prefix              # prompt (+ outputs after a resume)
+        n_prefix = req.prefix_len
+        c = min(self.prefill_chunk, n_prefix - off)
+        if self.paged and not self._ensure_pages(req, off + c):
+            return                       # self-preempted: resumes later
         cb = pow2_bucket(c, lo=8, cap=self.cache_len - off)  # pow2 bucket
         chunk = np.zeros((1, cb), np.int32)
-        chunk[0, :c] = req.prompt[off:off + c]
+        chunk[0, :c] = prefix[off:off + c]
         self._rng, srng = jax.random.split(self._rng)
-        tok_dev, self._cache = self._prefill_fn(cb)(
-            self.engine._params, self._cache, jnp.asarray(chunk),
-            jnp.asarray(slot, jnp.int32), jnp.asarray(off, jnp.int32),
-            jnp.asarray(c - 1, jnp.int32), srng)
+        if self.paged:
+            tok_dev, self._cache = self._prefill_fn(cb)(
+                self.engine._params, self._cache,
+                jnp.asarray(self.pool.page_table[slot]), jnp.asarray(chunk),
+                jnp.asarray(off, jnp.int32), jnp.asarray(c - 1, jnp.int32),
+                srng)
+        else:
+            tok_dev, self._cache = self._prefill_fn(cb)(
+                self.engine._params, self._cache, jnp.asarray(chunk),
+                jnp.asarray(slot, jnp.int32), jnp.asarray(off, jnp.int32),
+                jnp.asarray(c - 1, jnp.int32), srng)
         req.prefill_pos += c
         self._m_prefill_s.record(time.perf_counter() - t0)
         self._m_prefill_chunks.inc()
@@ -258,32 +426,44 @@ class ServingEngine:
         # progress means the NEXT chunk overwrites that row before any
         # query attends it
         self._pos[slot] = req.prefill_pos
-        if req.prefill_pos < req.prompt_len:
+        if req.prefill_pos < n_prefix:
+            # mirror the frontier onto the DEVICE pos carry: the decode
+            # block's parked junk write for this row must land at the
+            # frontier (overwritten by the next chunk), not at row 0 the
+            # previous chunk already filled
+            self._pos_dev = self._setpos_fn(
+                self._pos_dev, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(req.prefill_pos, jnp.int32))
             return
-        # prompt fully resident: the first generated token came out of the
-        # final chunk's program.  Its VALUE is only fetched when scheduling
+        # prefix fully resident: the next token came out of the final
+        # chunk's program.  Its VALUE is only fetched when scheduling
         # depends on it (EOS) — otherwise it stays on device and the
         # pipeline keeps flowing.
-        req.t_first_token = time.perf_counter()
-        # dispatch-time TTFT: on the sync-free path the token VALUE is still
-        # on device, but it exists and later work is ordered behind it
-        self._m_ttft.record(req.t_first_token - req.t_submit)
-        S = req.prompt_len
-        # limit <= S: the cache budget is already exhausted by the prompt
-        # (prompt length >= max_out_tokens - 1) — the prefill-sampled token
-        # is the only one this request can emit.  The bound is the LOGICAL
-        # max_out_tokens, not the block-rounded physical cache depth, so a
-        # request emits exactly the tokens generate() would
-        req_bound = S + req.max_new_tokens - 1
+        if not req.t_first_token:        # not re-recorded on a resume
+            req.t_first_token = time.perf_counter()
+            # dispatch-time TTFT: on the sync-free path the token VALUE is
+            # still device-resident, but it exists and later work is
+            # ordered behind it
+            self._m_ttft.record(req.t_first_token - req.t_submit)
+        S = n_prefix
+        # The position bound is ABSOLUTE, so it is invariant across
+        # preempt-resume (prefix grows by exactly the tokens produced).
+        # limit <= S: the cache budget is already exhausted by the prefix —
+        # the prefill-sampled token is the only one left to emit.  The
+        # bound is the LOGICAL max_out_tokens, not the page/block-rounded
+        # physical depth, so a request emits exactly what generate() would
+        req_bound = req.prompt_len + req.max_new_tokens - 1
         limit = min(req_bound, self.max_out - 1)
         req.limit_reason = "length" if limit == req_bound else "cache_budget"
-        if req.eos_token_id >= 0 or req.max_new_tokens == 1 or limit <= S:
-            first = int(tok_dev)
+        if (req.eos_token_id >= 0
+                or len(req.output_tokens) + 1 >= req.max_new_tokens
+                or limit <= S):
+            first = int(tok_dev)         # the once-per-request EOS sync
             req.output_tokens.append(first)
             if req.eos_token_id >= 0 and first == req.eos_token_id:
                 self._release(req, "eos")
                 return
-            if req.max_new_tokens == 1:
+            if len(req.output_tokens) >= req.max_new_tokens:
                 self._release(req, "length")
                 return
             if limit <= S:
@@ -293,28 +473,69 @@ class ServingEngine:
             req.pending_blocks.append(("tok", tok_dev))
         req.state = RUNNING
         self._last_dev = self._last_dev.at[slot].set(tok_dev)
+        self._pos_dev, self._act_dev = self._wake_fn(
+            self._pos_dev, self._act_dev, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(S, jnp.int32))
         self._pos[slot] = S
+        self._drained_pos[slot] = S
         self._limit[slot] = limit
         self._eos[slot] = req.eos_token_id
         self._active[slot] = True
 
     def _prefill_fn(self, cb: int):
-        """Per-slot chunked prefill, compiled once per pow2 chunk bucket:
-        slice the slot's cache rows out, run the standard (batch-1) prefill
-        forward at the chunk's absolute offset, write the slot back, and
-        sample the next token from the last real position's logits — the
-        token stays a DEVICE scalar so admission never syncs the host (its
-        value is only fetched when scheduling needs it: EOS requests, or
-        output materialization at finish).  Pad rows in [off+c, off+cb)
-        hold junk K/V but are only ever attended AFTER being overwritten by
-        the next chunk / decode step (queries attend key_pos <= q_pos, and
-        every row <= q_pos has been rewritten by then — same invariant as
-        the engine's bucketed prefill)."""
+        """Per-slot chunked prefill, compiled once per pow2 chunk bucket.
+
+        Fixed layout: slice the slot's cache rows out, run the standard
+        (batch-1) prefill forward at the chunk's absolute offset, write the
+        slot back, and sample the next token from the last real position's
+        logits — the token stays a DEVICE scalar so admission never syncs
+        the host.  Paged layout: the slot's pages are GATHERED into the
+        same contiguous logical view, the identical forward runs, and the
+        pages scatter back (prefill is matmul-bound; the gather cost is
+        one slot window per chunk, and the decode hot path never pays it).
+        Pad rows in [off+c, off+cb) hold junk K/V but are only ever
+        attended AFTER being overwritten by the next chunk / decode step
+        (queries attend key_pos <= q_pos, and every row <= q_pos has been
+        rewritten by then); junk landing past the allocated pages goes to
+        the junk page."""
         if cb in self._prefill_fns:
             return self._prefill_fns[cb]
         self._m_compiles.inc()
         model = self.module
         do_sample, temperature, top_k, top_p = self._sample
+        if self.paged:
+            maxp, page = self.pool.slot_pages, self.pool.page
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def prefill(params, cache, pt_row, chunk, start, last_idx, srng):
+                def gather(v):
+                    g = v[:, pt_row]            # [L, maxp, Hkv, page, D]
+                    L, mp, Hkv, pg, D = g.shape
+                    return g.transpose(0, 2, 1, 3, 4).reshape(
+                        L, 1, Hkv, mp * pg, D)
+
+                def scatter(dst, s):
+                    L, _, Hkv, _, D = s.shape
+                    pages = s.reshape(L, Hkv, maxp, page, D).transpose(
+                        0, 2, 1, 3, 4)
+                    return dst.at[:, pt_row].set(pages)
+
+                sub = {k: (gather(v) if v.ndim == 5 else v)
+                       for k, v in cache.items()}
+                logits, sub = forward_with_cache(model, params, chunk, sub,
+                                                 start)
+                out = {k: (scatter(cache[k], sub[k])
+                           if cache[k].ndim == 5 else sub[k])
+                       for k in cache}
+                last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1,
+                                                    keepdims=False)
+                tok = sample_token(last, srng, temperature=temperature,
+                                   top_k=top_k, top_p=top_p,
+                                   do_sample=do_sample)[0].astype(jnp.int32)
+                return tok, out
+
+            self._prefill_fns[cb] = prefill
+            return prefill
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(params, cache, chunk, slot, start, last_idx, srng):
@@ -337,83 +558,141 @@ class ServingEngine:
 
     # ------------------------------------------------------------------
     def _decode_block(self) -> None:
-        """Dispatch one compiled decode block.
+        """Dispatch one compiled decode block and schedule its outputs.
 
-        No-EOS fast path: without EOS stops, completion is pure position
-        arithmetic (a row emits exactly min(K, limit - pos) tokens), so the
-        host scheduler runs AHEAD of the device — blocks are dispatched
-        back-to-back with NO per-block sync, slot frees/admissions happen
-        on deterministic host state, and the sampled tokens are fetched
-        lazily when a request finishes (by which time later blocks are
-        already queued, so the fetch RTT overlaps device work).  On a
-        tunneled/remote runner this is the difference between goodput
-        bounded by host RTT and goodput bounded by the chip.
+        The device carries pos/active itself (EOS folded into the compiled
+        step), so dispatches never wait on token values:
 
-        With any active EOS request, token VALUES gate scheduling, so the
-        block is fetched synchronously and processed token-by-token."""
+        - no-EOS rows: a row emits exactly min(K, limit - pos) tokens —
+          the host appends a refcounted (block, n) ref and releases the
+          request the moment position arithmetic says it finished (the
+          deferred fetch at finish overlaps already-queued blocks);
+        - EOS rows: the host registers the request as a DRAIN PARTICIPANT
+          of this block and fetches the block's (toks, valid) pair only
+          after the NEXT block is dispatched (lag 1) — the fetch RTT
+          overlaps live device work, and the valid mask tells exactly how
+          many tokens each row emitted before its EOS stopped it."""
         t0 = time.perf_counter()
         running = self.scheduler.running()
-        toks, valid, self._last_dev, self._cache, self._rng = self._block()(
-            self._loop_params(), self._cache, self._last_dev,
-            jnp.asarray(self._pos), jnp.asarray(self._active),
-            jnp.asarray(self._limit), jnp.asarray(self._eos), self._rng)
-        self._m_decode_s.record(time.perf_counter() - t0)
-        if all(r.eos_token_id < 0 for r in running):
-            idx = self._next_block
-            self._next_block += 1
-            refs = 0
+        if self.paged:
             for req in running:
+                if req.state != RUNNING:     # preempted by an earlier ensure
+                    continue
                 b = req.slot
                 n = int(min(self._K, self._limit[b] - self._pos[b]))
-                req.pending_blocks.append((idx, n))
-                refs += 1
-                self._pos[b] += n
-                self._m_decode_toks.inc(n)
-                if self._pos[b] >= self._limit[b]:
-                    self._active[b] = False
-            if refs:
-                self._blocks[idx] = toks
-                self._block_refs[idx] = refs
-            for req in running:           # finish AFTER refs registered
-                if not self._active[req.slot] and req.state == RUNNING:
-                    self._materialize(req)
-                    self._release(req, req.limit_reason)
-            return
-        # synchronous path: flush any deferred output first so token order
-        # is preserved, then walk the fetched block
-        for req in running:
-            self._materialize(req)
-        toks = np.asarray(toks)    # [K, num_slots]
-        valid = np.asarray(valid)
+                if n > 0:
+                    # the block writes rows [pos, pos+n); EOS rows may stop
+                    # early on device — the host view only over-allocates.
+                    # A False return = req itself was the youngest and
+                    # self-preempted; the filter below drops it.
+                    self._ensure_pages(req, int(self._pos[b]) + n)
+            # a preemption above may have demoted someone mid-list
+            running = [r for r in running if r.state == RUNNING]
+            if not self._active.any():
+                return
+        args = [self._loop_params(), self._cache, self._last_dev,
+                self._pos_dev, self._act_dev, jnp.asarray(self._limit),
+                jnp.asarray(self._eos), self._rng]
+        if self.paged:
+            args.append(jnp.asarray(self.pool.page_table))
+        (toks, valid, self._last_dev, self._pos_dev, self._act_dev,
+         self._cache, self._rng) = self._block()(*args)
+        self._m_decode_s.record(time.perf_counter() - t0)
+        idx = self._next_block
+        self._next_block += 1
+        refs = 0
+        drainers: List[Request] = []
         for req in running:
             b = req.slot
-            for k in range(self._K):
-                if not valid[k, b]:
-                    break  # valid is monotone within a block
-                t = int(toks[k, b])
-                req.output_tokens.append(t)
-                self._pos[b] += 1
-                self._m_decode_toks.inc()
-                if req.eos_token_id >= 0 and t == req.eos_token_id:
-                    self._release(req, "eos")
-                    break
-                if len(req.output_tokens) >= req.max_new_tokens:
-                    self._release(req, "length")
-                    break
-            if req.state == RUNNING and self._pos[b] >= self._limit[b]:
-                # position-limit stop (in practice the cache-budget bound:
-                # a length-bound request releases in-loop at max_new)
+            n = int(min(self._K, self._limit[b] - self._pos[b]))
+            self._pos[b] += n
+            self._m_decode_toks.inc(n)
+            refs += 1
+            if req.eos_token_id < 0:
+                req.pending_blocks.append((idx, n))
+            else:
+                drainers.append(req)
+            if self._pos[b] >= self._limit[b]:
+                # stop scheduling the row; EOS rows RELEASE at their drain
+                # (token values decide), no-EOS rows release below
+                self._active[b] = False
+        if refs:
+            self._blocks[idx] = toks
+            self._block_refs[idx] = refs
+            if drainers:
+                self._block_valid[idx] = valid
+        if drainers:
+            self._outstanding.append((idx, drainers))
+            while len(self._outstanding) > self._drain_lag:
+                self._drain_one()
+        for req in running:              # finish AFTER refs registered
+            if (req.eos_token_id < 0 and not self._active[req.slot]
+                    and req.state == RUNNING):
+                self._materialize(req)
                 self._release(req, req.limit_reason)
 
+    # -- deferred finish-event drain -----------------------------------
+    def _fetch_block(self, idx: int):
+        """Device -> host fetch of one block's (toks, valid) arrays,
+        memoized.  All deferred output flows through here, which is what
+        the sync-free tests instrument."""
+        entry = self._block_np.get(idx)
+        if entry is None:
+            toks = np.asarray(self._blocks[idx])
+            valid = (np.asarray(self._block_valid[idx])
+                     if idx in self._block_valid else None)
+            entry = self._block_np[idx] = (toks, valid)
+        return entry
+
+    def _unref(self, idx: int) -> None:
+        self._block_refs[idx] -= 1
+        if self._block_refs[idx] == 0:
+            for d in (self._blocks, self._block_valid, self._block_np,
+                      self._block_refs):
+                d.pop(idx, None)
+
+    def _drain_one(self) -> None:
+        """Retire the oldest outstanding block: append each EOS
+        participant's share (its valid prefix) and release rows whose
+        finish the host could not predict."""
+        idx, drainers = self._outstanding.popleft()
+        toks, valid = self._fetch_block(idx)
+        for req in drainers:
+            b = req.slot
+            if req.state != RUNNING:     # released at an earlier drain
+                self._unref(idx)         # (its later blocks carry 0 tokens)
+                continue
+            n = int(valid[:, b].sum())   # valid is monotone within a block
+            req.output_tokens.extend(int(t) for t in toks[:n, b])
+            self._drained_pos[b] += n
+            self._unref(idx)
+            if (n and req.eos_token_id >= 0
+                    and req.output_tokens[-1] == req.eos_token_id):
+                self._release(req, "eos")
+            elif len(req.output_tokens) >= req.max_new_tokens:
+                self._release(req, "length")
+            elif self._drained_pos[b] >= self._limit[b]:
+                self._release(req, req.limit_reason)
+
+    def _flush_outstanding(self) -> None:
+        while self._outstanding:
+            self._drain_one()
+
     def _release(self, req: Request, reason: str) -> None:
-        """Finish the request and park its slot at depth 0: the parked
-        row's junk writes land on row 0 (overwritten by the next
-        occupant's first prefill chunk before it can be attended), and —
-        on the unfused path — the slot's stale depth no longer inflates
-        the flash-decode block loop bound (max over q_pos) for everyone
-        else."""
-        self._active[req.slot] = False
-        self._pos[req.slot] = 0
+        """Finish the request, park its slot at depth 0 (the parked row's
+        junk writes land on row 0 / the junk page, overwritten or never
+        read before any query can see them, and the slot's stale depth no
+        longer inflates the flash-decode loop bound), and — paged — return
+        its pages to the pool."""
+        b = req.slot
+        self._active[b] = False
+        self._pos[b] = 0
+        self._pos_dev, self._act_dev = self._park_fn(
+            self._pos_dev, self._act_dev, jnp.asarray(b, jnp.int32))
+        if self.paged:
+            self.pool.release(b)
+            self._m_pages_used.set(self.pool.pages_used)
+            self._m_pages_free.set(self.pool.pages_free)
         req.finish_reason = reason
         n = len(req.output_tokens)
         if n > 1 and req.t_first_token:
@@ -423,22 +702,20 @@ class ServingEngine:
 
     def _materialize(self, req: Request) -> None:
         """Fetch this request's deferred tokens (the prefill-sampled first
-        token + its share of each decode block) into output_tokens, in
-        order.  Blocks are refcounted: a device block is dropped once every
-        participating request has drained it."""
+        token + its (block, n) refs) into output_tokens, in order.  Blocks
+        are refcounted: a device block is dropped once every consumer has
+        drained it.  Only no-EOS requests carry refs (EOS requests drain);
+        a ref fetched here may sync on the just-dispatched block — that is
+        the existing fetch-at-finish, by which time later blocks are
+        already queued behind it."""
         for entry in req.pending_blocks:
             if entry[0] == "tok":                 # prefill-sampled token
                 req.output_tokens.append(int(entry[1]))
                 continue
             idx, n = entry
-            arr = self._block_np.get(idx)
-            if arr is None:
-                arr = self._block_np[idx] = np.asarray(self._blocks[idx])
-            req.output_tokens.extend(int(t) for t in arr[:n, req.slot])
-            self._block_refs[idx] -= 1
-            if self._block_refs[idx] == 0:
-                del self._blocks[idx], self._block_np[idx], \
-                    self._block_refs[idx]
+            toks, _ = self._fetch_block(idx)
+            req.output_tokens.extend(int(t) for t in toks[:n, req.slot])
+            self._unref(idx)
         req.pending_blocks.clear()
 
     def _loop_params(self):
@@ -448,26 +725,30 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def _step_fn(self):
         """One decode micro-step at per-row positions: (params, tokens
-        [B, 1], cache, pos [B]) -> (logits [B, V], cache)."""
+        [B, 1], cache, pos [B], page_table|None) -> (logits [B, V],
+        cache)."""
         model = self.module
         if self.engine._dparams is not None:
             from deepspeed_tpu.models.fused_decode import decode_step
 
-            def fused(params, tok, cache, pos):
-                return decode_step(model.config, params, tok, cache, pos)
+            def fused(params, tok, cache, pos, page_table):
+                return decode_step(model.config, params, tok, cache, pos,
+                                   page_table=page_table)
             return fused
 
-        def unfused(params, tok, cache, pos):
-            logits, cache = forward_with_cache(model, params, tok, cache, pos)
+        def unfused(params, tok, cache, pos, page_table):
+            logits, cache = forward_with_cache(model, params, tok, cache,
+                                               pos, page_table=page_table)
             return logits[:, -1], cache
         return unfused
 
     def _block(self):
         """ONE compiled program decoding ``decode_block_tokens`` tokens for
         all slots: lax.scan of per-row-position decode micro-steps with the
-        active mask traced (static shapes at any occupancy).  Rows stop
-        advancing when they hit their own EOS or position limit inside the
-        block; parked rows keep static shapes alive at their frozen pos."""
+        active mask AND positions as device carries (EOS termination folded
+        into the step — a row goes inactive the step its EOS is sampled,
+        with no host involvement).  Parked rows keep static shapes alive at
+        their frozen pos; the host reads (toks, valid) lazily."""
         if self._block_fn is not None:
             return self._block_fn
         self._m_compiles.inc()
@@ -475,13 +756,14 @@ class ServingEngine:
         do_sample, temperature, top_k, top_p = self._sample
         K = self._K
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def block(params, cache, last, pos, active, limit, eos, rng):
+        def body(params, cache, last, pos, active, limit, eos, rng,
+                 page_table):
             def sub(carry, _):
                 cache, last, pos, act, rng = carry
                 valid = act & (pos < limit)
                 rng, srng = jax.random.split(rng)
-                logits, cache = step_fn(params, last[:, None], cache, pos)
+                logits, cache = step_fn(params, last[:, None], cache, pos,
+                                        page_table)
                 nxt = sample_token(logits, srng, temperature=temperature,
                                    top_k=top_k, top_p=top_p,
                                    do_sample=do_sample).astype(last.dtype)
@@ -493,8 +775,13 @@ class ServingEngine:
 
             (cache, last, pos, act, rng), (toks, valid) = jax.lax.scan(
                 sub, (cache, last, pos, active, rng), None, length=K)
-            return toks, valid, last, cache, rng
+            return toks, valid, last, pos, act, cache, rng
 
+        if self.paged:
+            block = jax.jit(body, donate_argnums=(1, 2, 3, 4))
+        else:
+            block = jax.jit(functools.partial(body, page_table=None),
+                            donate_argnums=(1, 2, 3, 4))
         self._block_fn = block
         return block
 

@@ -1,0 +1,171 @@
+"""Paged KV cache: a block allocator over one shared pool of token pages.
+
+The fixed-slot serving cache (PR 1) reserves ``max_out_tokens`` of KV per
+slot, so a slot holding a 30-token chat reply pins the same HBM as one
+decoding 2k tokens — with bimodal chat-like lengths most of the
+reservation is dead weight and the slot count (goodput) is bounded by the
+worst-case request.  This module is the vLLM/PagedAttention answer mapped
+onto the existing flash-decode stack, and the serving-time counterpart of
+the ZeRO-Infinity argument (arXiv:2104.07857): treat KV memory as a
+managed pool, not a static reservation.
+
+Layout: the physical cache is ``[L, num_pages, Hkv, page_tokens, Dh]``
+(one pool shared by every slot) and each slot owns an ordered list of
+pages recorded in a ``[num_slots, slot_pages]`` int32 **page table**:
+logical token ``t`` of a slot lives at row ``t % page_tokens`` of
+physical page ``page_table[slot, t // page_tokens]``.  The table is host
+state, shipped into every compiled program; reads indirect through it
+(the Pallas flash-decode index map DMAs the right physical page per
+block; the XLA fallback gathers a logical view) and per-row appends
+scatter through it.
+
+Physical **page 0 is reserved as the junk page**: it is never allocated,
+and a released slot's table rows all point at it, so the parked row's
+junk K/V writes (inactive rows still execute in the static-shape compiled
+step) land somewhere no live slot ever reads.
+
+Allocation is host-side bookkeeping only (``ensure`` before a dispatch
+covers the tokens it will write; ``release`` on finish) — the pool's
+device arrays are owned and donated by the engine.  When the pool runs
+dry the engine preempts the youngest-admitted slot (LIFO) and requeues it
+at the head of the wait queue; the oldest request always keeps its pages,
+so admission pressure cannot livelock the pool.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.decoding import DECODE_BLOCK
+
+
+def default_page_tokens(max_out_tokens: int) -> int:
+    """Page granularity when the config leaves it 0: the flash-decode
+    block (pages ARE the kernel's DMA blocks), capped at the smallest
+    power of two covering the per-slot budget so tiny configs don't round
+    a 64-token budget up to one 256-token page."""
+    from deepspeed_tpu.inference.engine import pow2_bucket
+
+    return min(DECODE_BLOCK, pow2_bucket(max_out_tokens, lo=8))
+
+
+def init_paged_kv_cache(cfg, num_pages: int, page_tokens: int,
+                        dtype=jnp.bfloat16,
+                        quantized: bool = False) -> Dict[str, Any]:
+    """Device arrays for the shared page pool — the paged analog of
+    :func:`~deepspeed_tpu.models.decoding.init_kv_cache`, with the slot
+    dim replaced by the page dim and the sequence dim by the page depth."""
+    L, Hkv, Dh = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    if quantized:
+        return {
+            "k": jnp.zeros((L, num_pages, Hkv, page_tokens, Dh), jnp.int8),
+            "v": jnp.zeros((L, num_pages, Hkv, page_tokens, Dh), jnp.int8),
+            "k_scale": jnp.zeros((L, num_pages, Hkv, page_tokens, 1),
+                                 jnp.float32),
+            "v_scale": jnp.zeros((L, num_pages, Hkv, page_tokens, 1),
+                                 jnp.float32),
+            "x_dtype": jnp.zeros((), dtype),
+        }
+    return {
+        "k": jnp.zeros((L, num_pages, Hkv, page_tokens, Dh), dtype),
+        "v": jnp.zeros((L, num_pages, Hkv, page_tokens, Dh), dtype),
+    }
+
+
+class PagedKVPool:
+    """Host-side free-list allocator for the page pool.
+
+    Parameters
+    ----------
+    num_slots:
+        Slots (page-table rows) sharing the pool.
+    max_out_tokens:
+        Per-slot LOGICAL budget (prompt + generation), same meaning as the
+        fixed-slot cache; rounded up to a page multiple for the physical
+        table depth (``cache_len``).
+    page_tokens:
+        Tokens per page (0 = :func:`default_page_tokens`).
+    pool_tokens:
+        Total pool capacity in tokens (0 = ``num_slots * cache_len`` — the
+        same HBM as the fixed layout, but allocated on demand).  Setting
+        it lower oversubscribes slots against a fixed HBM budget; the pool
+        always holds at least one slot's full budget so a lone request can
+        never deadlock.
+    """
+
+    def __init__(self, num_slots: int, max_out_tokens: int, *,
+                 page_tokens: int = 0, pool_tokens: int = 0):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.page = int(page_tokens) or default_page_tokens(max_out_tokens)
+        self.slot_pages = -(-int(max_out_tokens) // self.page)
+        self.cache_len = self.slot_pages * self.page
+        want = int(pool_tokens) or num_slots * self.cache_len
+        usable = max(self.slot_pages, -(-want // self.page))
+        self.num_pages = usable + 1          # + the reserved junk page 0
+        self.num_slots = num_slots
+        # unallocated entries point at the junk page
+        self.page_table = np.zeros((num_slots, self.slot_pages), np.int32)
+        self._owned: List[List[int]] = [[] for _ in range(num_slots)]
+        # LIFO free list: released pages are reused first (locality, and
+        # deterministic reuse for the preempt-resume tests)
+        self._free: List[int] = list(range(usable, 0, -1))
+
+    # -- allocation ----------------------------------------------------
+    def ensure(self, slot: int, tokens: int) -> bool:
+        """Grow the slot's table to cover ``tokens`` logical tokens.
+        Returns False when the pool is exhausted — pages already granted
+        stay with the slot (the caller preempts a victim and retries)."""
+        if tokens > self.cache_len:
+            raise ValueError(f"slot needs {tokens} tokens > per-slot budget "
+                             f"{self.cache_len}")
+        owned = self._owned[slot]
+        need = -(-int(tokens) // self.page)
+        while len(owned) < need:
+            if not self._free:
+                return False
+            p = self._free.pop()
+            self.page_table[slot, len(owned)] = p
+            owned.append(p)
+        return True
+
+    def release(self, slot: int) -> int:
+        """Free every page the slot owns and park its table rows on the
+        junk page; returns the number of pages released."""
+        owned = self._owned[slot]
+        n = len(owned)
+        self._free.extend(owned)
+        owned.clear()
+        self.page_table[slot, :] = 0
+        return n
+
+    # -- accounting ----------------------------------------------------
+    @property
+    def pages_used(self) -> int:
+        return sum(len(o) for o in self._owned)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    def slot_pages_used(self, slot: int) -> int:
+        return len(self._owned[slot])
+
+    def utilization(self, live_tokens: int) -> float:
+        """live-tokens / allocated-page-tokens (1.0 = every allocated page
+        row holds a live token; the fixed-slot layout's equivalent is
+        live / (num_slots * cache_len))."""
+        alloc = self.pages_used * self.page
+        return (live_tokens / alloc) if alloc else 0.0
+
+    def check_no_leak(self) -> None:
+        """Invariant probe (tests): every non-junk page is either owned by
+        exactly one slot or on the free list."""
+        owned = [p for o in self._owned for p in o]
+        assert len(owned) == len(set(owned)), "page owned twice"
+        assert 0 not in owned and 0 not in self._free, "junk page allocated"
+        assert sorted(owned + self._free) == list(range(1, self.num_pages)), \
+            f"leaked pages: used={sorted(owned)} free={sorted(self._free)}"

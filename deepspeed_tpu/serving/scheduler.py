@@ -57,10 +57,29 @@ class Request:
     # budget and cache budget) — recorded WHERE the limit is computed so
     # finish attribution can't drift from the limit formula
     limit_reason: str = ""
+    # paged-KV preempt-and-requeue (engine._preempt): times this request
+    # lost its pages to pool pressure and went back to the queue head
+    preemptions: int = 0
 
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
+
+    @property
+    def prefix(self) -> np.ndarray:
+        """Tokens that must be cache-resident before decoding (re)starts:
+        the prompt, plus — after a preempt-resume — every output token
+        already produced (re-prefilling them regenerates the SAME KV state
+        the slot held before preemption, so the continuation is
+        token-identical under greedy decoding)."""
+        if not self.output_tokens:
+            return self.prompt
+        return np.concatenate([self.prompt,
+                               np.asarray(self.output_tokens, np.int32)])
+
+    @property
+    def prefix_len(self) -> int:
+        return self.prompt_len + len(self.output_tokens)
 
     @property
     def done(self) -> bool:
@@ -176,6 +195,21 @@ class IterationScheduler:
         # which silent folding into "length" would hide
         self._m_finished.get(req.finish_reason,
                              self._m_finished["unknown"]).inc()
+
+    def requeue_front(self, req: Request) -> None:
+        """Preempt-and-requeue (paged KV pool pressure): the request loses
+        its slot and goes back to the HEAD of the wait queue — it resumes
+        (re-prefilling its prompt + produced tokens) as soon as capacity
+        frees, ahead of requests that never ran.  The engine preempts the
+        YOUNGEST-admitted slot, so the oldest request always keeps its
+        pages and the pool cannot livelock."""
+        if req.slot >= 0 and self._slots[req.slot] is req:
+            self._slots[req.slot] = None
+        req.slot = -1
+        req.state = QUEUED
+        req.prefill_pos = 0
+        self._queue.appendleft(req)
+        self._m_queue_depth.set(len(self._queue))
 
     def drain_finished(self) -> List[Request]:
         """Return-and-clear the finished list.  Long-lived serving loops

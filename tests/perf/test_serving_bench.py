@@ -14,20 +14,35 @@ def test_serving_bench_scenario(capsys):
     from bench import bench_serving
 
     out = bench_serving(num_requests=12, num_slots=4, qps=200.0, tiny=True)
-    for side in ("continuous", "static"):
+    for side in ("continuous", "fixed_slot", "static"):
         assert out[side]["goodput_tok_s"] > 0
         assert out[side]["p99_latency_s"] >= out[side]["p50_latency_s"]
-    assert out["continuous"]["tokens"] == out["static"]["tokens"], \
-        "goodput must count the same requested tokens on both sides"
+    assert (out["continuous"]["tokens"] == out["static"]["tokens"]
+            == out["fixed_slot"]["tokens"]), \
+        "goodput must count the same requested tokens on every side"
     assert out["goodput_speedup"] > 0
+    assert out["paged_vs_fixed_speedup"] > 0
+    # equal-HBM comparison: the paged side runs 2x slots on the same KV
+    # budget, and allocation-on-demand makes its cache utilization at
+    # least the fixed reservation's on the identical trace
+    assert out["continuous"]["slots"] == 2 * out["fixed_slot"]["slots"]
+    assert out["continuous"]["kv_util"] >= out["fixed_slot"]["kv_util"] > 0
     # serving-health sub-object (BENCH_r*.json rows track these)
     m = out["metrics"]
     assert m["ttft_p99_s"] >= m["ttft_p50_s"] > 0
     assert m["queue_wait_p99_s"] >= 0
     assert 0 < m["mean_slot_occupancy"] <= 1
+    assert 0 < m["kv_util"] <= 1
+    assert m["preemptions"] >= 0
+    assert m["pages"]["pool"] * m["pages"]["page_tokens"] >= \
+        m["pages"]["budget_tokens"]
     with capsys.disabled():
-        print(f"\nserving bench (tiny/CPU): continuous "
-              f"{out['continuous']['goodput_tok_s']} tok/s vs static "
+        print(f"\nserving bench (tiny/CPU): paged "
+              f"{out['continuous']['goodput_tok_s']} tok/s vs fixed-slot "
+              f"{out['fixed_slot']['goodput_tok_s']} tok/s "
+              f"({out['paged_vs_fixed_speedup']}x at equal KV HBM, util "
+              f"{out['continuous']['kv_util']} vs "
+              f"{out['fixed_slot']['kv_util']}) vs static "
               f"{out['static']['goodput_tok_s']} tok/s "
               f"({out['goodput_speedup']}x); p99 "
               f"{out['continuous']['p99_latency_s']}s vs "

@@ -1,0 +1,238 @@
+"""Paged KV cache (serving/paged_kv.py + the paged serving engine):
+allocator unit behavior (alloc/free/LIFO reuse, exhaustion, leak probe),
+pool-pressure preempt-and-resume staying token-identical to sequential
+``generate()``, the fixed-slot fallback layout, and the sync-free EOS
+decode (finish events drained one block BEHIND dispatch — no per-step
+host-device sync).  Engines are module-scoped where possible: compiles
+dominate tier-1 wall time."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.models import causal_lm
+from deepspeed_tpu.serving import PagedKVPool
+
+
+@pytest.fixture(autouse=True)
+def _no_unknown_finish_reasons():
+    """Same tier-1 guard as test_serving: every release path must
+    attribute its finish reason."""
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    yield
+    c = get_registry().get("ds_serve_finished_total",
+                           labels={"reason": "unknown"})
+    assert c is None or c.value == 0
+
+
+# ---------------------------------------------------------------------------
+# allocator unit tests (pure host bookkeeping, no jax)
+# ---------------------------------------------------------------------------
+
+def test_pool_alloc_free_reuse():
+    pool = PagedKVPool(2, 64, page_tokens=16)
+    assert pool.page == 16 and pool.slot_pages == 4 and pool.cache_len == 64
+    assert pool.num_pages == 9                # 2 x 4 usable + junk page 0
+    assert pool.ensure(0, 1) and pool.slot_pages_used(0) == 1
+    assert pool.ensure(0, 16) and pool.slot_pages_used(0) == 1   # same page
+    assert pool.ensure(0, 17) and pool.slot_pages_used(0) == 2   # crosses
+    assert 0 not in pool.page_table[0, :2]    # junk page never allocated
+    assert (pool.page_table[0, 2:] == 0).all()  # unallocated -> junk
+    assert pool.ensure(1, 64)
+    assert pool.pages_used == 6 and pool.pages_free == 2
+    assert pool.ensure(0, 64) and pool.pages_free == 0
+    with pytest.raises(ValueError):           # beyond the per-slot budget
+        pool.ensure(0, 65)
+    assert pool.release(1) == 4
+    assert (pool.page_table[1] == 0).all() and pool.pages_free == 4
+    lifo_next = pool._free[-1]                # most recently freed
+    assert pool.ensure(1, 1) and pool.page_table[1, 0] == lifo_next
+    pool.check_no_leak()
+
+
+def test_pool_exhaustion_keeps_partial_grant():
+    pool = PagedKVPool(2, 64, page_tokens=16, pool_tokens=80)  # 5 usable
+    assert pool.ensure(0, 64)                 # 4 pages
+    assert not pool.ensure(1, 32)             # needs 2, only 1 free
+    assert pool.slot_pages_used(1) == 1       # the grant sticks
+    pool.release(0)
+    assert pool.ensure(1, 32)                 # satisfiable after release
+    pool.check_no_leak()
+
+
+def test_pool_sizing_defaults():
+    pool = PagedKVPool(4, 300)
+    # page = flash-decode block; window rounds 300 up to a page multiple
+    assert pool.page == 256 and pool.slot_pages == 2
+    assert pool.cache_len == 512
+    assert pool.num_pages == 4 * 2 + 1
+    assert PagedKVPool(4, 64).page == 64      # capped at pow2(max_out)
+    # the pool never drops below one full slot window (no self-deadlock)
+    assert PagedKVPool(4, 64, page_tokens=16,
+                       pool_tokens=16).num_pages == 4 + 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end paged serving on the CPU mesh
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def setup(devices):
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))
+    ref = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 64})
+    ref.set_params(params)
+    return model, params, ref
+
+
+def _serve(model, params, **over):
+    cfg = {"dtype": "float32", "max_out_tokens": 64, "kv_page_tokens": 16,
+           **over}
+    s = deepspeed_tpu.init_serving(model, config=cfg, num_slots=2,
+                                   prefill_chunk=4, decode_block_tokens=3)
+    s.set_params(params)
+    return s
+
+
+def _ref_out(ref, prompt, n):
+    return np.asarray(ref.generate(np.asarray(prompt)[None],
+                                   max_new_tokens=n,
+                                   do_sample=False))[0, len(prompt):]
+
+
+def test_pool_pressure_preempts_and_resumes_token_identical(setup, rng):
+    """An oversubscribed pool (5 pages for two 3-page requests) must
+    preempt the YOUNGEST slot, requeue it at the queue head, and resume it
+    token-identically (the resume re-prefills prompt + produced tokens, so
+    the greedy continuation cannot drift) — and no page may leak."""
+    model, params, ref = setup
+    serve = _serve(model, params, kv_pool_tokens=80)   # 5 usable pages
+    assert serve.pool.num_pages == 6
+    k1, k2 = jax.random.split(rng)
+    prompts = [np.asarray(jax.random.randint(k1, (8,), 0, 256)),
+               np.asarray(jax.random.randint(k2, (9,), 0, 256))]
+    want = [_ref_out(ref, p, 40) for p in prompts]     # pos -> 47/48: 3 pages
+    reqs = [serve.submit(p, max_new_tokens=40) for p in prompts]
+    serve.run()
+    assert sum(r.preemptions for r in reqs) >= 1, \
+        "5-page pool serving two 3-page requests must preempt"
+    for i, (req, w) in enumerate(zip(reqs, want)):
+        np.testing.assert_array_equal(
+            np.asarray(req.output_tokens), w,
+            err_msg=f"request {i} diverged across the preempt-resume cycle")
+    # free-on-finish freed everything; the allocator leaked nothing
+    assert serve.pool.pages_used == 0
+    serve.pool.check_no_leak()
+    assert serve.scheduler.drain_finished()            # history drainable
+    serve.pool.check_no_leak()
+
+
+def test_eos_decode_runs_sync_free(setup, rng):
+    """EOS workloads must not sync the host per decode block: every fetch
+    of a block's (toks, valid) pair happens either at least one block
+    BEHIND dispatch (the deferred drain — its RTT overlaps live device
+    work) or after the host has nothing left to dispatch (tail flush).
+    Instrumented at ``_fetch_block``, the single device->host readback
+    point — the same style of structural assertion the no-EOS fast path's
+    smoke test uses on ``_block``.  Outputs must equal the no-EOS greedy
+    trajectory truncated at the first EOS occurrence (inclusive)."""
+    model, params, ref = setup
+    serve = _serve(model, params)                      # ample pool
+    prompts = [np.asarray(jax.random.randint(k, (n,), 0, 256))
+               for k, n in zip(jax.random.split(rng, 3), (3, 5, 7))]
+    news = [8, 8, 8]
+    base = [_ref_out(ref, p, n) for p, n in zip(prompts, news)]
+    # request 0 stops mid-decode; request 1's eos never fires (drain
+    # releases it by length); request 2 stops near the tail
+    eos_ids = [int(base[0][3]),
+               int((set(range(256)) - set(base[1].tolist())).pop()),
+               int(base[2][-2])]
+
+    def truncate(seq, eos):
+        out = []
+        for t in seq:
+            out.append(int(t))
+            if int(t) == eos:
+                break
+        return out
+
+    want = [truncate(b, e) for b, e in zip(base, eos_ids)]
+    fetches = []
+    real_fetch = serve._fetch_block
+
+    def probing(idx):
+        fetches.append((idx, serve._next_block, bool(serve._active.any())))
+        return real_fetch(idx)
+
+    serve._fetch_block = probing
+    try:
+        reqs = [serve.submit(p, max_new_tokens=n, eos_token_id=e)
+                for p, n, e in zip(prompts, news, eos_ids)]
+        serve.run()
+    finally:
+        del serve.__dict__["_fetch_block"]
+    assert fetches, "EOS workload must flow through the deferred drain"
+    for idx, next_block, active in fetches:
+        assert idx < next_block - 1 or not active, (
+            f"block {idx} was fetched the same iteration it was dispatched "
+            f"(next_block={next_block}) with rows still active — a "
+            f"per-step host-device sync")
+    for i, (req, w) in enumerate(zip(reqs, want)):
+        assert req.output_tokens == w, (
+            f"eos request {i}: {req.output_tokens} != {w}")
+    assert reqs[0].finish_reason == "eos"
+    assert reqs[1].finish_reason == "length"
+    assert reqs[2].finish_reason == "eos"
+
+
+def test_int8_kv_paged_parity(setup, rng):
+    """Quantized KV + paged pool (the unfused gather path carries the
+    int8 payloads AND their fp32 scales through the same page tables):
+    token-identical to the int8-KV ``generate()``."""
+    model, params, _ = setup
+    cfg = {"dtype": "float32", "max_out_tokens": 64,
+           "quantize_kv_cache": True, "kv_page_tokens": 16}
+    ref = deepspeed_tpu.init_inference(model, config=cfg)
+    ref.set_params(params)
+    serve = deepspeed_tpu.init_serving(model, config=cfg, num_slots=2,
+                                       prefill_chunk=4,
+                                       decode_block_tokens=3)
+    serve.set_params(params)
+    assert serve.engine._dparams is None        # int8 KV = unfused path
+    prompts = [np.asarray(jax.random.randint(k, (n,), 0, 256))
+               for k, n in zip(jax.random.split(rng, 3), (3, 6, 9))]
+    news = [5, 7, 4]
+    want = [_ref_out(ref, p, n) for p, n in zip(prompts, news)]
+    reqs = [serve.submit(p, max_new_tokens=n) for p, n in zip(prompts, news)]
+    serve.run()
+    for i, (req, w) in enumerate(zip(reqs, want)):
+        np.testing.assert_array_equal(np.asarray(req.output_tokens), w,
+                                      err_msg=f"int8-KV paged request {i}")
+
+
+def test_fixed_slot_fallback_parity(setup, rng):
+    """``paged_kv_cache=False`` keeps the PR 1 contiguous per-slot layout
+    working (the reference the paged path is tested against)."""
+    model, params, ref = setup
+    serve = _serve(model, params, paged_kv_cache=False)
+    assert serve.pool is None
+    prompts = [np.asarray(jax.random.randint(k, (n,), 0, 256))
+               for k, n in zip(jax.random.split(rng, 3), (3, 6, 11))]
+    news = [5, 7, 4]
+    want = [_ref_out(ref, p, n) for p, n in zip(prompts, news)]
+    reqs = [serve.submit(p, max_new_tokens=n) for p, n in zip(prompts, news)]
+    serve.run()
+    for i, (req, w) in enumerate(zip(reqs, want)):
+        np.testing.assert_array_equal(np.asarray(req.output_tokens), w,
+                                      err_msg=f"fixed-slot request {i}")

@@ -107,8 +107,13 @@ def served(devices):
     ref = deepspeed_tpu.init_inference(
         model, config={"dtype": "float32", "max_out_tokens": 64})
     ref.set_params(params)
+    # kv_page_tokens=16 -> 4 pages per 64-token slot window: every e2e
+    # test in this module runs the PAGED cache with real multi-page
+    # tables (paged_kv_cache defaults on; page indirection is trivial at
+    # one page per slot)
     serve = deepspeed_tpu.init_serving(
-        model, config={"dtype": "float32", "max_out_tokens": 64},
+        model, config={"dtype": "float32", "max_out_tokens": 64,
+                       "kv_page_tokens": 16},
         num_slots=2, prefill_chunk=4, decode_block_tokens=3)
     serve.set_params(params)
     return model, params, ref, serve
@@ -304,7 +309,7 @@ def test_continuous_batching_parity_other_paths(devices, rng, position,
     prompts, news = _mixed_requests(rng, n=3)
     params = model.init(rng, jnp.asarray(prompts[0])[None])
     cfg = {"dtype": "float32", "max_out_tokens": 64,
-           "use_fused_decode": fused}
+           "use_fused_decode": fused, "kv_page_tokens": 16}
     ref = deepspeed_tpu.init_inference(model, config=cfg)
     ref.set_params(params)
     want = [np.asarray(ref.generate(p[None], max_new_tokens=n,

@@ -11,8 +11,11 @@ Sources (auto-detected from the one positional argument):
 
 ``--comms`` additionally prints the per-collective summary (count / bytes /
 p50 / p99 / busbw from the ``ds_comm_*`` family — the training-side comm
-ledger, docs/OBSERVABILITY.md).  ``ds_mem_*`` byte gauges render humanized
-(GiB/MiB) in the value column; ``ds_train_mfu`` renders as a percentage.
+ledger, docs/OBSERVABILITY.md).  ``--serving`` prints the paged-KV pool
+summary (pages used/free, cache-utilization percentiles, preemptions from
+the ``ds_serve_kv_*`` / ``ds_serve_preempted_total`` series).  ``ds_mem_*``
+byte gauges render humanized (GiB/MiB) in the value column;
+``ds_train_mfu`` and ``*_ratio`` histogram columns render as percentages.
 
 Zero dependencies — stdlib only, same as the metrics layer it reads.
 """
@@ -102,6 +105,29 @@ def render_comms(rows: List[List[str]]) -> str:
     return "\n".join(lines)
 
 
+def serving_kv_summary(metrics: Dict[str, object]) -> str:
+    """Paged-KV pool health lines from the ``ds_serve_kv_*`` series
+    (docs/OBSERVABILITY.md 'Serving — paged KV pool')."""
+    used = metrics.get("ds_serve_kv_pages_used")
+    free = metrics.get("ds_serve_kv_pages_free")
+    util = metrics.get("ds_serve_kv_cache_util_ratio") or {}
+    pre = metrics.get("ds_serve_preempted_total", 0)
+    if used is None and free is None and not util:
+        return "(no ds_serve_kv_* series recorded)"
+    lines = []
+    if used is not None or free is not None:
+        u, f = int(used or 0), int(free or 0)
+        lines.append(f"kv pages: {u} used / {f} free ({u + f} total)")
+    if isinstance(util, dict) and util.get("count"):
+        lines.append("kv cache utilization: "
+                     f"mean {100 * util['mean']:.1f}%  "
+                     f"p50 {100 * util['p50']:.1f}%  "
+                     f"p99 {100 * util['p99']:.1f}%  "
+                     f"({util['count']} steps)")
+    lines.append(f"preemptions: {int(pre)}")
+    return "\n".join(lines)
+
+
 def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
     """Flatten the snapshot into [name, count, mean, p50, p99, value]
     display rows (histograms fill the quantile columns, scalars the value
@@ -122,6 +148,12 @@ def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
 
     def emit(name, v):
         if isinstance(v, dict) and "p50" in v:          # histogram
+            if name.endswith("_ratio"):                 # fractions -> %
+                rows.append([name, str(v["count"]),
+                             f"{100 * v['mean']:.1f}%",
+                             f"{100 * v['p50']:.1f}%",
+                             f"{100 * v['p99']:.1f}%", ""])
+                return
             rows.append([name, str(v["count"]), fmt(v["mean"]),
                          fmt(v["p50"]), fmt(v["p99"]), ""])
         elif isinstance(v, dict) and "last" in v:       # csvMonitor series
@@ -166,6 +198,9 @@ def main(argv: List[str]) -> int:
         print()
         print(render_comms(rows) if rows
               else "(no ds_comm_* traffic recorded)")
+    if "--serving" in flags:
+        print()
+        print(serving_kv_summary(metrics))
     return 0
 
 
