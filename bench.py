@@ -45,6 +45,8 @@ def collect_train_metrics(registry) -> dict:
     for name, v in snap.items():
         if not (name.startswith("ds_comm_") and name.endswith("_seconds")):
             continue
+        if name.endswith("_device_seconds"):
+            continue        # device truth rides in the device_profile record
         if not isinstance(v, dict) or not v.get("count"):
             continue
         op = name[len("ds_comm_"): -len("_seconds")]
@@ -62,6 +64,55 @@ def collect_train_metrics(registry) -> dict:
 def sync(x) -> None:
     """Barrier that provably waits: fetch a scalar derived from x."""
     float(jax.tree.leaves(x)[0].sum())
+
+
+def capture_device_profile(step_fn, steps: int = 2, tag: str = "train"):
+    """Windowed perfetto capture around ``steps`` calls of ``step_fn``,
+    post-processed into the compact device-profile record the bench
+    attaches to its ``metrics`` sub-object (PR 3/4 pattern): per-step
+    phase breakdown (``ds_profile_*`` semantics), gap share, top device
+    collectives, serving dispatch slack.  Returns None when this jax
+    cannot write the perfetto export; a failed analysis returns a status
+    record instead of killing the bench."""
+    from deepspeed_tpu.profiling.trace import TraceCapture, perfetto_supported
+
+    if not perfetto_supported():
+        return None
+    import tempfile
+
+    from deepspeed_tpu.profiling import device_trace as dtr
+
+    d = tempfile.mkdtemp(prefix=f"ds_bench_trace_{tag}_")
+    cap = TraceCapture(d, start_step=1, num_steps=steps, perfetto=True)
+    try:
+        cap.maybe_start(1)
+        for i in range(1, steps + 1):
+            step_fn()
+            cap.after_step(i)
+        cap.close()
+        s = dtr.summarize_trace(d, steps=steps)
+    except Exception as exc:
+        return {"status": f"failed: {type(exc).__name__}: {str(exc)[:120]}"}
+    finally:
+        cap.close()   # a mid-window raise must release the one global
+                      # profiler session or every later capture 409s
+    per = s.get("per_step") or s["phases"]
+    out = {"steps": steps, "window_s": round(s["window_s"], 6),
+           "degraded": s["degraded"],
+           "per_step": {k: round(v, 6) for k, v in per.items()},
+           "trace_dir": d}
+    if s["window_s"] > 0:
+        out["gap_share"] = round(s["phases"]["gap_s"] / s["window_s"], 4)
+    top = sorted(s.get("comm_device", {}).items(),
+                 key=lambda kv: -kv[1]["seconds"])[:3]
+    if top:
+        out["top_device_collectives"] = [
+            {"op": op, "device_s": round(rec["seconds"], 6),
+             "spans": rec["count"]} for op, rec in top]
+    if s.get("serve"):
+        out["serve"] = {k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in s["serve"].items()}
+    return out
 
 
 def bench_8b_rung(budget_s: float = 900.0):
@@ -294,6 +345,18 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
                               "page_tokens": serve.pool.page,
                               "budget_tokens": kv_budget},
                 }
+                # device-true serving capture: a short burst of live
+                # requests under the profiler, post-processed into the
+                # decode dispatch-slack record (device decode time vs
+                # host dispatch window — the sync-free path's headroom)
+                for p, n in list(zip(prompts, news))[: serve.num_slots]:
+                    serve.submit(p, max_new_tokens=min(int(n), 16))
+                dp = capture_device_profile(serve.step, steps=4,
+                                            tag="serving")
+                serve.run()                 # drain the burst
+                serve.scheduler.drain_finished()
+                if dp:
+                    serving_metrics["device_profile"] = dp
     finally:
         if not was_enabled:                 # a mid-bench raise must not
             registry.disable()              # leave the registry hot
@@ -699,6 +762,13 @@ def main():
     # separately in detail for comparison.
     dt = time.perf_counter() - t0
     train_metrics = collect_train_metrics(registry)
+    # device-true phase breakdown over a 2-step post-measurement capture
+    # (the /profilez analysis, attached per BENCH row so the gap/overlap
+    # headroom and device-vs-analytic comm attribution travel with the
+    # throughput number)
+    dev_profile = capture_device_profile(one_step, steps=2, tag="train")
+    if dev_profile:
+        train_metrics["device_profile"] = dev_profile
 
     # The 8B rung is opt-in (DSTPU_BENCH_8B=1): on this runner the 16GB
     # host-tiered param tree must travel through the remote-device relay,

@@ -298,17 +298,27 @@ class CausalLM:
             # because the SPMD partitioner requires memory-space moves to
             # carry explicit shardings on multi-device meshes.
             specs = getattr(self, "_offload_specs", None)
+            from deepspeed_tpu.accelerator.real_accelerator import \
+                supports_pinned_host
 
-            def to_dev(t, spec_t):
-                def put(a, s):
-                    if s is None or mesh is None or mesh.empty:
-                        return jax.device_put(a, jax.memory.Space.Device)
-                    from jax.sharding import NamedSharding
-                    return jax.device_put(
-                        a, NamedSharding(mesh, s, memory_kind="device"))
-                if spec_t is None:
-                    return jax.tree.map(lambda a: put(a, None), t)
-                return jax.tree.map(put, t, spec_t)
+            if supports_pinned_host():
+                def to_dev(t, spec_t):
+                    def put(a, s):
+                        if s is None or mesh is None or mesh.empty:
+                            return jax.device_put(a, jax.memory.Space.Device)
+                        from jax.sharding import NamedSharding
+                        return jax.device_put(
+                            a, NamedSharding(mesh, s, memory_kind="device"))
+                    if spec_t is None:
+                        return jax.tree.map(lambda a: put(a, None), t)
+                    return jax.tree.map(put, t, spec_t)
+            else:
+                # capability-gated fallback: one memory space on this
+                # backend (CPU advertises only unpinned_host), so there is
+                # nothing to stream across — the in-jit memory-space move
+                # would be rejected at lowering
+                def to_dev(t, spec_t):
+                    return t
 
             self._offload_to_dev = to_dev
             params = {**params,

@@ -12,6 +12,15 @@ daemon thread:
   the *actual* elapsed time since then — long-lived serving gets rates
   without a Prometheus server.  The first scrape of a key primes it
   (``"primed": true``, no deltas); scrape again after your window.
+- ``GET /profilez?steps=N`` — on-demand device-true profile: parks a
+  capture request on the process-global profile broker
+  (profiling/device_trace.py); the next live engine step boundary claims
+  it, captures N steps (training steps or serving scheduler iterations)
+  with the perfetto export on, runs the post-processor, and the response
+  is the JSON phase summary (the same numbers land in the ``ds_profile_*``
+  registry series).  ``timeout=S`` bounds the wait (default 60s; 504 when
+  nothing is stepping, 409 when a capture is already in flight, 501 on
+  jax builds without the perfetto export).
 
 ``port=0`` binds an ephemeral port (read it back from ``server.port``) —
 the shape tests and multi-engine hosts need.  Zero dependencies: plain
@@ -51,8 +60,18 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 body = self.registry.statz_json().encode()
             ctype = "application/json"
+        elif path in ("/profilez", "/profilez/"):
+            code, payload = self._profilez(parse_qs(query))
+            body = json.dumps(payload, sort_keys=True).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         elif path == "/":
-            body = json.dumps({"endpoints": ["/metrics", "/statz"]}).encode()
+            body = json.dumps({"endpoints": ["/metrics", "/statz",
+                                             "/profilez"]}).encode()
             ctype = "application/json"
         else:
             self.send_error(404)
@@ -89,6 +108,36 @@ class _Handler(BaseHTTPRequestHandler):
         return {"window": key, "primed": False,
                 "window_s": round(dt, 6),
                 "metrics": window_delta(prev[1], snap, dt)}
+
+    def _profilez(self, qs: dict):
+        """``/profilez?steps=N[&timeout=S]``: park a capture request on
+        the profile broker and block this HTTP worker (ThreadingHTTPServer
+        — the scrape endpoints stay responsive) until a live engine
+        fulfills it.  Returns (status_code, json_payload)."""
+        from deepspeed_tpu.profiling.device_trace import (get_profile_broker,
+                                                          perfetto_supported)
+
+        if not perfetto_supported():
+            return 501, {"error": "this jax's start_trace has no "
+                                  "create_perfetto_trace; device-true "
+                                  "profiling unavailable"}
+        try:
+            steps = int(qs.get("steps", ["2"])[0])
+            timeout = float(qs.get("timeout", ["60"])[0])
+        except ValueError:
+            return 400, {"error": "steps/timeout must be numeric"}
+        broker = get_profile_broker()
+        try:
+            req = broker.submit(steps)
+        except RuntimeError as exc:
+            return 409, {"error": str(exc)}
+        try:
+            return 200, req.wait(timeout)
+        except TimeoutError as exc:
+            broker.cancel(req)
+            return 504, {"error": str(exc)}
+        except RuntimeError as exc:
+            return 500, {"error": str(exc)}
 
     def log_message(self, fmt, *args):  # scrapes are not log lines
         pass

@@ -1,0 +1,125 @@
+"""Rolling-median step-time watchdog.
+
+Long runs stall in ways nobody is watching for: a straggler host, a
+network hiccup re-running a collective, a data loader blocking the
+dispatch thread.  The watchdog watches the one signal every training loop
+already has — wall time between optimizer boundaries — and when a step
+exceeds ``factor`` x the rolling median it fires ONCE, arming a
+flight-recorder dump plus (engine-side) a one-shot device-trace capture of
+the following steps, closing the crash/stall post-mortem loop PR 3 left
+open.
+
+Steady-state cost contract (asserted in tests/unit/test_watchdog.py, the
+PR 2 no-alloc style): after warmup, ``observe`` is ONE deque append + ONE
+float comparison (+ an integer countdown).  The trick is a cached trip
+*bound*: the true median is recomputed only when a sample exceeds the
+bound (``median_recomputes`` counts those slow paths) — a suspect either
+confirms as a trip or raises the bound, so steady traffic never sorts on
+the suspect path.  Because the bound can also become STALE-HIGH when the
+median falls (the warmup window swallows multi-second compiles, then real
+steps are milliseconds — observed live: a 150x-median stall that never
+tripped), it is additionally re-anchored to ``factor x median`` once per
+``window`` samples (``bound_refreshes``; one 64-float sort amortized over
+64 steps).  ``observe`` is REBOUND from the warmup method to the steady
+method once the window has enough samples, so the steady path carries no
+warmup branch at all.  After a trip the bound is parked at +inf and the
+re-anchor is suppressed: one-shot by construction, no re-trigger storm;
+``reset()`` re-arms.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Dict, Optional
+
+__all__ = ["StepWatchdog"]
+
+
+class StepWatchdog:
+    def __init__(self, factor: float = 10.0, window: int = 64,
+                 warmup: int = 5):
+        if factor <= 1.0:
+            raise ValueError(f"watchdog factor must be > 1, got {factor}")
+        self.factor = float(factor)
+        self.window = max(2, int(window))
+        # warmup > window could never arm (the deque holds at most
+        # `window` samples, so the warmup gate would never be reached)
+        self.warmup = min(max(2, int(warmup)), self.window)
+        self._dq: deque = deque(maxlen=self.window)
+        self._bound = math.inf
+        self._refresh = self.window
+        self.fired = False
+        self.last_trip: Optional[Dict[str, Any]] = None
+        self.median_recomputes = 0
+        self.bound_refreshes = 0
+        self.observe = self._observe_warmup   # rebound to steady at warmup
+
+    # -- warmup path (first `warmup` samples; never trips) --------------
+    def _observe_warmup(self, seconds: float) -> bool:
+        self._dq.append(seconds)
+        if len(self._dq) >= self.warmup:
+            self._bound = self.factor * self._median()
+            self.observe = self._observe_steady
+        return False
+
+    # -- steady path: ONE append + ONE comparison (+ countdown) ---------
+    def _observe_steady(self, seconds: float) -> bool:
+        self._dq.append(seconds)
+        if seconds <= self._bound:
+            self._refresh -= 1
+            if self._refresh <= 0:
+                self._refresh = self.window
+                if not self.fired:
+                    # the median can FALL (compile-inflated warmup, caches
+                    # warming): re-anchor the cached bound once per window
+                    # so a stall vs the new fast median still trips
+                    self._bound = self.factor * self._median()
+                    self.bound_refreshes += 1
+            return False
+        return self._suspect(seconds)
+
+    # -- slow path (a sample exceeded the cached bound) -----------------
+    def _suspect(self, seconds: float) -> bool:
+        # median over the window EXCLUDING the suspect itself (it was just
+        # appended): the anomaly must not drag its own trip bar up
+        self.median_recomputes += 1
+        vals = list(self._dq)
+        vals.pop()
+        med = self._median(vals)
+        if med > 0 and seconds > self.factor * med:
+            self.fired = True
+            self.last_trip = {"seconds": seconds, "median": med,
+                              "factor": self.factor,
+                              "ratio": seconds / med,
+                              "samples": len(vals)}
+            # one-shot: nothing compares above +inf until reset()
+            self._bound = math.inf
+            return True
+        # false alarm (the median drifted up): refresh the cached bound so
+        # the new normal stops taking the slow path
+        self._bound = self.factor * max(med, seconds / self.factor)
+        return False
+
+    def _median(self, vals=None) -> float:
+        vals = sorted(vals if vals is not None else self._dq)
+        n = len(vals)
+        if not n:
+            return 0.0
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+    @property
+    def median(self) -> float:
+        """Current rolling median (reads sort; not the hot path)."""
+        return self._median()
+
+    def reset(self) -> None:
+        """Re-arm after a trip (the engine calls this if configured to
+        watch for repeat anomalies after the capture completes)."""
+        self.fired = False
+        self.last_trip = None
+        self._dq.clear()
+        self._bound = math.inf
+        self._refresh = self.window
+        self.observe = self._observe_warmup

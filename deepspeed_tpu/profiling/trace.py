@@ -52,19 +52,47 @@ def scope(name: str):
         return contextlib.nullcontext()
 
 
+def perfetto_supported() -> bool:
+    """Whether this jax's ``start_trace`` can write the perfetto
+    trace-event JSON (``create_perfetto_trace=``, present in jax 0.4.37)
+    — the input of the device-truth post-processor
+    (profiling/device_trace.py).  Probed once, by signature."""
+    global _PERFETTO_SUPPORTED
+    if _PERFETTO_SUPPORTED is None:
+        import inspect
+
+        try:
+            sig = inspect.signature(jax.profiler.start_trace)
+            _PERFETTO_SUPPORTED = "create_perfetto_trace" in sig.parameters
+        except (TypeError, ValueError):  # pragma: no cover - exotic builds
+            _PERFETTO_SUPPORTED = False
+    return _PERFETTO_SUPPORTED
+
+
+_PERFETTO_SUPPORTED = None
+
+
 class TraceCapture:
     """Start/stop a ``jax.profiler`` trace over steps
     ``[start_step, start_step + num_steps)``.  ``after_step(completed)`` is
     called by the engine after each optimizer step with the number of
     completed steps; the trace starts after step ``start_step - 1`` so the
     captured window contains whole steps (every micro-batch dispatch + the
-    update)."""
+    update).
+
+    ``perfetto=True`` additionally asks jax for the perfetto trace-event
+    JSON (``perfetto_trace.json.gz`` next to the xplane file — stdlib
+    gzip+json parseable), which the device-truth post-processor
+    (profiling/device_trace.py) consumes; silently ignored on jax builds
+    without ``create_perfetto_trace`` (check :func:`perfetto_supported`).
+    """
 
     def __init__(self, output_path: str, start_step: int = 2,
-                 num_steps: int = 2):
+                 num_steps: int = 2, perfetto: bool = False):
         self.output_path = output_path
         self.start_step = max(1, int(start_step))
         self.num_steps = max(1, int(num_steps))
+        self.perfetto = bool(perfetto)
         self.active = False
         self.done = False
 
@@ -78,7 +106,11 @@ class TraceCapture:
         import atexit
 
         os.makedirs(self.output_path, exist_ok=True)
-        jax.profiler.start_trace(self.output_path)
+        if self.perfetto and perfetto_supported():
+            jax.profiler.start_trace(self.output_path,
+                                     create_perfetto_trace=True)
+        else:
+            jax.profiler.start_trace(self.output_path)
         self.active = True
         # training may end inside the window; close() is idempotent
         atexit.register(self.close)

@@ -252,6 +252,29 @@ class FlightRecorderConfig(DeepSpeedConfigModel):
     on_signal: bool = False          # install the SIGUSR2 dump handler
 
 
+class WatchdogConfig(DeepSpeedConfigModel):
+    """``watchdog`` section (TPU extension; docs/OBSERVABILITY.md "Device
+    truth"): rolling-median step-time anomaly detector.  A step slower
+    than ``factor`` x the rolling median (over the last ``window`` steps,
+    armed after ``warmup`` samples) fires ONCE: flight-recorder dump +
+    (when this jax supports the perfetto export) a one-shot device-trace
+    capture of the next ``capture_steps`` steps, post-processed into the
+    ``ds_profile_*`` phase breakdown.  Steady-state cost: one deque append
+    + one comparison per step (plus a once-per-``window`` bound re-anchor
+    so a falling median — compile-inflated warmup — can't park the trip
+    bar out of reach).  Enabling the watchdog arms the flight recorder (a
+    dump needs a populated ring)."""
+
+    enabled: bool = False
+    factor: float = 10.0
+    window: int = 64
+    warmup: int = 5
+    capture_steps: int = 2
+    trace: bool = True               # arm the one-shot trace capture on trip
+    output_path: Optional[str] = None  # default: <flight dump_dir or cwd>
+    rearm: bool = False              # reset after a trip (watch for repeats)
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"
     load_universal: bool = False
@@ -438,6 +461,7 @@ class DeepSpeedConfig:
         self.csv_monitor = CSVConfig(**d.get("csv_monitor", {}))
         self.comms_logger = CommsLoggerConfig(**d.get("comms_logger", {}))
         self.flight_recorder = FlightRecorderConfig(**d.get("flight_recorder", {}))
+        self.watchdog = WatchdogConfig(**d.get("watchdog", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
         self.elasticity = ElasticityConfig(**d.get("elasticity", {}))
         self.tensor_parallel = TensorParallelConfig(**d.get("tensor_parallel", {}))

@@ -46,6 +46,7 @@ from deepspeed_tpu.monitor.memory import MemoryTelemetry, device_resident_bytes
 from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.monitor.monitor import MonitorMaster
 from deepspeed_tpu.profiling.flops import TrainFlopsMeter, lm_flops_per_token
+from deepspeed_tpu.profiling.trace import annotate, perfetto_supported
 from deepspeed_tpu.runtime import optimizer as opt_builder
 from deepspeed_tpu.runtime.checkpoint_engine import (MsgpackCheckpointEngine,
                                                      ShardedCheckpointEngine)
@@ -425,6 +426,38 @@ class DeepSpeedEngine:
             self._flight.enable(capacity=frc.capacity, dump_dir=frc.dump_dir)
             if frc.on_signal:
                 self._flight.install_signal_handler()
+
+        # -- device-true profiling (docs/OBSERVABILITY.md "Device truth"):
+        # one-shot auxiliary capture slot shared by /profilez requests and
+        # watchdog trips ((TraceCapture, trigger, payload) or None), polled
+        # at optimizer boundaries
+        self._aux_trace = None
+        from deepspeed_tpu.profiling.device_trace import get_profile_broker
+
+        self._pz_broker = get_profile_broker()
+        # step-time watchdog (ds_config `watchdog` block): rolling-median
+        # anomaly detector; a trip dumps the flight recorder and arms a
+        # one-shot trace capture of the following steps
+        self._watchdog = None
+        self._wd_last_t = None
+        wdc = self.config.watchdog
+        if wdc.enabled:
+            from deepspeed_tpu.monitor.watchdog import StepWatchdog
+
+            self._watchdog = StepWatchdog(factor=wdc.factor,
+                                          window=wdc.window,
+                                          warmup=wdc.warmup)
+            if not self._flight.enabled:
+                # a trip dump needs a populated ring; the watchdog implies
+                # the recorder (documented)
+                self._flight.enable(capacity=frc.capacity,
+                                    dump_dir=wdc.output_path or frc.dump_dir)
+            log_dist(f"watchdog armed: step > {wdc.factor:g}x rolling "
+                     f"median (window {wdc.window}) dumps the flight "
+                     f"recorder"
+                     + (f" + captures {wdc.capture_steps} steps"
+                        if wdc.trace and perfetto_supported() else ""),
+                     ranks=[0])
 
         self.flops_profiler = None
         self._profile_probes = {}
@@ -873,9 +906,26 @@ class DeepSpeedEngine:
             # only transient device-resident [model]-sized buffer is the grad
             # output at the program boundary.
             self._param_dev_shardings = self._param_shardings
-            self._param_shardings = jax.tree.map(
-                lambda s: NamedSharding(s.mesh, s.spec, memory_kind="pinned_host"),
-                self._param_shardings)
+            from deepspeed_tpu.accelerator.real_accelerator import \
+                host_memory_kind
+            hk = host_memory_kind()
+            if hk is not None:
+                if hk != "pinned_host":
+                    # capability gate (ROADMAP): this backend has no pinned
+                    # host memory space — commit the "host" masters to its
+                    # host-side kind instead (on CPU that IS the default
+                    # memory, so the placement is a no-op and the streamed
+                    # offload machinery runs unchanged)
+                    log_dist(f"ZeRO-Infinity: backend has no pinned_host "
+                             f"memory kind; params placed in {hk!r} "
+                             f"(gated fallback)", ranks=[0])
+                self._param_shardings = jax.tree.map(
+                    lambda s: NamedSharding(s.mesh, s.spec, memory_kind=hk),
+                    self._param_shardings)
+            else:  # pragma: no cover - clients without the memories API
+                logger.warning(
+                    "ZeRO-Infinity: backend exposes no memory-kind API; "
+                    "params keep the default placement (no host tiering)")
             self._acc_specs = ()
             self._acc_shardings = ()
             self._host_grad_acc = None
@@ -1483,6 +1533,154 @@ class DeepSpeedEngine:
                                            anchor=self._last_loss)
         self._mem_telemetry.sample()
 
+    # ------------------------------------------------------------------
+    # device-true profiling: /profilez capture + step-time watchdog
+    # (docs/OBSERVABILITY.md "Device truth")
+    # ------------------------------------------------------------------
+    def _maybe_start_aux_trace(self) -> None:
+        """Open a pending one-shot capture window before this step's first
+        dispatch (the analog of ``self._trace.maybe_start``).  A failed
+        start (jax has ONE global profiler session — another holder may
+        have it) fails the request / logs instead of crashing training."""
+        if self._aux_trace is None:
+            return
+        cap, trigger, payload = self._aux_trace
+        try:
+            cap.maybe_start(self._host_steps + 1)
+        except Exception as exc:
+            self._aux_trace = None
+            if trigger == "profilez":
+                self._pz_broker.resolve(
+                    payload, error=f"trace start failed: {exc}")
+            else:
+                logger.warning("watchdog: trace start failed: %s", exc)
+
+    def _profile_bytes_per_op(self, steps: int):
+        """Payload bytes the analytic comm plan says a ``steps``-step
+        window moved, per op slug — feeds the recomputed device busbw."""
+        if self._comm_plan is None:
+            return None
+        gas = self.config.gradient_accumulation_steps
+        out = {}
+        for mult, entries in ((gas, self._comm_plan["micro"]),
+                              (1, self._comm_plan["boundary"])):
+            for op, _calls, nbytes, _dtype, world in entries:
+                b, w = out.get(op, (0, world))
+                out[op] = (b + nbytes * mult * steps, max(w, world))
+        return out or None
+
+    def _aux_trace_tick(self) -> None:
+        """Per-boundary bookkeeping for the one-shot capture slot: close a
+        finished window (post-process + deliver), else claim a pending
+        ``/profilez`` request.  One attribute load per step when idle."""
+        if self._aux_trace is not None:
+            cap, trigger, payload = self._aux_trace
+            done = cap.after_step(self._host_steps)
+            if done is not None:
+                self._aux_trace = None
+                self._finish_aux_trace(done, cap, trigger, payload)
+            return
+        if self._pz_broker.pending is None:
+            return
+        req = self._pz_broker.claim()
+        if req is None:      # another engine grabbed it first
+            return
+        if self._trace is not None and not self._trace.done:
+            # pending counts too: an aux window overlapping the configured
+            # profile_trace start would collide in jax's single global
+            # profiler session
+            self._pz_broker.resolve(
+                req, error="the configured profile_trace window is "
+                           "capturing (or still ahead); retry after it "
+                           "closes")
+            return
+        import tempfile
+
+        trace_dir = req.trace_dir or tempfile.mkdtemp(prefix="ds_profilez_")
+        from deepspeed_tpu.profiling.trace import TraceCapture
+
+        cap = TraceCapture(trace_dir, start_step=self._host_steps + 1,
+                           num_steps=req.steps, perfetto=True)
+        self._aux_trace = (cap, "profilez", req)
+
+    def _finish_aux_trace(self, trace_dir, cap, trigger, payload) -> None:
+        """Post-process a closed capture window and deliver the summary:
+        registry backfill always; the HTTP waiter (profilez) or a JSON
+        file next to the trace (watchdog).  Failures never break the
+        training loop — they fail the request / log instead."""
+        from deepspeed_tpu.profiling import device_trace as dtr
+
+        try:
+            try:
+                summary = dtr.analyze_capture(
+                    trace_dir, cap.num_steps,
+                    bytes_per_op=self._profile_bytes_per_op(cap.num_steps),
+                    trigger=trigger)
+            except Exception as exc:
+                if trigger == "profilez":
+                    self._pz_broker.resolve(
+                        payload, error=f"trace post-processing failed: {exc}")
+                else:
+                    logger.warning(
+                        "watchdog: trace post-processing failed: %s", exc)
+                return
+            if trigger == "profilez":
+                self._pz_broker.resolve(payload, summary=summary)
+                return
+            out = os.path.join(trace_dir, "ds_watchdog_summary.json")
+            try:
+                with open(out, "w") as fh:
+                    json.dump(summary, fh, indent=1, default=str)
+            except Exception as exc:
+                logger.warning("watchdog: summary write failed: %s", exc)
+            logger.warning("watchdog: post-anomaly capture analyzed -> %s "
+                           "(per-step gap %.4fs)", out,
+                           summary.get("per_step", summary["phases"])["gap_s"])
+            if self.config.watchdog.rearm and self._watchdog is not None:
+                self._watchdog.reset()
+        finally:
+            if self._watchdog is not None:
+                # the gz+JSON parse above ran inside this boundary interval;
+                # exclude it from the next step-time sample or a /profilez
+                # capture could spuriously trip the watchdog
+                self._wd_last_t = time.perf_counter()
+
+    def _watchdog_tick(self) -> None:
+        """Feed the boundary-to-boundary wall time to the watchdog; on a
+        trip, dump the flight recorder and arm the one-shot capture.  The
+        steady-state cost is the watchdog's contract: one deque append +
+        one comparison (plus this clock read)."""
+        wd = self._watchdog
+        if wd is None:
+            return
+        now = time.perf_counter()
+        last, self._wd_last_t = self._wd_last_t, now
+        if last is None or not wd.observe(now - last):
+            return
+        trip = dict(wd.last_trip)
+        trip["step"] = self._host_steps
+        self._flight.record("watchdog", **trip)
+        reason = (f"watchdog: step {self._host_steps} took "
+                  f"{trip['seconds']:.3f}s > {wd.factor:g}x median "
+                  f"{trip['median']:.3f}s")
+        logger.warning("%s", reason)
+        try:
+            self._flight.dump(reason=reason)
+        except Exception as exc:   # a broken disk must not kill the run
+            logger.error("watchdog: flight dump failed: %s", exc)
+        wdc = self.config.watchdog
+        if (wdc.trace and perfetto_supported() and self._aux_trace is None
+                and (self._trace is None or self._trace.done)):
+            import tempfile
+
+            trace_dir = (wdc.output_path
+                         or tempfile.mkdtemp(prefix="ds_watchdog_"))
+            from deepspeed_tpu.profiling.trace import TraceCapture
+
+            cap = TraceCapture(trace_dir, start_step=self._host_steps + 1,
+                               num_steps=wdc.capture_steps, perfetto=True)
+            self._aux_trace = (cap, "watchdog", None)
+
     def _flight_crash(self, exc: Exception) -> None:
         """Dump the event ring once, before the exception propagates."""
         if not self._flight.enabled or self._flight_dumped:
@@ -1533,6 +1731,8 @@ class DeepSpeedEngine:
             return self._eval_fn(self.state.params, batch, rng)
         if self._trace is not None and self._micro_count == 0:
             self._trace.maybe_start(self._host_steps + 1)
+        if self._micro_count == 0:
+            self._maybe_start_aux_trace()
         self.timers(SynchronizedWallClockTimer.FORWARD).start()
         self._rng, rng = jax.random.split(self._rng)
         if self._param_offload:
@@ -1559,7 +1759,11 @@ class DeepSpeedEngine:
             t0 = (time.perf_counter()
                   if self._comm_plan is not None and comm_metrics.active
                   else 0.0)
-            self.state, loss = self._accum_fn(self.state, batch, rng)
+            # host-timeline twin of the in-jit ds_fwd_bwd named scope: on
+            # backends whose trace export drops compiled-op scope names
+            # (CPU), the post-processor's degraded mode reads this range
+            with annotate("ds_fwd_bwd"):
+                self.state, loss = self._accum_fn(self.state, batch, rng)
             if t0:
                 comm_metrics.commit(self._comm_plan["micro"],
                                     time.perf_counter() - t0)
@@ -1660,7 +1864,8 @@ class DeepSpeedEngine:
         elif self._offload:
             gnorm, overflow = self._step_offload()
         else:
-            self.state, gnorm, overflow = self._apply_fn(self.state)
+            with annotate("ds_optimizer_step"):
+                self.state, gnorm, overflow = self._apply_fn(self.state)
         self.timers(SynchronizedWallClockTimer.STEP).stop()
         if t0 and self._comm_plan["boundary"]:
             comm_metrics.commit(self._comm_plan["boundary"],
@@ -1683,6 +1888,8 @@ class DeepSpeedEngine:
         self._maybe_emit_flops_profile()
         if self._trace is not None:
             self._trace.after_step(self._host_steps)
+        self._watchdog_tick()
+        self._aux_trace_tick()
 
     def _maybe_emit_flops_profile(self) -> None:
         if (self.flops_profiler is None
@@ -1860,13 +2067,18 @@ class DeepSpeedEngine:
                                                   (self.state, stacked, rng))
         if self._trace is not None:
             self._trace.maybe_start(self._host_steps + 1)
+        self._maybe_start_aux_trace()
         self._flight.record("step_begin", step=self._host_steps + 1,
                             fused=True)
         self.timers(SynchronizedWallClockTimer.STEP).start()
         t0 = (time.perf_counter()
               if self._comm_plan is not None and comm_metrics.active
               else 0.0)
-        self.state, loss, gnorm, overflow = self._fused_fn(self.state, stacked, rng)
+        # the fused program runs fwd/bwd AND the update in one dispatch:
+        # the host range cannot separate them (device scope rows can)
+        with annotate("ds_fwd_bwd"):
+            self.state, loss, gnorm, overflow = self._fused_fn(
+                self.state, stacked, rng)
         self.timers(SynchronizedWallClockTimer.STEP).stop()
         if t0:
             # the fused program runs gas micro-batches + the boundary in one
@@ -1899,6 +2111,8 @@ class DeepSpeedEngine:
         self._maybe_emit_flops_profile()
         if self._trace is not None:
             self._trace.after_step(self._host_steps)
+        self._watchdog_tick()
+        self._aux_trace_tick()
         return loss
 
     def train_batch(self, data_iter=None):

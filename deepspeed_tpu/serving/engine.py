@@ -197,6 +197,13 @@ class ServingEngine:
         self._next_block = 0
         self.steps = 0
         self.metrics_server = None   # attached by init_serving(metrics_port=)
+        # /profilez: windowed capture over scheduler iterations (decode
+        # blocks), claimed from the process-global broker — one attribute
+        # load per step while nothing is requested
+        from deepspeed_tpu.profiling.device_trace import get_profile_broker
+
+        self._pz_broker = get_profile_broker()
+        self._pz = None              # [TraceCapture, ProfileRequest, done]
         # compute-side lifecycle metrics (queue-side spans live in the
         # scheduler; all are one-branch no-ops while the registry is
         # disabled — see docs/OBSERVABILITY.md for the schema)
@@ -290,6 +297,7 @@ class ServingEngine:
         finished during this iteration."""
         if self.engine._params is None:
             raise RuntimeError("no weights: set_params() first")
+        self._profilez_begin()
         done_before = len(self.scheduler.finished)
         # 1. admission: freed slots pick up the oldest queued requests
         with annotate("ds_serve_admit"):
@@ -326,6 +334,7 @@ class ServingEngine:
                 int(self._pos.sum()) / (self.num_slots * self.cache_len))
         finished = self.scheduler.finished[done_before:]
         self._m_step_finished.set(len(finished))
+        self._profilez_end()
         return finished
 
     def run(self) -> List[Request]:
@@ -334,6 +343,51 @@ class ServingEngine:
         while self.scheduler.has_work:
             self.step()
         return self.scheduler.finished
+
+    # ------------------------------------------------------------------
+    # /profilez: on-demand device-true capture over scheduler iterations
+    # (docs/OBSERVABILITY.md "Device truth")
+    # ------------------------------------------------------------------
+    def _profilez_begin(self) -> None:
+        if self._pz is not None or self._pz_broker.pending is None:
+            return
+        req = self._pz_broker.claim()
+        if req is None:
+            return
+        import tempfile
+
+        from deepspeed_tpu.profiling.trace import TraceCapture
+
+        trace_dir = req.trace_dir or tempfile.mkdtemp(prefix="ds_profilez_")
+        cap = TraceCapture(trace_dir, start_step=1, num_steps=req.steps,
+                           perfetto=True)
+        try:
+            cap.maybe_start(1)       # the window opens before this step's
+        except Exception as exc:     # dispatches (prefill + decode block)
+            self._pz_broker.resolve(req, error=f"trace start failed: {exc}")
+            return
+        self._pz = [cap, req, 0]
+
+    def _profilez_end(self) -> None:
+        if self._pz is None:
+            return
+        cap, req, done = self._pz
+        self._pz[2] = done = done + 1
+        trace_dir = cap.after_step(done)
+        if trace_dir is None:
+            return
+        self._pz = None
+        from deepspeed_tpu.profiling import device_trace as dtr
+
+        try:
+            summary = dtr.analyze_capture(trace_dir, cap.num_steps,
+                                          trigger="profilez",
+                                          engine="serving")
+        except Exception as exc:
+            self._pz_broker.resolve(
+                req, error=f"trace post-processing failed: {exc}")
+            return
+        self._pz_broker.resolve(req, summary=summary)
 
     # ------------------------------------------------------------------
     # paged-pool allocation + preemption

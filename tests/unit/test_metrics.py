@@ -368,6 +368,11 @@ def test_namespace_guard_all_metrics_documented(devices):
     comm_metrics.ensure_registered()
     MemoryTelemetry()
     TrainFlopsMeter()
+    # ISSUE 5 device-truth families: the ds_profile_* gauges and every
+    # ds_comm_<op>_device_* series must be documented too
+    from deepspeed_tpu.profiling import device_trace
+
+    device_trace.ensure_registered(get_registry())
 
     with open(_DOC) as fh:
         documented = set(re.findall(r"ds_[a-z0-9_]+", fh.read()))
@@ -375,10 +380,17 @@ def test_namespace_guard_all_metrics_documented(devices):
     train_re = re.compile(r"^ds_train_[a-z0-9_]+_seconds$")
     # ds_comm_<op>_<suffix>: the suffix schema is documented as a table;
     # every OP SLUG must additionally appear in the documented op list
-    # (written there as `ds_comm_<op>_` tokens)
+    # (written there as `ds_comm_<op>_` tokens).  The device-truth
+    # suffixes (_device_seconds / _device_busbw_gbps) are part of the
+    # schema and additionally require their suffix token documented —
+    # no blanket exemption for the new family.
     comm_re = re.compile(r"^ds_comm_([a-z0-9_]+?)_"
                          r"(calls_total|bytes_total|seconds|algbw_gbps|"
-                         r"busbw_gbps)$")
+                         r"busbw_gbps|device_seconds|device_busbw_gbps)$")
+    for suffix in ("device_seconds", "device_busbw_gbps"):
+        assert any(d.endswith(suffix) for d in documented), (
+            f"the ds_comm_*_{suffix} schema is registered but no "
+            f"*_{suffix} name is documented in docs/OBSERVABILITY.md")
     names = get_registry().names()
     assert names, "no metrics registered — instrumentation went missing?"
     bad_ns = [n for n in names if not name_re.match(n)]

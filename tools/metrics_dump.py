@@ -11,7 +11,10 @@ Sources (auto-detected from the one positional argument):
 
 ``--comms`` additionally prints the per-collective summary (count / bytes /
 p50 / p99 / busbw from the ``ds_comm_*`` family — the training-side comm
-ledger, docs/OBSERVABILITY.md).  ``--serving`` prints the paged-KV pool
+ledger, docs/OBSERVABILITY.md) with the device-truth columns
+(``ds_comm_<op>_device_seconds`` p50 + recomputed device busbw, when a
+``/profilez``/watchdog capture populated them) alongside the analytic
+attribution for side-by-side error reading.  ``--serving`` prints the paged-KV pool
 summary (pages used/free, cache-utilization percentiles, preemptions from
 the ``ds_serve_kv_*`` / ``ds_serve_preempted_total`` series).  ``ds_mem_*``
 byte gauges render humanized (GiB/MiB) in the value column;
@@ -70,31 +73,49 @@ def human_bytes(n: float) -> str:
 
 
 def comms_rows(metrics: Dict[str, object]) -> List[List[str]]:
-    """Per-collective summary rows [op, calls, bytes, p50, p99, busbw]
-    from the ``ds_comm_*`` family (one row per op that recorded traffic)."""
+    """Per-collective summary rows [op, calls, bytes, p50, p99, busbw,
+    dev_p50, dev_busbw] from the ``ds_comm_*`` family (one row per op that
+    recorded traffic).  The last two columns come from the device-truth
+    ``ds_comm_<op>_device_*`` series (perfetto post-processor,
+    docs/OBSERVABILITY.md "Device truth") and sit NEXT TO the analytic
+    host-window attribution so the attribution error reads off one row."""
     ops = {}
     for name in metrics:
         if name.startswith("ds_comm_") and name.endswith("_calls_total"):
             ops[name[len("ds_comm_"): -len("_calls_total")]] = None
+        elif name.startswith("ds_comm_") and name.endswith("_device_seconds"):
+            # a capture can populate device truth for an op the analytic
+            # feed never counted (comms_logger off) — still a row
+            v = metrics.get(name)
+            if isinstance(v, dict) and v.get("count"):
+                ops[name[len("ds_comm_"): -len("_device_seconds")]] = None
     rows = []
     for op in sorted(ops):
         calls = metrics.get(f"ds_comm_{op}_calls_total", 0)
         byt = metrics.get(f"ds_comm_{op}_bytes_total", 0)
         if isinstance(byt, dict):           # {dtype=...} labeled family
             byt = sum(v for v in byt.values() if isinstance(v, (int, float)))
-        if not calls and not byt:
+        dev = metrics.get(f"ds_comm_{op}_device_seconds") or {}
+        if not calls and not byt and not (isinstance(dev, dict)
+                                          and dev.get("count")):
             continue
         hist = metrics.get(f"ds_comm_{op}_seconds") or {}
         busbw = metrics.get(f"ds_comm_{op}_busbw_gbps", 0)
+        if not isinstance(dev, dict):
+            dev = {}
+        dev_bw = metrics.get(f"ds_comm_{op}_device_busbw_gbps", 0)
         rows.append([op, str(calls), human_bytes(float(byt)),
                      f"{hist.get('p50', 0):.6g}" if hist.get("count") else "",
                      f"{hist.get('p99', 0):.6g}" if hist.get("count") else "",
-                     f"{busbw:.3g} GB/s" if busbw else ""])
+                     f"{busbw:.3g} GB/s" if busbw else "",
+                     f"{dev.get('p50', 0):.6g}" if dev.get("count") else "",
+                     f"{dev_bw:.3g} GB/s" if dev_bw else ""])
     return rows
 
 
 def render_comms(rows: List[List[str]]) -> str:
-    header = ["collective", "calls", "bytes", "p50_s", "p99_s", "busbw"]
+    header = ["collective", "calls", "bytes", "p50_s", "p99_s", "busbw",
+              "dev_p50_s", "dev_busbw"]
     table = [header] + rows
     widths = [max(len(r[i]) for r in table) for i in range(len(header))]
     lines = []

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Offline device-truth report from a jax profiler trace directory.
+
+The same analysis ``/profilez`` runs on a live engine
+(``deepspeed_tpu/profiling/device_trace.py``), pointed at a trace on disk:
+
+    python tools/trace_report.py /tmp/ds_trace            # terminal tables
+    python tools/trace_report.py /tmp/ds_trace --steps 2  # per-step columns
+    python tools/trace_report.py /tmp/ds_trace --json     # machine-readable
+
+Accepts any directory containing a ``perfetto_trace.json.gz`` (captures
+made with ``profile_trace`` + this repo's perfetto flag, ``/profilez``, or
+the watchdog) or a direct path to the file.  Shows the phase breakdown
+(fwd_bwd / optimizer / comm / other / gap — gap is device idle, the
+overlap headroom), the device-true per-collective table, and the serving
+dispatch-slack numbers when ``ds_serve_*`` ranges are present.
+
+Needs this repo (and its jax dependency) importable; the trace file
+itself is plain gzip'd trace-event JSON, parsed with stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deepspeed_tpu.profiling import device_trace  # noqa: E402
+
+
+def _table(header: List[str], rows: List[List[str]]) -> str:
+    table = [header] + rows
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.3f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def render(summary: dict) -> str:
+    out = [f"trace: {summary['source']}"]
+    if summary["degraded"]:
+        out.append("NOTE: no device rows in this trace — the breakdown "
+                   "below is HOST-range attribution (degraded mode)")
+    elif summary.get("host_scoped"):
+        out.append("host-bracketed scopes (device durations, host-range "
+                   "assignment): " + ", ".join(summary["host_scoped"]))
+    steps = summary.get("steps")
+    window = summary["window_s"]
+    busy = summary["device_busy_s"]
+    out.append(f"window {_fmt_s(window)}"
+               + (f" over {steps} step(s)" if steps else "")
+               + f", device busy {_fmt_s(busy)}"
+               + (f" ({100 * busy / window:.1f}%)" if window else ""))
+    ph = summary["phases"]
+    per = summary.get("per_step")
+    rows = []
+    for key in ("fwd_bwd_s", "optimizer_s", "comm_s", "other_s", "gap_s"):
+        name = key[:-2]
+        share = 100 * ph[key] / window if window else 0.0
+        rows.append([name, _fmt_s(ph[key]), f"{share:.1f}%",
+                     _fmt_s(per[key]) if per else ""])
+    out.append("")
+    out.append(_table(["phase", "total", "share", "per-step"], rows))
+    cd = summary.get("comm_device") or {}
+    if cd:
+        crows = [[op, str(rec["count"]), _fmt_s(rec["seconds"]),
+                  _fmt_s(rec["max_s"])]
+                 for op, rec in sorted(cd.items(),
+                                       key=lambda kv: -kv[1]["seconds"])]
+        out.append("")
+        out.append("device-true collectives (union per scope; compare with "
+                   "the analytic ds_comm_*_seconds attribution):")
+        out.append(_table(["collective", "spans", "device_s", "max_span"],
+                          crows))
+    serve = summary.get("serve")
+    if serve:
+        out.append("")
+        out.append(
+            f"serving: {serve['decode_blocks']} decode block(s), host "
+            f"dispatch {_fmt_s(serve['decode_host_s'])}, device "
+            f"{_fmt_s(serve['decode_device_s'])}, dispatch slack "
+            f"{_fmt_s(serve['dispatch_slack_s'])}"
+            + (f"; prefill host {_fmt_s(serve['prefill_host_s'])} / "
+               f"device {_fmt_s(serve['prefill_device_s'])}"
+               if serve.get("prefill_host_s") else ""))
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="device-truth report from a jax profiler trace")
+    ap.add_argument("trace", help="trace dir (or perfetto_trace.json.gz)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="steps in the captured window (per-step column; "
+                         "inferred from ds_optimizer_step spans when absent)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of tables")
+    ns = ap.parse_args(argv[1:])
+    try:
+        summary = device_trace.summarize_trace(ns.trace, steps=ns.steps)
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if ns.json:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
