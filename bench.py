@@ -414,6 +414,171 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
     }
 
 
+def bench_overlap_rung(steps: int = 4, warmup: int = 2) -> dict:
+    """ZeRO-3 compute/collective overlap on/off ablation on the 1.34B
+    training scenario (ROADMAP open item 1; runtime/zero/overlap.py).
+
+    Runs the SAME workload twice over an fsdp mesh spanning every local
+    device — once with GSPMD-placed collectives (``overlap_comm: false``),
+    once with the layer-chunked explicit schedule (``overlap_comm: true``)
+    — and records per side: tokens/sec, MFU (live ``ds_train_mfu`` gauge),
+    and the device-profile ``gap_share`` / ``gap_plus_comm_share`` (the
+    exact numbers the overlap schedule is supposed to shrink).  The headline
+    ``overlap_speedup`` plus the two device-phase rows land in BENCH_JSON.
+
+    On CPU runners the 1.34B architecture is scaled to smoke size (the
+    bucket structure, collective schedule, and phase accounting are what
+    the CPU row exercises — absolute rates are not comparable to TPU).
+    Needs >1 device for the fsdp collectives to exist; the parent launches
+    this in a child process so a CPU parent can force a virtual 8-device
+    mesh without re-initializing its own backend.
+    """
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    t0 = time.perf_counter()
+    try:
+        devs = jax.devices()
+        if len(devs) < 2:
+            return {"status": "skipped: needs >1 device for fsdp "
+                              "collectives", "devices": len(devs)}
+        on_tpu = jax.default_backend() != "cpu"
+        W = len(devs)
+        mesh = build_mesh(fsdp=W, devices=devs)
+        set_global_mesh(mesh)
+        if on_tpu:
+            over = {}
+            micro, accum, seq = 2, 2, 1024
+            bucket_layers = 2
+        else:
+            over = dict(num_layers=4, hidden_size=128,
+                        intermediate_size=256, num_heads=4, num_kv_heads=4,
+                        vocab_size=512, max_seq_len=128)
+            micro, accum, seq = 1, 2, 64
+            bucket_layers = 1
+        registry = get_registry()
+        results = {}
+        n_params = 0
+        for side, overlap in (("off", False), ("on", True)):
+            model = causal_lm("llama-1b4", mesh=mesh, **over)
+            cfg_m = model.config
+            ds_config = {
+                "train_micro_batch_size_per_gpu": micro,
+                "gradient_accumulation_steps": accum,
+                "bf16": {"enabled": bool(on_tpu)},
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-4}},
+                "gradient_clipping": 1.0,
+                "zero_optimization": {
+                    "stage": 3, "overlap_comm": overlap,
+                    "overlap_bucket_layers": bucket_layers,
+                    "stage3_param_persistence_threshold": 0},
+                "comms_logger": {"enabled": True},
+                "steps_per_print": 10**9,
+            }
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model, config=ds_config, mesh=mesh,
+                rng=jax.random.PRNGKey(11))
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (accum, micro * W, seq), 0,
+                cfg_m.vocab_size)
+            batch = (tokens, tokens)
+            for _ in range(warmup):
+                engine.train_step(batch)
+            if overlap and not engine._overlap:
+                # a silent fallback here would benchmark off-vs-off and
+                # report a bogus ~1.0x speedup with loss_parity true
+                return {"status": "failed: overlap_comm did not activate "
+                                  "on the 'on' side",
+                        "reason": engine._overlap_reason}
+            sync(engine.state.params)
+            registry.reset()
+            engine._flops_meter.reset_clock()
+            t1 = time.perf_counter()
+            for _ in range(steps):
+                engine.train_step(batch)
+            sync(engine.state.params)
+            dt = (time.perf_counter() - t1) / steps
+            n_params = sum(x.size for x in
+                           jax.tree.leaves(engine.state.params))
+            tps = accum * micro * W * seq / dt
+            row = {"tokens_per_sec": round(tps, 1),
+                   "step_ms": round(dt * 1e3, 1),
+                   "overlap_active": bool(engine._overlap),
+                   "loss": round(float(engine._last_loss), 6)}
+            tm = collect_train_metrics(registry)
+            if tm.get("mfu") is not None:
+                row["mfu"] = round(tm["mfu"], 5)
+            dp = capture_device_profile(
+                lambda: engine.train_step(batch), steps=2,
+                tag=f"overlap_{side}")
+            if dp and "per_step" in dp:
+                row["gap_share"] = dp.get("gap_share")
+                per = dp["per_step"]
+                win = sum(per.values())
+                if win > 0:
+                    row["gap_plus_comm_share"] = round(
+                        (per["gap_s"] + per["comm_s"]) / win, 4)
+                row["device_profile"] = dp
+            results[side] = row
+            engine = model = None
+            import gc
+
+            gc.collect()
+        speedup = (results["on"]["tokens_per_sec"]
+                   / max(results["off"]["tokens_per_sec"], 1e-9))
+        return {"status": "ok", "zero_stage": 3, "devices": W,
+                "backend": jax.default_backend(),
+                "params_b": round(n_params / 1e9, 4),
+                "micro_batch": micro, "grad_accum": accum, "seq": seq,
+                "steps": steps, "bucket_layers": bucket_layers,
+                "off": results["off"], "on": results["on"],
+                "overlap_speedup": round(speedup, 3),
+                "loss_parity": bool(np.allclose(
+                    results["on"]["loss"], results["off"]["loss"],
+                    rtol=1e-3)),
+                "scaled_for_cpu": not on_tpu}
+    except Exception as exc:
+        return {"status": f"failed: {type(exc).__name__}",
+                "error": str(exc)[:300],
+                "elapsed_s": round(time.perf_counter() - t0, 1)}
+
+
+def _run_overlap_subprocess() -> dict:
+    """Run the overlap ablation in a child process: a CPU parent gets a
+    virtual 8-device mesh via XLA_FLAGS (which must be set before jax
+    initializes — impossible in-process), and on TPU a child abort cannot
+    kill the 125M headline (same isolation story as the 1.34B ladder)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    fd, out = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    os.unlink(out)
+    env = dict(os.environ, DSTPU_BENCH_OVERLAP_OUT=out)
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=8 "
+            "--xla_cpu_enable_concurrency_optimized_scheduler=false "
+            + env.get("XLA_FLAGS", ""))
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=1800, capture_output=True,
+                              text=True)
+        try:
+            with open(out) as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return {"status": f"failed: child exited {proc.returncode} "
+                              "without a result",
+                    "stderr_tail": proc.stderr[-400:]}
+    except subprocess.TimeoutExpired:
+        return {"status": "failed: child timeout (1800s)"}
+
+
 # micro=4 exceeds what the AOT compiler will place at 48 layers (probed:
 # fwd+grad compile-OOMs); micro=2 compiles under every policy
 LADDER_1B4 = [("mlp_dots", 2), ("dots", 2), ("full", 2), ("full", 1)]
@@ -676,6 +841,13 @@ def main():
         with open(os.environ["DSTPU_BENCH_1B4_OUT"], "w") as fh:
             json.dump(result, fh)
         return
+    if os.environ.get("DSTPU_BENCH_OVERLAP_OUT"):
+        # child mode: overlap on/off ablation over all local devices (the
+        # CPU parent hands this child a virtual 8-device mesh)
+        result = bench_overlap_rung()
+        with open(os.environ["DSTPU_BENCH_OVERLAP_OUT"], "w") as fh:
+            json.dump(result, fh)
+        return
 
     # The >1B rung runs in a child process BEFORE the parent initializes the
     # TPU client (two live clients on the tunnel conflict; and a child abort
@@ -685,6 +857,12 @@ def main():
     if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu" \
             and os.environ.get("DSTPU_BENCH_SKIP_1B4") != "1":
         rung_1b4 = _run_1b4_subprocess()
+
+    # overlap on/off ablation (ROADMAP item 1 mechanical acceptance): runs
+    # on CPU too — the child gets its own virtual multi-device mesh
+    rung_overlap = None
+    if os.environ.get("DSTPU_BENCH_SKIP_OVERLAP") != "1":
+        rung_overlap = _run_overlap_subprocess()
 
     on_tpu = jax.default_backend() != "cpu"
     mesh = build_mesh(devices=jax.devices()[:1])
@@ -852,6 +1030,8 @@ def main():
                    # live tflops/mfu gauges, peak HBM, top collectives
                    **({"metrics": train_metrics} if train_metrics else {}),
                    **({"llama_1b4": rung_1b4} if rung_1b4 else {}),
+                   **({"overlap_1b4": rung_overlap} if rung_overlap
+                      else {}),
                    **({"llama3_8b": rung_8b} if rung_8b else {}),
                    **({"decode_125m": rung_decode} if rung_decode else {}),
                    **({"serving_125m": rung_serving} if rung_serving
@@ -875,6 +1055,18 @@ def summary_lines(record: dict, rung_serving) -> list:
                "backend": record["detail"]["backend"]}
     if record["detail"].get("metrics"):
         summary["train_metrics"] = record["detail"]["metrics"]
+    ov = record["detail"].get("overlap_1b4")
+    if ov and "overlap_speedup" in ov:
+        # the ROADMAP item 1 acceptance row: both ablation sides' device
+        # phase shares + MFU travel with the headline speedup
+        summary["overlap_speedup"] = ov["overlap_speedup"]
+        summary["overlap_ablation"] = {
+            side: {k: ov[side][k] for k in
+                   ("tokens_per_sec", "mfu", "gap_share",
+                    "gap_plus_comm_share", "loss")
+                   if k in ov[side]}
+            for side in ("off", "on")}
+        summary["overlap_loss_parity"] = ov.get("loss_parity")
     if rung_serving and "goodput_speedup" in rung_serving:
         summary["serving_goodput_tok_s"] = \
             rung_serving["continuous"]["goodput_tok_s"]

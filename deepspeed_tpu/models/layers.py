@@ -53,6 +53,15 @@ def constrain(x, mesh: Optional[Mesh], *spec):
         get_am = getattr(_mesh_lib, "get_abstract_mesh", None)
     am = get_am() if get_am is not None else None
     manual = set(getattr(am, "manual_axes", ()) or ())
+    # jax 0.4.x experimental shard_map does not surface its manual axes on
+    # the abstract mesh; inside the region they ARE bound named axes, so
+    # the trace-time axis env names them (observed: the overlap schedule's
+    # full-manual train step tracing the model's constrain calls)
+    try:
+        from jax._src import core as _jcore
+        manual |= set(getattr(_jcore.get_axis_env(), "axis_sizes", {}))
+    except Exception:
+        pass
     names = set(mesh.axis_names) - manual
     if not names:
         return x  # fully-manual region: nothing left to constrain

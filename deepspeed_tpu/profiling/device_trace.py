@@ -213,6 +213,13 @@ def _clip(intervals: List[Tuple[float, float]], lo: float,
             if min(e, hi) > max(s, lo)]
 
 
+def _intersect(a: List[Tuple[float, float]],
+               b: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Interval set intersection ``a ∩ b`` (via ``a - (a - b)``)."""
+    a = _merge(a)
+    return _subtract(a, _subtract(a, b))
+
+
 # ---------------------------------------------------------------------------
 # summarization
 # ---------------------------------------------------------------------------
@@ -301,6 +308,7 @@ def summarize_trace(trace_path: str,
     if not window_rows:
         return {"source": path, "degraded": True, "steps": steps,
                 "window_s": 0.0, "device_busy_s": 0.0, "device_rows": 0,
+                "overlapped_comm_s": 0.0,
                 "phases": {"fwd_bwd_s": 0.0, "optimizer_s": 0.0,
                            "comm_s": 0.0, "other_s": 0.0, "gap_s": 0.0},
                 "comm_device": {}, "serve": None}
@@ -318,6 +326,13 @@ def summarize_trace(trace_path: str,
     comm_s = _union_len(comm_iv)
     fwd_s = _union_len(_subtract(fwd_iv, comm_iv))
     opt_s = _union_len(_subtract(opt_iv, comm_iv + fwd_iv))
+    # comm concurrent with compute scopes — the comm the overlap schedule
+    # HID.  The exclusive partition claims this time for ``comm`` and
+    # subtracts it from fwd_bwd/optimizer exactly once (never from gap,
+    # which is computed against the busy union), so overlapped comm is
+    # not double-subtracted; this reports it explicitly so the hidden-
+    # comm gauge and the bench ablation can read it.
+    overlapped_s = _union_len(_intersect(comm_iv, fwd_iv + opt_iv))
     claimed = comm_iv + fwd_iv + opt_iv + (serve_iv if degraded else [])
     other_s = _union_len(_subtract(busy, claimed))
     gap_s = (hi - lo) - _union_len(busy)
@@ -363,6 +378,7 @@ def summarize_trace(trace_path: str,
     out = {"source": path, "degraded": degraded, "steps": n_steps,
            "window_s": (hi - lo) * us, "device_busy_s": _union_len(busy) * us,
            "device_rows": len(dev_ops), "host_scoped": sorted(host_scoped),
+           "overlapped_comm_s": overlapped_s * us,
            "phases": phases, "comm_device": comm_device, "serve": serve}
     if n_steps:
         out["per_step"] = {k: v / n_steps for k, v in phases.items()}
@@ -390,6 +406,18 @@ _PROFILE_GAUGES = ("ds_profile_fwd_bwd_seconds", "ds_profile_optimizer_seconds",
                    "ds_profile_serve_decode_device_seconds",
                    "ds_profile_serve_dispatch_slack_seconds")
 
+# single source of truth for the overlap gauge help strings — registered
+# here AND at engine init (docs/OBSERVABILITY.md "Overlap")
+OVERLAP_GAUGES = {
+    "ds_overlap_buckets":
+        "layer-chunked overlap schedule bucket count "
+        "(0 = overlap_comm off/ineligible)",
+    "ds_overlap_hidden_comm_seconds_est":
+        "per-step device comm time measured CONCURRENT with compute "
+        "scopes in the last trace capture (the comm the overlap schedule "
+        "hid; 0 until a capture runs)",
+}
+
 
 def ensure_registered(registry) -> None:
     """Register the device-truth instrument family up front (namespace
@@ -397,6 +425,8 @@ def ensure_registered(registry) -> None:
     for name in _PROFILE_GAUGES:
         registry.gauge(name, "device-true profile (last capture; see "
                              "docs/OBSERVABILITY.md 'Device truth')")
+    for name, help_ in OVERLAP_GAUGES.items():
+        registry.gauge(name, help_)
     for op in KNOWN_OPS:
         registry.histogram(
             f"ds_comm_{op}_device_seconds",
@@ -433,6 +463,12 @@ def publish_summary(summary: Dict[str, Any], registry=None,
     g("ds_profile_gap_seconds").set(per["gap_s"])
     g("ds_profile_window_seconds").set(summary["window_s"])
     g("ds_profile_steps").set(summary.get("steps") or 0)
+    # measured comm∩compute per step — backfills the engine-registered
+    # overlap gauge (docs/OBSERVABILITY.md "Overlap")
+    g("ds_overlap_hidden_comm_seconds_est",
+      OVERLAP_GAUGES["ds_overlap_hidden_comm_seconds_est"]).set(
+        summary.get("overlapped_comm_s", 0.0)
+        / max(1, summary.get("steps") or 1))
     for op, rec in summary.get("comm_device", {}).items():
         registry.histogram(f"ds_comm_{op}_device_seconds").record(
             rec["seconds"])

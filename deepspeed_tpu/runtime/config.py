@@ -135,6 +135,13 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     allgather_partitions: bool = True
     allgather_bucket_size: int = 500_000_000
     overlap_comm: Optional[bool] = None
+    # TPU extension riding the reference's overlap_comm flag: when true,
+    # ZeRO collectives are chunked per layer bucket and explicitly
+    # interleaved with compute (runtime/zero/overlap.py) instead of leaving
+    # placement to GSPMD; overlap_bucket_layers sets the chunk granularity
+    # (layers per bucket — the layer-granular analog of the reference's
+    # allgather_bucket_size, which is byte-granular).
+    overlap_bucket_layers: int = 1
     load_from_fp32_weights: bool = True
     elastic_checkpoint: bool = False
     offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None

@@ -86,7 +86,7 @@ def _spec_world(spec, mesh) -> int:
 
 
 def _build_comm_plan(params, param_specs, acc_specs, mesh, zero_stage,
-                     compute_dtype, acc_dtype):
+                     compute_dtype, acc_dtype, overlap_sched=None):
     """Analytic per-step collective volumes for the GSPMD ZeRO path.
 
     GSPMD inserts the ZeRO collectives implicitly (sharded accumulator ->
@@ -101,6 +101,14 @@ def _build_comm_plan(params, param_specs, acc_specs, mesh, zero_stage,
       once per micro-batch; stages 0/1 all-reduce them instead;
     - stages 1/2: the boundary update on sharded optimizer state implies
       one param all-gather back to the replicated layout.
+
+    With ``overlap_sched`` (the layer-chunked explicit schedule,
+    runtime/zero/overlap.py) the MICRO entries come from the schedule's
+    own per-bucket accounting — per-bucket call counts and bytes, in the
+    dtype the explicit collectives actually move — so the ``ds_comm_*``
+    series stays honest when ``overlap_comm`` is on.  Boundary entries
+    keep the GSPMD arithmetic (the overlap path leaves the boundary
+    update on the GSPMD path).
 
     Returns ``{"micro": [entries], "boundary": [entries]}`` with entries
     shaped for :meth:`CommMetrics.commit`; empty lists when the mesh has no
@@ -145,6 +153,12 @@ def _build_comm_plan(params, param_specs, acc_specs, mesh, zero_stage,
 
     micro: List[Tuple[str, int, int, str, int]] = []
     boundary: List[Tuple[str, int, int, str, int]] = []
+    if overlap_sched is not None:
+        micro = overlap_sched.comm_plan_entries()
+        if zero_stage in (1, 2) and dp_world > 1 and total_bytes:
+            boundary.append(("all_gather", len(p_leaves), total_bytes,
+                             cname, dp_world))
+        return {"micro": micro, "boundary": boundary}
     if zero_stage == 3 and gather_bytes:
         micro.append(("all_gather", 2 * gather_calls, 2 * gather_bytes,
                       cname, gather_world))
@@ -346,6 +360,41 @@ class DeepSpeedEngine:
                     f"ZeRO++ active: qw={zc.zero_quantized_weights} "
                     f"qg={zc.zero_quantized_gradients} hpz={max(1, z)} "
                     f"over fsdp={P}", ranks=[0])
+        # Layer-chunked compute/collective overlap (runtime/zero/overlap.py;
+        # ROADMAP open item 1): ``zero_optimization.overlap_comm: true``
+        # replaces the GSPMD-placed ZeRO collectives with an explicit
+        # per-layer-bucket schedule so comm hides under the matmuls.
+        # Config-level eligibility decided here (audit warns on the knob
+        # while ineligible); the model-level half (stream_segments, stacked
+        # param layout) resolves at state init.
+        self._overlap = False
+        self._overlap_sched = None
+        self._overlap_reason = None
+        self._overlap_want = False
+        if zc.overlap_comm:
+            bad = [a for a in ("tp", "sp", "pp", "ep")
+                   if self.mesh.shape.get(a, 1) > 1]
+            if self.zero_stage not in (1, 2, 3):
+                self._overlap_reason = ("requires ZeRO stage 1-3 (stage 0 "
+                                        "has no sharded state to schedule)")
+            elif self._offload or self._param_offload:
+                self._overlap_reason = ("offload paths already own their "
+                                        "own streaming schedule")
+            elif self._onebit:
+                self._overlap_reason = ("1-bit optimizers keep local grads "
+                                        "(no collective to chunk)")
+            elif self._zeropp:
+                self._overlap_reason = ("ZeRO++ runs its own quantized "
+                                        "collective schedule")
+            elif bad:
+                self._overlap_reason = (f"model/expert-parallel axes {bad} "
+                                        "are not supported on the overlap "
+                                        "path")
+            elif loss_fn is not None:
+                self._overlap_reason = ("a client loss_fn cannot route "
+                                        "through the model's layer segments")
+            else:
+                self._overlap_want = True
         self.gradient_accumulation_steps = lambda: self.config.gradient_accumulation_steps
         self.train_batch_size = lambda: self.config.train_batch_size
         self.train_micro_batch_size_per_gpu = lambda: self.config.train_micro_batch_size_per_gpu
@@ -503,10 +552,13 @@ class DeepSpeedEngine:
 
         - *by-design no-ops*: knobs whose capability XLA/GSPMD delivers
           structurally (bucket sizes, ``contiguous_gradients``,
-          ``overlap_comm``, ``prescale_gradients`` — gradient scaling order
-          is numerically immaterial inside one XLA program,
-          ``round_robin_gradients`` — a CUDA-stream scheduling detail).
-          These stay silent: the behavior the user asked for happens.
+          ``prescale_gradients`` — gradient scaling order is numerically
+          immaterial inside one XLA program, ``round_robin_gradients`` — a
+          CUDA-stream scheduling detail).  These stay silent: the behavior
+          the user asked for happens.  ``overlap_comm`` moved OUT of this
+          class: true now activates the layer-chunked explicit overlap
+          schedule (runtime/zero/overlap.py) and warns when the
+          configuration cannot take it.
         - *inert behavior knobs*: sections that would change observable
           behavior and currently change nothing.  Each warns once here so a
           capability gap can never hide behind a successfully-parsed config.
@@ -523,6 +575,10 @@ class DeepSpeedEngine:
         if cfg.communication_data_type:
             inert.append(("communication_data_type", "collective dtype "
                           "follows the compute dtype under GSPMD"))
+        if zc.overlap_comm and not self._overlap_want:
+            inert.append(("zero_optimization.overlap_comm",
+                          f"{self._overlap_reason}; the GSPMD-placed "
+                          "collectives run unchanged"))
         if not self._zeropp_active():
             if zc.zero_quantized_weights:
                 inert.append(("zero_optimization.zero_quantized_weights",
@@ -549,6 +605,52 @@ class DeepSpeedEngine:
     def _zeropp_inactive_reason(self) -> str:
         why = self._zeropp_reason or "ZeRO++ path not applicable"
         return f"{why}; the knob changes nothing"
+
+    def _setup_overlap(self, params, persist: int) -> None:
+        """Model-level half of the ``overlap_comm`` gate (config half ran in
+        ``__init__``): the bucketed schedule drives the model through its
+        streamed per-layer segments, so the model must expose
+        ``stream_segments`` and carry the stacked embed/layers/head param
+        layout.  On success, replaces ``self._param_specs`` with the
+        layer-dim-0-safe variant and marks the overlap path active."""
+        from deepspeed_tpu.runtime.zero.overlap import layerwise_pspecs
+
+        reason = None
+        seg = None
+        if not hasattr(self.module, "stream_segments"):
+            reason = (f"model {type(self.module).__name__} exposes no "
+                      "stream_segments (the per-layer contract the bucketed "
+                      "schedule drives)")
+        else:
+            seg = self.module.stream_segments()
+            if seg is None:
+                reason = ("model declined segmenting (e.g. pipeline "
+                          "parallelism owns the layer loop)")
+        if reason is None:
+            keys = set(params) if isinstance(params, dict) else set()
+            if not {"embed", "layers", "final_norm"} <= keys or \
+                    not keys <= {"embed", "layers", "final_norm", "lm_head",
+                                 "lm_head_bias"}:
+                reason = ("param tree is not the stacked embed/layers/head "
+                          "layout the bucketed schedule slices")
+        if reason is not None:
+            self._overlap_reason = reason
+            logger.warning(
+                "zero_optimization.overlap_comm: %s — falling back to the "
+                "GSPMD-placed collective schedule", reason)
+            return
+        self._overlap = True
+        self._overlap_segments = seg
+        if self.zero_stage == 3:
+            self._param_specs = layerwise_pspecs(
+                params, self.mesh, shard=True,
+                persistence_threshold=persist,
+                logical_specs=self._client_param_pspecs)
+        log_dist(
+            f"overlap_comm active: layer-chunked collective schedule, "
+            f"bucket={self.config.zero_config.overlap_bucket_layers} "
+            f"layer(s), zero stage {self.zero_stage} "
+            f"(runtime/zero/overlap.py)", ranks=[0])
 
     def _apply_activation_checkpointing_config(self, model) -> None:
         """Push the ds_config ``activation_checkpointing`` section into the
@@ -863,6 +965,10 @@ class DeepSpeedEngine:
         self._param_specs = params_pspecs(params, mesh, shard=self.zero_stage == 3,
                                           persistence_threshold=persist,
                                           logical_specs=self._client_param_pspecs)
+        if self._overlap_want:
+            # may replace self._param_specs (stacked-layer dim 0 must stay
+            # device-local for the bucketed schedule) and set self._overlap
+            self._setup_overlap(params, persist)
         self._onebit_stacked = (self._onebit
                                 and getattr(self.optimizer, "stacked_params", False))
         if self._onebit_stacked:
@@ -891,6 +997,24 @@ class DeepSpeedEngine:
             waxes = ("dp", "fsdp", "ep")
             self._acc_specs = jax.tree.map(
                 lambda p: P(waxes, *([None] * getattr(p, "ndim", 0))), params)
+        elif self._overlap:
+            # overlap schedule: stage 3 accumulates in EXACTLY the param
+            # layout (each bucket's reduce-scatter is the gather's
+            # transpose — the shard shapes must line up); stage 2 shards
+            # with the same layer-dim-0 constraint; stage 1 replicates as
+            # before
+            from deepspeed_tpu.runtime.zero.overlap import layerwise_pspecs
+
+            if self.zero_stage == 3:
+                self._acc_specs = self._param_specs
+            elif self.zero_stage == 2:
+                self._acc_specs = layerwise_pspecs(
+                    params, mesh, shard=True, persistence_threshold=0,
+                    logical_specs=self._client_param_pspecs)
+            else:
+                self._acc_specs = params_pspecs(
+                    params, mesh, shard=False,
+                    logical_specs=self._client_param_pspecs)
         else:
             self._acc_specs = params_pspecs(params, mesh, shard=acc_shard,
                                             persistence_threshold=0 if acc_shard else persist,
@@ -1073,6 +1197,18 @@ class DeepSpeedEngine:
             if isinstance(b, (tuple, list)):
                 return self.module.init(rng, *b)
             if isinstance(b, dict):
+                # batch keys init doesn't take (e.g. loss_mask — an apply()
+                # arg, irrelevant to param shapes) must not break a
+                # first-call dict batch
+                import inspect
+                try:
+                    sig = inspect.signature(self.module.init)
+                    if not any(p.kind == p.VAR_KEYWORD
+                               for p in sig.parameters.values()):
+                        b = {k: v for k, v in b.items()
+                             if k in sig.parameters}
+                except (TypeError, ValueError):
+                    pass
                 return self.module.init(rng, **b)
             return self.module.init(rng, b)
 
@@ -1257,6 +1393,9 @@ class DeepSpeedEngine:
                     evaluate, in_shardings=(self._param_shardings, None, None),
                     out_shardings=scalar)
             return
+        if self._overlap:
+            self._compile_overlap_steps(apply, evaluate, gas)
+            return
         self._accum_fn = jax.jit(accum, donate_argnums=(0,), in_shardings=(sh, None, None),
                                  out_shardings=(sh, NamedSharding(self.mesh, P())))
         if not self._offload:
@@ -1276,6 +1415,71 @@ class DeepSpeedEngine:
                                                     NamedSharding(self.mesh, P())))
         self._eval_fn = jax.jit(evaluate, in_shardings=(self._param_shardings, None, None),
                                 out_shardings=NamedSharding(self.mesh, P()))
+
+    def _compile_overlap_steps(self, apply, evaluate, gas) -> None:
+        """Accum (and the fused step's micro scan) under full-manual
+        ``shard_map`` with the layer-bucketed explicit collective schedule
+        (runtime/zero/overlap.py).  The boundary ``apply`` and ``evaluate``
+        stay on the GSPMD path — the overlap tentpole targets the per-micro
+        collectives; state layout differs from the GSPMD path only in the
+        stacked-layer dim-0 constraint, so checkpointing/eval reshard
+        transparently."""
+        import functools
+
+        from deepspeed_tpu.runtime.zero.overlap import OverlapSchedule
+
+        mesh = self.mesh
+        mcfg = getattr(self.module, "config", None)
+        self._overlap_sched = OverlapSchedule(
+            segments=self._overlap_segments,
+            params=self._state.params,
+            param_specs=self._param_specs,
+            acc_specs=self._acc_specs,
+            mesh=mesh,
+            zero_stage=self.zero_stage,
+            compute_dtype=self.compute_dtype,
+            bucket_layers=self.config.zero_config.overlap_bucket_layers,
+            use_dropout=True,
+            # stage 3 ALWAYS remats the layer buckets (the backward must
+            # re-gather instead of holding gathered params as residuals —
+            # the ZeRO-3 memory contract); stages 1/2 follow the model's
+            # activation-checkpointing choice
+            remat=(self.zero_stage == 3 or bool(getattr(mcfg, "remat",
+                                                        False))))
+        state_specs = TrainState(
+            params=self._param_specs, opt_state=self._opt_specs,
+            grad_acc=self._acc_specs, global_steps=P(),
+            scaler=scaler_lib.LossScaleState(P(), P(), P(), P()))
+        bspec = P(("dp", "fsdp", "ep"))
+        accum_local = self._overlap_sched.make_accum(gas, self.fp16_enabled)
+        sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+        sm_accum = sm(accum_local, in_specs=(state_specs, bspec, P()),
+                      out_specs=(state_specs, P()))
+        self._accum_fn = jax.jit(sm_accum, donate_argnums=(0,))
+        sh = self._state_shardings
+        scalar = NamedSharding(mesh, P())
+
+        def fused(state: TrainState, batches, rng):
+            rngs = jax.random.split(rng, gas)
+
+            def micro(st, xs):
+                b, r = xs
+                st, loss = sm_accum(st, b, r)
+                return st, loss
+
+            state, losses = jax.lax.scan(micro, state, (batches, rngs))
+            state, gnorm, overflow = apply(state)
+            return state, losses.mean(), gnorm, overflow
+
+        self._fused_fn = jax.jit(
+            fused, donate_argnums=(0,), in_shardings=(sh, None, None),
+            out_shardings=(sh, scalar, scalar, scalar))
+        self._apply_fn = jax.jit(apply, donate_argnums=(0,),
+                                 in_shardings=(sh,),
+                                 out_shardings=(sh, scalar, scalar))
+        self._eval_fn = jax.jit(
+            evaluate, in_shardings=(self._param_shardings, None, None),
+            out_shardings=scalar)
 
     def _compile_zeropp_steps(self, loss_fn, gas) -> None:
         """Accum/apply/fused under full-manual shard_map over the data axes
@@ -1479,7 +1683,8 @@ class DeepSpeedEngine:
                 plan = _build_comm_plan(
                     self.state.params, self._param_specs, self._acc_specs,
                     self.mesh, self.zero_stage, self.compute_dtype,
-                    self._acc_dtype(jnp.float32))
+                    self._acc_dtype(jnp.float32),
+                    overlap_sched=self._overlap_sched)
                 if self._offload:
                     # the host optimizer step replaces the boundary
                     # gather with per-leaf device_puts — not a collective
@@ -1488,6 +1693,28 @@ class DeepSpeedEngine:
                     else None
             except Exception as exc:
                 logger.warning("telemetry: comm plan unavailable (%s)", exc)
+        # overlap-schedule gauges (docs/OBSERVABILITY.md "Overlap"):
+        # bucket count is static truth; the hidden-comm estimate starts at
+        # zero and is backfilled with the measured comm∩compute time by
+        # every device-trace capture (profiling/device_trace.py)
+        try:
+            from deepspeed_tpu.profiling.device_trace import OVERLAP_GAUGES
+
+            reg = get_registry()
+            n_buckets = (len(self._overlap_sched.bucket_infos())
+                         if self._overlap_sched is not None else 0)
+            for name, help_ in OVERLAP_GAUGES.items():
+                reg.gauge(name, help_)
+            reg.gauge("ds_overlap_buckets").set(n_buckets)
+            reg.gauge("ds_overlap_hidden_comm_seconds_est").set(0.0)
+            if self._overlap_sched is not None and get_registry().enabled:
+                log_dist(
+                    f"overlap_comm: {n_buckets} buckets, analytic hideable "
+                    f"comm fraction "
+                    f"{self._overlap_sched.hideable_comm_fraction():.2f}",
+                    ranks=[0])
+        except Exception as exc:
+            logger.warning("telemetry: overlap gauges unavailable (%s)", exc)
         if get_registry().enabled:
             try:
                 st = self.state
@@ -1532,6 +1759,11 @@ class DeepSpeedEngine:
         self._flops_meter.observe_boundary(flops or None,
                                            anchor=self._last_loss)
         self._mem_telemetry.sample()
+        if self._overlap_sched is not None:
+            # static truth, republished so a bench-hygiene registry.reset()
+            # between passes cannot make a live scrape read "overlap: off"
+            get_registry().gauge("ds_overlap_buckets").set(
+                len(self._overlap_sched.bucket_infos()))
 
     # ------------------------------------------------------------------
     # device-true profiling: /profilez capture + step-time watchdog
@@ -1753,6 +1985,7 @@ class DeepSpeedEngine:
                     self._profile_probes["fwdbwd"] = (
                         self._pofwdbwd_fn, (self.state.params, batch, rng))
         else:
+            self._check_overlap_batch(batch)
             if self.flops_profiler is not None:
                 self._profile_probes["accum"] = (self._accum_fn,
                                                  (self.state, batch, rng))
@@ -1828,12 +2061,24 @@ class DeepSpeedEngine:
         differently (the caller falls back so both paths keep one contract).
         A loss mask is only accepted by its explicit dict key — a positional
         third element is ambiguous (position_ids? attention_mask?) and the
-        whole-program path rejects it."""
-        if isinstance(batch, (tuple, list)) and len(batch) == 2:
-            return batch[0], batch[1], None
-        if isinstance(batch, dict) and "tokens" in batch and "labels" in batch:
-            return batch["tokens"], batch["labels"], batch.get("loss_mask")
-        return None
+        whole-program path rejects it.  Shared with the overlap schedule
+        (one contract for every segment-driven path)."""
+        from deepspeed_tpu.runtime.zero.overlap import unpack_lm_batch
+
+        return unpack_lm_batch(batch)
+
+    def _check_overlap_batch(self, batch) -> None:
+        """The overlap schedule drives the model through its layer segments,
+        which need the LM batch forms; unroutable batches fail loudly here
+        (before dispatch) instead of deep inside the shard_map trace."""
+        if not self._overlap:
+            return
+        if self._unpack_lm_batch(batch) is None:
+            raise ValueError(
+                "zero_optimization.overlap_comm requires (tokens, labels) "
+                "tuple or {'tokens': ..., 'labels': ...[, 'loss_mask': ...]} "
+                f"dict batches (got {type(batch).__name__}); disable "
+                "overlap_comm for custom batch forms")
 
     def backward(self, loss, retain_graph: bool = False):
         """Reference-parity no-op: gradients were already computed and
@@ -2061,6 +2306,7 @@ class DeepSpeedEngine:
             self.step()
             return jnp.mean(jnp.stack(losses))
         stacked = shard_batch(stacked, self.mesh, stacked=True)
+        self._check_overlap_batch(stacked)
         self._rng, rng = jax.random.split(self._rng)
         if self.flops_profiler is not None:
             self._profile_probes["train_step"] = (self._fused_fn,

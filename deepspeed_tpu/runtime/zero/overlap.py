@@ -1,0 +1,688 @@
+"""Layer-chunked compute/collective overlap for ZeRO (ROADMAP open item 1).
+
+The GSPMD train step leaves collective *placement* to XLA: ZeRO-3 params are
+sharded and the partitioner inserts the all-gathers where it likes — in
+practice hoisted to the program head, so the whole parameter tree gathers
+before the first matmul and comm serializes against compute.  The device
+profiler (PR 5) measures exactly that serialization as
+``ds_profile_gap_seconds``.  This module is the consumer of that number
+(T3, arXiv:2401.16677; prefetch-while-compute discipline of ZeRO-Infinity,
+arXiv:2104.07857): an *explicit* bucketed schedule the compiler cannot
+re-serialize, built from the model's streamed per-layer segments
+(``model.stream_segments()``, the same contract the ZeRO-Infinity host
+tier drives):
+
+- parameter leaves are grouped into ordered **buckets**: the embedding
+  piece, then the stacked transformer layers in chunks of
+  ``zero_optimization.overlap_bucket_layers`` layers (slices of the
+  leading ``[L]`` dim, which the overlap partitioner never shards), then
+  the head piece (final norm + lm_head);
+- the forward gathers each bucket with explicit per-leaf
+  ``lax.all_gather`` collectives inside a full-manual ``shard_map`` —
+  bucket *i+1*'s gather is sequenced (via ``lax.optimization_barrier``
+  ties) to start no earlier than bucket *i*'s input, so the scheduler may
+  run it concurrently with bucket *i*'s matmuls but cannot hoist the whole
+  tree to the program head;
+- the backward needs no hand-scheduled collectives for ZeRO-3: the AD
+  transpose of a tiled ``all_gather`` IS ``psum_scatter`` — each bucket's
+  gradient reduce-scatter materializes exactly where that bucket's
+  backward produces its gradients, interleaved with the remaining
+  backward compute (emitted via :func:`_scoped_all_gather`'s custom VJP
+  so it carries its own ``ds_comm_reduce_scatter`` scope instead of
+  inheriting the forward gather's).  Layer buckets are wrapped in ``jax.checkpoint`` so
+  the backward re-gathers (the ZeRO-3 2x-gather schedule) instead of
+  holding gathered params as residuals;
+- stages 1/2 (replicated params) skip the forward gathers; their per-
+  bucket gradient reduction (``psum_scatter`` into the sharded stage-2
+  accumulator, ``pmean`` for stage 1) is applied per bucket on the
+  separate per-bucket grad values the bucketed forward yields, chained on
+  a virtual comm stream by barriers so the ops stay distinct (no combiner
+  re-serialization) while each may start as soon as its bucket's backward
+  finishes.
+
+Every gather/reduce is wrapped in the ``ds_comm_<op>`` ``jax.named_scope``
+the device-trace post-processor matches, so ``/profilez`` captures show
+the per-bucket schedule and the measured comm/compute overlap lands in
+``ds_overlap_hidden_comm_seconds_est``.
+
+Loss semantics are identical to the GSPMD path (same segments, same
+1/gas scaling, global-batch-mean gradients); only the schedule differs.
+The engine activates this path when ``zero_optimization.overlap_comm`` is
+true and the configuration is eligible (see ``overlap_inactive_reason``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.runtime.zero.partition import choose_pspec, params_pspecs
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["OverlapSchedule", "plan_buckets", "layerwise_pspecs",
+           "unpack_lm_batch"]
+
+# the data-parallel axes the overlap step is manual over; param shards live
+# on SHARD_AXIS (the ZeRO convention everywhere else in runtime/zero)
+DATA_AXES = ("dp", "fsdp", "ep")
+SHARD_AXIS = "fsdp"
+# sentinel claiming the stacked-layer dim during spec choice (stripped
+# before the spec leaves this module)
+_LAYER_DIM = "__overlap_layer_dim__"
+
+
+def plan_buckets(num_layers: int, bucket_layers: int) -> List[Tuple[int, int]]:
+    """Contiguous ``[start, stop)`` layer ranges covering ``num_layers``."""
+    bl = max(1, int(bucket_layers))
+    return [(i, min(i + bl, num_layers)) for i in range(0, num_layers, bl)]
+
+
+def unpack_lm_batch(batch):
+    """(tokens, labels, loss_mask) for the LM batch forms the built-in
+    models accept, or None for forms the segment-driven schedule cannot
+    route (same contract as the streamed-offload driver)."""
+    if isinstance(batch, (tuple, list)) and len(batch) == 2:
+        return batch[0], batch[1], None
+    if isinstance(batch, dict) and "tokens" in batch and "labels" in batch:
+        return batch["tokens"], batch["labels"], batch.get("loss_mask")
+    return None
+
+
+def layerwise_pspecs(params: Any, mesh: Mesh, shard: bool,
+                     persistence_threshold: int = 0,
+                     logical_specs: Any = None) -> Any:
+    """``params_pspecs`` variant that never shards dim 0 of stacked-layer
+    leaves: the bucketed schedule slices layer ranges along that dim inside
+    the manual region, which requires it device-local.  Non-layer leaves
+    keep the standard chooser."""
+    specs = params_pspecs(params, mesh, shard=shard,
+                          persistence_threshold=persistence_threshold,
+                          logical_specs=logical_specs)
+    if not shard or not (isinstance(params, dict) and "layers" in params):
+        return specs
+
+    overridden: List[str] = []
+
+    def spec_for(leaf, logical):
+        nd = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+        base = list(logical) if logical is not None else [None] * nd
+        while len(base) < nd:
+            base.append(None)
+        if base[0] is None:
+            base[0] = _LAYER_DIM
+        s = choose_pspec(leaf.shape, mesh, min_size=persistence_threshold,
+                         existing=P(*base))
+        out = list(s)
+        if out and out[0] is not None:
+            # dim 0 is device-local, PERIOD: _split slices layer ranges
+            # along it inside the manual region.  A client logical spec
+            # claiming it with a real mesh extent is overridden loudly
+            # (an extent-1 claim is placement-identical to None).
+            if out[0] != _LAYER_DIM:
+                axes = (out[0] if isinstance(out[0], (tuple, list))
+                        else (out[0],))
+                if any(mesh.shape.get(a, 1) > 1 for a in axes):
+                    overridden.append(str(out[0]))
+            out[0] = None
+        return P(*out)
+
+    lspecs = (logical_specs.get("layers")
+              if isinstance(logical_specs, dict) else None)
+    if lspecs is None:
+        layers = jax.tree.map(lambda l: spec_for(l, None), params["layers"])
+    else:
+        layers = jax.tree.map(spec_for, params["layers"], lspecs)
+    if overridden:
+        logger.warning(
+            "overlap_comm: %d stacked-layer leaves claimed sharding on the "
+            "layer dim (axes %s) via logical_pspecs — overridden to "
+            "device-local (the bucketed schedule slices that dim in-region)",
+            len(overridden), sorted(set(overridden)))
+    out = dict(specs)
+    out["layers"] = layers
+    return out
+
+
+# ---------------------------------------------------------------------------
+# spec helpers
+# ---------------------------------------------------------------------------
+
+
+def _sharded_dims(spec: P, mesh: Mesh) -> List[Tuple[int, str]]:
+    """(dim, axis) pairs with mesh extent > 1 — the dims a leaf actually
+    communicates over."""
+    out = []
+    for dim, part in enumerate(tuple(spec)):
+        if part is None:
+            continue
+        for ax in (part if isinstance(part, (tuple, list)) else (part,)):
+            if mesh.shape.get(ax, 1) > 1:
+                out.append((dim, ax))
+    return out
+
+
+def _tie(tree: Any, anchor: Any) -> Any:
+    """Barrier-tie: ``tree``'s values become available no earlier than
+    ``anchor`` — the sequencing primitive pinning gather *i+1* behind
+    bucket *i*'s input (forward) and reduce *k* behind reduce *k+1*'s
+    output (backward/comm chain).  Differentiable via the compat-shim
+    ``optimization_barrier`` AD rules (utils/compat.py)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    out = jax.lax.optimization_barrier(tuple(leaves) + (anchor,))
+    return jax.tree_util.tree_unflatten(treedef, out[:-1])
+
+
+def _tiled_gathers(leaf, dims_axes):
+    # the ds_comm_all_gather scope lives HERE, inside the custom-VJP body,
+    # not at the call site: the bwd rule's ops inherit the call site's
+    # name stack, so a call-site scope would prefix the backward
+    # reduce-scatter with ds_comm_all_gather too and the device-trace
+    # matcher (which collects EVERY ds_comm_<op> in the op name) would
+    # double-attribute it
+    with jax.named_scope("ds_comm_all_gather"):
+        for dim, ax in dims_axes:
+            leaf = jax.lax.all_gather(leaf, ax, axis=dim, tiled=True)
+    return leaf
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _scoped_all_gather(leaf, dims_axes):
+    """Tiled all-gather over each (dim, axis) with a custom VJP: the AD
+    transpose of ``all_gather`` IS ``psum_scatter``, but the automatic
+    transpose inherits the FORWARD'S ``ds_comm_all_gather`` named scope
+    (HLO op_name ``transpose(jvp(ds_comm_all_gather))/reduce-scatter``),
+    which the device-trace matcher would misattribute.  The custom bwd
+    emits the same ``psum_scatter`` under its own
+    ``ds_comm_reduce_scatter`` scope so per-op device series stay honest."""
+    return _tiled_gathers(leaf, dims_axes)
+
+
+def _scoped_all_gather_fwd(leaf, dims_axes):
+    return _tiled_gathers(leaf, dims_axes), None
+
+
+def _scoped_all_gather_bwd(dims_axes, _res, ct):
+    with jax.named_scope("ds_comm_reduce_scatter"):
+        for dim, ax in reversed(dims_axes):
+            ct = jax.lax.psum_scatter(ct, ax, scatter_dimension=dim,
+                                      tiled=True)
+    return (ct,)
+
+
+_scoped_all_gather.defvjp(_scoped_all_gather_fwd, _scoped_all_gather_bwd)
+
+
+class BucketInfo(NamedTuple):
+    """One schedule bucket, for tests / the analytic comm plan."""
+
+    name: str
+    kind: str                 # "embed" | "layers" | "head"
+    start: int                # layer range (kind == "layers" only)
+    stop: int
+    gathers_per_micro: int    # 2 = rematerialized (backward re-gathers)
+
+
+class OverlapSchedule:
+    """Bucketed compute/collective schedule for one engine configuration.
+
+    Built once at state init (``DeepSpeedEngine._setup_overlap``); provides
+    the accum body the engine compiles under full-manual ``shard_map``, the
+    leaf->bucket assignment, and the chunked analytic comm-plan entries.
+    """
+
+    def __init__(self, *, segments: Dict[str, Any], params: Any,
+                 param_specs: Any, acc_specs: Any, mesh: Mesh,
+                 zero_stage: int, compute_dtype, bucket_layers: int,
+                 use_dropout: bool, remat: bool):
+        self.seg = segments
+        self.mesh = mesh
+        self.zero_stage = zero_stage
+        self.compute_dtype = compute_dtype
+        self.L = int(segments["num_layers"])
+        self.buckets = plan_buckets(self.L, bucket_layers)
+        self.tied = bool(segments["tied"])
+        self.moe_coef = float(segments["moe_coef"])
+        self.use_dropout = use_dropout and segments["dropout"] > 0
+        self.remat = remat
+        self.param_specs = param_specs
+        self.acc_specs = acc_specs
+        self._shapes = jax.tree.map(lambda a: tuple(a.shape), params)
+        self._has_lm_head = "lm_head" in params
+        self._has_head_bias = "lm_head_bias" in params
+
+    # -- structure ------------------------------------------------------
+    def _split(self, params: Any) -> Dict[str, Any]:
+        """Params tree -> ordered pieces the forward consumes; the layer
+        buckets are static slices of the stacked [L] leaves (dim 0 is
+        device-local by construction — see :func:`layerwise_pspecs`)."""
+        head = {"final_norm": params["final_norm"]}
+        if self._has_lm_head:
+            head["lm_head"] = params["lm_head"]
+        if self._has_head_bias:
+            head["lm_head_bias"] = params["lm_head_bias"]
+        return {
+            "embed": params["embed"],
+            "buckets": [jax.tree.map(
+                lambda a: jax.lax.slice_in_dim(a, b0, b1, axis=0),
+                params["layers"]) for b0, b1 in self.buckets],
+            "head": head,
+        }
+
+    def _split_specs(self, specs: Any) -> Dict[str, Any]:
+        head = {"final_norm": specs["final_norm"]}
+        if self._has_lm_head:
+            head["lm_head"] = specs["lm_head"]
+        if self._has_head_bias:
+            head["lm_head_bias"] = specs["lm_head_bias"]
+        return {"embed": specs["embed"],
+                "buckets": [specs["layers"]] * len(self.buckets),
+                "head": head}
+
+    def bucket_infos(self) -> List[BucketInfo]:
+        infos = [BucketInfo("embed", "embed", 0, 0, 1)]
+        for i, (b0, b1) in enumerate(self.buckets):
+            infos.append(BucketInfo(f"layers[{b0}:{b1}]", "layers", b0, b1,
+                                    2 if self.remat else 1))
+        infos.append(BucketInfo("head", "head", 0, 0, 1))
+        return infos
+
+    def bucket_assignment(self) -> Dict[str, str]:
+        """Flat ``param leaf id -> bucket name`` map (test surface: every
+        leaf lands in exactly one bucket, buckets follow layer order).
+        Stacked-layer leaves are identified per layer range, so the ranges
+        of one stacked leaf must partition ``[0, L)``."""
+        out = {}
+
+        def add(tree, prefix, bucket):
+            for path, _ in jax.tree_util.tree_leaves_with_path(
+                    tree, is_leaf=lambda x: isinstance(x, tuple)):
+                out[prefix + jax.tree_util.keystr(path)] = bucket
+
+        add(self._shapes["embed"], "embed", "embed")
+        for b0, b1 in self.buckets:
+            add(self._shapes["layers"], f"layers[{b0}:{b1}]",
+                f"layers[{b0}:{b1}]")
+        for key in ("final_norm", "lm_head", "lm_head_bias"):
+            if key in self._shapes:
+                add(self._shapes[key], key, "head")
+        return out
+
+    # -- analytic comm plan (chunked) -----------------------------------
+    def comm_plan_entries(self) -> List[Tuple[str, int, int, str, int]]:
+        """Per-bucket ``(op, calls, bytes, dtype, world)`` micro entries for
+        the ``ds_comm_*`` ledger — one entry per bucket per direction, so
+        call counts and bytes reflect the chunked schedule, not one
+        tree-wide op.  Bytes are in the compute dtype (the dtype the
+        explicit collectives actually move; the GSPMD plan counted the
+        stage>=2 reduce in the accumulation dtype because GSPMD reduced the
+        accumulator — here the reduce-scatter is the gather's transpose on
+        the compute-dtype cotangent).  Leaves replicated in BOTH layouts
+        reduce via pmean and land in per-bucket ``all_reduce`` entries.
+        Boundary entries are unchanged by the overlap path (the engine
+        composes them separately)."""
+        mesh = self.mesh
+        c_item = jnp.dtype(self.compute_dtype).itemsize
+        cname = jnp.dtype(self.compute_dtype).name
+        dp_world = 1
+        for a in DATA_AXES:
+            dp_world *= mesh.shape.get(a, 1)
+
+        def piece_shapes(kind, start=0, stop=0):
+            if kind == "layers":
+                frac = (stop - start) / max(1, self.L)
+                return self._shapes["layers"], self.param_specs["layers"], \
+                    self.acc_specs["layers"], frac
+            if kind == "embed":
+                return self._shapes["embed"], self.param_specs["embed"], \
+                    self.acc_specs["embed"], 1.0
+            keys = [k for k in ("final_norm", "lm_head", "lm_head_bias")
+                    if k in self._shapes]
+            return ({k: self._shapes[k] for k in keys},
+                    {k: self.param_specs[k] for k in keys},
+                    {k: self.acc_specs[k] for k in keys}, 1.0)
+
+        micro: List[Tuple[str, int, int, str, int]] = []
+        for info in self.bucket_infos():
+            shapes, pspec, aspec, frac = piece_shapes(info.kind, info.start,
+                                                      info.stop)
+            flat_sh = jax.tree_util.tree_leaves(
+                shapes, is_leaf=lambda x: isinstance(x, tuple))
+            flat_p = jax.tree_util.tree_leaves(
+                pspec, is_leaf=lambda s: isinstance(s, P))
+            flat_a = jax.tree_util.tree_leaves(
+                aspec, is_leaf=lambda s: isinstance(s, P))
+            g_rows, r_rows, ar_rows = [], [], []
+
+            def rest_world(dims):
+                w = 1
+                used = {ax for _, ax in dims}
+                for a in DATA_AXES:
+                    if a not in used:
+                        w *= mesh.shape.get(a, 1)
+                return w
+
+            for shape, ps, asp in zip(flat_sh, flat_p, flat_a):
+                nbytes = int((int(np.prod(shape)) if shape else 1)
+                             * c_item * frac)
+                gdims = _sharded_dims(ps, mesh)
+                adims = _sharded_dims(asp, mesh)
+                if gdims:
+                    w = 1
+                    for _, ax in gdims:
+                        w *= mesh.shape.get(ax, 1)
+                    g_rows.append((nbytes, w))
+                    r_rows.append((nbytes, w))   # the gather's transpose
+                    # residual pmean over the data axes the scatter did not
+                    # cover (_reduce_tree's rest-axis all_reduce) — on the
+                    # shard-sized cotangent
+                    rw = rest_world(gdims)
+                    if rw > 1:
+                        ar_rows.append((max(1, nbytes // w), rw))
+                elif adims:
+                    w = 1
+                    for _, ax in adims:
+                        w *= mesh.shape.get(ax, 1)
+                    r_rows.append((nbytes, w))
+                    rw = rest_world(adims)
+                    if rw > 1:
+                        ar_rows.append((max(1, nbytes // w), rw))
+                elif dp_world > 1:
+                    ar_rows.append((nbytes, dp_world))
+
+            def add(op, rows, mult=1):
+                if rows:
+                    micro.append((op, mult * len(rows),
+                                  mult * sum(b for b, _ in rows), cname,
+                                  max(w for _, w in rows)))
+
+            if self.zero_stage == 3:
+                add("all_gather", g_rows, mult=info.gathers_per_micro)
+            if self.zero_stage >= 2:
+                add("reduce_scatter", r_rows)
+            else:
+                ar_rows = ar_rows + r_rows   # stage<2: everything pmeans
+            add("all_reduce", ar_rows)
+        return micro
+
+    def hideable_comm_fraction(self) -> float:
+        """Fraction of per-micro collective bytes the schedule can overlap
+        with compute: everything except the first bucket's forward gather
+        (nothing precedes it) and the final reduction (nothing follows it
+        inside the micro-step).  Analytic — the measured number is the
+        device-trace ``overlapped_comm_s``."""
+        entries = self.comm_plan_entries()
+        total = sum(e[2] for e in entries)
+        if not total:
+            return 0.0
+        gathers = [e for e in entries if e[0] == "all_gather"]
+        reduces = [e for e in entries if e[0] != "all_gather"]
+        exposed = 0
+        if gathers:
+            exposed += gathers[0][2]   # first bucket's gather (conservative)
+        if reduces:
+            # entries run embed -> layers -> head (forward order); the
+            # backward reduces head-FIRST and embed-LAST, so the embed
+            # bucket's reduce (entry order [0]) is the temporally final,
+            # truly exposed one
+            exposed += reduces[0][2]
+        return max(0.0, 1.0 - exposed / total)
+
+    # -- collectives ----------------------------------------------------
+    def _gather_tree(self, tree: Any, spec_tree: Any) -> Any:
+        """Cast to compute dtype then all-gather each leaf's sharded dims
+        (tiled ring gather; its transpose is the per-bucket reduce-scatter
+        the backward needs)."""
+        mesh = self.mesh
+        cdtype = self.compute_dtype
+
+        def g(leaf, spec):
+            if (jnp.issubdtype(leaf.dtype, jnp.floating)
+                    and leaf.dtype != cdtype):
+                leaf = leaf.astype(cdtype)
+            dims = tuple((d, a) for d, a in _sharded_dims(spec, mesh))
+            if dims:
+                leaf = _scoped_all_gather(leaf, dims)
+            return leaf
+
+        return jax.tree.map(g, tree, spec_tree)
+
+    def _reduce_tree(self, gtree: Any, spec_tree: Any,
+                     acc_spec_tree: Any) -> Any:
+        """Normalize one bucket's raw backward grads to the global-batch
+        MEAN in the accumulator's layout.  Three leaf cases:
+
+        - gathered in forward (stage 3 sharded leaf): the ``all_gather``
+          transpose already reduce-scattered over ``fsdp`` — divide by the
+          fsdp extent and pmean the remaining data axes;
+        - replicated param, sharded accumulator (stage 2): explicit
+          ``psum_scatter`` on the accumulator's sharded dim;
+        - replicated accumulator: plain pmean (all-reduce).
+        """
+        mesh = self.mesh
+
+        def r(g, pspec, aspec):
+            gathered = _sharded_dims(pspec, mesh)
+            if gathered:
+                w = 1
+                for _, ax in gathered:
+                    w *= mesh.shape.get(ax, 1)
+                rest = tuple(a for a in DATA_AXES
+                             if a not in {ax for _, ax in gathered})
+                g = g / w
+                if any(mesh.shape.get(a, 1) > 1 for a in rest):
+                    with jax.named_scope("ds_comm_all_reduce"):
+                        g = jax.lax.pmean(g, rest)
+                return g
+            target = _sharded_dims(aspec, mesh)
+            if target:
+                w = 1
+                with jax.named_scope("ds_comm_reduce_scatter"):
+                    for dim, ax in target:
+                        g = jax.lax.psum_scatter(g, ax, scatter_dimension=dim,
+                                                 tiled=True)
+                        w *= mesh.shape.get(ax, 1)
+                g = g / w
+                rest = tuple(a for a in DATA_AXES
+                             if a not in {ax for _, ax in target})
+                if any(mesh.shape.get(a, 1) > 1 for a in rest):
+                    with jax.named_scope("ds_comm_all_reduce"):
+                        g = jax.lax.pmean(g, rest)
+                return g
+            if any(mesh.shape.get(a, 1) > 1 for a in DATA_AXES):
+                with jax.named_scope("ds_comm_all_reduce"):
+                    g = jax.lax.pmean(g, DATA_AXES)
+            return g
+
+        return jax.tree.map(r, gtree, spec_tree, acc_spec_tree)
+
+    # -- the bucketed forward + loss ------------------------------------
+    def _ce_weight(self, labels, mask, axes):
+        """Per-shard CE weight making the sharded masked mean exact: the
+        model's loss is ``nll_sum / valid_count`` over the LOCAL batch
+        shard, so a plain pmean of shard losses diverges from the GSPMD
+        path's GLOBAL masked mean whenever valid-token counts (-100
+        ignore_index / loss_mask) differ across data shards.  Scaling each
+        shard's CE by ``local_valid * world / global_valid`` makes both
+        the reported loss and the reduced gradients equal the global
+        masked mean exactly (weight == 1 when counts are uniform).  Same
+        valid semantics as ``models/transformer.cross_entropy`` (shifted
+        labels >= 0, optionally & shifted loss_mask > 0)."""
+        valid = labels[:, 1:] >= 0
+        if mask is not None:
+            valid = valid & (mask[:, 1:] > 0)
+        cnt = valid.sum().astype(jnp.float32)
+        if not axes:
+            return jnp.float32(1.0)
+        world = 1
+        for a in axes:
+            world *= self.mesh.shape.get(a, 1)
+        total = jax.lax.psum(cnt, axes)
+        return cnt * world / jnp.maximum(total, 1.0)
+
+    def _forward_loss(self, pieces: Dict[str, Any], tokens, labels, mask,
+                      rng, ce_weight):
+        """Bucket-chunked forward to the scalar LM loss (count-weighted
+        local-batch mean — ``pmean`` across shards yields the exact global
+        masked mean, see :meth:`_ce_weight`).  Differentiating this w.r.t.
+        ``pieces`` yields per-bucket grads as separate values — each
+        bucket's reduce can start mid-backward."""
+        seg = self.seg
+        sspecs = self._split_specs(self.param_specs)
+        S = int(tokens.shape[1])
+        cos, sin = seg["rope"](S, jnp.dtype(self.compute_dtype))
+        if self.use_dropout:
+            keys = jax.random.split(rng, self.L)
+        else:
+            keys = jnp.zeros((self.L,), jnp.uint32)
+        use_drop = self.use_dropout
+        layer_fwd = seg["layer_fwd"]
+        layer_spec = self.param_specs["layers"]   # shared by every bucket
+
+        with jax.named_scope("overlap_embed"):
+            embed_full = self._gather_tree(pieces["embed"], sspecs["embed"])
+        x = seg["embed_fwd"](embed_full, tokens)
+        aux_total = jnp.zeros((), jnp.float32)
+
+        def bucket_body(shards, x_in, keys_b):
+            full = self._gather_tree(shards, layer_spec)
+
+            def scan_body(c, xs):
+                lp, k = xs
+                y, aux = layer_fwd(lp, c, k, cos, sin, use_drop)
+                return y, aux.astype(jnp.float32)
+
+            y, auxes = jax.lax.scan(scan_body, x_in, (full, keys_b))
+            return y, jnp.sum(auxes)
+
+        if self.remat:
+            # default policy saves nothing: the backward re-gathers the
+            # bucket (the ZeRO-3 2x schedule) and recomputes its layers —
+            # gathered params never persist as residuals
+            bucket_body = jax.checkpoint(bucket_body, prevent_cse=False)
+
+        prev_x = None
+        for i, (b0, b1) in enumerate(self.buckets):
+            shards = pieces["buckets"][i]
+            if prev_x is not None:
+                # gather i may start once bucket i-1's INPUT exists — at
+                # most one bucket of lookahead, concurrent with bucket
+                # i-1's compute
+                shards = _tie(shards, prev_x)
+            prev_x = x
+            with jax.named_scope(f"overlap_b{i}"):
+                x, aux = bucket_body(shards, x, keys[b0:b1])
+            aux_total = aux_total + aux
+
+        head_shards = pieces["head"]
+        if prev_x is not None:
+            head_shards = _tie(head_shards, prev_x)
+        with jax.named_scope("overlap_head"):
+            head_full = self._gather_tree(head_shards, sspecs["head"])
+        head_tree = {"final_norm": head_full["final_norm"],
+                     "head": (embed_full["tok"] if self.tied
+                              else head_full["lm_head"])}
+        if self._has_head_bias:
+            head_tree["head_bias"] = head_full["lm_head_bias"]
+        # weight applies to the masked-mean CE only: the MoE aux loss is
+        # an unmasked per-shard batch mean, for which plain pmean is exact
+        # (shards are equal-sized)
+        loss = seg["head_loss"](head_tree, x, labels, mask) * ce_weight
+        if self.moe_coef:
+            loss = loss + self.moe_coef * aux_total
+        return loss
+
+    # -- the accum body the engine compiles under shard_map -------------
+    def make_accum(self, gas: int, fp16: bool):
+        """Build ``accum_local(state, batch, rng) -> (state', loss)`` for
+        full-manual ``shard_map`` over the mesh.  Semantics match the GSPMD
+        ``accum``: grads of ``loss * scale / gas`` accumulate into
+        ``state.grad_acc`` (global-batch mean layout), loss returned
+        unscaled as the global mean."""
+        mesh = self.mesh
+        sspecs = self._split_specs(self.param_specs)
+        aspecs = self._split_specs(self.acc_specs)
+        buckets = self.buckets
+
+        def accum_local(state, batch, rng):
+            unpacked = unpack_lm_batch(batch)
+            if unpacked is None:  # engine checks before dispatch; belt+braces
+                raise ValueError(
+                    "overlap_comm requires (tokens, labels[, loss_mask]) "
+                    "batches — see zero_optimization.overlap_comm docs")
+            tokens, labels, mask = unpacked
+            scale = (state.scaler.scale if fp16 else jnp.float32(1.0))
+            axes = tuple(a for a in DATA_AXES if mesh.shape.get(a, 1) > 1)
+            # data-only scalar (labels/mask), computed outside the grad —
+            # no cotangent ever flows through the psum
+            ce_w = self._ce_weight(labels, mask, axes)
+
+            def loss_f(pieces):
+                loss = self._forward_loss(pieces, tokens, labels, mask, rng,
+                                          ce_w)
+                return (loss.astype(jnp.float32) * scale) / gas, loss
+
+            with jax.named_scope("ds_fwd_bwd"):
+                pieces = self._split(state.params)
+                grads, loss = jax.grad(loss_f, has_aux=True)(pieces)
+
+                # reduce pieces on a barrier-chained virtual comm stream in
+                # backward-production order (head first, embed last): each
+                # reduce may start as soon as its bucket's backward is done,
+                # and the chain keeps the collectives distinct + ordered
+                order = (["head"]
+                         + [f"b{i}" for i in
+                            range(len(buckets) - 1, -1, -1)]
+                         + ["embed"])
+                g_by = {"head": grads["head"], "embed": grads["embed"]}
+                s_by = {"head": sspecs["head"], "embed": sspecs["embed"]}
+                a_by = {"head": aspecs["head"], "embed": aspecs["embed"]}
+                for i in range(len(buckets)):
+                    g_by[f"b{i}"] = grads["buckets"][i]
+                    s_by[f"b{i}"] = sspecs["buckets"][i]
+                    a_by[f"b{i}"] = aspecs["buckets"][i]
+                reduced: Dict[str, Any] = {}
+                chain = None
+                for name in order:
+                    g = g_by[name]
+                    if chain is not None:
+                        g = _tie(g, chain)
+                    red = self._reduce_tree(g, s_by[name], a_by[name])
+                    leaves = jax.tree_util.tree_leaves(red)
+                    if leaves:
+                        chain = leaves[0]
+                    reduced[name] = red
+
+                acc = state.grad_acc
+
+                def add(a, g):
+                    return a + g.astype(a.dtype)
+
+                new_acc = dict(acc)
+                new_acc["embed"] = jax.tree.map(add, acc["embed"],
+                                                reduced["embed"])
+                bucket_gs = [reduced[f"b{i}"] for i in range(len(buckets))]
+
+                def addcat(a, *gs):
+                    parts = [jax.lax.slice_in_dim(a, b0, b1, axis=0)
+                             + g.astype(a.dtype)
+                             for (b0, b1), g in zip(buckets, gs)]
+                    return (jnp.concatenate(parts, axis=0)
+                            if len(parts) > 1 else parts[0])
+
+                new_acc["layers"] = jax.tree.map(addcat, acc["layers"],
+                                                 *bucket_gs)
+                for key in ("final_norm", "lm_head", "lm_head_bias"):
+                    if key in acc:
+                        new_acc[key] = jax.tree.map(add, acc[key],
+                                                    reduced["head"][key])
+            loss_out = jax.lax.pmean(loss, axes) if axes else loss
+            return state._replace(grad_acc=new_acc), loss_out
+
+        return accum_local

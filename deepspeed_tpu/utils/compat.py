@@ -47,10 +47,47 @@ def _shard_map_from_experimental():
     return shard_map
 
 
+def _install_optimization_barrier_ad() -> None:
+    """Backport differentiation rules for ``lax.optimization_barrier``.
+
+    jax 0.4.37 ships the primitive without JVP/transpose rules (added
+    upstream later), so a barrier inside a differentiated function raises
+    ``NotImplementedError``.  The overlap scheduler
+    (runtime/zero/overlap.py) uses barriers to pin the compute/collective
+    interleaving inside the train-step program — in both directions: the
+    rules below barrier the tangents/cotangents exactly like upstream, so
+    the backward schedule mirrors the forward sequencing."""
+    try:
+        from jax._src.interpreters import ad
+        from jax._src.lax import lax as lax_internal
+
+        prim = lax_internal.optimization_barrier_p
+    except (ImportError, AttributeError):
+        # private internals moved (other jax version): leave the primitive
+        # as-is — a jax that reorganized these modules ships its own AD
+        # rules, and even if not, only the overlap schedule needs them
+        return
+    if prim in ad.primitive_jvps:      # newer jax: rules already present
+        return
+
+    def _jvp(primals, tangents):
+        tangents = [ad.instantiate_zeros(t) for t in tangents]
+        return (jax.lax.optimization_barrier(tuple(primals)),
+                jax.lax.optimization_barrier(tuple(tangents)))
+
+    def _transpose(cts, *primals):
+        cts = [ad.instantiate_zeros(ct) for ct in cts]
+        return jax.lax.optimization_barrier(tuple(cts))
+
+    ad.primitive_jvps[prim] = _jvp
+    ad.primitive_transposes[prim] = _transpose
+
+
 def install_jax_compat() -> None:
     """Install public-API fallbacks on the ``jax`` module (idempotent)."""
     if not hasattr(jax, "shard_map"):
         jax.shard_map = _shard_map_from_experimental()
+    _install_optimization_barrier_ad()
     if not hasattr(jax.lax, "axis_size"):
         # the classic idiom: psum of a concrete 1 over a named axis
         # constant-folds to the (static) axis size

@@ -177,6 +177,59 @@ def test_golden_comm_device_series_and_backfill(tmp_path):
         s["per_step"]["gap_s"])
 
 
+def test_golden_overlapped_comm_not_double_subtracted(tmp_path):
+    """Comm rows CONCURRENT with compute rows (a second device op lane —
+    what the layer-chunked overlap schedule produces): the exclusive
+    partition must claim the overlapped time for ``comm`` exactly once
+    (never subtract it from gap, which is computed against the busy
+    union), phases + gap must still sum to the window, and the
+    comm∩compute time must surface as ``overlapped_comm_s`` feeding the
+    ``ds_overlap_hidden_comm_seconds_est`` gauge.
+
+    Layout (us), one step, two op lanes:
+      lane A [0,100)   fwd/bwd fusion
+      lane B [40,80)   all_gather CONCURRENT with fwd/bwd   (hidden, 40)
+      lane B [100,120) all_gather after compute             (exposed, 20)
+      idle   [120,130)                                      (gap, 10)
+      lane A [130,150) optimizer fusion
+      lane B [140,150) reduce_scatter CONCURRENT with optimizer (hidden, 10)
+    """
+    LANE_B = 13
+    evs = _meta(DEV_PID, "/device:TPU:0", [
+        (OPS_TID, "XLA Ops"), (LANE_B, "XLA Ops c1")])
+    evs.append(_x("fusion.1", DEV_PID, OPS_TID, 0, 100,
+                  {"tf_op": "jit_step/ds_fwd_bwd/fusion.1"}))
+    evs.append(_x("all-gather.2", DEV_PID, LANE_B, 40, 40,
+                  {"tf_op": "jit_step/ds_fwd_bwd/ds_comm_all_gather/ag.2"}))
+    evs.append(_x("all-gather.3", DEV_PID, LANE_B, 100, 20,
+                  {"tf_op": "jit_step/ds_comm_all_gather/ag.3"}))
+    evs.append(_x("fusion.4", DEV_PID, OPS_TID, 130, 20,
+                  {"tf_op": "jit_step/ds_optimizer_step/fusion.4"}))
+    evs.append(_x("reduce-scatter.5", DEV_PID, LANE_B, 140, 10,
+                  {"tf_op": "jit_step/ds_optimizer_step/"
+                            "ds_comm_reduce_scatter/rs.5"}))
+    s = device_trace.summarize_trace(_write(tmp_path, evs), steps=1)
+    us = 1e-6
+    ph = s["phases"]
+    assert s["window_s"] == pytest.approx(150 * us)
+    # comm union claims hidden + exposed once: 40 + 20 + 10
+    assert ph["comm_s"] == pytest.approx(70 * us)
+    # fwd_bwd = its 100us minus the 40us concurrent comm — subtracted ONCE
+    assert ph["fwd_bwd_s"] == pytest.approx(60 * us)
+    assert ph["optimizer_s"] == pytest.approx(10 * us)
+    assert ph["other_s"] == pytest.approx(0.0, abs=1e-12)
+    # gap is true idle only — overlapped comm must NOT eat into it
+    assert ph["gap_s"] == pytest.approx(10 * us)
+    assert sum(ph.values()) == pytest.approx(s["window_s"])
+    # the hidden-comm measurement: comm ∩ (fwd_bwd ∪ optimizer)
+    assert s["overlapped_comm_s"] == pytest.approx(50 * us)
+
+    reg = MetricsRegistry().enable()
+    device_trace.publish_summary(s, reg)
+    assert reg.get("ds_overlap_hidden_comm_seconds_est").value == \
+        pytest.approx(50 * us)
+
+
 def test_cpu_proxy_rows_classify_as_device(tmp_path):
     """CPU traces have no /device process; XLA-runtime rows tagged with
     args.hlo_op count as device-proxy op rows, and a scope with host

@@ -326,6 +326,30 @@ def test_metrics_dump_renders_snapshot_and_csv(tmp_path):
         metrics_dump.load_snapshot(str(mon))))
     assert "Train_loss" in table and "2.25 @ step 2" in table
 
+    # --comms overlap on/off indicator (docs/OBSERVABILITY.md "Overlap")
+    assert metrics_dump.overlap_line({}) == \
+        "overlap: off (GSPMD-placed collectives)"
+    line = metrics_dump.overlap_line({"ds_overlap_buckets": 4.0,
+                                      "ds_overlap_hidden_comm_seconds_est":
+                                      0.0})
+    assert line == "overlap: on (4 buckets, no device capture yet)"
+    # a capture that MEASURED zero hidden comm is not "no capture"
+    line = metrics_dump.overlap_line({"ds_overlap_buckets": 4.0,
+                                      "ds_overlap_hidden_comm_seconds_est":
+                                      0.0,
+                                      "ds_profile_window_seconds": 1.5})
+    assert line == "overlap: on (4 buckets, 0s comm hidden in last capture)"
+    line = metrics_dump.overlap_line({"ds_overlap_buckets": 4.0,
+                                      "ds_overlap_hidden_comm_seconds_est":
+                                      0.0125})
+    assert "overlap: on (4 buckets" in line and "0.0125s/step" in line
+    # csvMonitor-directory snapshots carry {"last": ...} series dicts
+    line = metrics_dump.overlap_line(
+        {"ds_overlap_buckets": {"last": 4.0, "step": 3, "events": 3},
+         "ds_overlap_hidden_comm_seconds_est": {"last": 0.0125, "step": 3,
+                                                "events": 3}})
+    assert "overlap: on (4 buckets" in line and "0.0125s/step" in line
+
 
 # ---------------------------------------------------------------------------
 # tier-1 namespace guard

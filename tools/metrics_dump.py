@@ -113,6 +113,33 @@ def comms_rows(metrics: Dict[str, object]) -> List[List[str]]:
     return rows
 
 
+def overlap_line(metrics: Dict[str, object]) -> str:
+    """One-line compute/collective overlap indicator (the layer-chunked
+    schedule, docs/OBSERVABILITY.md 'Overlap'): whether ``overlap_comm``
+    was active on the scraped engine and how much comm a device capture
+    measured hidden under compute."""
+    def scalar(name):
+        v = metrics.get(name)
+        if isinstance(v, dict):             # csvMonitor series
+            v = v.get("last")
+        return v
+
+    buckets = scalar("ds_overlap_buckets")
+    if not buckets:
+        return "overlap: off (GSPMD-placed collectives)"
+    hidden = scalar("ds_overlap_hidden_comm_seconds_est") or 0.0
+    line = f"overlap: on ({int(buckets)} buckets"
+    if hidden:
+        line += f", {hidden:.6g}s/step comm hidden under compute"
+    elif scalar("ds_profile_window_seconds"):
+        # a capture ran and measured zero hidden comm — the exact failure
+        # being diagnosed; don't render it as "no capture"
+        line += ", 0s comm hidden in last capture"
+    else:
+        line += ", no device capture yet"
+    return line + ")"
+
+
 def render_comms(rows: List[List[str]]) -> str:
     header = ["collective", "calls", "bytes", "p50_s", "p99_s", "busbw",
               "dev_p50_s", "dev_busbw"]
@@ -219,6 +246,7 @@ def main(argv: List[str]) -> int:
         print()
         print(render_comms(rows) if rows
               else "(no ds_comm_* traffic recorded)")
+        print(overlap_line(metrics))
     if "--serving" in flags:
         print()
         print(serving_kv_summary(metrics))
