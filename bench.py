@@ -292,10 +292,17 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
         return toks, makespan, lat
 
     from deepspeed_tpu.monitor.metrics import get_registry
+    from deepspeed_tpu.monitor.request_trace import get_request_tracer
 
     registry = get_registry()
     was_enabled = registry.enabled
     registry.enable()
+    # per-request span tracing for the recorded pass: the ring must hold
+    # the whole wave so tail attribution sees every request, not a sample
+    tracer = get_request_tracer()
+    tracer_was = tracer.enabled
+    tracer_ring_was = tracer._ring.maxlen
+    tracer.configure(ring=max(2 * num_requests, 256)).enable()
     sides = {}
     serving_metrics = {}
     try:
@@ -310,6 +317,7 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
             run_continuous(serve)           # compile-warm passes
             run_continuous(serve)
             registry.reset()                # warm passes out of the record
+            tracer.reset()
             toks_c, span_c, lat_c = run_continuous(serve)
             p50_c, p99_c = percentiles(lat_c)
             snap = registry.snapshot()
@@ -345,6 +353,19 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
                               "page_tokens": serve.pool.page,
                               "budget_tokens": kv_budget},
                 }
+                # per-request tail attribution over the recorded pass:
+                # WHICH phase dominates the requests above the p99
+                # latency cut (queue vs prefill vs decode vs preemption
+                # wait) — the "why is my p99 slow" row for BENCH_r*.json
+                ta = tracer.tail_attribution(p=0.99)
+                serving_metrics["tail_attribution"] = {
+                    "p": ta["p"], "n": ta["n"], "tail_n": ta["tail_n"],
+                    "cut_s": round(ta["cut_s"], 4),
+                    "dominant_phase": ta["dominant_phase"],
+                    "phase_share": {k: round(v, 4) for k, v in
+                                    ta["phase_share"].items()},
+                    "exemplars": ta["exemplars"],
+                }
                 # device-true serving capture: a short burst of live
                 # requests under the profiler, post-processed into the
                 # decode dispatch-slack record (device decode time vs
@@ -360,6 +381,9 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
     finally:
         if not was_enabled:                 # a mid-bench raise must not
             registry.disable()              # leave the registry hot
+        if not tracer_was:
+            tracer.disable()
+        tracer.configure(ring=tracer_ring_was)  # undo the wave-sized ring
 
     # -- static-batch baseline ----------------------------------------
     engine = deepspeed_tpu.init_inference(

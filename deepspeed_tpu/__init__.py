@@ -88,9 +88,14 @@ def init_serving(model=None, config=None, **kwargs):
 
     ``metrics_port=`` (optional) enables the process-global metrics
     registry and serves it over HTTP for the engine's lifetime:
-    ``GET /metrics`` (Prometheus text) + ``GET /statz`` (JSON snapshot).
-    Pass ``0`` for an ephemeral port — read it back from
-    ``engine.metrics_server.port``.  See docs/OBSERVABILITY.md.
+    ``GET /metrics`` (Prometheus text) + ``GET /statz`` (JSON snapshot) +
+    ``GET /requestz`` (per-request span timelines).  Pass ``0`` for an
+    ephemeral port — read it back from ``engine.metrics_server.port``.
+    ``request_trace=True`` (optional) additionally enables the
+    per-request span tracer (``monitor/request_trace.py``) feeding
+    ``/requestz`` and the ``ds_serve_phase_*`` attribution histograms —
+    off by default (one branch, zero allocation per lifecycle hook).
+    See docs/OBSERVABILITY.md.
     """
     from deepspeed_tpu.serving.engine import ServingEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
@@ -98,6 +103,7 @@ def init_serving(model=None, config=None, **kwargs):
     params = kwargs.pop("params", None)
     mesh = kwargs.pop("mesh", None)
     metrics_port = kwargs.pop("metrics_port", None)
+    request_trace = kwargs.pop("request_trace", False)
     engine_kw = {k: kwargs.pop(k) for k in
                  ("engine", "num_slots", "prefill_chunk",
                   "decode_block_tokens", "do_sample", "temperature",
@@ -108,6 +114,10 @@ def init_serving(model=None, config=None, **kwargs):
         config = _merge_inference_config(config, kwargs,
                                          DeepSpeedInferenceConfig)
     serve = ServingEngine(model, config, params=params, mesh=mesh, **engine_kw)
+    if request_trace:
+        from deepspeed_tpu.monitor.request_trace import get_request_tracer
+
+        get_request_tracer().enable()
     if metrics_port is not None:
         import weakref
 

@@ -21,6 +21,12 @@ daemon thread:
   registry series).  ``timeout=S`` bounds the wait (default 60s; 504 when
   nothing is stepping, 409 when a capture is already in flight, 501 on
   jax builds without the perfetto export).
+- ``GET /requestz`` — per-request span timelines from the request tracer
+  (monitor/request_trace.py): recent completions, slowest exemplars, and
+  the tail-attribution summary.  ``?n=`` bounds the lists;
+  ``?format=perfetto`` returns trace-event JSON keyed to the clock anchor
+  of the most recent profiler capture, so it loads in ONE Perfetto
+  session next to a ``/profilez`` capture with aligned timestamps.
 
 ``port=0`` binds an ephemeral port (read it back from ``server.port``) —
 the shape tests and multi-engine hosts need.  Zero dependencies: plain
@@ -53,12 +59,50 @@ class _Handler(BaseHTTPRequestHandler):
             body = self.registry.prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path in ("/statz", "/statz/"):
-            window = parse_qs(query).get("window", [None])[0]
+            qs = parse_qs(query)
+            window = qs.get("window", [None])[0]
             if window is not None:
                 body = json.dumps(self._windowed(window),
                                   sort_keys=True).encode()
+            elif "kinds" in qs:
+                # instrument kinds alongside the snapshot: fleet
+                # aggregation (tools/fleet_dump.py) must know whether to
+                # SUM a scalar (counter) or min/max/mean it (gauge) —
+                # the plain snapshot erases that.  Both maps derive from
+                # ONE typed_snapshot so a metric registered mid-scrape
+                # can't appear in metrics but not kinds.
+                kinds: dict = {}
+                metrics: dict = {}
+                for (name, ls), (kind, value) in \
+                        self.registry.typed_snapshot().items():
+                    kinds[name] = kind
+                    if ls:
+                        metrics.setdefault(name, {})[ls] = value
+                    else:
+                        metrics[name] = value
+                body = json.dumps(
+                    {"enabled": self.registry.enabled,
+                     "metrics": metrics,
+                     "kinds": kinds}, sort_keys=True).encode()
             else:
                 body = self.registry.statz_json().encode()
+            ctype = "application/json"
+        elif path in ("/requestz", "/requestz/"):
+            from deepspeed_tpu.monitor.request_trace import \
+                get_request_tracer
+
+            qs = parse_qs(query)
+            tracer = get_request_tracer()
+            if qs.get("format", [""])[0] == "perfetto":
+                body = json.dumps(tracer.perfetto_trace()).encode()
+            else:
+                try:
+                    limit = int(qs.get("n", ["32"])[0])
+                except ValueError:
+                    self.send_error(400, "n must be an integer")
+                    return
+                body = json.dumps(tracer.snapshot(limit),
+                                  sort_keys=True).encode()
             ctype = "application/json"
         elif path in ("/profilez", "/profilez/"):
             code, payload = self._profilez(parse_qs(query))
@@ -71,7 +115,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         elif path == "/":
             body = json.dumps({"endpoints": ["/metrics", "/statz",
-                                             "/profilez"]}).encode()
+                                             "/profilez",
+                                             "/requestz"]}).encode()
             ctype = "application/json"
         else:
             self.send_error(404)

@@ -226,13 +226,15 @@ def _intersect(a: List[Tuple[float, float]],
 
 
 def summarize_trace(trace_path: str,
-                    steps: Optional[int] = None) -> Dict[str, Any]:
+                    steps: Optional[int] = None,
+                    clock: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Walk one perfetto trace into the device-truth summary.
 
     Returns (durations in SECONDS)::
 
         {"source", "degraded", "steps", "window_s", "device_busy_s",
          "device_rows",
+         "window_lo_us", "window_hi_us",             # raw trace-file ts
          "phases": {"fwd_bwd_s", "optimizer_s", "comm_s", "other_s",
                     "gap_s"},                       # sums to window_s
          "per_step": {... phases / steps ...},       # when steps known
@@ -240,6 +242,14 @@ def summarize_trace(trace_path: str,
          "serve": {"decode_host_s", "decode_device_s",
                    "dispatch_slack_s", "decode_blocks",
                    "prefill_host_s", "prefill_device_s"} | None}
+
+    ``window_lo_us``/``window_hi_us`` are in the FILE's clock domain —
+    microseconds since the profiler-session start — the same domain
+    ``/requestz?format=perfetto`` exports into, so a request span and the
+    device phase tracks compare directly.  ``clock`` (the capturing
+    ``TraceCapture.clock`` anchor) additionally translates the window
+    onto the unix clock: ``summary["clock"] = {"anchor_unix",
+    "window_unix_lo", "window_unix_hi", "source"}``.
 
     Phase accounting is exclusive by construction: ``comm`` is the union of
     device comm-scope time; ``fwd_bwd`` / ``optimizer`` are their scope
@@ -304,14 +314,26 @@ def summarize_trace(trace_path: str,
                 scope_iv[scope] = attributed
                 host_scoped.append(scope)
 
+    def _clock_block(lo_us: float, hi_us: float) -> Dict[str, Any]:
+        return {"anchor_unix": clock.get("unix"),
+                "source": clock.get("source"),
+                "window_unix_lo": clock.get("unix", 0.0) + lo_us * 1e-6,
+                "window_unix_hi": clock.get("unix", 0.0) + hi_us * 1e-6}
+
     window_rows = busy_iv or [iv for ivs in scope_iv.values() for iv in ivs]
     if not window_rows:
-        return {"source": path, "degraded": True, "steps": steps,
-                "window_s": 0.0, "device_busy_s": 0.0, "device_rows": 0,
-                "overlapped_comm_s": 0.0,
-                "phases": {"fwd_bwd_s": 0.0, "optimizer_s": 0.0,
-                           "comm_s": 0.0, "other_s": 0.0, "gap_s": 0.0},
-                "comm_device": {}, "serve": None}
+        out = {"source": path, "degraded": True, "steps": steps,
+               "window_s": 0.0, "device_busy_s": 0.0, "device_rows": 0,
+               "window_lo_us": 0.0, "window_hi_us": 0.0,
+               "overlapped_comm_s": 0.0,
+               "phases": {"fwd_bwd_s": 0.0, "optimizer_s": 0.0,
+                          "comm_s": 0.0, "other_s": 0.0, "gap_s": 0.0},
+               "comm_device": {}, "serve": None}
+        if clock is not None:
+            # the documented clock contract holds on degraded summaries
+            # too — those are exactly the captures someone is diagnosing
+            out["clock"] = _clock_block(0.0, 0.0)
+        return out
     lo = min(s for s, _ in window_rows)
     hi = max(e for _, e in window_rows)
     us = 1e-6  # file timestamps are microseconds
@@ -378,8 +400,11 @@ def summarize_trace(trace_path: str,
     out = {"source": path, "degraded": degraded, "steps": n_steps,
            "window_s": (hi - lo) * us, "device_busy_s": _union_len(busy) * us,
            "device_rows": len(dev_ops), "host_scoped": sorted(host_scoped),
+           "window_lo_us": lo, "window_hi_us": hi,
            "overlapped_comm_s": overlapped_s * us,
            "phases": phases, "comm_device": comm_device, "serve": serve}
+    if clock is not None:
+        out["clock"] = _clock_block(lo, hi)
     if n_steps:
         out["per_step"] = {k: v / n_steps for k, v in phases.items()}
     return out
@@ -488,12 +513,15 @@ def publish_summary(summary: Dict[str, Any], registry=None,
 
 def analyze_capture(trace_dir: str, steps: int,
                     bytes_per_op: Optional[Dict[str, Tuple[int, int]]] = None,
+                    clock: Optional[Dict[str, Any]] = None,
                     **tags: Any) -> Dict[str, Any]:
     """Summarize + tag + registry-backfill in one call — the shared tail
     of every capture lifecycle (training aux slot, serving ``/profilez``):
     ``tags`` (e.g. ``trigger=\"watchdog\"``, ``engine=\"serving\"``) land
-    on the returned summary verbatim."""
-    summary = summarize_trace(trace_dir, steps=steps)
+    on the returned summary verbatim; ``clock`` (the capture's
+    ``TraceCapture.clock`` anchor) translates the window onto the unix
+    clock for cross-file correlation (``/requestz``)."""
+    summary = summarize_trace(trace_dir, steps=steps, clock=clock)
     summary.update(tags)
     publish_summary(summary, bytes_per_op=bytes_per_op)
     return summary

@@ -95,6 +95,17 @@ class TraceCapture:
         self.perfetto = bool(perfetto)
         self.active = False
         self.done = False
+        # clock anchor stamped when the window opens: the perfetto file's
+        # timestamps are microseconds since the start_trace call, and this
+        # records where that epoch sits on perf_counter/unix time — the
+        # post-processor and /requestz correlate through it
+        self.clock = None
+
+    def _stamp_clock(self) -> None:
+        from deepspeed_tpu.monitor.request_trace import \
+            set_trace_clock_anchor
+
+        self.clock = set_trace_clock_anchor()
 
     def maybe_start(self, upcoming_step: int) -> None:
         """Called before the first micro-batch of ``upcoming_step``: opens
@@ -106,6 +117,9 @@ class TraceCapture:
         import atexit
 
         os.makedirs(self.output_path, exist_ok=True)
+        # anchor IMMEDIATELY before start_trace: the trace file's ts
+        # epoch is the session start (measured within ~100us of the call)
+        self._stamp_clock()
         if self.perfetto and perfetto_supported():
             jax.profiler.start_trace(self.output_path,
                                      create_perfetto_trace=True)

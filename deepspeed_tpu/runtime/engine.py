@@ -72,6 +72,19 @@ class TrainState(NamedTuple):
     scaler: scaler_lib.LossScaleState
 
 
+# training-numerics gauges published at every optimizer boundary while the
+# registry is enabled (values the engine already computes for _report);
+# the namespace guard registers these explicitly so docs can't drift
+TRAIN_STEP_GAUGES = {
+    "ds_train_loss":
+        "loss at the last optimizer boundary (the _report value, "
+        "published every boundary while telemetry is on)",
+    "ds_train_grad_norm":
+        "global grad norm at the last optimizer boundary (pre-clip "
+        "value from the step program)",
+}
+
+
 def _spec_world(spec, mesh) -> int:
     """Product of the mesh-axis extents a PartitionSpec shards over."""
     axes = []
@@ -1759,6 +1772,22 @@ class DeepSpeedEngine:
         self._flops_meter.observe_boundary(flops or None,
                                            anchor=self._last_loss)
         self._mem_telemetry.sample()
+        # training-numerics blind spot: loss + grad norm as gauges, every
+        # boundary.  Gated on the registry so the disabled path never pays
+        # the float() device sync; enabled, LM-shaped configs already
+        # blocked on the loss for the FLOPs clock above (same boundary
+        # bubble), while non-LM configs opt into one boundary sync — the
+        # price of reading the numbers out.
+        reg = get_registry()
+        if reg.enabled:
+            if self._last_loss is not None:
+                reg.gauge("ds_train_loss",
+                          TRAIN_STEP_GAUGES["ds_train_loss"]).set(
+                    float(self._last_loss))
+            if self._last_grad_norm is not None:
+                reg.gauge("ds_train_grad_norm",
+                          TRAIN_STEP_GAUGES["ds_train_grad_norm"]).set(
+                    float(self._last_grad_norm))
         if self._overlap_sched is not None:
             # static truth, republished so a bench-hygiene registry.reset()
             # between passes cannot make a live scrape read "overlap: off"
@@ -1847,7 +1876,7 @@ class DeepSpeedEngine:
                 summary = dtr.analyze_capture(
                     trace_dir, cap.num_steps,
                     bytes_per_op=self._profile_bytes_per_op(cap.num_steps),
-                    trigger=trigger)
+                    clock=cap.clock, trigger=trigger)
             except Exception as exc:
                 if trigger == "profilez":
                     self._pz_broker.resolve(

@@ -66,7 +66,9 @@ from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine, pow2_bucket
 from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
                                            sample_token)
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
 from deepspeed_tpu.monitor.metrics import get_registry
+from deepspeed_tpu.monitor.request_trace import get_request_tracer
 from deepspeed_tpu.profiling.trace import annotate
 from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
 from deepspeed_tpu.serving.scheduler import (PREFILLING, RUNNING,
@@ -204,6 +206,11 @@ class ServingEngine:
 
         self._pz_broker = get_profile_broker()
         self._pz = None              # [TraceCapture, ProfileRequest, done]
+        # per-request span tracing (compute-side edges/spans; the
+        # scheduler owns the queue-side ones) + flight-recorder request
+        # events — both disabled-by-default one-branch no-ops
+        self._tracer = get_request_tracer()
+        self._flight = get_flight_recorder()
         # compute-side lifecycle metrics (queue-side spans live in the
         # scheduler; all are one-branch no-ops while the registry is
         # disabled — see docs/OBSERVABILITY.md for the schema)
@@ -381,6 +388,7 @@ class ServingEngine:
 
         try:
             summary = dtr.analyze_capture(trace_dir, cap.num_steps,
+                                          clock=cap.clock,
                                           trigger="profilez",
                                           engine="serving")
         except Exception as exc:
@@ -439,9 +447,13 @@ class ServingEngine:
         self._eos[b] = -1
         self._pos_dev, self._act_dev = self._park_fn(
             self._pos_dev, self._act_dev, jnp.asarray(b, jnp.int32))
-        self.pool.release(b)
+        freed = self.pool.release(b)
         victim.preemptions += 1
-        self.scheduler.requeue_front(victim)
+        self.scheduler.requeue_front(victim)   # records the preempt edge
+        if self._flight.enabled:
+            self._flight.record("serve_preempt", rid=victim.request_id,
+                                pages_freed=freed,
+                                tokens_reclaimed=freed * self.pool.page)
         self._m_preempted.inc()
         self._m_pages_used.set(self.pool.pages_used)
         self._m_pages_free.set(self.pool.pages_free)
@@ -473,7 +485,9 @@ class ServingEngine:
                 jnp.asarray(slot, jnp.int32), jnp.asarray(off, jnp.int32),
                 jnp.asarray(c - 1, jnp.int32), srng)
         req.prefill_pos += c
-        self._m_prefill_s.record(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._tracer.span(req.request_id, "prefill_chunk", t0, t1, c)
+        self._m_prefill_s.record(t1 - t0)
         self._m_prefill_chunks.inc()
         self._m_prefill_toks.inc(c)
         # parked rows write junk at their own pos; keeping pos = prefill
@@ -493,12 +507,16 @@ class ServingEngine:
         # chunk's program.  Its VALUE is only fetched when scheduling
         # depends on it (EOS) — otherwise it stays on device and the
         # pipeline keeps flowing.
+        tpf = time.perf_counter()
         if not req.t_first_token:        # not re-recorded on a resume
-            req.t_first_token = time.perf_counter()
+            req.t_first_token = tpf
             # dispatch-time TTFT: on the sync-free path the token VALUE is
             # still device-resident, but it exists and later work is
             # ordered behind it
             self._m_ttft.record(req.t_first_token - req.t_submit)
+        # prefix resident + first token dispatched: the request's decode
+        # phase begins here (re-entered after a preempt-resume re-prefill)
+        self._tracer.decode_start(req.request_id, tpf)
         S = n_prefix
         # The position bound is ABSOLUTE, so it is invariant across
         # preempt-resume (prefix grows by exactly the tokens produced).
@@ -651,7 +669,8 @@ class ServingEngine:
             args.append(jnp.asarray(self.pool.page_table))
         (toks, valid, self._last_dev, self._pos_dev, self._act_dev,
          self._cache, self._rng) = self._block()(*args)
-        self._m_decode_s.record(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._m_decode_s.record(t1 - t0)
         idx = self._next_block
         self._next_block += 1
         refs = 0
@@ -660,6 +679,9 @@ class ServingEngine:
             b = req.slot
             n = int(min(self._K, self._limit[b] - self._pos[b]))
             self._pos[b] += n
+            # one span per participating row: the block's host dispatch
+            # window with this request's scheduled token count
+            self._tracer.span(req.request_id, "decode_block", t0, t1, n)
             self._m_decode_toks.inc(n)
             refs += 1
             if req.eos_token_id < 0:
@@ -710,13 +732,18 @@ class ServingEngine:
         participant's share (its valid prefix) and release rows whose
         finish the host could not predict."""
         idx, drainers = self._outstanding.popleft()
+        t0 = time.perf_counter()
         toks, valid = self._fetch_block(idx)
+        t1 = time.perf_counter()
         for req in drainers:
             b = req.slot
             if req.state != RUNNING:     # released at an earlier drain
                 self._unref(idx)         # (its later blocks carry 0 tokens)
                 continue
             n = int(valid[:, b].sum())   # valid is monotone within a block
+            # the deferred (toks, valid) fetch this EOS participant rode —
+            # memoized, so only the first drainer of a block pays the RTT
+            self._tracer.span(req.request_id, "drain_fetch", t0, t1, n)
             req.output_tokens.extend(int(t) for t in toks[:n, b])
             self._drained_pos[b] += n
             self._unref(idx)

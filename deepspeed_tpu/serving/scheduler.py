@@ -24,7 +24,16 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
 from deepspeed_tpu.monitor.metrics import get_registry
+from deepspeed_tpu.monitor.request_trace import get_request_tracer
+
+# process-global request id sequence: ids must be unique ACROSS engines in
+# one process — the request tracer and flight recorder key per-request
+# state/events by id, and two schedulers both starting at 0 would corrupt
+# open timelines.  FIFO admission order per scheduler is preserved (ids
+# are still assigned in submit order).
+_REQUEST_IDS = itertools.count()
 
 QUEUED = "queued"          # waiting for a slot
 PREFILLING = "prefilling"  # owns a slot; prompt partially in the KV cache
@@ -108,7 +117,12 @@ class IterationScheduler:
         self._queue: Deque[Request] = deque()
         self._slots: List[Optional[Request]] = [None] * num_slots
         self.finished: List[Request] = []
-        self._ids = itertools.count()
+        self._ids = _REQUEST_IDS
+        # per-request span tracing + flight-recorder request events (both
+        # disabled-by-default one-branch no-ops; the scheduler owns the
+        # queue-side edges, the engine the compute-side ones)
+        self._tracer = get_request_tracer()
+        self._flight = get_flight_recorder()
         # lifecycle metrics (no-ops while the registry is disabled; the
         # scheduler owns the queue-side spans, the engine owns the
         # compute-side ones — see docs/OBSERVABILITY.md)
@@ -136,6 +150,8 @@ class IterationScheduler:
         req.state = QUEUED
         req.t_submit = time.perf_counter()
         self._queue.append(req)
+        self._tracer.submit(req.request_id, req.t_submit, req.prompt_len,
+                            req.max_new_tokens)
         self._m_submitted.inc()
         self._m_queue_depth.set(len(self._queue))
         return req
@@ -157,8 +173,17 @@ class IterationScheduler:
             req.t_admit = time.perf_counter()
             self._slots[slot] = req
             admitted.append(req)
+            self._tracer.admit(req.request_id, slot, req.t_admit)
+            if self._flight.enabled:
+                self._flight.record("serve_admit", rid=req.request_id,
+                                    slot=slot)
             self._m_admitted.inc()
-            self._m_queue_wait.record(req.t_admit - req.t_submit)
+            # queue wait is submit -> FIRST admission only: a re-admission
+            # after a paged-KV preempt would otherwise record the whole
+            # first run as "queue" time (that wait is the preempted_wait
+            # phase, per docs/OBSERVABILITY.md)
+            if req.preemptions == 0:
+                self._m_queue_wait.record(req.t_admit - req.t_submit)
         if admitted:
             self._m_queue_depth.set(len(self._queue))
         return admitted
@@ -189,6 +214,15 @@ class IterationScheduler:
         if req.slot >= 0 and self._slots[req.slot] is req:
             self._slots[req.slot] = None
         self.finished.append(req)
+        # terminal edge: closes the request's span timeline with the SAME
+        # timestamp the latency histogram records, so the per-request
+        # phase partition reconciles with ds_serve_request_latency exactly
+        self._tracer.finish(req.request_id, req.t_finish,
+                            req.finish_reason or "unknown",
+                            len(req.output_tokens))
+        if self._flight.enabled:
+            self._flight.record("serve_finish", rid=req.request_id,
+                                reason=req.finish_reason or "unknown")
         self._m_latency.record(req.t_finish - req.t_submit)
         # an unset/novel reason lands in the explicit "unknown" series —
         # a nonzero count there means a release path forgot to attribute,
@@ -209,6 +243,7 @@ class IterationScheduler:
         req.state = QUEUED
         req.prefill_pos = 0
         self._queue.appendleft(req)
+        self._tracer.preempt(req.request_id, time.perf_counter())
         self._m_queue_depth.set(len(self._queue))
 
     def drain_finished(self) -> List[Request]:

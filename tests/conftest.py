@@ -78,12 +78,16 @@ def _restore_metrics_registry_enabled():
     enabled-state baseline)."""
     from deepspeed_tpu.monitor.comms import comm_metrics
     from deepspeed_tpu.monitor.metrics import get_registry
+    from deepspeed_tpu.monitor.request_trace import get_request_tracer
 
     reg = get_registry()
+    tracer = get_request_tracer()
     prev_reg, prev_comms = reg.enabled, comm_metrics.enabled
+    prev_tracer = tracer.enabled
     yield
     reg._enabled = prev_reg
     comm_metrics.enabled = prev_comms
+    tracer.enabled = prev_tracer
 
 
 @pytest.fixture(scope="session")

@@ -207,6 +207,10 @@ def test_zero3_training_smoke_exposes_comm_and_mfu_via_statz(mesh8):
         # MFU/TFLOPS gauges: set from the 2nd boundary on
         assert snap["ds_train_tflops"] > 0
         assert 0 < snap["ds_train_mfu"] < 10  # sanity, CPU "peak" is fake
+        # ISSUE 7 step-numerics gauges: loss + grad norm at the boundary
+        # (values the engine already computed for _report)
+        assert snap["ds_train_loss"] == pytest.approx(losses_on[-1])
+        assert snap["ds_train_grad_norm"] > 0
         # shard-group byte breakdown was recorded at init
         assert snap["ds_mem_param_shard_bytes"] > 0
         # the engine timers still bridge (PR 2 behavior intact)

@@ -286,12 +286,20 @@ def test_bench_summary_last_line_roundtrips_json():
                "continuous": {"goodput_tok_s": 100.0, "p99_latency_s": 0.5},
                "metrics": {"ttft_p50_s": 0.01, "ttft_p99_s": 0.05,
                            "queue_wait_p99_s": 0.2,
-                           "mean_slot_occupancy": 0.9}}
+                           "mean_slot_occupancy": 0.9,
+                           "tail_attribution": {
+                               "p": 0.99, "n": 64, "tail_n": 2,
+                               "cut_s": 1.2, "dominant_phase": "queue",
+                               "phase_share": {"queue": 0.8},
+                               "exemplars": [7, 3]}}}
     lines = bench.summary_lines(record, serving)
     # the runner parses the LAST stdout line: it must be the bare object
     parsed = json.loads(lines[-1])
     assert parsed["metric"] == "m"
     assert parsed["serving_metrics"]["queue_wait_p99_s"] == 0.2
+    # the ISSUE 7 tail-attribution sub-object rides BENCH_JSON verbatim
+    ta = parsed["serving_metrics"]["tail_attribution"]
+    assert ta["dominant_phase"] == "queue" and ta["exemplars"] == [7, 3]
     # the human-greppable prefixed line stays, directly above it
     assert lines[-2] == "BENCH_JSON: " + lines[-1]
     # no serving rung (CPU smoke): still a parseable bare last line
@@ -397,9 +405,28 @@ def test_namespace_guard_all_metrics_documented(devices):
     from deepspeed_tpu.profiling import device_trace
 
     device_trace.ensure_registered(get_registry())
+    # ISSUE 7 families: the per-request phase-attribution histograms
+    # (registered at tracer construction) and the training-numerics
+    # step gauges (registered lazily at the optimizer boundary, so the
+    # guard registers them explicitly here)
+    from deepspeed_tpu.monitor.request_trace import PHASES, \
+        get_request_tracer
+    from deepspeed_tpu.runtime.engine import TRAIN_STEP_GAUGES
+
+    get_request_tracer()
+    for _n, _h in TRAIN_STEP_GAUGES.items():
+        get_registry().gauge(_n, _h)
 
     with open(_DOC) as fh:
         documented = set(re.findall(r"ds_[a-z0-9_]+", fh.read()))
+    # every phase in the edge partition must have its histogram
+    # documented BY NAME (not as a pattern): the fleet/router consumers
+    # key on the exact series names
+    for _p in PHASES:
+        assert f"ds_serve_phase_{_p}_seconds" in documented, (
+            f"ds_serve_phase_{_p}_seconds is part of the request-span "
+            f"edge partition but is not documented in "
+            f"docs/OBSERVABILITY.md")
     name_re = re.compile(r"^ds_[a-z0-9_]+$")
     train_re = re.compile(r"^ds_train_[a-z0-9_]+_seconds$")
     # ds_comm_<op>_<suffix>: the suffix schema is documented as a table;

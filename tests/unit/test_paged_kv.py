@@ -138,6 +138,67 @@ def test_pool_pressure_preempts_and_resumes_token_identical(setup, rng):
     serve.pool.check_no_leak()
 
 
+def test_preempt_correlates_flight_events_with_request_timelines(setup,
+                                                                 rng):
+    """ISSUE 7 correlation contract: with the flight recorder AND the
+    request tracer on, a pool-pressure preempt-resume run must leave
+    ``serve_admit`` / ``serve_preempt`` / ``serve_finish`` events whose
+    ``rid`` fields match the tracer's completed timelines — a
+    watchdog-tripped flight dump and ``/requestz`` exemplars join by id.
+    The preempt event carries the reclaim size; the preempted request's
+    timeline shows the ``preempted_wait`` phase."""
+    from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+    from deepspeed_tpu.monitor.metrics import get_registry
+    from deepspeed_tpu.monitor.request_trace import get_request_tracer
+
+    model, params, ref = setup
+    flight = get_flight_recorder()
+    tracer = get_request_tracer()
+    reg = get_registry()
+    serve = _serve(model, params, kv_pool_tokens=80)    # 5 usable pages
+    flight.enable()
+    reg.enable()
+    reg.reset()
+    tracer.reset()
+    tracer.enable()
+    try:
+        k1, k2 = jax.random.split(rng)
+        prompts = [np.asarray(jax.random.randint(k1, (8,), 0, 256)),
+                   np.asarray(jax.random.randint(k2, (9,), 0, 256))]
+        reqs = [serve.submit(p, max_new_tokens=40) for p in prompts]
+        serve.run()
+        assert sum(r.preemptions for r in reqs) >= 1
+        evs = flight.events()
+        by_kind = {}
+        for e in evs:
+            by_kind.setdefault(e["kind"], []).append(e)
+        rids = {r.request_id for r in reqs}
+        # every lifecycle event names its request; ids line up with the
+        # tracer's completed timelines
+        assert {e["rid"] for e in by_kind["serve_finish"]} == rids
+        assert {e["rid"] for e in by_kind["serve_admit"]} >= rids
+        pre = by_kind["serve_preempt"]
+        assert pre and all(e["rid"] in rids for e in pre)
+        assert all(e["pages_freed"] > 0 and e["tokens_reclaimed"] > 0
+                   for e in pre)
+        timelines = {r["id"]: r for r in tracer.completed()}
+        assert set(timelines) == rids
+        for e in pre:
+            rec = timelines[e["rid"]]
+            assert rec["preemptions"] >= 1
+            assert rec["phases"]["preempted_wait"] > 0
+        for e in by_kind["serve_finish"]:
+            assert timelines[e["rid"]]["reason"] == e["reason"]
+        # queue wait is recorded once per REQUEST, not per admission: a
+        # preempt's re-admission wait is the preempted_wait phase, never
+        # a second (run-length-sized) queue_wait observation
+        assert reg.get("ds_serve_queue_wait_seconds").count == len(reqs)
+    finally:
+        flight.disable()
+        tracer.disable()
+        reg.reset()
+
+
 def test_eos_decode_runs_sync_free(setup, rng):
     """EOS workloads must not sync the host per decode block: every fetch
     of a block's (toks, valid) pair happens either at least one block

@@ -1,0 +1,388 @@
+"""Per-request span tracing (monitor/request_trace.py) units, plus the
+offline-tool selftests (trace_report / fleet_dump) and the live two-replica
+fleet-scrape merge — the ISSUE 7 attribution surface.  Pure host logic:
+no jax compiles, runs in milliseconds (tier-1)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from deepspeed_tpu.monitor.metrics import MetricsRegistry, get_registry
+from deepspeed_tpu.monitor.request_trace import (PHASES, RequestTracer,
+                                                 get_request_tracer,
+                                                 get_trace_clock_anchor,
+                                                 set_trace_clock_anchor)
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# phase partition / reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_phase_partition_telescopes_through_preemption():
+    """The edge partition must telescope to exactly t_finish - t_submit,
+    including across a preempt -> requeue -> re-admit -> re-prefill cycle
+    (the paged-KV resume path): every instant of the request's lifetime
+    belongs to exactly one of the four phases."""
+    tr = RequestTracer().enable()
+    tr.submit(1, 10.0, prompt_len=8, max_new=16)
+    tr.admit(1, 0, 11.0)          # queue          = 1.0
+    tr.decode_start(1, 13.0)      # prefill       += 2.0
+    tr.preempt(1, 14.0)           # decode        += 1.0
+    tr.admit(1, 1, 16.0)          # preempted_wait = 2.0
+    tr.decode_start(1, 17.0)      # prefill       += 1.0 (re-prefill)
+    tr.finish(1, 19.0, "eos", 5)  # decode        += 2.0
+    (rec,) = tr.completed()
+    assert rec["phases"] == {"queue": 1.0, "prefill": 3.0, "decode": 3.0,
+                             "preempted_wait": 2.0}
+    assert sum(rec["phases"].values()) == rec["latency_s"] == 9.0
+    assert rec["preemptions"] == 1 and rec["reason"] == "eos"
+    assert rec["t_first_token"] == 13.0     # not re-stamped on resume
+    assert rec["edges"][-1] == (19.0, "finish")
+    assert tr.open_count == 0
+
+
+def test_phase_histograms_record_once_per_finish():
+    """Each finish records exactly one observation into every
+    ``ds_serve_phase_*_seconds`` histogram, and the four values sum to
+    the request's latency — the aggregate mirror of the per-request
+    telescoping (asserted via count/sum deltas on the global registry,
+    which the tracer's histograms live on)."""
+    reg = get_registry()
+    was = reg.enabled
+    reg.enable()
+    try:
+        before = {p: (reg.get(f"ds_serve_phase_{p}_seconds").count,
+                      reg.get(f"ds_serve_phase_{p}_seconds").sum)
+                  for p in PHASES}
+        tr = RequestTracer().enable()
+        tr.submit(2, 0.0, 4, 8)
+        tr.admit(2, 0, 0.5)
+        tr.decode_start(2, 1.25)
+        tr.finish(2, 3.0, "length", 8)
+        deltas = {}
+        for p in PHASES:
+            h = reg.get(f"ds_serve_phase_{p}_seconds")
+            c0, s0 = before[p]
+            assert h.count - c0 == 1, p
+            deltas[p] = h.sum - s0
+        assert deltas["queue"] == pytest.approx(0.5)
+        assert deltas["prefill"] == pytest.approx(0.75)
+        assert deltas["decode"] == pytest.approx(1.75)
+        assert deltas["preempted_wait"] == 0.0
+        assert sum(deltas.values()) == pytest.approx(3.0)
+    finally:
+        reg._enabled = was
+
+
+# ---------------------------------------------------------------------------
+# retention: ring + slowest heap
+# ---------------------------------------------------------------------------
+
+
+def _complete(tr, rid, t0, latency):
+    tr.submit(rid, t0, 4, 4)
+    tr.admit(rid, 0, t0 + latency * 0.25)
+    tr.decode_start(rid, t0 + latency * 0.5)
+    tr.finish(rid, t0 + latency, "eos", 4)
+
+
+def test_ring_churn_keeps_slowest_exemplars():
+    """A slow request must survive ring churn via the slowest-exemplar
+    heap: the tail stays inspectable however long the run."""
+    tr = RequestTracer(ring=4, slowest_k=2).enable()
+    _complete(tr, 0, 0.0, 50.0)              # the slowest, finished first
+    for rid in range(1, 10):
+        _complete(tr, rid, 100.0 + rid, 1.0 + rid * 0.01)
+    recent_ids = {r["id"] for r in tr._ring}
+    assert 0 not in recent_ids               # churned out of the ring...
+    all_ids = {r["id"] for r in tr.completed()}
+    assert 0 in all_ids                      # ...but retained by the heap
+    assert tr.slowest(1)[0]["id"] == 0
+    assert tr.completed_total == 10
+    # slowest list is sorted most-severe first
+    lats = [r["latency_s"] for r in tr.slowest()]
+    assert lats == sorted(lats, reverse=True)
+    # completed() dedups ring∩heap and orders by completion time
+    fins = [r["t_finish"] for r in tr.completed()]
+    assert fins == sorted(fins)
+    # max_spans cap: overflow counts instead of growing the timeline
+    tr2 = RequestTracer(max_spans=2).enable()
+    tr2.submit(7, 0.0, 4, 4)
+    for i in range(5):
+        tr2.span(7, "decode_block", float(i), i + 0.5, 3)
+    tr2.finish(7, 9.0, "eos", 4)
+    (rec,) = tr2.completed()
+    assert len(rec["spans"]) == 2 and rec["spans_dropped"] == 3
+
+
+def test_tail_attribution_finds_dominant_phase():
+    """Tail attribution answers "why is the p99 slow": among requests
+    above the p-quantile cut, which phase holds the time."""
+    tr = RequestTracer(ring=256).enable()
+    for rid in range(99):                    # fast, decode-dominated
+        _complete(tr, rid, float(rid), 0.1)
+    # one pathological straggler: 60s in queue, fast after admission
+    tr.submit(99, 1000.0, 4, 4)
+    tr.admit(99, 0, 1060.0)
+    tr.decode_start(99, 1060.5)
+    tr.finish(99, 1061.0, "eos", 4)
+    ta = tr.tail_attribution(p=0.99)
+    assert ta["n"] == 100 and ta["tail_n"] == 1
+    assert ta["dominant_phase"] == "queue"
+    assert ta["phase_share"]["queue"] > 0.9
+    assert sum(ta["phase_share"].values()) == pytest.approx(1.0)
+    assert ta["exemplars"] == [99]
+    # empty tracer degrades cleanly
+    assert RequestTracer().tail_attribution()["dominant_phase"] is None
+
+
+# ---------------------------------------------------------------------------
+# disabled-path contract + lifecycle guards
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_hooks_allocate_nothing():
+    """The metrics.py hot-path contract: a DISABLED tracer's lifecycle
+    hooks are one attribute-load + branch and allocate nothing per
+    request (the serving loop calls them unconditionally)."""
+    tr = RequestTracer()
+    assert not tr.enabled
+    for hook in range(2):                    # warm any lazy interpreter state
+        tr.submit(1, 0.0, 4, 4)
+        tr.admit(1, 0, 0.1)
+        tr.span(1, "prefill_chunk", 0.1, 0.2, 4)
+        tr.decode_start(1, 0.2)
+        tr.span(1, "decode_block", 0.2, 0.3, 3)
+        tr.preempt(1, 0.3)
+        tr.finish(1, 0.4, "eos", 3)
+    before = sys.getallocatedblocks()
+    for _ in range(1000):
+        tr.submit(1, 0.0, 4, 4)
+        tr.admit(1, 0, 0.1)
+        tr.span(1, "prefill_chunk", 0.1, 0.2, 4)
+        tr.decode_start(1, 0.2)
+        tr.span(1, "decode_block", 0.2, 0.3, 3)
+        tr.preempt(1, 0.3)
+        tr.finish(1, 0.4, "eos", 3)
+    delta = sys.getallocatedblocks() - before
+    assert tr.open_count == 0 and not tr.completed()
+    # interpreter internals may wiggle a few blocks, never per-call
+    assert delta < 100, delta
+
+
+def test_disable_drops_in_flight_timelines():
+    """disable() while requests are mid-flight (bench teardown, operator
+    toggle) must clear the open timelines: their finish edges will never
+    arrive while disabled, so keeping them would leak phantom 'open'
+    requests forever and trip the span-completeness guard on a later
+    re-enable.  Retained completions survive the toggle."""
+    tr = RequestTracer().enable()
+    _complete(tr, 1, 0.0, 1.0)
+    tr.submit(2, 5.0, 4, 4)
+    tr.admit(2, 0, 5.5)
+    assert tr.open_count == 1
+    tr.disable()
+    assert tr.open_count == 0
+    tr.finish(2, 9.0, "eos", 4)              # no-op, no resurrection
+    tr.enable()
+    tr.finish(2, 9.0, "eos", 4)              # unknown rid now: no-op
+    assert tr.open_count == 0 and tr.completed_total == 1
+    assert [r["id"] for r in tr.completed()] == [1]
+
+
+def test_unknown_or_preenable_requests_are_ignored():
+    """Edges for requests the tracer never saw (submitted while tracing
+    was off, or plain bogus ids) must be silent no-ops — enabling the
+    tracer mid-run cannot corrupt or grow state."""
+    tr = RequestTracer().enable()
+    tr.admit(404, 0, 1.0)
+    tr.decode_start(404, 2.0)
+    tr.span(404, "decode_block", 2.0, 2.5, 3)
+    tr.preempt(404, 3.0)
+    tr.finish(404, 4.0, "eos", 3)
+    assert tr.open_count == 0 and not tr.completed()
+    assert tr.completed_total == 0
+
+
+def test_configure_and_reset():
+    tr = RequestTracer(ring=8, slowest_k=4).enable()
+    for rid in range(6):
+        _complete(tr, rid, float(rid), 1.0 + rid)
+    tr.configure(slowest_k=2)                # keeps the 2 slowest
+    assert [r["id"] for r in tr.slowest()] == [5, 4]
+    tr.configure(ring=2)
+    assert len(tr._ring) == 2
+    tr.reset()
+    assert not tr.completed() and tr.completed_total == 0
+    # the process-global accessor hands back one shared instance
+    assert get_request_tracer() is get_request_tracer()
+
+
+# ---------------------------------------------------------------------------
+# exports: snapshot + perfetto clock mapping
+# ---------------------------------------------------------------------------
+
+
+def test_perfetto_export_maps_onto_trace_clock():
+    """`/requestz?format=perfetto` timestamps must be microseconds since
+    the trace-session anchor — the same epoch jax's perfetto file uses —
+    so both files load in one Perfetto session on a shared clock."""
+    anchor = set_trace_clock_anchor()
+    a = anchor["perf"]
+    tr = RequestTracer().enable()
+    tr.submit(3, a + 0.25, 4, 8)
+    tr.admit(3, 0, a + 0.5)
+    tr.span(3, "prefill_chunk", a + 0.5, a + 0.6, 4)
+    tr.decode_start(3, a + 0.75)
+    tr.finish(3, a + 1.0, "eos", 8)
+    trace = tr.perfetto_trace()
+    assert trace["otherData"]["clock_source"] == "trace_session"
+    assert trace["otherData"]["clock_anchor_unix"] == anchor["unix"]
+    xs = {(e["tid"], e["name"]): e for e in trace["traceEvents"]
+          if e.get("ph") == "X"}
+    phases_tid = 2 * 3
+    q = xs[(phases_tid, "queue")]
+    assert q["ts"] == pytest.approx(0.25e6) and \
+        q["dur"] == pytest.approx(0.25e6)
+    d = xs[(phases_tid, "decode")]
+    assert d["ts"] == pytest.approx(0.75e6) and \
+        d["dur"] == pytest.approx(0.25e6)
+    sp = xs[(phases_tid + 1, "prefill_chunk")]
+    assert sp["ts"] == pytest.approx(0.5e6) and \
+        sp["args"]["tokens"] == 4
+    # thread metadata names the request for the Perfetto track list
+    metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+    names = {e["args"]["name"] for e in metas}
+    assert {"ds_requests", "req 3 phases", "req 3 spans"} <= names
+    # the whole export is valid JSON (what the endpoint serves)
+    json.loads(json.dumps(trace))
+    # the module-level accessor mirrors the last stamp
+    assert get_trace_clock_anchor()["perf"] == anchor["perf"]
+
+
+def test_snapshot_shape():
+    tr = RequestTracer().enable()
+    _complete(tr, 11, 0.0, 2.0)
+    snap = tr.snapshot(limit=4)
+    assert snap["enabled"] and snap["completed_total"] == 1
+    assert snap["open"] == 0 and snap["retained"] == 1
+    assert snap["tail_attribution"]["n"] == 1
+    assert snap["recent"][0]["id"] == 11
+    assert snap["slowest"][0]["edges"][-1] == [2.0, "finish"]
+    assert "clock" in snap
+    json.loads(json.dumps(snap))             # endpoint-serializable
+
+
+# ---------------------------------------------------------------------------
+# offline tools: selftests wired as tier-1 (they cannot silently rot)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_selftest():
+    """tools/trace_report.py --selftest parses its bundled synthetic
+    perfetto fixture and asserts the phase partition."""
+    trace_report = _tool("trace_report")
+    assert trace_report.main(["trace_report", "--selftest"]) == 0
+
+
+def test_fleet_dump_selftest():
+    """tools/fleet_dump.py --selftest merges two synthetic replicas built
+    through the REAL registry and asserts counter sums / gauge spreads /
+    merged-histogram quantiles."""
+    fleet_dump = _tool("fleet_dump")
+    assert fleet_dump.main(["fleet_dump", "--selftest"]) == 0
+
+
+def test_metrics_dump_requests_table(tmp_path, capsys):
+    """tools/metrics_dump.py --requests renders the slowest-exemplar
+    table (id, latency, phase breakdown, preemptions, reason) plus the
+    tail-attribution line from a saved /requestz snapshot."""
+    metrics_dump = _tool("metrics_dump")
+    tr = RequestTracer().enable()
+    _complete(tr, 5, 0.0, 4.0)
+    tr.submit(6, 10.0, 4, 4)
+    tr.admit(6, 0, 11.0)
+    tr.decode_start(6, 11.5)
+    tr.preempt(6, 12.0)
+    tr.admit(6, 1, 13.0)
+    tr.decode_start(6, 13.5)
+    tr.finish(6, 30.0, "length", 4)
+    snap = tmp_path / "requestz.json"
+    snap.write_text(json.dumps(tr.snapshot()))
+    assert metrics_dump.main(
+        ["metrics_dump", "--requests", str(snap)]) == 0
+    out = capsys.readouterr().out
+    assert "slowest 2 of 2 completed" in out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    header = lines[1].split()
+    assert header[:6] == ["id", "latency_s", "queue_s", "prefill_s",
+                          "decode_s", "preempt_wait_s"]
+    row6 = next(ln for ln in lines if ln.startswith("6 "))
+    assert "length" in row6 and " 1 " in row6   # reason + preemption count
+    assert "dominant=" in out                   # tail-attribution line
+    # empty snapshot: a helpful hint, not a crash
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps(RequestTracer().snapshot()))
+    assert metrics_dump.main(
+        ["metrics_dump", "--requests", str(empty)]) == 0
+    assert "is the tracer enabled" in capsys.readouterr().out
+
+
+def test_fleet_dump_merges_two_live_endpoints():
+    """The acceptance run: two LIVE /statz endpoints (each its own
+    registry + HTTP server, the bench-child / router-replica shape)
+    scraped and merged over real HTTP — counters sum, gauges spread,
+    histograms merge by bucket counts, kinds ride the ?kinds=1 query."""
+    from deepspeed_tpu.monitor.server import MetricsServer
+
+    fleet_dump = _tool("fleet_dump")
+    servers, urls = [], []
+    try:
+        for depth, lat in ((2, 0.01), (8, 1.9)):
+            reg = MetricsRegistry().enable()
+            reg.counter("ds_serve_submitted_total").inc(depth * 10)
+            reg.gauge("ds_serve_queue_depth").set(depth)
+            for _ in range(50):
+                reg.histogram(
+                    "ds_serve_request_latency_seconds").record(lat)
+            srv = MetricsServer(reg, port=0).start()
+            servers.append(srv)
+            urls.append(f"127.0.0.1:{srv.port}")
+        snaps, kinds = {}, {}
+        for i, u in enumerate(urls):
+            data = fleet_dump.fetch_statz(u)
+            snaps[f"r{i}"] = data["metrics"]
+            kinds.update(data["kinds"])
+        # the ?kinds=1 contract: merge decisions come from real kinds,
+        # not naming heuristics
+        assert kinds["ds_serve_queue_depth"] == "gauge"
+        assert kinds["ds_serve_submitted_total"] == "counter"
+        fleet = fleet_dump.merge_snapshots(snaps, kinds)
+        sub = fleet["ds_serve_submitted_total"]
+        assert sub["sum"] == 100 and sub["per_replica"]["r1"] == 80
+        q = fleet["ds_serve_queue_depth"]
+        assert (q["min"], q["max"]) == (2, 8) and q["skew"] > 1
+        lat = fleet["ds_serve_request_latency_seconds"]
+        assert lat["count"] == 100
+        # fleet p99 comes from the MERGED distribution: it must land in
+        # the slow replica's bucket, which averaging per-replica p99s
+        # could never say
+        assert 1.0 < lat["p99"] <= 3.2
+        table = fleet_dump.render(fleet, sorted(snaps))
+        assert "ds_serve_queue_depth" in table and "r1" in table
+    finally:
+        for srv in servers:
+            srv.stop()

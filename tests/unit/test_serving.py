@@ -37,6 +37,30 @@ def _no_unknown_finish_reasons():
         "release path forgot to set finish_reason (unattributed release)")
 
 
+@pytest.fixture(autouse=True)
+def _span_completeness_guard():
+    """Tier-1 span-completeness assertion (mirror of the unknown-reason
+    guard, for the request tracer): after any test, every request the
+    tracer recorded must have reached its terminal finish edge — zero
+    timelines remain open once the test's requests are drained, and every
+    retained completion carries the terminal data `/requestz` and the
+    phase histograms key on.  An open timeline here means some release
+    path finished a request without closing its span record."""
+    from deepspeed_tpu.monitor.request_trace import PHASES, \
+        get_request_tracer
+
+    tracer = get_request_tracer()
+    yield
+    assert tracer.open_count == 0, (
+        f"request timelines left open after the test: "
+        f"{tracer.open_ids()} — a release path finished these requests "
+        "without recording the terminal finish edge")
+    for rec in tracer.completed():
+        assert rec["edges"][-1][1] == "finish", rec
+        assert "reason" in rec and "latency_s" in rec, rec
+        assert set(rec["phases"]) == set(PHASES), rec
+
+
 # ---------------------------------------------------------------------------
 # scheduler unit tests (pure host logic, no jax)
 # ---------------------------------------------------------------------------
@@ -289,6 +313,129 @@ def test_serving_metrics_enabled_parity_and_live_endpoints(served, rng):
         serve.close()                 # stops the exporter (port released)
         assert serve.metrics_server is None
         reg.disable()
+
+
+def test_request_spans_reconcile_with_latency(served, rng):
+    """The ISSUE 7 reconciliation contract: with the request tracer on,
+    every finished request's four-phase edge partition must telescope to
+    exactly its latency, the ``ds_serve_phase_*_seconds`` histograms must
+    see one observation per finished request (same count as the latency
+    histogram), and the four phase sums must add up to the latency
+    histogram's sum — the aggregate and per-request views agree."""
+    from deepspeed_tpu.monitor.metrics import get_registry
+    from deepspeed_tpu.monitor.request_trace import (PHASES,
+                                                     get_request_tracer)
+
+    _, _, _, serve = served
+    reg = get_registry()
+    reg.enable()
+    reg.reset()
+    tracer = get_request_tracer()
+    tracer.reset()
+    tracer.enable()
+    prompts, news = _mixed_requests(rng)
+    reqs = [serve.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts, news)]
+    serve.run()
+    n = len(reqs)
+    by_id = {r["id"]: r for r in tracer.completed()}
+    for req in reqs:
+        rec = by_id[req.request_id]
+        # per-request: the edge partition telescopes to the latency
+        assert sum(rec["phases"].values()) == pytest.approx(
+            rec["latency_s"], rel=1e-9, abs=1e-12)
+        assert rec["latency_s"] == req.t_finish - req.t_submit
+        assert rec["reason"] == req.finish_reason
+        assert rec["tokens_out"] == len(req.output_tokens)
+        # the measured dispatch spans rode along with token counts
+        kinds = {s[0] for s in rec["spans"]}
+        assert "prefill_chunk" in kinds and "decode_block" in kinds
+        assert sum(s[3] for s in rec["spans"]
+                   if s[0] == "prefill_chunk") == req.prompt_len
+    # aggregate: one observation per request in every phase histogram,
+    # and the phase sums reconcile with the latency histogram's sum
+    m = reg.snapshot()
+    lat = m["ds_serve_request_latency_seconds"]
+    assert lat["count"] == n
+    phase_sum = 0.0
+    for p in PHASES:
+        h = m[f"ds_serve_phase_{p}_seconds"]
+        assert h["count"] == n, (p, h)
+        phase_sum += h["sum"]
+    assert phase_sum == pytest.approx(lat["sum"], rel=1e-9)
+    # the tail-attribution summary is non-degenerate over a real wave
+    ta = tracer.tail_attribution(p=0.5)
+    assert ta["tail_n"] >= 1 and ta["dominant_phase"] in PHASES
+    assert sum(ta["phase_share"].values()) == pytest.approx(1.0)
+
+
+def test_requestz_live_endpoint_and_profilez_clock_agreement(served, rng):
+    """The ISSUE 7 acceptance e2e: against ONE live serving run,
+    ``/requestz?format=perfetto`` and a ``/profilez?steps=N`` capture
+    must share a clock domain — the tracer's anchor is stamped at
+    ``start_trace`` (source ``trace_session``), the capture summary
+    carries the same anchor, and the request spans recorded during the
+    capture overlap the capture's ``[window_lo_us, window_hi_us]``
+    device window, so both files load in one Perfetto session with
+    aligned timelines."""
+    import json
+    import threading
+    import urllib.request
+
+    from deepspeed_tpu.monitor.metrics import get_registry
+    from deepspeed_tpu.monitor.request_trace import get_request_tracer
+    from deepspeed_tpu.profiling.device_trace import perfetto_supported
+
+    if not perfetto_supported():
+        pytest.skip("this jax's start_trace has no create_perfetto_trace")
+    _, _, ref, _ = served
+    reg = get_registry()
+    reg.enable()
+    serve = deepspeed_tpu.init_serving(
+        engine=ref, num_slots=2, prefill_chunk=4, decode_block_tokens=3,
+        metrics_port=0, request_trace=True)
+    tracer = get_request_tracer()
+    tracer.reset()
+    stop = threading.Event()
+
+    def waves():
+        while not stop.is_set():
+            for _ in range(2):
+                serve.submit(np.asarray([1, 2, 3], np.int32),
+                             max_new_tokens=5)
+            serve.run()
+
+    t = threading.Thread(target=waves, daemon=True)
+    t.start()
+    try:
+        url = serve.metrics_server.url
+        with urllib.request.urlopen(
+                f"{url}/profilez?steps=3&timeout=120", timeout=150) as r:
+            summary = json.load(r)
+        with urllib.request.urlopen(
+                f"{url}/requestz?format=perfetto", timeout=10) as r:
+            trace = json.load(r)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        serve.close()
+    # both surfaces carry the SAME trace-session anchor
+    assert summary["clock"]["source"] == "trace_session"
+    other = trace["otherData"]
+    assert other["clock_source"] == "trace_session"
+    assert other["clock_anchor_unix"] == summary["clock"]["anchor_unix"]
+    # clock-domain agreement: request spans recorded while the capture
+    # was open land inside (overlap) the capture's device window, in the
+    # file's own microsecond domain — the one-Perfetto-session contract
+    lo, hi = summary["window_lo_us"], summary["window_hi_us"]
+    assert hi > lo
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "no request spans exported during a live run"
+    overlapping = [e for e in xs
+                   if e["ts"] < hi and e["ts"] + e["dur"] > lo]
+    assert overlapping, (
+        f"no request span overlaps the capture window [{lo}, {hi}]us — "
+        f"the /requestz and /profilez clock domains diverged")
 
 
 @pytest.mark.parametrize("position,fused", [("learned", False),

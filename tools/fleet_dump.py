@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Fleet-scrape aggregation: merge N ``/statz`` endpoints into one view.
+
+The multi-replica router (ROADMAP item 3) dispatches off each replica's
+live ``ds_serve_*`` gauges; this tool is that signal surface delivered as
+an operator view — scrape every replica, merge the series, and show the
+per-replica SKEW (a hot replica reads directly off the skew column):
+
+    python tools/fleet_dump.py http://host:9101 http://host:9102
+    python tools/fleet_dump.py r1=host:9101 r2=host:9102   # named replicas
+    python tools/fleet_dump.py --json url...               # machine-readable
+    python tools/fleet_dump.py snap1.json snap2.json       # saved snapshots
+    python tools/fleet_dump.py --selftest                  # parser self-check
+
+Merge semantics by instrument kind (fetched from ``/statz?kinds=1``; a
+saved snapshot without kinds falls back to the ``*_total`` naming
+heuristic):
+
+- **counters** sum across replicas (fleet totals: requests, tokens);
+- **gauges** report the MEAN as the fleet value plus min/max spread
+  (fleet state: queue depth, active slots, KV pages — the per-replica
+  columns carry the absolute values, ``skew`` the imbalance);
+- **histograms** merge exactly: bucket counts add element-wise (every
+  replica uses the same fixed bounds), so the FLEET p50/p99 is computed
+  from the merged distribution, not averaged from per-replica quantiles
+  (averaging quantiles is wrong; merging counts is not).  When the bucket
+  layout is not one this repo ships (34 log buckets / 17 linear ratio
+  buckets), merged quantiles are omitted and per-replica p99s remain.
+
+``skew`` is ``(max - min) / mean`` over the per-replica values (counters:
+their deltas-as-values; histograms: per-replica p99) — ``0`` means a
+balanced fleet, ``>= 1`` means some replica sees a multiple of another's
+load, which is exactly the router's rebalance trigger.
+
+``--selftest`` builds two synthetic replicas through the real
+``MetricsRegistry`` and asserts the merge (wired as a tier-1 unit test so
+this offline tool cannot silently rot).  Zero dependencies beyond the
+repo's stdlib-only metrics module — no jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from metrics_dump import base_url, is_url, render_table  # noqa: E402
+
+
+def _load_metrics():
+    """The repo's stdlib-only metrics module WITHOUT importing the
+    ``deepspeed_tpu`` package (whose ``__init__`` pulls in jax — an
+    operator box scraping a fleet has no jax): reuse the module when the
+    package is already loaded (tests), else exec ``metrics.py`` by file
+    path."""
+    mod = sys.modules.get("deepspeed_tpu.monitor.metrics")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "deepspeed_tpu", "monitor", "metrics.py")
+    spec = importlib.util.spec_from_file_location("_ds_fleet_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_metrics = _load_metrics()
+DEFAULT_BUCKETS = _metrics.DEFAULT_BUCKETS
+_quantile_from_counts = _metrics._quantile_from_counts
+
+# bucket bounds inferable from snapshot bucket-list length: the repo's two
+# fixed layouts (DEFAULT log buckets; 16-linear ratio histograms)
+_RATIO_BUCKETS = tuple(i / 16 for i in range(1, 17))
+_BOUNDS_BY_LEN = {len(DEFAULT_BUCKETS) + 1: DEFAULT_BUCKETS,
+                  len(_RATIO_BUCKETS) + 1: _RATIO_BUCKETS}
+
+
+def fetch_statz(url: str, timeout: float = 5.0) -> Dict[str, object]:
+    """GET one replica's ``/statz?kinds=1`` (URL normalized via
+    metrics_dump's shared helper); returns the parsed body
+    ``{"metrics", "kinds"?}``."""
+    import urllib.request
+
+    with urllib.request.urlopen(base_url(url) + "/statz?kinds=1",
+                                timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def load_source(src: str) -> Dict[str, object]:
+    """A live endpoint or a saved ``/statz`` snapshot file."""
+    if is_url(src):
+        return fetch_statz(src)
+    with open(src) as fh:
+        data = json.load(fh)
+    if "metrics" not in data:          # bare metrics mapping
+        data = {"metrics": data}
+    return data
+
+
+def _kind_of(name: str, values: List[object],
+             kinds: Dict[str, str]) -> str:
+    k = kinds.get(name)
+    if k:
+        return k
+    if any(isinstance(v, dict) and "buckets" in v for v in values):
+        return "histogram"
+    return "counter" if name.endswith("_total") else "gauge"
+
+
+def _spread(vals: List[float]) -> Dict[str, float]:
+    mean = sum(vals) / len(vals)
+    lo, hi = min(vals), max(vals)
+    return {"min": lo, "max": hi, "mean": mean,
+            "skew": ((hi - lo) / abs(mean)) if mean else 0.0}
+
+
+def _merge_histograms(per: Dict[str, dict]) -> Dict[str, object]:
+    counts = [v["count"] for v in per.values()]
+    sums = [v["sum"] for v in per.values()]
+    total = sum(counts)
+    out: Dict[str, object] = {
+        "count": total, "sum": sum(sums),
+        "mean": (sum(sums) / total) if total else 0.0,
+        "per_replica": {r: {"count": v["count"], "p99": v["p99"]}
+                        for r, v in per.items()},
+    }
+    p99s = [v["p99"] for v in per.values() if v["count"]]
+    if len(p99s) >= 2:
+        out["p99_skew"] = _spread(p99s)["skew"]
+    # exact merged quantiles when the bucket layout is one we know: the
+    # element-wise count sum IS the fleet distribution
+    lens = {len(v.get("buckets", [])) for v in per.values()}
+    if len(lens) == 1:
+        bounds = _BOUNDS_BY_LEN.get(lens.pop())
+        if bounds is not None and total:
+            merged = [0] * (len(bounds) + 1)
+            for v in per.values():
+                for i, c in enumerate(v["buckets"]):
+                    merged[i] += c
+            out["p50"] = _quantile_from_counts(bounds, merged, 0.5)
+            out["p99"] = _quantile_from_counts(bounds, merged, 0.99)
+    return out
+
+
+def merge_snapshots(snaps: Dict[str, Dict[str, object]],
+                    kinds: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, object]:
+    """Merge ``{replica: metrics-mapping}`` into the fleet view
+    ``{name: entry}`` (labeled families nest one entry per label set)."""
+    kinds = kinds or {}
+    names: Dict[str, None] = {}
+    for m in snaps.values():
+        for n in m:
+            names.setdefault(n)
+    fleet: Dict[str, object] = {}
+    for name in names:
+        per = {r: m[name] for r, m in snaps.items() if name in m}
+        vals = list(per.values())
+        # a labeled family ({'{reason="eos"}': ...}): recurse per label
+        if all(isinstance(v, dict) and
+               all(k.startswith("{") for k in v) for v in vals):
+            labels: Dict[str, None] = {}
+            for v in vals:
+                for ls in v:
+                    labels.setdefault(ls)
+            fam = {}
+            for ls in labels:
+                sub = {r: {name: v[ls]} for r, v in per.items() if ls in v}
+                fam[ls] = merge_snapshots(sub, kinds)[name]
+            fleet[name] = fam
+            continue
+        kind = _kind_of(name, vals, kinds)
+        if kind == "histogram":
+            hist = {r: v for r, v in per.items() if isinstance(v, dict)}
+            if hist:
+                fleet[name] = {"kind": "histogram",
+                               **_merge_histograms(hist)}
+            continue
+        nums = {r: float(v) for r, v in per.items()
+                if isinstance(v, (int, float))}
+        if not nums:
+            continue
+        entry = {"kind": kind, "per_replica": nums,
+                 **_spread(list(nums.values()))}
+        entry["sum" if kind == "counter" else "value"] = (
+            sum(nums.values()) if kind == "counter"
+            else entry["mean"])
+        fleet[name] = entry
+    return fleet
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def fleet_rows(fleet: Dict[str, object],
+               replicas: List[str]) -> List[List[str]]:
+    """[metric, fleet, p50, p99, <one col per replica>, skew] rows."""
+    rows = []
+
+    def emit(name, e):
+        if isinstance(e, dict) and "kind" not in e:      # labeled family
+            for ls, sub in sorted(e.items()):
+                emit(f"{name}{ls}", sub)
+            return
+        if e["kind"] == "histogram":
+            per = e["per_replica"]
+            rows.append([name, f"n={e['count']}",
+                         _fmt(e["p50"]) if "p50" in e else "",
+                         _fmt(e["p99"]) if "p99" in e else ""]
+                        + [(_fmt(per[r]["p99"]) if r in per and
+                            per[r]["count"] else "") for r in replicas]
+                        + [_fmt(e["p99_skew"]) if "p99_skew" in e else ""])
+            return
+        per = e["per_replica"]
+        head = _fmt(e["sum"]) if e["kind"] == "counter" else _fmt(e["value"])
+        rows.append([name, head, "", ""]
+                    + [(_fmt(per[r]) if r in per else "") for r in replicas]
+                    + [_fmt(round(e["skew"], 4))])
+
+    for name, e in sorted(fleet.items()):
+        emit(name, e)
+    return rows
+
+
+def render(fleet: Dict[str, object], replicas: List[str]) -> str:
+    header = (["metric", "fleet", "p50", "p99"] + list(replicas) + ["skew"])
+    return "\n".join(render_table(header, fleet_rows(fleet, replicas)))
+
+
+# ---------------------------------------------------------------------------
+# selftest (bundled synthetic fixture; tier-1 wired)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_replicas() -> Tuple[Dict[str, dict], Dict[str, str]]:
+    """Two synthetic replicas built through the REAL registry (so the
+    fixture tracks the snapshot shape instead of freezing a copy of it)."""
+    MetricsRegistry = _metrics.MetricsRegistry
+
+    snaps, kinds = {}, {}
+    for r, (reqs, depth, lats) in (
+            ("r0", (100, 2, [0.01] * 90 + [0.5] * 10)),
+            ("r1", (300, 8, [0.02] * 80 + [2.0] * 20))):
+        reg = MetricsRegistry().enable()
+        reg.counter("ds_serve_submitted_total").inc(reqs)
+        reg.gauge("ds_serve_queue_depth").set(depth)
+        h = reg.histogram("ds_serve_request_latency_seconds")
+        for v in lats:
+            h.record(v)
+        reg.counter("ds_serve_finished_total",
+                    labels={"reason": "eos"}).inc(reqs - 1)
+        snaps[r] = reg.snapshot()
+        kinds = {name: kind for (name, _), (kind, _) in
+                 reg.typed_snapshot().items()}
+    return snaps, kinds
+
+
+def selftest() -> int:
+    snaps, kinds = _synthetic_replicas()
+    fleet = merge_snapshots(snaps, kinds)
+    sub = fleet["ds_serve_submitted_total"]
+    assert sub["kind"] == "counter" and sub["sum"] == 400, sub
+    assert sub["per_replica"] == {"r0": 100.0, "r1": 300.0}
+    assert sub["skew"] == (300 - 100) / 200
+    q = fleet["ds_serve_queue_depth"]
+    assert q["kind"] == "gauge" and q["min"] == 2 and q["max"] == 8
+    lat = fleet["ds_serve_request_latency_seconds"]
+    assert lat["count"] == 200
+    # merged-distribution p99 lands in the slow replica's 2.0s log bucket
+    # (upper bound ~3.16s) — per-replica p99s alone could never say that
+    assert 1.0 < lat["p99"] <= 3.2, lat
+    assert lat["p99_skew"] > 0
+    fam = fleet["ds_serve_finished_total"]['{reason="eos"}']
+    assert fam["sum"] == 99 + 299
+    table = render(fleet, sorted(snaps))
+    assert "ds_serve_submitted_total" in table and "400" in table
+    print(table)
+    print("fleet_dump selftest: OK")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: List[str]) -> int:
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    flags = {a for a in argv[1:] if a.startswith("--")}
+    if "--selftest" in flags:
+        return selftest()
+    if not args or "--help" in flags or "-h" in argv[1:]:
+        print(__doc__.strip())
+        return 0 if args else 2
+    snaps: Dict[str, Dict[str, object]] = {}
+    kinds: Dict[str, str] = {}
+    for i, src in enumerate(args):
+        name, sep, rest = src.partition("=")
+        if sep and not name.startswith("http"):
+            src = rest
+        else:
+            name = f"r{i}"
+        data = load_source(src)
+        snaps[name] = data.get("metrics", {})
+        kinds.update(data.get("kinds") or {})
+    fleet = merge_snapshots(snaps, kinds)
+    if not fleet:
+        print("(no metrics found on any replica)")
+        return 1
+    if "--json" in flags:
+        print(json.dumps({"replicas": sorted(snaps), "fleet": fleet},
+                         sort_keys=True, default=str))
+    else:
+        print(render(fleet, sorted(snaps)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -16,7 +16,12 @@ ledger, docs/OBSERVABILITY.md) with the device-truth columns
 ``/profilez``/watchdog capture populated them) alongside the analytic
 attribution for side-by-side error reading.  ``--serving`` prints the paged-KV pool
 summary (pages used/free, cache-utilization percentiles, preemptions from
-the ``ds_serve_kv_*`` / ``ds_serve_preempted_total`` series).  ``ds_mem_*``
+the ``ds_serve_kv_*`` / ``ds_serve_preempted_total`` series).  ``--requests``
+prints the slowest-exemplar table from the same host's ``/requestz``
+endpoint (or a saved ``/requestz`` snapshot file passed as the source):
+per request id, latency, the queue/prefill/decode/preempted-wait phase
+breakdown, preemption count and finish reason, plus the tail-attribution
+line — the "which requests were slow and why" view.  ``ds_mem_*``
 byte gauges render humanized (GiB/MiB) in the value column;
 ``ds_train_mfu`` and ``*_ratio`` histogram columns render as percentages.
 
@@ -32,20 +37,32 @@ import sys
 from typing import Dict, List
 
 
+def is_url(src: str) -> bool:
+    return src.startswith(("http://", "https://")) or (
+        ":" in src and not os.path.exists(src))
+
+
+def base_url(src: str) -> str:
+    """Normalize ``host[:port]`` or any known endpoint URL on the host to
+    the server base (scheme + authority), stripping endpoint suffixes and
+    any query/fragment — the ONE place the metrics server's URL shape is
+    known (fleet_dump imports it too)."""
+    url = src if src.startswith("http") else f"http://{src}"
+    url = url.split("?", 1)[0].split("#", 1)[0].rstrip("/")
+    for suffix in ("/metrics", "/statz", "/requestz", "/profilez"):
+        if url.endswith(suffix):
+            url = url[: -len(suffix)]
+    return url
+
+
 def load_snapshot(src: str) -> Dict[str, object]:
     """Return the ``{name: value-or-dict}`` metrics mapping from a URL,
     JSON file, or csvMonitor directory."""
-    if src.startswith(("http://", "https://")) or (
-            ":" in src and not os.path.exists(src)):
+    if is_url(src):
         import urllib.request
 
-        url = src if src.startswith("http") else f"http://{src}"
-        url = url.rstrip("/")
-        if url.endswith("/metrics"):
-            url = url[: -len("/metrics")] + "/statz"
-        if not url.endswith("/statz"):
-            url = url.rstrip("/") + "/statz"
-        with urllib.request.urlopen(url, timeout=5) as resp:
+        with urllib.request.urlopen(base_url(src) + "/statz",
+                                    timeout=5) as resp:
             return json.load(resp)["metrics"]
     if os.path.isdir(src):
         out: Dict[str, object] = {}
@@ -176,6 +193,68 @@ def serving_kv_summary(metrics: Dict[str, object]) -> str:
     return "\n".join(lines)
 
 
+def load_requestz(src: str) -> Dict[str, object]:
+    """The ``/requestz`` snapshot from a live endpoint (any URL on the
+    host is normalized to ``/requestz``) or a saved JSON file."""
+    if is_url(src):
+        import urllib.request
+
+        with urllib.request.urlopen(base_url(src) + "/requestz",
+                                    timeout=5) as resp:
+            return json.load(resp)
+    with open(src) as fh:
+        return json.load(fh)
+
+
+def requests_rows(snap: Dict[str, object]) -> List[List[str]]:
+    """Slowest-exemplar rows [id, latency, queue, prefill, decode,
+    preempted_wait, toks, preempts, reason] from a ``/requestz``
+    snapshot."""
+    rows = []
+    for rec in snap.get("slowest") or []:
+        ph = rec.get("phases") or {}
+        rows.append([str(rec["id"]), f"{rec['latency_s']:.4g}"]
+                    + [f"{ph.get(p, 0.0):.4g}" for p in
+                       ("queue", "prefill", "decode", "preempted_wait")]
+                    + [str(rec.get("tokens_out", "")),
+                       str(rec.get("preemptions", 0)),
+                       str(rec.get("reason", ""))])
+    return rows
+
+
+def render_table(header: List[str], rows: List[List[str]]) -> List[str]:
+    """Column-width-aligned table lines (header, separator, rows) — the
+    one table renderer the ops tools share."""
+    table = [header] + rows
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in table]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    return lines
+
+
+def render_requests(snap: Dict[str, object]) -> str:
+    rows = requests_rows(snap)
+    if not rows:
+        return ("(no completed request timelines — is the tracer enabled? "
+                "init_serving(request_trace=True))")
+    header = ["id", "latency_s", "queue_s", "prefill_s", "decode_s",
+              "preempt_wait_s", "toks", "preempts", "reason"]
+    lines = [f"slowest {len(rows)} of {snap.get('completed_total', '?')} "
+             f"completed ({snap.get('open', 0)} open)"]
+    lines += render_table(header, rows)
+    ta = snap.get("tail_attribution") or {}
+    if ta.get("tail_n"):
+        share = ta.get("phase_share") or {}
+        parts = "  ".join(f"{p}={100 * share.get(p, 0.0):.1f}%"
+                          for p in ("queue", "prefill", "decode",
+                                    "preempted_wait"))
+        lines.append(f"tail (>= p{int(100 * ta.get('p', 0.99))} cut "
+                     f"{ta.get('cut_s', 0.0):.4g}s, n={ta['tail_n']}): "
+                     f"dominant={ta.get('dominant_phase')}  {parts}")
+    return "\n".join(lines)
+
+
 def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
     """Flatten the snapshot into [name, count, mean, p50, p99, value]
     display rows (histograms fill the quantile columns, scalars the value
@@ -219,15 +298,8 @@ def rows_from_snapshot(metrics: Dict[str, object]) -> List[List[str]]:
 
 
 def render(rows: List[List[str]]) -> str:
-    header = ["metric", "count", "mean", "p50", "p99", "value"]
-    table = [header] + rows
-    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
-    lines = []
-    for i, r in enumerate(table):
-        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
-        if i == 0:
-            lines.append("  ".join("-" * w for w in widths))
-    return "\n".join(lines)
+    return "\n".join(render_table(
+        ["metric", "count", "mean", "p50", "p99", "value"], rows))
 
 
 def main(argv: List[str]) -> int:
@@ -236,6 +308,11 @@ def main(argv: List[str]) -> int:
     if len(args) != 1 or "--help" in flags or "-h" in argv[1:]:
         print(__doc__.strip())
         return 0 if len(args) == 1 else 2
+    if "--requests" in flags:
+        # the source here is the /requestz surface (a URL is normalized to
+        # it; a file is a saved /requestz snapshot), not a /statz snapshot
+        print(render_requests(load_requestz(args[0])))
+        return 0
     metrics = load_snapshot(args[0])
     if not metrics:
         print("(no metrics found)")

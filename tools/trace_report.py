@@ -15,6 +15,10 @@ the watchdog) or a direct path to the file.  Shows the phase breakdown
 overlap headroom), the device-true per-collective table, and the serving
 dispatch-slack numbers when ``ds_serve_*`` ranges are present.
 
+``--selftest`` writes a bundled synthetic trace to a temp dir and runs
+the full parse + render on it, asserting the phase partition (wired as a
+tier-1 unit test so this offline tool cannot silently rot).
+
 Needs this repo (and its jax dependency) importable; the trace file
 itself is plain gzip'd trace-event JSON, parsed with stdlib only.
 """
@@ -100,9 +104,83 @@ def render(summary: dict) -> str:
     return "\n".join(out)
 
 
+def _selftest_trace(path: str) -> str:
+    """Bundled synthetic fixture: one device process with two 100us steps
+    (fwd_bwd ops with a nested all_gather, an optimizer fusion on the
+    name-scope lane, a trailing reduce_scatter, 10us idle), plus a host
+    dispatch range — the exact shapes the classifier must keep parsing."""
+    import gzip
+
+    def meta(pid, pname, threads):
+        evs = [{"ph": "M", "pid": pid, "name": "process_name",
+                "args": {"name": pname}}]
+        for tid, tname in threads:
+            evs.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name", "args": {"name": tname}})
+        return evs
+
+    def x(name, pid, tid, ts, dur, args=None):
+        e = {"ph": "X", "name": name, "pid": pid, "tid": tid,
+             "ts": float(ts), "dur": float(dur)}
+        if args:
+            e["args"] = args
+        return e
+
+    evs = meta(1, "/device:TPU:0", [(10, "XLA Ops"),
+                                    (11, "TensorFlow Name Scope")])
+    evs += meta(2, "/host:CPU", [(20, "python")])
+    for base in (0, 100):
+        evs.append(x("fusion.1", 1, 10, base, 20,
+                     {"tf_op": "jit_step/ds_fwd_bwd/fusion.1"}))
+        evs.append(x("all-gather.2", 1, 10, base + 20, 20,
+                     {"tf_op": "jit_step/ds_fwd_bwd/ds_comm_all_gather/"
+                               "ag.2"}))
+        evs.append(x("fusion.3", 1, 10, base + 40, 20,
+                     {"tf_op": "jit_step/ds_fwd_bwd/fusion.3"}))
+        evs.append(x("fusion.4", 1, 10, base + 60, 20))
+        evs.append(x("ds_optimizer_step", 1, 11, base + 60, 20))
+        evs.append(x("reduce-scatter.5", 1, 10, base + 80, 10,
+                     {"tf_op": "jit_step/ds_comm_reduce_scatter/rs.5"}))
+        evs.append(x("ds_fwd_bwd", 2, 20, base, 55))
+    p = os.path.join(path, "perfetto_trace.json.gz")
+    with gzip.open(p, "wt") as fh:
+        json.dump({"displayTimeUnit": "ns", "traceEvents": evs}, fh)
+    return p
+
+
+def selftest() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(
+            prefix="ds_trace_report_selftest_") as d:
+        return _selftest_in(d)
+
+
+def _selftest_in(d: str) -> int:
+    _selftest_trace(d)
+    summary = device_trace.summarize_trace(d, steps=2)
+    ph = summary["phases"]
+    assert not summary["degraded"], summary
+    # the five phases partition the window exactly (the core invariant)
+    assert abs(sum(ph.values()) - summary["window_s"]) < 1e-12, summary
+    assert abs(ph["fwd_bwd_s"] - 80e-6) < 1e-12, ph      # 2 x (60-20)us
+    assert abs(ph["comm_s"] - 60e-6) < 1e-12, ph         # 2 x (20+10)us
+    assert abs(ph["gap_s"] - 10e-6) < 1e-12, ph          # inter-step idle
+    assert summary["window_lo_us"] == 0.0
+    assert summary["window_hi_us"] == 190.0
+    assert "all_gather" in summary["comm_device"]
+    text = render(summary)
+    assert "fwd_bwd" in text and "all_gather" in text
+    print(text)
+    print("trace_report selftest: OK")
+    return 0
+
+
 def main(argv: List[str]) -> int:
     import argparse
 
+    if "--selftest" in argv[1:]:
+        return selftest()
     ap = argparse.ArgumentParser(
         description="device-truth report from a jax profiler trace")
     ap.add_argument("trace", help="trace dir (or perfetto_trace.json.gz)")
