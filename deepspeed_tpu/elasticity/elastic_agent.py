@@ -21,6 +21,11 @@ fail-fast exit — it
 
 Give-up conditions: ``max_restarts`` exhausted, or the surviving count is
 not in the elastic set.
+
+Preemption-aware (docs/RESILIENCE.md): ranks exiting with
+``PREEMPTED_EXIT_CODE`` (``runtime/preemption.py`` — SIGTERM emergency
+save taken, left on purpose) trigger a relaunch at the SAME world size
+instead of a shrink; the checkpoint they just wrote is the resume point.
 """
 
 from __future__ import annotations
@@ -160,6 +165,22 @@ class DSElasticAgent:
                 logger.error("elastic agent: max_restarts=%d exhausted",
                              self.max_restarts)
                 return code
+            # Preemption is not member loss: a rank exiting with the
+            # preempted code (runtime/preemption.py) took its SIGTERM
+            # emergency save and left ON PURPOSE — the host is coming
+            # back, so relaunch at the SAME world size instead of
+            # shrinking (still bounded by max_restarts).
+            from deepspeed_tpu.runtime.preemption import PREEMPTED_EXIT_CODE
+
+            if all(c == PREEMPTED_EXIT_CODE for _, c in failed):
+                self.restart_count += 1
+                port = _free_port(self.master_addr)
+                logger.info(
+                    "elastic agent: rank(s) %s preempted (clean emergency "
+                    "save); restart #%d at unchanged world=%d — training "
+                    "resumes from the latest checkpoint",
+                    [r for r, _ in failed], self.restart_count, world)
+                continue
             new_world = world - len(failed)
             if new_world < 1:
                 logger.error("elastic agent: no survivors to restart with")

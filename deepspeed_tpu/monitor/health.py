@@ -1,0 +1,58 @@
+"""Process-global readiness state backing the ``/healthz`` endpoint.
+
+The multi-replica router (ROADMAP item 3) needs one boolean per replica:
+"may I send you new work?".  Liveness is the HTTP server answering at
+all; READINESS is this flag — flipped off by ``ServingEngine.drain()``
+for the whole drain window (and by any other subsystem that wants
+traffic to stop) and surfaced as ``GET /healthz`` → 200/503 on the
+metrics server.
+
+Deliberately tiny and lock-free on the read side (the serving loop and
+the HTTP scrape threads both touch it): a single attribute read per
+check, same contract as the metrics registry's disabled path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["HealthState", "get_health"]
+
+
+class HealthState:
+    def __init__(self) -> None:
+        self.ready = True
+        self.reason: Optional[str] = None
+        self.since_unix = time.time()
+        self._transitions = 0
+
+    def set_ready(self) -> None:
+        if not self.ready:
+            self._transitions += 1
+            self.since_unix = time.time()
+        self.reason = None
+        self.ready = True
+
+    def set_not_ready(self, reason: str) -> None:
+        if self.ready:
+            self._transitions += 1
+            self.since_unix = time.time()
+        self.reason = str(reason)
+        self.ready = False
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"ready": self.ready,
+                               "since_unix": self.since_unix,
+                               "transitions": self._transitions}
+        if self.reason is not None:
+            out["reason"] = self.reason
+        return out
+
+
+_HEALTH = HealthState()
+
+
+def get_health() -> HealthState:
+    """The process-global readiness flag the ``/healthz`` endpoint serves."""
+    return _HEALTH

@@ -21,6 +21,10 @@ daemon thread:
   registry series).  ``timeout=S`` bounds the wait (default 60s; 504 when
   nothing is stepping, 409 when a capture is already in flight, 501 on
   jax builds without the perfetto export).
+- ``GET /healthz`` — READINESS (not liveness): 200 ``{"ready": true}``
+  while the process accepts new work, 503 with a ``reason`` while it does
+  not (``ServingEngine.drain()`` flips it for the whole drain window) —
+  the router/load-balancer stop-sending signal (monitor/health.py).
 - ``GET /requestz`` — per-request span timelines from the request tracer
   (monitor/request_trace.py): recent completions, slowest exemplars, and
   the tail-attribution summary.  ``?n=`` bounds the lists;
@@ -113,9 +117,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        elif path in ("/healthz", "/healthz/"):
+            # READINESS, not liveness: 503 while draining (or any other
+            # not-ready reason) is the router's stop-sending signal —
+            # liveness is this server answering at all.
+            from deepspeed_tpu.monitor.health import get_health
+
+            snap = get_health().snapshot()
+            body = json.dumps(snap, sort_keys=True).encode()
+            self.send_response(200 if snap["ready"] else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         elif path == "/":
-            body = json.dumps({"endpoints": ["/metrics", "/statz",
-                                             "/profilez",
+            body = json.dumps({"endpoints": ["/healthz", "/metrics",
+                                             "/statz", "/profilez",
                                              "/requestz"]}).encode()
             ctype = "application/json"
         else:

@@ -7,10 +7,11 @@ tensorstore/OCDBT shape of SURVEY.md §5.4).  ``MsgpackCheckpointEngine``
 remains for small single-file payloads (inference exports, tools).
 """
 
+from deepspeed_tpu.runtime.checkpoint_engine import atomic
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (CheckpointEngine,
                                                                        MsgpackCheckpointEngine)
 from deepspeed_tpu.runtime.checkpoint_engine.sharded import (ShardedCheckpointEngine,
                                                              is_sharded_checkpoint)
 
 __all__ = ["CheckpointEngine", "MsgpackCheckpointEngine",
-           "ShardedCheckpointEngine", "is_sharded_checkpoint"]
+           "ShardedCheckpointEngine", "is_sharded_checkpoint", "atomic"]

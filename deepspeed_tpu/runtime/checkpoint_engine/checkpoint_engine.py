@@ -47,6 +47,8 @@ class MsgpackCheckpointEngine(CheckpointEngine):
         tmp = path + ".tmp"
         with open(tmp, "wb") as fh:
             fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())  # crash-atomicity: durable before publish
         os.replace(tmp, path)
 
     def load(self, path: str, target: Any = None) -> Any:

@@ -288,6 +288,22 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
     async_save: bool = False
+    # TPU extensions (docs/RESILIENCE.md): crash-atomic saves are always
+    # on; these knobs govern the verified-load / retention / preemption
+    # layers around them.
+    # verify the MANIFEST.json (existence + size + sha256) before a load
+    # trusts a tag's bytes; on failure the loader walks back to the
+    # newest valid tag instead of crashing
+    verify_on_load: bool = True
+    # retention GC: after a successful commit, delete the oldest VALID
+    # tags beyond this count (never the tag `latest` points to); 0 = keep
+    # everything
+    keep_last_n: int = 0
+    # SIGTERM -> emergency save at the next optimizer boundary, then exit
+    # with PREEMPTED_EXIT_CODE (runtime/preemption.py); requires save_dir
+    preemption_save: bool = False
+    # where preemption saves (and supervisor resumes) live
+    save_dir: Optional[str] = None
 
 
 class ElasticityConfig(DeepSpeedConfigModel):

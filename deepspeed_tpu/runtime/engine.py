@@ -489,6 +489,24 @@ class DeepSpeedEngine:
             if frc.on_signal:
                 self._flight.install_signal_handler()
 
+        # -- preemption grace-window handling (docs/RESILIENCE.md): the
+        # SIGTERM handler only latches a flag; the next optimizer boundary
+        # runs one emergency save (the watchdog/_aux_trace_tick boundary-
+        # hook pattern).  Config-driven install here; the explicit API is
+        # enable_preemption_save().
+        self._preempt = None
+        self._preempt_cfg = None
+        self._preempt_client_state_fn = None
+        ckc = self.config.checkpoint_config
+        if ckc.preemption_save:
+            if ckc.save_dir:
+                self.enable_preemption_save(ckc.save_dir)
+            else:
+                logger.warning(
+                    "checkpoint.preemption_save is set but checkpoint."
+                    "save_dir is not: SIGTERM handler NOT installed "
+                    "(nowhere to save)")
+
         # -- device-true profiling (docs/OBSERVABILITY.md "Device truth"):
         # one-shot auxiliary capture slot shared by /profilez requests and
         # watchdog trips ((TraceCapture, trigger, payload) or None), polled
@@ -1942,6 +1960,94 @@ class DeepSpeedEngine:
                                num_steps=wdc.capture_steps, perfetto=True)
             self._aux_trace = (cap, "watchdog", None)
 
+    # ------------------------------------------------------------------
+    # preemption: SIGTERM -> emergency save at the next optimizer boundary
+    # (docs/RESILIENCE.md; same boundary-hook slot as the watchdog)
+    # ------------------------------------------------------------------
+    def enable_preemption_save(self, save_dir: str, *,
+                               client_state_fn: Optional[Callable[[], dict]] = None,
+                               exit_after: bool = True,
+                               exit_code: Optional[int] = None,
+                               signum: Optional[int] = None):
+        """Arm the TPU grace-window idiom: SIGTERM latches a flag (a
+        handler cannot checkpoint — saves run collectives mid-dispatch);
+        the next optimizer boundary performs ONE emergency
+        ``save_checkpoint(save_dir)`` carrying ``client_state_fn()`` (the
+        dataloader position, so resume is step-accurate) and, when
+        ``exit_after``, raises ``SystemExit`` with
+        :data:`~deepspeed_tpu.runtime.preemption.PREEMPTED_EXIT_CODE` so a
+        supervisor (``tools/train_supervisor.py``, elastic agent)
+        restarts-and-resumes instead of treating it as a crash."""
+        import signal as _signal
+
+        from deepspeed_tpu.runtime.preemption import (PREEMPTED_EXIT_CODE,
+                                                      PreemptionHandler)
+
+        if self._preempt is None:
+            self._preempt = PreemptionHandler()
+        self._preempt.install(signum if signum is not None
+                              else _signal.SIGTERM)
+        self._preempt_cfg = (save_dir, bool(exit_after),
+                             PREEMPTED_EXIT_CODE if exit_code is None
+                             else int(exit_code))
+        if client_state_fn is not None:
+            self._preempt_client_state_fn = client_state_fn
+        log_dist(f"preemption handler armed: SIGTERM -> emergency save to "
+                 f"{save_dir} at the next optimizer boundary", ranks=[0])
+        return self._preempt
+
+    def set_preemption_client_state(self, fn: Callable[[], dict]) -> None:
+        """Register the callable whose dict rides the emergency save's
+        ``client_state`` (dataloader position etc.)."""
+        self._preempt_client_state_fn = fn
+
+    def _preemption_tick(self) -> None:
+        """Boundary poll of the SIGTERM latch: emergency-save once, then
+        exit (when configured) with the preempted code.  One attribute
+        load + branch while nothing is pending (single-process)."""
+        if self._preempt is None:
+            return
+        requested = self._preempt.requested
+        if jax.process_count() > 1:
+            # Collective agreement: the signal can land while ranks sit on
+            # opposite sides of a boundary, and a rank-local decision
+            # would have them enter the save's collectives at DIFFERENT
+            # boundaries — a mismatch that hangs out the grace window.
+            # Any rank's latch preempts everyone, at the same boundary.
+            # Cost: one small host allgather per boundary, only while the
+            # handler is armed on a multi-process run.
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.asarray(requested, np.int32))
+            requested = bool(np.asarray(flags).max())
+        if not requested:
+            return
+        save_dir, exit_after, exit_code = self._preempt_cfg
+        tag = f"global_step{self.global_steps}"
+        client_state = {}
+        if self._preempt_client_state_fn is not None:
+            try:
+                client_state = dict(self._preempt_client_state_fn() or {})
+            except Exception as exc:
+                logger.error("preemption: client_state_fn failed: %s", exc)
+        self._flight.record("ckpt_emergency", tag=tag, step=self._host_steps,
+                            signal_time=self._preempt.signal_time)
+        get_registry().counter(
+            "ds_ckpt_emergency_saves_total",
+            "SIGTERM-triggered boundary emergency saves").inc()
+        path = self.save_checkpoint(save_dir, tag=tag,
+                                    client_state=client_state)
+        # cleared only AFTER the save succeeded: a transient save failure
+        # (exception propagates to the caller) leaves the latch set, so
+        # the next boundary retries instead of dropping the request
+        self._preempt.clear()
+        log_dist("preemption: emergency checkpoint %s saved; %s"
+                 % (path, "exiting for supervisor restart" if exit_after
+                    else "continuing (exit_after=False)"), ranks=[0])
+        if exit_after:
+            raise SystemExit(exit_code)
+
     def _flight_crash(self, exc: Exception) -> None:
         """Dump the event ring once, before the exception propagates."""
         if not self._flight.enabled or self._flight_dumped:
@@ -2133,13 +2239,20 @@ class DeepSpeedEngine:
         t0 = (time.perf_counter()
               if self._comm_plan is not None and comm_metrics.active
               else 0.0)
-        if self._param_offload:
-            gnorm, overflow = self._step_param_offload()
-        elif self._offload:
-            gnorm, overflow = self._step_offload()
-        else:
-            with annotate("ds_optimizer_step"):
-                self.state, gnorm, overflow = self._apply_fn(self.state)
+        try:
+            if self._param_offload:
+                gnorm, overflow = self._step_param_offload()
+            elif self._offload:
+                gnorm, overflow = self._step_offload()
+            else:
+                with annotate("ds_optimizer_step"):
+                    self.state, gnorm, overflow = self._apply_fn(self.state)
+        except BaseException:
+            # leave the timer re-startable: a caller that catches a
+            # mid-step failure and resumes from a checkpoint must not hit
+            # "timer already started" on the next boundary
+            self.timers(SynchronizedWallClockTimer.STEP).stop(record=False)
+            raise
         self.timers(SynchronizedWallClockTimer.STEP).stop()
         if t0 and self._comm_plan["boundary"]:
             comm_metrics.commit(self._comm_plan["boundary"],
@@ -2164,6 +2277,7 @@ class DeepSpeedEngine:
             self._trace.after_step(self._host_steps)
         self._watchdog_tick()
         self._aux_trace_tick()
+        self._preemption_tick()
 
     def _maybe_emit_flops_profile(self) -> None:
         if (self.flops_profiler is None
@@ -2351,9 +2465,14 @@ class DeepSpeedEngine:
               else 0.0)
         # the fused program runs fwd/bwd AND the update in one dispatch:
         # the host range cannot separate them (device scope rows can)
-        with annotate("ds_fwd_bwd"):
-            self.state, loss, gnorm, overflow = self._fused_fn(
-                self.state, stacked, rng)
+        try:
+            with annotate("ds_fwd_bwd"):
+                self.state, loss, gnorm, overflow = self._fused_fn(
+                    self.state, stacked, rng)
+        except BaseException:
+            # keep the timer re-startable across a caught mid-step failure
+            self.timers(SynchronizedWallClockTimer.STEP).stop(record=False)
+            raise
         self.timers(SynchronizedWallClockTimer.STEP).stop()
         if t0:
             # the fused program runs gas micro-batches + the boundary in one
@@ -2388,6 +2507,7 @@ class DeepSpeedEngine:
             self._trace.after_step(self._host_steps)
         self._watchdog_tick()
         self._aux_trace_tick()
+        self._preemption_tick()
         return loss
 
     def train_batch(self, data_iter=None):
@@ -2475,61 +2595,186 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
-        """Sharded, multi-host-safe save: every process writes only its
-        addressable shards (no full gather — reference layout role of
-        ``*_zero_pp_rank_*`` files, SURVEY.md §5.4; TPU plan = sharded index
-        layout via ShardedCheckpointEngine)."""
+        """Crash-atomic, sharded, multi-host-safe save (docs/RESILIENCE.md).
+
+        Every process writes only its addressable shards (no full gather —
+        reference layout role of ``*_zero_pp_rank_*`` files, SURVEY.md
+        §5.4), into a ``tmp.<tag>`` staging directory.  Rank 0 then writes
+        ``MANIFEST.json`` (per-file size + sha256, world_size, zero_stage,
+        format version) with every data file fsynced, the backend
+        ``commit`` runs, and ONLY then is the stage atomically renamed
+        into place and the ``latest`` pointer updated via tmp +
+        ``os.replace`` — a kill at any byte offset during the save leaves
+        ``latest`` naming a tag that still loads."""
         if self.state is None:
             raise RuntimeError("nothing to checkpoint: engine state not initialized")
-        tag = tag or f"global_step{self.global_steps}"
-        ckpt_dir = os.path.join(save_dir, str(tag))
-        os.makedirs(ckpt_dir, exist_ok=True)
+        from deepspeed_tpu.runtime.checkpoint_engine import atomic
+
+        tag = str(tag or f"global_step{self.global_steps}")
+        final_dir = os.path.join(save_dir, tag)
+        stage_dir = atomic.stage_path(save_dir, tag)
+        rank0 = comm.get_rank() == 0
+        # every process ensures the dirs exist (a non-shared filesystem
+        # would otherwise FileNotFoundError on non-zero ranks); only rank
+        # 0 clears crash debris — concurrent rmtrees could delete a
+        # freshly-created stage on a shared filesystem
+        os.makedirs(save_dir, exist_ok=True)
+        if rank0:
+            atomic.clear_stage(save_dir, tag)  # debris of a crashed save
+        os.makedirs(stage_dir, exist_ok=True)
         comm.barrier()
-        self.checkpoint_engine.create(str(tag))
+        self.checkpoint_engine.create(tag)
         self.checkpoint_engine.save(self.state.params,
-                                    os.path.join(ckpt_dir, "model_states"))
+                                    os.path.join(stage_dir, "model_states"))
         optim_payload = {"opt_state": self.state.opt_state,
                          "grad_acc": self.state.grad_acc,
                          "global_steps": self.state.global_steps,
                          "scaler": tuple(self.state.scaler)}
         self.checkpoint_engine.save(optim_payload,
-                                    os.path.join(ckpt_dir, "optim_states"))
-        if self._offload and comm.get_rank() == 0:
+                                    os.path.join(stage_dir, "optim_states"))
+        if self._offload and rank0:
             # host-resident fp32 master + moments, streamed one leaf at a time
-            self._offload_opt.write_state(os.path.join(ckpt_dir, "offload_states"))
-        if comm.get_rank() == 0:
+            self._offload_opt.write_state(os.path.join(stage_dir, "offload_states"))
+        if rank0:
             meta = {"client_state": client_state or {},
                     "micro_count": self._micro_count,
                     "lr_scheduler": (self.lr_scheduler.state_dict()
                                      if self.lr_scheduler else None),
                     "zero_stage": self.zero_stage,
                     "world_size": comm.get_world_size()}
-            with open(os.path.join(ckpt_dir, "client_state.json"), "w") as fh:
+            with open(os.path.join(stage_dir, "client_state.json"), "w") as fh:
                 json.dump(meta, fh, default=str)
-            if save_latest:
-                with open(os.path.join(save_dir, "latest"), "w") as fh:
-                    fh.write(str(tag))
+        comm.barrier()               # every process's shards are on disk
+        if rank0:
+            atomic.write_manifest(
+                stage_dir, tag,
+                extra={"world_size": comm.get_world_size(),
+                       "zero_stage": self.zero_stage,
+                       "global_steps": int(self.global_steps)})
         comm.barrier()
-        self.checkpoint_engine.commit(str(tag))
-        self._flight.record("checkpoint", tag=str(tag), dir=ckpt_dir)
-        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
-        return ckpt_dir
+        # The backend commit point.  Publication happens strictly AFTER it
+        # (regression-pinned: a crash between the shard writes and here
+        # must leave `latest` untouched — the pointer used to be written
+        # before commit, a window that published partial checkpoints).
+        self.checkpoint_engine.commit(tag)
+        if rank0:
+            atomic.publish_dir(stage_dir, final_dir)
+            if save_latest:
+                atomic.write_latest(save_dir, tag)
+            self._ckpt_gc(save_dir)
+        comm.barrier()
+        get_registry().counter("ds_ckpt_saves_total",
+                               "committed checkpoint saves").inc()
+        self._flight.record("checkpoint", tag=tag, dir=final_dir)
+        log_dist(f"saved checkpoint {final_dir}", ranks=[0])
+        return final_dir
+
+    def _ckpt_gc(self, save_dir: str) -> None:
+        """Retention GC (``checkpoint.keep_last_n``): after a successful
+        commit, delete the oldest VALID tags beyond the budget — never the
+        tag ``latest`` points to, and never unverifiable/corrupt dirs
+        (kept as post-mortem evidence).  ``ds_ckpt_retained`` publishes
+        the surviving tag count either way."""
+        from deepspeed_tpu.runtime.checkpoint_engine import atomic
+
+        keep = self.config.checkpoint_config.keep_last_n
+        # any .trash.* here is a leak from a publish that crashed between
+        # rename-aside and cleanup (checkpoint-sized, invisible to tags)
+        for name in atomic.sweep_trash(save_dir):
+            log_dist(f"checkpoint GC: removed crashed-publish debris "
+                     f"{name}", ranks=[0])
+        tags = atomic.list_tags(save_dir)
+        if keep and keep > 0:
+            import shutil
+
+            latest = atomic.read_latest(save_dir)
+            valid = [t for t in tags
+                     if atomic.verify_dir(os.path.join(save_dir, t),
+                                          level="fast").ok]
+            for t in valid[keep:]:
+                if t == latest:
+                    continue
+                shutil.rmtree(os.path.join(save_dir, t), ignore_errors=True)
+                self._flight.record("ckpt_gc", tag=t)
+                log_dist(f"checkpoint GC: deleted tag {t} "
+                         f"(keep_last_n={keep})", ranks=[0])
+            tags = atomic.list_tags(save_dir)
+        get_registry().gauge(
+            "ds_ckpt_retained",
+            "checkpoint tags retained in the save dir after GC").set(
+            len(tags))
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_module_strict: bool = True, load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
-        if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
-                logger.warning("no 'latest' file in %s; cannot load", load_dir)
-                return None, {}
-            with open(latest) as fh:
-                tag = fh.read().strip()
-        ckpt_dir = os.path.join(load_dir, str(tag))
+        """Verified load with walk-back (docs/RESILIENCE.md): the
+        requested tag (or the one ``latest`` names) is manifest-verified
+        before any bytes are resharded; a corrupt / partial / missing tag
+        records ``ds_ckpt_verify_failures_total`` plus a flight-recorder
+        event and the loader walks back to the newest valid tag
+        (``ds_ckpt_fallbacks_total``) instead of crashing.  Returns
+        ``(ckpt_dir, client_state)``, or ``(None, {})`` when nothing
+        loadable exists."""
         if self.state is None:
             raise RuntimeError("load_checkpoint requires initialized state "
                                "(pass model_parameters or run one batch first)")
+        from deepspeed_tpu.runtime.checkpoint_engine import atomic
+
+        requested = (str(tag) if tag is not None
+                     else atomic.read_latest(load_dir))
+        candidates = [requested] if requested else []
+        for t in atomic.list_tags(load_dir):
+            if t not in candidates:
+                candidates.append(t)
+        if not candidates:
+            logger.warning("no 'latest' pointer or checkpoint tags in %s; "
+                           "cannot load", load_dir)
+            return None, {}
+        verify = self.config.checkpoint_config.verify_on_load
+        reg = get_registry()
+        for i, t in enumerate(candidates):
+            ckpt_dir = os.path.join(load_dir, t)
+            if verify:
+                st = atomic.verify_dir(ckpt_dir, level="full")
+                if st.state == "no_manifest":
+                    logger.warning("checkpoint %s has no MANIFEST.json "
+                                   "(pre-manifest save): loading "
+                                   "unverified", ckpt_dir)
+                elif not st.ok:
+                    reg.counter(
+                        "ds_ckpt_verify_failures_total",
+                        "checkpoint tags that failed manifest verification "
+                        "at load").inc()
+                    self._flight.record("ckpt_verify_fail", tag=t,
+                                        state=st.state,
+                                        problems=st.problems[:3])
+                    logger.warning(
+                        "checkpoint %s failed verification (%s): %s — "
+                        "walking back", ckpt_dir, st.state,
+                        "; ".join(st.problems[:3]) or "?")
+                    continue
+            result = self._load_checkpoint_dir(
+                ckpt_dir, load_optimizer_states, load_lr_scheduler_states,
+                load_module_only)
+            if i > 0:
+                reg.counter(
+                    "ds_ckpt_fallbacks_total",
+                    "loads that fell back to an older valid tag").inc()
+                self._flight.record("ckpt_fallback",
+                                    requested=candidates[0], loaded=t)
+                logger.warning("checkpoint fallback: tag %r was unloadable; "
+                               "resumed from %r instead", candidates[0], t)
+            reg.counter("ds_resume_total",
+                        "successful checkpoint loads (resumes)").inc()
+            return result
+        logger.warning("no valid checkpoint in %s (tried %s)", load_dir,
+                       candidates)
+        return None, {}
+
+    def _load_checkpoint_dir(self, ckpt_dir: str, load_optimizer_states: bool,
+                             load_lr_scheduler_states: bool,
+                             load_module_only: bool):
         from deepspeed_tpu.runtime.checkpoint_engine import is_sharded_checkpoint
 
         if not is_sharded_checkpoint(os.path.join(ckpt_dir, "model_states")):
@@ -2566,6 +2811,7 @@ class DeepSpeedEngine:
                 global_steps=jnp.asarray(opt["global_steps"], jnp.int32),
                 scaler=scaler_lib.LossScaleState(*[jnp.asarray(x) for x in opt["scaler"]]))
             self._host_steps = int(jax.device_get(opt["global_steps"]))
+            self._micro_count = int(meta.get("micro_count", 0) or 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.state = new_state

@@ -1,0 +1,88 @@
+"""Preemption handling: SIGTERM -> emergency save at the next optimizer
+boundary.
+
+TPU preemption is a routine scheduling event, delivered as SIGTERM with a
+grace window.  A signal handler cannot checkpoint (saves run collectives
+and touch jax state mid-dispatch), so the handler only RAISES A FLAG; the
+engine polls it at every optimizer boundary — the same boundary-hook slot
+the watchdog and ``/profilez`` captures use — performs one emergency
+``save_checkpoint``, and (by default) exits with
+:data:`PREEMPTED_EXIT_CODE` so a supervisor (``tools/train_supervisor.py``
+or the elastic agent) can distinguish "preempted after a clean save"
+from a crash and restart without shrinking the world.
+
+Stdlib-only on purpose: the supervisor runs on boxes without jax and
+mirrors the exit-code contract (``DS_PREEMPT_EXIT_CODE`` overrides both
+sides).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+__all__ = ["PREEMPTED_EXIT_CODE", "PreemptionHandler"]
+
+# Exit status of a process that took its emergency save and left on
+# purpose.  243 sits above the shell/signal ranges (126-128+N) and below
+# 255; tools/train_supervisor.py carries the same default.
+PREEMPTED_EXIT_CODE = int(os.environ.get("DS_PREEMPT_EXIT_CODE", "243"))
+
+
+class PreemptionHandler:
+    """Latched SIGTERM flag, polled at optimizer boundaries.
+
+    The handler chains to any previously-installed handler (a host
+    framework's own SIGTERM bookkeeping keeps running) and is restored by
+    :meth:`uninstall`.  ``install`` is explicit — a library must not take
+    over process signals unasked (the flight-recorder rule).
+    """
+
+    def __init__(self) -> None:
+        self._requested = False
+        self.signal_time: Optional[float] = None
+        self._installed_signal: Optional[int] = None
+        self._prev_handler = None
+
+    # -- signal side ----------------------------------------------------
+    def install(self, signum: int = signal.SIGTERM) -> "PreemptionHandler":
+        if self._installed_signal == signum:
+            return self
+
+        def _handler(sig, frame):
+            self._requested = True
+            self.signal_time = time.time()
+            prev = self._prev_handler
+            if callable(prev):
+                prev(sig, frame)
+
+        self._prev_handler = signal.signal(signum, _handler)
+        self._installed_signal = signum
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed_signal is None:
+            return
+        try:
+            signal.signal(self._installed_signal,
+                          self._prev_handler or signal.SIG_DFL)
+        except (ValueError, OSError):  # pragma: no cover - non-main thread
+            pass
+        self._installed_signal = None
+        self._prev_handler = None
+
+    # -- boundary side --------------------------------------------------
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def request(self) -> None:
+        """Programmatic preemption (tests, chaos harness): same latch the
+        signal sets."""
+        self._requested = True
+        self.signal_time = time.time()
+
+    def clear(self) -> None:
+        self._requested = False

@@ -244,6 +244,13 @@ class ServingEngine:
             buckets=tuple(i / 16 for i in range(1, 17)))
         self._m_step_finished = reg.gauge(
             "ds_serve_step_finished", "requests drained by the last step")
+        # graceful drain (docs/RESILIENCE.md): 1 for the whole drain()
+        # window — the same signal /healthz serves as 503
+        self._draining = False
+        self._m_draining = reg.gauge(
+            "ds_serve_draining",
+            "1 while drain() runs (admission stopped, in-flight requests "
+            "finishing); 0 otherwise")
         # paged-KV pool health (registered unconditionally so the metrics
         # namespace guard covers them; zero-valued on fixed-slot engines)
         self._m_pages_used = reg.gauge(
@@ -283,6 +290,11 @@ class ServingEngine:
                eos_token_id: Optional[int] = None) -> Request:
         """Enqueue one request; returns the live Request handle (its
         ``output_tokens`` fill in as the scheduler serves it)."""
+        if self._draining or self.scheduler.admission_paused:
+            raise RuntimeError(
+                "engine is draining/drained: not admitting new requests "
+                "(the router should have stopped sending — /healthz is "
+                "503; resume_admission() re-opens)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -345,11 +357,93 @@ class ServingEngine:
         return finished
 
     def run(self) -> List[Request]:
-        """Drain: iterate until queue and slots are empty; returns finished
-        requests in completion order."""
+        """Serve to empty: iterate until queue and slots are empty; returns
+        finished requests in completion order.  With admission paused (the
+        state ``drain()`` leaves behind) and only queued work remaining,
+        returns instead of spinning — queued requests cannot be admitted
+        until :meth:`resume_admission`."""
         while self.scheduler.has_work:
+            if (self.scheduler.admission_paused
+                    and self.scheduler.num_occupied == 0
+                    and not self._outstanding):
+                break
             self.step()
         return self.scheduler.finished
+
+    # ------------------------------------------------------------------
+    # graceful drain (docs/RESILIENCE.md; the router drain signal of
+    # ROADMAP item 3)
+    # ------------------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> List[Request]:
+        """Stop admission and finish every in-flight request.
+
+        For the whole drain window: ``submit()`` raises, the scheduler
+        hands out no new slots, ``/healthz`` reports not-ready (503), and
+        ``ds_serve_draining`` reads 1.  Already-admitted requests
+        (prefilling or decoding) run to completion TOKEN-IDENTICALLY —
+        the per-slot decode path is untouched, admission is the only
+        thing gated.  Requests still queued (never admitted) stay in the
+        queue for the caller/router to re-dispatch.
+
+        Readiness stays ``not ready`` after the drain completes (the
+        process is about to go away); call :meth:`resume_admission` to
+        take traffic again.  Returns the requests that finished during
+        the drain; with ``timeout`` (seconds) the loop stops early and
+        returns what finished, leaving the rest in flight."""
+        from deepspeed_tpu.monitor.health import get_health
+
+        if self._draining:
+            return []
+        self._draining = True
+        self.scheduler.pause_admission()
+        self._m_draining.set(1)
+        get_health().set_not_ready("draining")
+        inflight = self.scheduler.running() + self.scheduler.prefilling()
+        if self._flight.enabled:
+            self._flight.record("serve_drain_start",
+                                occupied=self.scheduler.num_occupied,
+                                queued=self.scheduler.num_queued,
+                                rids=[r.request_id for r in inflight][:32])
+        done_before = len(self.scheduler.finished)
+        t0 = time.perf_counter()
+        timed_out = False
+        try:
+            while self.scheduler.num_occupied > 0:
+                if timeout is not None and time.perf_counter() - t0 > timeout:
+                    timed_out = True
+                    break
+                self.step()
+        finally:
+            self._m_draining.set(0)
+            self._draining = False
+            finished = self.scheduler.finished[done_before:]
+            if self._flight.enabled:
+                self._flight.record(
+                    "serve_drain_done", finished=len(finished),
+                    timed_out=timed_out,
+                    queued=self.scheduler.num_queued,
+                    seconds=time.perf_counter() - t0,
+                    rids=[r.request_id for r in finished][:32])
+            log_dist(f"serving drain: {len(finished)} request(s) finished"
+                     + (", TIMED OUT with slots still occupied"
+                        if timed_out else "")
+                     + f"; {self.scheduler.num_queued} left queued "
+                     f"(admission stays paused; /healthz not-ready)",
+                     ranks=[0])
+        return finished
+
+    def resume_admission(self) -> None:
+        """Undo :meth:`drain`: admission resumes and ``/healthz`` reports
+        ready again (a drained-but-not-terminated replica rejoining the
+        router pool)."""
+        from deepspeed_tpu.monitor.health import get_health
+
+        self.scheduler.resume_admission()
+        get_health().set_ready()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # ------------------------------------------------------------------
     # /profilez: on-demand device-true capture over scheduler iterations

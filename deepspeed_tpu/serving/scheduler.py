@@ -117,6 +117,9 @@ class IterationScheduler:
         self._queue: Deque[Request] = deque()
         self._slots: List[Optional[Request]] = [None] * num_slots
         self.finished: List[Request] = []
+        # drain support (ServingEngine.drain): while paused, admit() hands
+        # out no slots — queued requests wait, occupied slots run dry
+        self.admission_paused = False
         self._ids = _REQUEST_IDS
         # per-request span tracing + flight-recorder request events (both
         # disabled-by-default one-branch no-ops; the scheduler owns the
@@ -159,9 +162,20 @@ class IterationScheduler:
     def free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._slots) if r is None]
 
+    def pause_admission(self) -> None:
+        """Stop handing out slots (drain): queued requests stay queued,
+        running slots finish naturally.  Reversible via
+        :meth:`resume_admission`."""
+        self.admission_paused = True
+
+    def resume_admission(self) -> None:
+        self.admission_paused = False
+
     def admit(self) -> List[Request]:
         """Assign free slots to the oldest queued requests (FIFO); returns
         the newly-admitted requests, now in PREFILLING state."""
+        if self.admission_paused:
+            return []
         admitted = []
         for slot in self.free_slots():
             if not self._queue:

@@ -1,0 +1,9 @@
+"""Testing utilities: the fault-injection harness (``chaos.py``) behind
+the resilience suite (docs/RESILIENCE.md)."""
+
+from deepspeed_tpu.testing.chaos import (InjectedFault, crash_before,
+                                         crash_on_write, fail_after_calls,
+                                         flip_bit, truncate_file)
+
+__all__ = ["InjectedFault", "crash_on_write", "crash_before",
+           "fail_after_calls", "truncate_file", "flip_bit"]

@@ -1,0 +1,178 @@
+"""Fault-injection harness: the crash vocabulary behind the resilience
+suite (docs/RESILIENCE.md).
+
+The checkpoint atomicity contract is only worth anything if it is proved
+against actual mid-write deaths, and a unit test cannot SIGKILL itself at
+byte 1337 of a shard file.  This module supplies the equivalent faults as
+injectable, deterministic primitives:
+
+- :func:`crash_on_write` — "process dies at byte offset N of the save":
+  patches ``builtins.open`` so matched files' writes cut off after a
+  cumulative byte budget and raise :class:`InjectedFault`.  The partial
+  prefix IS flushed to disk first, so the on-disk state equals what a
+  kill at that offset leaves behind (no cleanup code runs — the save
+  aborts mid-flight exactly like a death would, modulo OS page-cache
+  durability, which the atomicity contract does not depend on).
+- :func:`crash_before` — "process dies right before method M": the
+  between-the-barriers probe (e.g. after all shards are written but
+  before ``checkpoint_engine.commit``).
+- :func:`truncate_file` / :func:`flip_bit` — post-save storage faults
+  (torn tail, silent media corruption) that manifest verification must
+  catch.
+- :func:`fail_after_calls` — an exception out of the Nth call of any
+  method ("exception mid-step").
+
+Process-level faults (SIGKILL between incarnations, SIGTERM grace
+windows) are exercised by the supervisor tests via real subprocesses;
+this module covers the intra-process byte-level vocabulary those cannot
+aim precisely.
+
+Stdlib-only; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+__all__ = ["InjectedFault", "crash_on_write", "crash_before",
+           "fail_after_calls", "truncate_file", "flip_bit"]
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected failure (never raised by real code paths,
+    so tests can assert on the type)."""
+
+
+class _CrashingWriter:
+    """File proxy that writes through until the shared byte budget is
+    exhausted, flushes the partial prefix, and dies."""
+
+    def __init__(self, fh, state: Dict[str, Any]):
+        self._fh = fh
+        self._state = state
+
+    def write(self, data):
+        n = len(data)
+        room = self._state["budget"] - self._state["written"]
+        if room <= 0:
+            raise InjectedFault(
+                f"injected crash at byte {self._state['budget']} of save")
+        if n > room:
+            self._fh.write(data[:room])
+            self._fh.flush()
+            self._state["written"] += room
+            raise InjectedFault(
+                f"injected crash at byte {self._state['budget']} of save "
+                f"(mid-write of {getattr(self._fh, 'name', '?')})")
+        self._state["written"] += n
+        return self._fh.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._fh, name)
+
+    def __enter__(self):
+        self._fh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._fh.__exit__(*exc)
+
+    def __iter__(self):  # pragma: no cover - completeness
+        return iter(self._fh)
+
+
+@contextmanager
+def crash_on_write(after_bytes: int, path_substr: str = "",
+                   ) -> Iterator[Dict[str, Any]]:
+    """Kill the next save at a chosen byte offset.
+
+    Every file opened for writing whose path contains ``path_substr``
+    shares one ``after_bytes`` budget; the write that crosses it flushes
+    the in-budget prefix and raises :class:`InjectedFault`.  Yields the
+    shared state dict (``written`` tells how far the "crash" got).
+
+    ``after_bytes=0`` dies on the very first write — the earliest
+    possible mid-save death."""
+    state = {"budget": int(after_bytes), "written": 0}
+    real_open = builtins.open
+
+    def fake_open(file, mode="r", *args, **kwargs):
+        fh = real_open(file, mode, *args, **kwargs)
+        if any(m in mode for m in ("w", "x", "a", "+")) \
+                and path_substr in str(file):
+            return _CrashingWriter(fh, state)
+        return fh
+
+    builtins.open = fake_open
+    try:
+        yield state
+    finally:
+        builtins.open = real_open
+
+
+@contextmanager
+def crash_before(obj: Any, method: str) -> Iterator[None]:
+    """Die immediately before ``obj.method`` runs — the probe for
+    ordering bugs between two barriers (e.g. everything written, commit
+    never reached: ``latest`` must not have moved)."""
+    real = getattr(obj, method)
+
+    def bomb(*_a, **_k):
+        raise InjectedFault(f"injected crash before {method}")
+
+    setattr(obj, method, bomb)
+    try:
+        yield
+    finally:
+        setattr(obj, method, real)
+
+
+@contextmanager
+def fail_after_calls(obj: Any, method: str, n: int) -> Iterator[Dict[str, int]]:
+    """Let ``obj.method`` succeed ``n`` times, then raise
+    :class:`InjectedFault` from every later call ("exception
+    mid-step")."""
+    real = getattr(obj, method)
+    state = {"calls": 0}
+
+    def wrapped(*args, **kwargs):
+        state["calls"] += 1
+        if state["calls"] > n:
+            raise InjectedFault(
+                f"injected failure on call {state['calls']} of {method}")
+        return real(*args, **kwargs)
+
+    setattr(obj, method, wrapped)
+    try:
+        yield state
+    finally:
+        setattr(obj, method, real)
+
+
+def truncate_file(path: str, drop_bytes: int = 1) -> int:
+    """Torn-tail storage fault: cut ``drop_bytes`` off the end of a file
+    (post-save truncation).  Returns the new size."""
+    size = os.path.getsize(path)
+    new = max(0, size - int(drop_bytes))
+    with open(path, "rb+") as fh:
+        fh.truncate(new)
+    return new
+
+
+def flip_bit(path: str, byte_offset: Optional[int] = None,
+             bit: int = 0) -> int:
+    """Silent media corruption: flip one bit in place (default: the
+    middle byte).  Returns the byte offset flipped."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot flip a bit in empty file {path}")
+    off = size // 2 if byte_offset is None else int(byte_offset)
+    with open(path, "rb+") as fh:
+        fh.seek(off)
+        b = fh.read(1)
+        fh.seek(off)
+        fh.write(bytes([b[0] ^ (1 << bit)]))
+    return off

@@ -1,0 +1,571 @@
+"""Preemption-safe training: crash-atomic checkpoints, verified load with
+walk-back, retention GC, SIGTERM emergency saves, and the offline
+verifier (docs/RESILIENCE.md).
+
+The acceptance bar: a kill at ANY point during ``save_checkpoint`` never
+leaves ``latest`` pointing at a checkpoint that fails to load, and every
+corruption the manifest can express (torn tail, bit flip, missing files,
+missing tag) makes the loader walk back to the newest valid tag instead
+of crashing.  Faults come from the injection harness
+(``deepspeed_tpu/testing/chaos.py``)."""
+
+import glob
+import json
+import os
+import shutil
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.metrics import get_registry
+from deepspeed_tpu.runtime.checkpoint_engine import atomic
+from deepspeed_tpu.testing import chaos
+from tests.unit.simple_model import SimpleModel, random_dataset
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _make_engine(stage=0, ckpt_cfg=None):
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": stage},
+           "steps_per_print": 10**9}
+    if ckpt_cfg:
+        cfg["checkpoint"] = ckpt_cfg
+    x, y = random_dataset(n=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg,
+        rng=jax.random.PRNGKey(3))
+    return engine, (x[:8], y[:8])
+
+
+def _train_steps(engine, batch, n=1):
+    loss = None
+    for _ in range(n):
+        loss = engine.forward(batch)
+        engine.step()
+    return loss
+
+
+def _params_snapshot(engine):
+    # OWNED copies: on CPU, device_get can return views aliasing device
+    # buffers that the next (donating) train step mutates in place
+    return jax.tree.map(lambda x: np.array(x),
+                        jax.device_get(engine.state.params))
+
+
+def _assert_params_equal(engine, snap):
+    for a, b in zip(jax.tree.leaves(snap),
+                    jax.tree.leaves(jax.device_get(engine.state.params))):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# atomic layout unit tests (no engine)
+# ---------------------------------------------------------------------------
+
+
+def _toy_ckpt(tmp_path, tag="t", payload=b"a" * 4096):
+    d = tmp_path / tag
+    (d / "model_states").mkdir(parents=True)
+    (d / "model_states" / "shard_p0.bin").write_bytes(payload)
+    (d / "client_state.json").write_text(json.dumps({"client_state": {}}))
+    atomic.write_manifest(str(d), tag, extra={"world_size": 1,
+                                              "zero_stage": 0})
+    return str(d)
+
+
+def test_manifest_write_and_verify(tmp_path):
+    d = _toy_ckpt(tmp_path)
+    st = atomic.verify_dir(d)
+    assert st.ok and st.state == "valid"
+    m = st.manifest
+    assert m["format_version"] == atomic.FORMAT_VERSION
+    assert m["world_size"] == 1 and m["zero_stage"] == 0
+    # every file except the manifest itself is covered, with size + sha256
+    assert set(m["files"]) == {"model_states/shard_p0.bin",
+                               "client_state.json"}
+    for meta in m["files"].values():
+        assert meta["nbytes"] > 0 and len(meta["sha256"]) == 64
+
+
+def test_verify_catches_truncation_size_only(tmp_path):
+    d = _toy_ckpt(tmp_path)
+    chaos.truncate_file(os.path.join(d, "model_states", "shard_p0.bin"), 7)
+    st = atomic.verify_dir(d, level="fast")      # no checksums needed
+    assert st.state == "corrupt"
+    assert any("size mismatch" in p for p in st.problems)
+
+
+def test_verify_catches_bit_flip_full_only(tmp_path):
+    d = _toy_ckpt(tmp_path)
+    chaos.flip_bit(os.path.join(d, "model_states", "shard_p0.bin"))
+    assert atomic.verify_dir(d, level="fast").ok      # size unchanged
+    st = atomic.verify_dir(d, level="full")
+    assert st.state == "corrupt"
+    assert any("checksum mismatch" in p for p in st.problems)
+
+
+def test_verify_catches_missing_file_and_dir(tmp_path):
+    d = _toy_ckpt(tmp_path)
+    os.remove(os.path.join(d, "client_state.json"))
+    st = atomic.verify_dir(d)
+    assert st.state == "corrupt"
+    assert any("missing file" in p for p in st.problems)
+    assert atomic.verify_dir(str(tmp_path / "nope")).state == "missing"
+    shutil.rmtree(os.path.join(d))
+    assert atomic.verify_dir(d).state == "missing"
+
+
+def test_list_tags_excludes_stage_and_orders_newest_first(tmp_path):
+    _toy_ckpt(tmp_path, "older")
+    _toy_ckpt(tmp_path, "newer")
+    os.makedirs(tmp_path / (atomic.TMP_PREFIX + "staged"))
+    (tmp_path / "latest").write_text("newer")       # plain file: not a tag
+    assert atomic.list_tags(str(tmp_path)) == ["newer", "older"]
+
+
+def test_latest_pointer_roundtrip(tmp_path):
+    assert atomic.read_latest(str(tmp_path)) is None
+    atomic.write_latest(str(tmp_path), "global_step7")
+    assert atomic.read_latest(str(tmp_path)) == "global_step7"
+    # atomic replace: no .tmp debris left behind
+    assert [n for n in os.listdir(tmp_path) if n.startswith("latest")] == \
+        ["latest"]
+
+
+# ---------------------------------------------------------------------------
+# chaos-primitive contracts
+# ---------------------------------------------------------------------------
+
+
+def test_crash_on_write_cuts_at_exact_offset(tmp_path):
+    target = str(tmp_path / "f.bin")
+    with pytest.raises(chaos.InjectedFault):
+        with chaos.crash_on_write(10, str(tmp_path)):
+            with open(target, "wb") as fh:
+                fh.write(b"x" * 6)       # under budget
+                fh.write(b"y" * 6)       # crosses it: 4 more land, then die
+    assert os.path.getsize(target) == 10      # the partial prefix IS on disk
+    # unmatched paths are untouched
+    with chaos.crash_on_write(0, str(tmp_path / "only")):
+        (tmp_path / "other.txt").write_text("fine")
+
+
+def test_fail_after_calls(tmp_path):
+    class Thing:
+        def hit(self):
+            return "ok"
+
+    t = Thing()
+    with chaos.fail_after_calls(t, "hit", 2) as state:
+        assert t.hit() == "ok" and t.hit() == "ok"
+        with pytest.raises(chaos.InjectedFault):
+            t.hit()
+        assert state["calls"] == 3
+    assert t.hit() == "ok"               # restored
+
+
+# ---------------------------------------------------------------------------
+# crash-atomic engine saves: kill anywhere, `latest` still loads
+# ---------------------------------------------------------------------------
+
+
+def test_kill_at_any_byte_offset_mid_save_never_corrupts_latest(tmp_path):
+    """The acceptance sweep: inject a crash at byte offsets spanning the
+    whole save (first write → almost-done) and prove ``latest`` still
+    names a tag that verifies AND loads after every single one."""
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    p1 = _params_snapshot(engine)
+    total = sum(os.path.getsize(os.path.join(root, f))
+                for root, _d, files in os.walk(os.path.join(save_dir, "t1"))
+                for f in files)
+    assert total > 1000
+    _train_steps(engine, batch)          # diverge from t1
+
+    offsets = [0, 1, 333, total // 2, total - 100]
+    for i, off in enumerate(offsets):
+        tag = f"crash{i}"
+        with pytest.raises(chaos.InjectedFault):
+            with chaos.crash_on_write(off, save_dir):
+                engine.save_checkpoint(save_dir, tag=tag)
+        # the pointer never moved, the dead tag never published
+        assert atomic.read_latest(save_dir) == "t1"
+        assert not os.path.exists(os.path.join(save_dir, tag))
+        assert atomic.list_tags(save_dir) == ["t1"]
+        st = atomic.verify_dir(os.path.join(save_dir, "t1"), level="full")
+        assert st.ok, (off, st.problems)
+
+    # ...and the surviving checkpoint actually LOADS (not just verifies)
+    ckpt_dir, _ = engine.load_checkpoint(save_dir)
+    assert ckpt_dir.endswith("t1")
+    _assert_params_equal(engine, p1)
+
+    # a later clean save publishes normally over the crash debris
+    ckpt = engine.save_checkpoint(save_dir, tag="t2")
+    assert atomic.read_latest(save_dir) == "t2"
+    assert atomic.verify_dir(ckpt, level="full").ok
+
+
+def test_regression_latest_is_written_only_after_commit(tmp_path):
+    """The pinned ordering bug: `latest` used to be written (plain
+    open/write) BEFORE ``checkpoint_engine.commit`` — a crash between the
+    two barriers published a partial checkpoint.  Kill exactly there and
+    assert the pointer never moved, even though every shard is already on
+    disk."""
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    _train_steps(engine, batch)
+    with pytest.raises(chaos.InjectedFault):
+        with chaos.crash_before(engine.checkpoint_engine, "commit"):
+            engine.save_checkpoint(save_dir, tag="t2")
+    assert atomic.read_latest(save_dir) == "t1"
+    assert not os.path.exists(os.path.join(save_dir, "t2"))
+    # everything was staged (the crash hit between write and commit),
+    # proving the kill window is exactly the old bug's
+    stage = atomic.stage_path(save_dir, "t2")
+    assert os.path.isdir(stage)
+    assert os.path.exists(os.path.join(stage, atomic.MANIFEST_NAME))
+    # the stale stage is debris, not a tag; the next save clears it
+    assert atomic.list_tags(save_dir) == ["t1"]
+    engine.save_checkpoint(save_dir, tag="t2")
+    assert not os.path.isdir(stage)
+    assert atomic.read_latest(save_dir) == "t2"
+
+
+# ---------------------------------------------------------------------------
+# verified load: corrupt/truncated/missing tag -> walk back to newest valid
+# ---------------------------------------------------------------------------
+
+
+def _corruption_fallback_case(tmp_path, corrupt):
+    """Save t1, t2; corrupt t2 via ``corrupt(t2_dir)``; load must fall
+    back to t1 and account for it."""
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    p1 = _params_snapshot(engine)
+    _train_steps(engine, batch)
+    engine.save_checkpoint(save_dir, tag="t2")
+    corrupt(os.path.join(save_dir, "t2"))
+
+    reg = get_registry()
+    reg.enable()
+    fails0 = reg.counter("ds_ckpt_verify_failures_total").value
+    fb0 = reg.counter("ds_ckpt_fallbacks_total").value
+    flight = get_flight_recorder()
+    flight.reset()
+    flight.enable()
+    try:
+        ckpt_dir, _ = engine.load_checkpoint(save_dir)   # latest -> t2
+        assert ckpt_dir is not None and ckpt_dir.endswith("t1")
+        _assert_params_equal(engine, p1)
+        assert reg.counter("ds_ckpt_verify_failures_total").value - fails0 >= 1
+        assert reg.counter("ds_ckpt_fallbacks_total").value - fb0 == 1
+        kinds = [e["kind"] for e in flight.events()]
+        assert "ckpt_verify_fail" in kinds
+        assert "ckpt_fallback" in kinds
+        fb = [e for e in flight.events() if e["kind"] == "ckpt_fallback"][-1]
+        assert fb["requested"] == "t2" and fb["loaded"] == "t1"
+    finally:
+        flight.disable()
+        reg.disable()
+
+
+def test_bit_flipped_model_states_falls_back(tmp_path):
+    def corrupt(d):
+        shard = glob.glob(os.path.join(d, "model_states", "shard_p*.bin"))[0]
+        chaos.flip_bit(shard)
+
+    _corruption_fallback_case(tmp_path, corrupt)
+
+
+def test_truncated_optim_states_falls_back(tmp_path):
+    def corrupt(d):
+        shard = glob.glob(os.path.join(d, "optim_states", "shard_p*.bin"))[0]
+        chaos.truncate_file(shard, drop_bytes=64)
+
+    _corruption_fallback_case(tmp_path, corrupt)
+
+
+def test_missing_tag_dir_falls_back(tmp_path):
+    _corruption_fallback_case(tmp_path, shutil.rmtree)
+
+
+def test_lost_latest_pointer_still_resumes_newest_valid(tmp_path):
+    """latest itself vanishing (partial dir loss) walks back through
+    list_tags instead of giving up."""
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    _train_steps(engine, batch)
+    engine.save_checkpoint(save_dir, tag="t2")
+    p2 = _params_snapshot(engine)
+    os.remove(os.path.join(save_dir, "latest"))
+    _train_steps(engine, batch)          # diverge in memory
+    ckpt_dir, _ = engine.load_checkpoint(save_dir)
+    assert ckpt_dir.endswith("t2")       # newest valid by manifest time
+    _assert_params_equal(engine, p2)
+
+
+def test_nothing_loadable_returns_none(tmp_path):
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    assert engine.load_checkpoint(str(tmp_path)) == (None, {})
+    # a save dir where every tag is corrupt also degrades to (None, {})
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    shard = glob.glob(os.path.join(save_dir, "t1", "model_states",
+                                   "shard_p*.bin"))[0]
+    chaos.flip_bit(shard)
+    assert engine.load_checkpoint(save_dir) == (None, {})
+
+
+# ---------------------------------------------------------------------------
+# retention GC
+# ---------------------------------------------------------------------------
+
+
+def test_retention_gc_keeps_last_n_and_latest(tmp_path):
+    engine, batch = _make_engine(ckpt_cfg={"keep_last_n": 2})
+    save_dir = str(tmp_path)
+    reg = get_registry()
+    reg.enable()
+    try:
+        for i in range(1, 5):
+            _train_steps(engine, batch)
+            engine.save_checkpoint(save_dir, tag=f"t{i}")
+        assert atomic.list_tags(save_dir) == ["t4", "t3"]
+        assert atomic.read_latest(save_dir) == "t4"
+        assert reg.gauge("ds_ckpt_retained").value == 2
+        # the survivors still load
+        ckpt_dir, _ = engine.load_checkpoint(save_dir)
+        assert ckpt_dir.endswith("t4")
+    finally:
+        reg.disable()
+
+
+def test_retention_gc_never_deletes_latest_even_if_old(tmp_path):
+    """latest pinned to an OLD tag (operator rollback): GC must keep it
+    alive alongside the newest keep_last_n."""
+    engine, batch = _make_engine(ckpt_cfg={"keep_last_n": 1})
+    save_dir = str(tmp_path)
+    _train_steps(engine, batch)
+    engine.save_checkpoint(save_dir, tag="pinned")
+    atomic.write_latest(save_dir, "pinned")
+    for i in range(2):
+        _train_steps(engine, batch)
+        engine.save_checkpoint(save_dir, tag=f"n{i}", save_latest=False)
+    tags = atomic.list_tags(save_dir)
+    assert "pinned" in tags              # latest survived the budget
+    assert "n1" in tags                  # newest valid kept
+    assert "n0" not in tags              # oldest beyond budget collected
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM -> emergency save at the next optimizer boundary
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_emergency_save_at_boundary(tmp_path):
+    engine, batch = _make_engine()
+    save_dir = str(tmp_path)
+    handler = engine.enable_preemption_save(
+        save_dir, client_state_fn=lambda: {"data_step": 41},
+        exit_after=False)
+    flight = get_flight_recorder()
+    flight.reset()
+    flight.enable()
+    reg = get_registry()
+    reg.enable()
+    em0 = reg.counter("ds_ckpt_emergency_saves_total").value
+    try:
+        _train_steps(engine, batch)              # no signal: no save
+        assert atomic.read_latest(save_dir) is None
+        os.kill(os.getpid(), signal.SIGTERM)     # the grace-window signal
+        assert handler.requested
+        _train_steps(engine, batch)              # boundary takes the save
+        tag = atomic.read_latest(save_dir)
+        assert tag == "global_step2"
+        st = atomic.verify_dir(os.path.join(save_dir, tag), level="full")
+        assert st.ok
+        # dataloader position rode along for a step-accurate resume
+        _, client_state = engine.load_checkpoint(save_dir)
+        assert client_state == {"data_step": 41}
+        assert not handler.requested             # latched once, cleared
+        kinds = [e["kind"] for e in flight.events()]
+        assert "ckpt_emergency" in kinds
+        assert reg.counter("ds_ckpt_emergency_saves_total").value - em0 == 1
+
+        # exit_after=True: the boundary exits with the preempted code for
+        # the supervisor (programmatic request — same latch the signal
+        # sets)
+        engine.enable_preemption_save(save_dir, exit_after=True)
+        handler.request()
+        with pytest.raises(SystemExit) as ei:
+            _train_steps(engine, batch)
+        from deepspeed_tpu.runtime.preemption import PREEMPTED_EXIT_CODE
+
+        assert ei.value.code == PREEMPTED_EXIT_CODE
+        assert atomic.read_latest(save_dir) == "global_step3"
+    finally:
+        handler.uninstall()
+        flight.disable()
+        reg.disable()
+
+
+def test_failed_emergency_save_keeps_the_latch(tmp_path):
+    """A transient failure of the emergency save must not DROP the
+    preemption request: the latch clears only after a successful save, so
+    the next boundary retries instead of running to the SIGKILL deadline
+    with no checkpoint."""
+    engine, batch = _make_engine()
+    save_dir = str(tmp_path)
+    handler = engine.enable_preemption_save(save_dir, exit_after=False)
+    try:
+        handler.request()
+        with chaos.crash_before(engine.checkpoint_engine, "save"):
+            with pytest.raises(chaos.InjectedFault):
+                _train_steps(engine, batch)
+        assert handler.requested, "failed save dropped the latch"
+        _train_steps(engine, batch)          # next boundary retries
+        assert not handler.requested
+        tag = atomic.read_latest(save_dir)
+        assert tag is not None
+        assert atomic.verify_dir(os.path.join(save_dir, tag),
+                                 level="full").ok
+    finally:
+        handler.uninstall()
+
+
+def test_resave_same_tag_overwrites_cleanly(tmp_path):
+    """Re-saving an existing tag (emergency save colliding with a regular
+    one) replaces it whole and leaves no ``.trash.`` debris behind."""
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    _train_steps(engine, batch)
+    p2 = _params_snapshot(engine)
+    engine.save_checkpoint(save_dir, tag="t1")
+    assert atomic.verify_dir(os.path.join(save_dir, "t1"),
+                             level="full").ok
+    assert [n for n in os.listdir(save_dir)
+            if n.startswith(atomic.TRASH_PREFIX)] == []
+    _train_steps(engine, batch)              # diverge, then load back
+    engine.load_checkpoint(save_dir, tag="t1")
+    _assert_params_equal(engine, p2)
+
+
+def test_crashed_publish_trash_is_reported_and_swept(tmp_path):
+    """A publish killed between rename-aside and cleanup leaks a
+    checkpoint-sized ``.trash.`` dir: the offline auditor reports it and
+    the next save's GC sweeps it."""
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    leak = os.path.join(save_dir, ".trash.t0.12345")
+    os.makedirs(os.path.join(leak, "model_states"))
+    ckpt_verify = _tool("ckpt_verify")
+    rep = ckpt_verify.audit(save_dir)
+    assert [d["name"] for d in rep["stage_debris"]] == [".trash.t0.12345"]
+    assert atomic.list_tags(save_dir) == ["t1"]   # never mistaken for a tag
+    _train_steps(engine, batch)
+    engine.save_checkpoint(save_dir, tag="t2")
+    assert not os.path.exists(leak)
+
+
+def test_preempt_exit_code_contract_matches_supervisor():
+    """runtime/preemption.py and the no-jax tools/train_supervisor.py
+    carry the same exit-code default (both read DS_PREEMPT_EXIT_CODE) —
+    drift here would turn clean preemptions into counted crashes."""
+    from deepspeed_tpu.runtime.preemption import PREEMPTED_EXIT_CODE
+
+    sup = _tool("train_supervisor")
+    assert sup.PREEMPT_EXIT_CODE == PREEMPTED_EXIT_CODE
+
+
+# ---------------------------------------------------------------------------
+# exception mid-step (the third chaos fault) still leaves a loadable chain
+# ---------------------------------------------------------------------------
+
+
+def test_exception_mid_step_then_resume(tmp_path):
+    engine, batch = _make_engine()
+    save_dir = str(tmp_path)
+    _train_steps(engine, batch)
+    engine.save_checkpoint(save_dir)
+    p1 = _params_snapshot(engine)
+    with chaos.fail_after_calls(engine, "_apply_fn", 0):
+        with pytest.raises(chaos.InjectedFault):
+            _train_steps(engine, batch)
+    # the crash did not touch the checkpoint chain: reload and continue
+    ckpt_dir, _ = engine.load_checkpoint(save_dir)
+    assert ckpt_dir is not None
+    _assert_params_equal(engine, p1)
+    loss = _train_steps(engine, batch)
+    assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# offline verifier (tools/ckpt_verify.py)
+# ---------------------------------------------------------------------------
+
+
+def test_ckpt_verify_selftest():
+    """tools/ckpt_verify.py --selftest builds a synthetic save dir through
+    the real atomic module and asserts the audit verdicts."""
+    ckpt_verify = _tool("ckpt_verify")
+    assert ckpt_verify.main(["ckpt_verify", "--selftest"]) == 0
+
+
+def test_ckpt_verify_audits_real_engine_checkpoints(tmp_path, capsys):
+    engine, batch = _make_engine()
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    _train_steps(engine, batch)
+    engine.save_checkpoint(save_dir, tag="t2")
+    ckpt_verify = _tool("ckpt_verify")
+    rep = ckpt_verify.audit(save_dir)
+    assert rep["latest"] == "t2" and rep["loadable"] == "t2"
+    assert {e["tag"]: e["state"] for e in rep["tags"]} == \
+        {"t1": "valid", "t2": "valid"}
+    assert all(e["world_size"] == jax.device_count()
+               and e["zero_stage"] == 0 for e in rep["tags"])
+    # corrupt latest: the CLI reports the walk-back target and exits 0
+    shard = glob.glob(os.path.join(save_dir, "t2", "model_states",
+                                   "shard_p*.bin"))[0]
+    chaos.flip_bit(shard)
+    assert ckpt_verify.main(["ckpt_verify", save_dir]) == 0
+    out = capsys.readouterr().out
+    assert "walk-back" in out and "corrupt" in out
+    # nothing valid left: nonzero exit
+    shard1 = glob.glob(os.path.join(save_dir, "t1", "model_states",
+                                    "shard_p*.bin"))[0]
+    chaos.flip_bit(shard1)
+    assert ckpt_verify.main(["ckpt_verify", save_dir]) == 1

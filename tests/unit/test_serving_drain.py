@@ -1,0 +1,196 @@
+"""ServingEngine.drain() + /healthz readiness (docs/RESILIENCE.md; the
+router drain signal of ROADMAP item 3).
+
+Acceptance: drain completes every in-flight request TOKEN-IDENTICALLY to
+sequential generate(), admits nothing new for the whole window, and the
+live metrics server's /healthz reports not-ready throughout — verified
+against a real HTTP server with a concurrent poller."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.models import causal_lm
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.health import get_health
+from deepspeed_tpu.monitor.metrics import get_registry
+
+
+@pytest.fixture(scope="module")
+def ref_engine():
+    """Shared weights + a reference InferenceEngine for greedy parity."""
+    devs = jax.devices()
+    mesh = build_mesh(fsdp=8, devices=devs)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    ref = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 64})
+    ref.set_params(params)
+    return ref
+
+
+@pytest.fixture(autouse=True)
+def _health_reset():
+    yield
+    get_health().set_ready()
+
+
+def _get(url, timeout=5):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_drain_e2e_token_identical_healthz_not_ready(ref_engine, rng):
+    reg = get_registry()
+    reg.enable()
+    flight = get_flight_recorder()
+    flight.reset()
+    flight.enable()
+    serve = deepspeed_tpu.init_serving(
+        engine=ref_engine, num_slots=2, prefill_chunk=4,
+        decode_block_tokens=3, metrics_port=0)
+    try:
+        url = serve.metrics_server.url
+        code, body = _get(url + "/healthz")
+        assert code == 200 and body["ready"] is True
+
+        prompts = [np.asarray(p, np.int32) for p in
+                   ([3, 5, 7], [11, 13, 17, 19], [23, 29], [31, 37, 41])]
+        news = [12, 9, 11, 8]
+        want = [np.asarray(ref_engine.generate(
+                    p[None], max_new_tokens=n, do_sample=False))[0, len(p):]
+                for p, n in zip(prompts, news)]
+        reqs = [serve.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        serve.step()                       # admit the first two slots
+        inflight = {r.request_id for r in (serve.scheduler.running()
+                                           + serve.scheduler.prefilling())}
+        assert len(inflight) == 2
+
+        statuses = []
+        stop = threading.Event()
+
+        def poll():
+            while not stop.is_set():
+                try:
+                    statuses.append(_get(url + "/healthz", timeout=2)[0])
+                except Exception:
+                    pass
+                time.sleep(0.002)
+
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+        finished = serve.drain()
+        stop.set()
+        t.join(timeout=10)
+
+        # every in-flight request finished, token-identically
+        assert {r.request_id for r in finished} == inflight
+        done_ids = {id(r) for r in finished}
+        for req in finished:
+            i = next(j for j, r in enumerate(reqs) if r is req)
+            np.testing.assert_array_equal(
+                np.asarray(req.output_tokens), want[i],
+                err_msg=f"request {i} diverged across drain")
+        # nothing new was admitted: the never-admitted pair is still queued
+        assert serve.scheduler.num_queued == 2
+        assert all(r.state == "queued" for r in reqs
+                   if id(r) not in done_ids)
+
+        # not-ready for the WHOLE window: observed live mid-drain, and
+        # still 503 after (the process is about to go away)
+        assert 503 in statuses, f"poller never saw 503 in {statuses[:20]}"
+        code, body = _get(url + "/healthz")
+        assert code == 503 and body["ready"] is False
+        assert body["reason"] == "draining"
+        # admission stays closed until an explicit resume
+        with pytest.raises(RuntimeError, match="drain"):
+            serve.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+        # run() with admission paused and only queued work RETURNS
+        # (queued requests cannot be admitted) instead of spinning
+        t1 = time.perf_counter()
+        serve.run()
+        assert time.perf_counter() - t1 < 5
+        assert serve.scheduler.num_queued == 2
+        # the draining gauge flipped back to 0 and is exported
+        assert reg.gauge("ds_serve_draining").value == 0
+        prom = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "ds_serve_draining 0" in prom
+        # flight events bracket the window with the request ids
+        ev = {e["kind"]: e for e in flight.events()}
+        assert ev["serve_drain_start"]["occupied"] == 2
+        assert set(ev["serve_drain_start"]["rids"]) == inflight
+        assert ev["serve_drain_done"]["finished"] == 2
+        assert ev["serve_drain_done"]["timed_out"] is False
+
+        # resume: readiness returns, the queued pair completes with the
+        # same tokens generate() would produce
+        serve.resume_admission()
+        assert _get(url + "/healthz")[0] == 200
+        serve.run()
+        for i, req in enumerate(reqs):
+            assert req.done
+            np.testing.assert_array_equal(np.asarray(req.output_tokens),
+                                          want[i])
+    finally:
+        serve.close()
+        flight.disable()
+        reg.disable()
+
+
+def test_drain_idle_engine_is_immediate_and_reversible(ref_engine):
+    serve = deepspeed_tpu.init_serving(engine=ref_engine, num_slots=2,
+                                       prefill_chunk=4,
+                                       decode_block_tokens=3)
+    assert serve.drain() == []
+    assert not get_health().ready
+    with pytest.raises(RuntimeError):
+        serve.submit(np.asarray([1], np.int32), max_new_tokens=2)
+    serve.resume_admission()
+    assert get_health().ready
+    req = serve.submit(np.asarray([1, 2], np.int32), max_new_tokens=3)
+    serve.run()
+    assert req.done
+
+
+def test_drain_timeout_returns_partial(ref_engine):
+    """timeout=0 stops the loop before any step: nothing finishes, the
+    in-flight request stays live, and the window is flagged timed_out."""
+    flight = get_flight_recorder()
+    flight.reset()
+    flight.enable()
+    serve = deepspeed_tpu.init_serving(engine=ref_engine, num_slots=2,
+                                       prefill_chunk=4,
+                                       decode_block_tokens=3)
+    try:
+        req = serve.submit(np.asarray([5, 6, 7], np.int32),
+                           max_new_tokens=6)
+        serve.step()
+        t0 = time.perf_counter()
+        finished = serve.drain(timeout=0)
+        assert time.perf_counter() - t0 < 5
+        assert finished == [] and not req.done
+        ev = [e for e in flight.events() if e["kind"] == "serve_drain_done"]
+        assert ev and ev[-1]["timed_out"] is True
+        # the engine still works: resume and finish the request
+        serve.resume_admission()
+        serve.run()
+        assert req.done
+    finally:
+        flight.disable()

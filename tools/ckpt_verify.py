@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Offline checkpoint manifest verifier — audit a checkpoint dir from any
+box (no jax import, like fleet_dump).
+
+    python tools/ckpt_verify.py /ckpts            # a save dir of tags
+    python tools/ckpt_verify.py /ckpts/global_step100   # one tag
+    python tools/ckpt_verify.py --fast /ckpts     # existence+size only
+    python tools/ckpt_verify.py --json /ckpts     # machine-readable
+    python tools/ckpt_verify.py --selftest        # tier-1 wired
+
+Checks each tag's ``MANIFEST.json`` (docs/RESILIENCE.md schema: per-file
+size + sha256, world_size, zero_stage, format version) against the bytes
+on disk, reports which tag the ``latest`` pointer names, and flags
+leftover ``tmp.<tag>`` staging debris from crashed saves (harmless — the
+next save clears it — but a large one is reclaimable space).
+
+Exit status: 0 when the checkpoint the loader would pick (``latest``, or
+the single dir given) verifies valid — including when ``latest`` is
+corrupt but an older valid tag exists for the walk-back; 1 when nothing
+valid is loadable; 2 on usage errors.
+
+States per tag: ``valid`` | ``corrupt`` (manifest contradicted by disk)
+| ``no_manifest`` (pre-manifest save: loadable but unverifiable) |
+``missing``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from metrics_dump import render_table  # noqa: E402
+
+
+def _load_atomic():
+    """The repo's stdlib-only atomic-checkpoint module WITHOUT importing
+    the ``deepspeed_tpu`` package (whose ``__init__`` pulls in jax):
+    reuse it when already loaded (tests), else exec by file path."""
+    mod = sys.modules.get("deepspeed_tpu.runtime.checkpoint_engine.atomic")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "deepspeed_tpu", "runtime", "checkpoint_engine",
+                        "atomic.py")
+    spec = importlib.util.spec_from_file_location("_ds_ckpt_atomic", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+atomic = _load_atomic()
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
+
+
+def verify_tag(save_dir: str, tag: str, level: str) -> Dict[str, object]:
+    path = os.path.join(save_dir, tag)
+    st = atomic.verify_dir(path, level=level)
+    entry: Dict[str, object] = {"tag": tag, "state": st.state,
+                                "problems": st.problems,
+                                "bytes": _dir_bytes(path)}
+    if st.manifest:
+        entry["files"] = len(st.manifest.get("files", {}))
+        for k in ("world_size", "zero_stage", "global_steps",
+                  "format_version"):
+            if k in st.manifest:
+                entry[k] = st.manifest[k]
+    return entry
+
+
+def audit(save_dir: str, level: str = "full") -> Dict[str, object]:
+    """Verify every tag in a save dir; the report the table/JSON render."""
+    latest = atomic.read_latest(save_dir)
+    tags = atomic.list_tags(save_dir)
+    if latest and latest not in tags:
+        tags = [latest] + tags            # dangling pointer: show it
+    entries = [verify_tag(save_dir, t, level) for t in tags]
+    debris = [n for n in (os.listdir(save_dir)
+                          if os.path.isdir(save_dir) else [])
+              if n.startswith((atomic.TMP_PREFIX, atomic.TRASH_PREFIX))]
+    valid = [e["tag"] for e in entries if e["state"] == "valid"]
+    loadable: Optional[str] = None
+    if latest in valid:
+        loadable = latest
+    elif valid:
+        loadable = valid[0]               # the loader's walk-back target
+    return {"save_dir": save_dir, "latest": latest, "loadable": loadable,
+            "level": level, "tags": entries,
+            "stage_debris": [{"name": n,
+                              "bytes": _dir_bytes(os.path.join(save_dir, n))}
+                             for n in sorted(debris)]}
+
+
+def render(report: Dict[str, object]) -> str:
+    rows: List[List[str]] = []
+    latest = report["latest"]
+    for e in report["tags"]:
+        mark = " <- latest" if e["tag"] == latest else ""
+        rows.append([str(e["tag"]) + mark, str(e["state"]),
+                     str(e.get("files", "")), f"{e['bytes']:,}",
+                     "; ".join(e["problems"][:2])])
+    for d in report["stage_debris"]:
+        what = ("crashed-publish leftovers (next save's GC sweeps)"
+                if d["name"].startswith(atomic.TRASH_PREFIX)
+                else "crashed save leftovers (next save clears)")
+        rows.append([d["name"], "stage-debris", "", f"{d['bytes']:,}", what])
+    lines = list(render_table(["tag", "state", "files", "bytes", "detail"],
+                              rows))
+    if report["loadable"]:
+        suffix = ("" if report["loadable"] == latest
+                  else f" (walk-back: latest={latest!r} is not valid)")
+        lines.append(f"loadable: {report['loadable']}{suffix}")
+    else:
+        lines.append("loadable: NONE — no tag verifies valid")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest (tier-1 wired: tests/unit/test_resilience.py)
+# ---------------------------------------------------------------------------
+
+
+def _make_tag(save_dir: str, tag: str, payload: bytes) -> str:
+    path = os.path.join(save_dir, tag)
+    os.makedirs(os.path.join(path, "model_states"))
+    with open(os.path.join(path, "model_states", "shard_p0.bin"), "wb") as fh:
+        fh.write(payload)
+    with open(os.path.join(path, "client_state.json"), "w") as fh:
+        json.dump({"client_state": {}}, fh)
+    atomic.write_manifest(path, tag, extra={"world_size": 1,
+                                            "zero_stage": 0})
+    return path
+
+
+def selftest() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        a = _make_tag(td, "global_step1", b"x" * 2048)
+        b = _make_tag(td, "global_step2", b"y" * 2048)
+        atomic.write_latest(td, "global_step2")
+        rep = audit(td)
+        assert rep["latest"] == "global_step2"
+        assert rep["loadable"] == "global_step2"
+        assert all(e["state"] == "valid" for e in rep["tags"]), rep
+
+        # torn tail: size check catches it even at --fast
+        with open(os.path.join(b, "model_states", "shard_p0.bin"),
+                  "rb+") as fh:
+            fh.truncate(100)
+        rep = audit(td, level="fast")
+        by = {e["tag"]: e["state"] for e in rep["tags"]}
+        assert by["global_step2"] == "corrupt"
+        assert rep["loadable"] == "global_step1"      # the walk-back target
+
+        # restore size, flip one bit: only a full checksum pass catches it
+        with open(os.path.join(b, "model_states", "shard_p0.bin"),
+                  "rb+") as fh:
+            fh.write(b"y" * 2048)
+            fh.seek(512)
+            fh.write(b"z")
+        assert audit(td, level="fast")["loadable"] == "global_step2"
+        rep = audit(td, level="full")
+        assert rep["loadable"] == "global_step1"
+        bad = [e for e in rep["tags"] if e["tag"] == "global_step2"][0]
+        assert any("checksum" in p for p in bad["problems"])
+
+        # stage debris is reported, never treated as a tag
+        os.makedirs(os.path.join(td, atomic.TMP_PREFIX + "global_step3"))
+        rep = audit(td)
+        assert [d["name"] for d in rep["stage_debris"]] == \
+            ["tmp.global_step3"]
+        assert all(e["tag"] != "tmp.global_step3" for e in rep["tags"])
+
+        # missing latest target: dangling pointer shows as missing,
+        # walk-back still finds step1
+        import shutil
+
+        shutil.rmtree(b)
+        rep = audit(td)
+        by = {e["tag"]: e["state"] for e in rep["tags"]}
+        assert by["global_step2"] == "missing"
+        assert rep["loadable"] == "global_step1"
+
+        table = render(rep)
+        assert "global_step1" in table and "walk-back" in table
+
+        # no manifest at all (legacy layout): unverifiable, not loadable
+        # by the verifier's standard (the engine may still accept it)
+        os.remove(os.path.join(a, atomic.MANIFEST_NAME))
+        rep = audit(td)
+        assert rep["loadable"] is None
+        assert any(e["state"] == "no_manifest" for e in rep["tags"])
+    print("ckpt_verify selftest: OK")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: List[str]) -> int:
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    flags = {a for a in argv[1:] if a.startswith("--")}
+    if "--selftest" in flags:
+        return selftest()
+    if not args or "--help" in flags or "-h" in argv[1:]:
+        print(__doc__.strip())
+        return 0 if args else 2
+    target = args[0]
+    level = "fast" if "--fast" in flags else "full"
+    if os.path.exists(os.path.join(target, atomic.MANIFEST_NAME)):
+        # a single tag dir: report it alone
+        save_dir, tag = os.path.split(os.path.abspath(target.rstrip("/")))
+        entry = verify_tag(save_dir, tag, level)
+        report = {"save_dir": save_dir, "latest": None,
+                  "loadable": tag if entry["state"] == "valid" else None,
+                  "level": level, "tags": [entry], "stage_debris": []}
+    elif os.path.isdir(target):
+        report = audit(target, level=level)
+    else:
+        print(f"no such directory: {target}", file=sys.stderr)
+        return 2
+    if "--json" in flags:
+        print(json.dumps(report, sort_keys=True, default=str))
+    else:
+        print(render(report))
+    return 0 if report["loadable"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""Training supervisor: restart a crashed training process with bounded
+retries + exponential backoff, resuming from the newest valid checkpoint.
+
+    python tools/train_supervisor.py --max-restarts 5 -- \\
+        python train.py --deepspeed_config ds_config.json
+    python tools/train_supervisor.py --selftest          # tier-1 wired
+
+The training script is responsible for calling
+``engine.load_checkpoint(save_dir)`` at startup (no tag — the engine
+walks back to the newest VALID tag, docs/RESILIENCE.md) and carrying its
+dataloader position in ``client_state`` so resume is step-accurate.  The
+supervisor's contract is deliberately thin:
+
+- **exit 0** — training completed; the supervisor exits 0.
+- **exit PREEMPT (default 243,** ``DS_PREEMPT_EXIT_CODE``**)** — the child
+  took its SIGTERM emergency save and left on purpose
+  (``runtime/preemption.py``); restart IMMEDIATELY (no backoff) and do
+  NOT count it against the crash budget — preemptions are routine
+  scheduling events, and abandoning a healthy job after N of them would
+  defeat the whole layer.
+- **any other nonzero exit** — a crash; restart after exponential backoff
+  (``backoff_base * 2^n``, capped at ``backoff_max``) until
+  ``max_restarts`` CRASH restarts are exhausted, then exit with the
+  child's code.
+- **SIGTERM to the supervisor** — forwarded to the child (its grace
+  window runs); when the child exits, the supervisor exits with the
+  child's code WITHOUT restarting (the whole job is being preempted).
+
+Each incarnation sees ``DS_SUPERVISOR_RESTART=<n>`` (0 on the first run)
+so training scripts/tests can behave differently per incarnation.
+
+Zero dependencies beyond the stdlib — no jax import, so the supervisor
+runs on any box (the ``fleet_dump`` / ``ckpt_verify`` rule).
+``--selftest`` exercises the retry/backoff/preempt state machine against
+synthetic children and is wired into tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+# runtime/preemption.py carries the same default; both sides read the env
+# override so the contract cannot drift silently in a deployment
+PREEMPT_EXIT_CODE = int(os.environ.get("DS_PREEMPT_EXIT_CODE", "243"))
+
+SIGTERM_GRACE_S = 30.0
+
+
+class TrainSupervisor:
+    """Restart-on-crash loop around one training process (module
+    docstring has the exit-code contract)."""
+
+    def __init__(self, cmd: List[str], max_restarts: int = 3,
+                 backoff_base: float = 1.0, backoff_max: float = 60.0,
+                 preempt_exit_code: int = PREEMPT_EXIT_CODE,
+                 env: Optional[Dict[str, str]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 grace_s: float = SIGTERM_GRACE_S):
+        if not cmd:
+            raise ValueError("no child command given")
+        self.cmd = list(cmd)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.preempt_exit_code = int(preempt_exit_code)
+        self.base_env = dict(env if env is not None else os.environ)
+        self.sleep = sleep
+        self.grace_s = grace_s
+        self.restarts = 0            # restarts performed (any reason)
+        self.crash_restarts = 0      # restarts that burned backoff budget
+        self.preempt_restarts = 0
+        self.backoffs: List[float] = []
+        self._terminating = False
+        self._child: Optional[subprocess.Popen] = None
+
+    # -- signal forwarding ----------------------------------------------
+    def _forward_sigterm(self, _sig, _frame):
+        self._terminating = True
+        child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    def _log(self, msg: str) -> None:
+        print(f"[train_supervisor] {msg}", file=sys.stderr, flush=True)
+
+    # -- main loop -------------------------------------------------------
+    def run(self) -> int:
+        prev = None
+        try:
+            prev = signal.signal(signal.SIGTERM, self._forward_sigterm)
+        except ValueError:           # non-main thread (tests)
+            prev = None
+        try:
+            return self._run()
+        finally:
+            if prev is not None:
+                try:
+                    signal.signal(signal.SIGTERM, prev)
+                except ValueError:
+                    pass
+
+    def _run(self) -> int:
+        last_code = 0
+        while True:
+            if self._terminating:
+                # SIGTERM landed between incarnations (e.g. during a
+                # backoff sleep): spawning now would create a child that
+                # never got the forwarded signal and dies by SIGKILL with
+                # no emergency save — the job is being preempted, stop
+                self._log("terminated during the restart window; not "
+                          "spawning a new incarnation")
+                return last_code or 143
+            env = dict(self.base_env)
+            env["DS_SUPERVISOR_RESTART"] = str(self.restarts)
+            env["DS_PREEMPT_EXIT_CODE"] = str(self.preempt_exit_code)
+            cmdline = " ".join(self.cmd).replace("\n", "\\n")
+            if len(cmdline) > 160:
+                cmdline = cmdline[:157] + "..."
+            self._log(f"starting (incarnation {self.restarts}): {cmdline}")
+            self._child = subprocess.Popen(self.cmd, env=env)
+            code = self._wait_child()
+            self._child = None
+            last_code = code
+            if code == 0:
+                self._log(f"child completed (restarts={self.restarts})")
+                return 0
+            if self._terminating:
+                self._log(f"supervisor was terminated; child exited "
+                          f"{code} — not restarting")
+                return code
+            if code == self.preempt_exit_code:
+                # a clean emergency save was taken: restart immediately;
+                # preemptions are routine scheduling events and do NOT
+                # burn the crash budget (a child that lies about 243
+                # without actually saving is operator error)
+                self.restarts += 1
+                self.preempt_restarts += 1
+                self._log(f"child preempted (exit {code}, emergency save "
+                          f"taken): restart #{self.restarts}, no backoff")
+                continue
+            if self.crash_restarts >= self.max_restarts:
+                self._log(f"max_restarts={self.max_restarts} crash "
+                          f"restarts exhausted; giving up with exit code "
+                          f"{code}")
+                return code
+            self.restarts += 1
+            self.crash_restarts += 1
+            delay = min(self.backoff_max,
+                        self.backoff_base * (2 ** (self.crash_restarts - 1)))
+            self.backoffs.append(delay)
+            self._log(f"child crashed (exit {code}): restart "
+                      f"#{self.restarts} after {delay:g}s backoff; "
+                      f"training should resume from the newest valid "
+                      f"checkpoint")
+            self.sleep(delay)
+
+    def _wait_child(self) -> int:
+        child = self._child
+        assert child is not None
+        while True:
+            try:
+                return child.wait(timeout=0.5)
+            except subprocess.TimeoutExpired:
+                if self._terminating:
+                    # grace window: SIGTERM was forwarded; escalate only
+                    # past the deadline
+                    try:
+                        return child.wait(timeout=self.grace_s)
+                    except subprocess.TimeoutExpired:
+                        self._log("grace window expired; killing child")
+                        child.kill()
+                        return child.wait()
+
+
+# ---------------------------------------------------------------------------
+# selftest (tier-1 wired: tests/unit/test_supervisor.py)
+# ---------------------------------------------------------------------------
+
+
+def _counter_child(tmp: str, fail_times: int, fail_code: int = 7) -> List[str]:
+    """A child that exits ``fail_code`` its first ``fail_times`` runs
+    (counted in a state file), then 0."""
+    prog = (
+        "import os,sys\n"
+        f"p = {tmp!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        f"sys.exit({fail_code} if n < {fail_times} else 0)\n")
+    return [sys.executable, "-c", prog]
+
+
+def selftest() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        # crash twice, then succeed: two backoffs, doubling
+        sleeps: List[float] = []
+        sup = TrainSupervisor(_counter_child(os.path.join(td, "a"), 2),
+                              max_restarts=3, backoff_base=0.01,
+                              sleep=sleeps.append)
+        assert sup.run() == 0
+        assert sup.restarts == 2 and sup.crash_restarts == 2
+        assert sleeps == [0.01, 0.02], sleeps
+
+        # budget exhausted: the child's code comes back
+        sup = TrainSupervisor(_counter_child(os.path.join(td, "b"), 99),
+                              max_restarts=1, backoff_base=0.0,
+                              sleep=lambda _s: None)
+        assert sup.run() == 7 and sup.restarts == 1
+
+        # preemption exit: restart without backoff or crash budget
+        sup = TrainSupervisor(
+            _counter_child(os.path.join(td, "c"), 1,
+                           fail_code=PREEMPT_EXIT_CODE),
+            max_restarts=3, backoff_base=5.0, sleep=sleeps.append)
+        n_sleeps = len(sleeps)
+        assert sup.run() == 0
+        assert sup.preempt_restarts == 1 and sup.crash_restarts == 0
+        assert len(sleeps) == n_sleeps      # no backoff slept
+
+        # preemptions beyond max_restarts still restart (only CRASHES
+        # burn the budget): 3 preempt exits with max_restarts=1
+        sup = TrainSupervisor(
+            _counter_child(os.path.join(td, "c2"), 3,
+                           fail_code=PREEMPT_EXIT_CODE),
+            max_restarts=1, backoff_base=5.0, sleep=sleeps.append)
+        assert sup.run() == 0
+        assert sup.preempt_restarts == 3 and sup.crash_restarts == 0
+        assert len(sleeps) == n_sleeps
+
+        # SIGTERM latched between incarnations: no new child is spawned
+        sup = TrainSupervisor(_counter_child(os.path.join(td, "c3"), 0),
+                              max_restarts=3, sleep=lambda _s: None)
+        sup._terminating = True
+        assert sup.run() == 143
+        assert not os.path.exists(os.path.join(td, "c3")), \
+            "a child was spawned after termination latched"
+
+        # backoff cap
+        sup = TrainSupervisor(_counter_child(os.path.join(td, "d"), 4),
+                              max_restarts=4, backoff_base=1.0,
+                              backoff_max=2.5, sleep=lambda _s: None)
+        assert sup.run() == 0
+        assert sup.backoffs == [1.0, 2.0, 2.5, 2.5]
+
+        # DS_SUPERVISOR_RESTART is visible per incarnation
+        marker = os.path.join(td, "e")
+        prog = ("import os,sys\n"
+                f"open({marker!r}, 'a').write("
+                "os.environ['DS_SUPERVISOR_RESTART'] + ',')\n"
+                "sys.exit(0 if os.environ['DS_SUPERVISOR_RESTART'] == '1' "
+                "else 3)\n")
+        sup = TrainSupervisor([sys.executable, "-c", prog], max_restarts=2,
+                              backoff_base=0.0, sleep=lambda _s: None)
+        assert sup.run() == 0
+        assert open(marker).read() == "0,1,"
+    print("train_supervisor selftest: OK")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    if "--selftest" in argv[1:]:
+        return selftest()
+    parser = argparse.ArgumentParser(
+        prog="train_supervisor",
+        description="Restart a crashed training process with bounded "
+                    "retries + exponential backoff (resume from the newest "
+                    "valid checkpoint).")
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--backoff-base", type=float, default=1.0,
+                        help="first crash backoff in seconds (doubles per "
+                             "crash)")
+    parser.add_argument("--backoff-max", type=float, default=60.0)
+    parser.add_argument("--preempt-exit-code", type=int,
+                        default=PREEMPT_EXIT_CODE,
+                        help="child exit code meaning 'preempted after a "
+                             "clean emergency save' (restart immediately)")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- followed by the training command")
+    args = parser.parse_args(argv[1:])
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        parser.error("no training command given (… -- python train.py …)")
+    sup = TrainSupervisor(cmd, max_restarts=args.max_restarts,
+                          backoff_base=args.backoff_base,
+                          backoff_max=args.backoff_max,
+                          preempt_exit_code=args.preempt_exit_code)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
