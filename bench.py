@@ -438,6 +438,166 @@ def bench_serving(num_requests: int = 64, num_slots: int = 8, qps: float = 50.0,
     }
 
 
+def bench_prefix_serving(num_requests: int = 48, num_slots: int = 8,
+                         qps: float = 50.0, seed: int = 0,
+                         tiny: bool = False) -> dict:
+    """Shared-prefix serving scenario: copy-on-write prefix caching on vs
+    off on ONE identical trace (serving/prefix_cache.py — ROADMAP item 3).
+
+    The trace is the regime the cache exists for: ~70% of requests open
+    with one of two shared system prompts (multi-page), the rest are
+    cold, output lengths are bimodal chat-like.  Both sides run the PAGED
+    engine with identical slots/pool; the only delta is
+    ``prefix_caching``.  Recorded per side: goodput, TTFT p50/p99, and
+    ``prefill_tokens_computed`` (the host-countable savings — this is the
+    first serving speedup PROVABLE on CPU, unlike the TPU-bandwidth-bound
+    paged-goodput win).  Headline: ``prefill_savings_ratio`` (acceptance:
+    >= 40% fewer prefill tokens computed with the cache on) +
+    ``prefix_hit_ratio`` + ``outputs_token_identical`` (greedy outputs
+    must not change — the correctness half of the acceptance bar).
+    """
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    mesh = build_mesh(devices=jax.devices()[:1])
+    set_global_mesh(mesh)
+    rng = np.random.default_rng(seed + 7)
+    if tiny:  # CPU smoke scale (tests/perf/test_serving_bench.py)
+        model = causal_lm("gpt2-small", mesh=mesh, num_layers=2,
+                          hidden_size=128, intermediate_size=256, num_heads=4,
+                          vocab_size=512)
+        max_out, page_tokens = 96, 16
+        sys_len, tail = 48, (4, 12)
+        n_short, n_long = (4, 10), (16, 24)
+    else:
+        model = causal_lm("gpt2-small", mesh=mesh, vocab_size=50304)
+        max_out, page_tokens = 1024, 0
+        sys_len, tail = 256, (16, 128)
+        n_short, n_long = (16, 96), (192, 256)
+    params = jax.jit(model.init)(jax.random.PRNGKey(seed))
+    V = model.config.vocab_size
+
+    system_prompts = [rng.integers(0, V, size=sys_len).astype(np.int32)
+                      for _ in range(2)]
+    shared_mask = rng.random(num_requests) < 0.7   # the 60-80% regime
+    long_mask = rng.random(num_requests) < 0.25
+    prompts, news = [], []
+    for i in range(num_requests):
+        t = rng.integers(0, V, size=int(rng.integers(tail[0], tail[1] + 1))
+                         ).astype(np.int32)
+        if shared_mask[i]:
+            prompts.append(np.concatenate(
+                [system_prompts[int(rng.integers(2))], t]))
+        else:  # cold request: unique prompt, roughly half the system size
+            prompts.append(rng.integers(
+                0, V, size=sys_len // 2 + len(t)).astype(np.int32))
+        news.append(int(rng.integers(n_long[0], n_long[1] + 1) if long_mask[i]
+                        else rng.integers(n_short[0], n_short[1] + 1)))
+    arrivals = np.cumsum(rng.exponential(1.0 / qps, size=num_requests))
+    arrivals -= arrivals[0]
+
+    def percentiles(lat):
+        return (round(float(np.percentile(lat, 50)), 4),
+                round(float(np.percentile(lat, 99)), 4))
+
+    def make_serve(prefix_on: bool):
+        s = deepspeed_tpu.init_serving(
+            model, config={"dtype": "bfloat16", "max_out_tokens": max_out,
+                           "kv_page_tokens": page_tokens,
+                           "prefix_caching": prefix_on},
+            num_slots=num_slots, decode_block_tokens=8)
+        s.set_params(params)
+        return s
+
+    def run_trace(serve):
+        t0 = time.perf_counter()
+        reqs, i = [], 0
+        while i < num_requests or serve.scheduler.has_work:
+            now = time.perf_counter() - t0
+            while i < num_requests and arrivals[i] <= now:
+                reqs.append(serve.submit(prompts[i], max_new_tokens=news[i]))
+                i += 1
+            if not serve.scheduler.has_work:
+                time.sleep(max(0.0, arrivals[i] - now))
+                continue
+            serve.step()
+        makespan = time.perf_counter() - t0
+        lat = [r.t_finish - (t0 + arrivals[j]) for j, r in enumerate(reqs)]
+        outs = [list(r.output_tokens) for r in reqs]
+        toks = sum(len(o) for o in outs)
+        serve.scheduler.drain_finished()
+        return toks, makespan, lat, outs
+
+    registry = get_registry()
+    was_enabled = registry.enabled
+    registry.enable()
+    sides, outputs = {}, {}
+    try:
+        for side, on in (("cache_on", True), ("cache_off", False)):
+            serve = make_serve(on)
+            run_trace(serve)            # compile-warm passes
+            run_trace(serve)
+            if on:
+                # measure the INTRA-trace sharing win, not a replay of a
+                # fully-warm cache: the warm passes served this same
+                # trace, so without a clear even the cold prompts would
+                # hit and the savings would read ~100%
+                serve.prefix_cache.clear()
+            registry.reset()
+            toks, span, lat, outs = run_trace(serve)
+            outputs[side] = outs
+            p50, p99 = percentiles(lat)
+            snap = registry.snapshot()
+            ttft = snap.get("ds_serve_ttft_seconds") or {}
+            entry = {
+                "goodput_tok_s": round(toks / span, 1),
+                "tokens": toks, "makespan_s": round(span, 3),
+                "p50_latency_s": p50, "p99_latency_s": p99,
+                "ttft_p50_s": round(ttft.get("p50", 0.0), 4),
+                "ttft_p99_s": round(ttft.get("p99", 0.0), 4),
+                "prefill_tokens_computed":
+                    int(snap.get("ds_serve_prefill_tokens_total", 0)),
+            }
+            if on:
+                hit = int(snap.get("ds_serve_prefix_hit_tokens_total", 0))
+                miss = int(snap.get("ds_serve_prefix_miss_tokens_total", 0))
+                entry["prefix_hit_ratio"] = round(
+                    hit / max(hit + miss, 1), 4)
+                entry["prefix_hit_tokens"] = hit
+                entry["prefix_evictions"] = int(
+                    snap.get("ds_serve_prefix_evictions_total", 0))
+                entry["prefix_cache_pages"] = serve.pool.pages_cached
+            sides[side] = entry
+            serve.close()
+    finally:
+        if not was_enabled:             # a mid-bench raise must not leave
+            registry.disable()          # the registry hot
+    on_c = sides["cache_on"]["prefill_tokens_computed"]
+    off_c = sides["cache_off"]["prefill_tokens_computed"]
+    return {
+        "workload": {"num_requests": num_requests, "num_slots": num_slots,
+                     "qps": qps, "shared_prefix_frac": 0.7,
+                     "system_prompt_tokens": sys_len,
+                     "system_prompts": 2,
+                     "new_tokens": {"short": list(n_short),
+                                    "long": list(n_long), "p_long": 0.25},
+                     "arrivals": "poisson", "seed": seed},
+        "cache_on": sides["cache_on"],
+        "cache_off": sides["cache_off"],
+        # the acceptance pair: >= 0.4 savings, outputs unchanged
+        "prefill_savings_ratio": round(1.0 - on_c / max(off_c, 1), 4),
+        "outputs_token_identical": outputs["cache_on"] ==
+                                   outputs["cache_off"],
+        "prefix_hit_ratio": sides["cache_on"]["prefix_hit_ratio"],
+        "prefix_goodput_speedup": round(
+            sides["cache_on"]["goodput_tok_s"]
+            / max(sides["cache_off"]["goodput_tok_s"], 1e-9), 2),
+    }
+
+
 def bench_overlap_rung(steps: int = 4, warmup: int = 2) -> dict:
     """ZeRO-3 compute/collective overlap on/off ablation on the 1.34B
     training scenario (ROADMAP open item 1; runtime/zero/overlap.py).
@@ -1010,8 +1170,17 @@ def main():
         except Exception as exc:
             rung_serving = {"status": f"failed: {type(exc).__name__}",
                             "error": str(exc)[:200]}
+        # shared-prefix trace: prefix caching on/off (prefill-token
+        # savings are host-counted, so this row is also meaningful on
+        # the CPU smoke path — tests/perf runs it tiny)
+        try:
+            rung_prefix = bench_prefix_serving()
+        except Exception as exc:
+            rung_prefix = {"status": f"failed: {type(exc).__name__}",
+                           "error": str(exc)[:200]}
     else:
         rung_serving = None
+        rung_prefix = None
 
     tokens_per_step = batch * seq
     tps = steps * tokens_per_step / dt
@@ -1059,6 +1228,8 @@ def main():
                    **({"llama3_8b": rung_8b} if rung_8b else {}),
                    **({"decode_125m": rung_decode} if rung_decode else {}),
                    **({"serving_125m": rung_serving} if rung_serving
+                      else {}),
+                   **({"prefix_serving_125m": rung_prefix} if rung_prefix
                       else {})},
     })
     print(json.dumps(record))
@@ -1105,6 +1276,19 @@ def summary_lines(record: dict, rung_serving) -> list:
         # registry) so BENCH_r*.json tracks latency attribution, not just
         # aggregate goodput
         summary["serving_metrics"] = rung_serving.get("metrics")
+    pf = record["detail"].get("prefix_serving_125m")
+    if pf and "prefill_savings_ratio" in pf:
+        # the prefix-caching acceptance row: prefill-token savings (>=
+        # 0.4 target), hit ratio, and the token-identity bit travel with
+        # the headline (docs/OBSERVABILITY.md "Serving — prefix cache")
+        summary["serving_prefix"] = {
+            "prefill_savings_ratio": pf["prefill_savings_ratio"],
+            "prefix_hit_ratio": pf["prefix_hit_ratio"],
+            "outputs_token_identical": pf["outputs_token_identical"],
+            "goodput_speedup": pf["prefix_goodput_speedup"],
+            "ttft_p99_on_s": pf["cache_on"]["ttft_p99_s"],
+            "ttft_p99_off_s": pf["cache_off"]["ttft_p99_s"],
+        }
     line = json.dumps(summary, separators=(",", ":"))
     return ["BENCH_JSON: " + line, line]
 

@@ -86,15 +86,25 @@ def init_serving(model=None, config=None, **kwargs):
     per-row-position decode, and sync-free (device-resident) EOS
     termination with deferred finish-event drains.
 
-    ``metrics_port=`` (optional) enables the process-global metrics
-    registry and serves it over HTTP for the engine's lifetime:
-    ``GET /metrics`` (Prometheus text) + ``GET /statz`` (JSON snapshot) +
-    ``GET /requestz`` (per-request span timelines).  Pass ``0`` for an
-    ephemeral port — read it back from ``engine.metrics_server.port``.
+    ``metrics_port=`` (optional) enables the engine's metrics registry
+    and serves it over HTTP for the engine's lifetime: ``GET /metrics``
+    (Prometheus text) + ``GET /statz`` (JSON snapshot) + ``GET
+    /requestz`` (per-request span timelines) + ``GET /healthz``
+    (readiness) + ``POST /generate`` (the multi-replica router's dispatch
+    target — ``serving/router.py``; requires a stepping loop, see
+    ``serve_loop`` below).  Pass ``0`` for an ephemeral port — read it
+    back from ``engine.metrics_server.port``.
     ``request_trace=True`` (optional) additionally enables the
     per-request span tracer (``monitor/request_trace.py``) feeding
     ``/requestz`` and the ``ds_serve_phase_*`` attribution histograms —
     off by default (one branch, zero allocation per lifecycle hook).
+    ``serve_loop=True`` starts the background serving loop
+    (``ServingEngine.start_loop``) so ``/generate`` requests progress
+    without a caller-driven ``step()`` loop.
+    ``registry=`` / ``private_health=True`` scope the metrics registry
+    and the ``/healthz`` readiness flag to THIS engine instead of the
+    process globals — how N replica engines in one process keep
+    per-replica truths for the router (docs/OBSERVABILITY.md "Router").
     See docs/OBSERVABILITY.md.
     """
     from deepspeed_tpu.serving.engine import ServingEngine
@@ -104,6 +114,14 @@ def init_serving(model=None, config=None, **kwargs):
     mesh = kwargs.pop("mesh", None)
     metrics_port = kwargs.pop("metrics_port", None)
     request_trace = kwargs.pop("request_trace", False)
+    serve_loop = kwargs.pop("serve_loop", False)
+    registry = kwargs.pop("registry", None)
+    if kwargs.pop("private_health", False):
+        from deepspeed_tpu.monitor.health import HealthState
+
+        health = HealthState()
+    else:
+        health = None
     engine_kw = {k: kwargs.pop(k) for k in
                  ("engine", "num_slots", "prefill_chunk",
                   "decode_block_tokens", "do_sample", "temperature",
@@ -113,19 +131,28 @@ def init_serving(model=None, config=None, **kwargs):
         # ServingEngine rejects engine= combined with config/model args
         config = _merge_inference_config(config, kwargs,
                                          DeepSpeedInferenceConfig)
-    serve = ServingEngine(model, config, params=params, mesh=mesh, **engine_kw)
+    serve = ServingEngine(model, config, params=params, mesh=mesh,
+                          registry=registry, health=health, **engine_kw)
     if request_trace:
         from deepspeed_tpu.monitor.request_trace import get_request_tracer
 
         get_request_tracer().enable()
+    if serve_loop:
+        # before the HTTP server comes up: a /generate racing the loop
+        # start must find a live stepper
+        serve.start_loop()
     if metrics_port is not None:
         import weakref
 
         from deepspeed_tpu.monitor.metrics import get_registry
         from deepspeed_tpu.monitor.server import MetricsServer
 
-        get_registry().enable()
-        server = MetricsServer(get_registry(), port=int(metrics_port)).start()
+        reg = registry if registry is not None else get_registry()
+        reg.enable()
+        server = MetricsServer(reg, port=int(metrics_port),
+                               health=serve.health)
+        server.set_generate_handler(serve._http_generate)
+        server.start()
         serve.metrics_server = server
         # "for the engine's lifetime": a discarded engine must not leak its
         # bound port + exporter thread — engine.close() stops it

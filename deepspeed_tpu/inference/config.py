@@ -75,6 +75,16 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     paged_kv_cache: bool = True
     kv_page_tokens: int = 0
     kv_pool_tokens: int = 0
+    # Copy-on-write prefix caching (serving/prefix_cache.py — the
+    # vLLM/SGLang radix-cache idiom): finished requests' full prompt
+    # pages stay in a page-granular trie; a new request whose prompt
+    # shares a cached prefix adopts those pages read-only (refcounted)
+    # and prefill starts at the match frontier, with one device-side
+    # page copy when the boundary page is only partially matched
+    # (copy-on-write).  Greedy outputs are token-identical with the
+    # cache on or off.  Paged engines only (ignored on the fixed-slot
+    # layout).
+    prefix_caching: bool = True
 
     def __init__(self, **kwargs):
         # legacy alias: mp_size -> tensor_parallel.tp_size

@@ -24,7 +24,17 @@ daemon thread:
 - ``GET /healthz`` — READINESS (not liveness): 200 ``{"ready": true}``
   while the process accepts new work, 503 with a ``reason`` while it does
   not (``ServingEngine.drain()`` flips it for the whole drain window) —
-  the router/load-balancer stop-sending signal (monitor/health.py).
+  the router/load-balancer stop-sending signal (monitor/health.py).  A
+  server built with ``health=`` serves that state instead of the
+  process-global one (N replicas in one process each keep their own
+  drain truth).
+- ``POST /generate`` — replica inference endpoint (the router's dispatch
+  target, ``serving/router.py``): available when a serving engine is
+  attached (``init_serving(metrics_port=...)`` wires its handler); the
+  JSON body ``{"prompt": [ids], "max_new_tokens", "eos_token_id"?,
+  "timeout"?}`` blocks this worker thread until the request finishes and
+  returns its tokens; 503 while the engine drains (the router re-sends
+  elsewhere — no request is dropped on a drain).
 - ``GET /requestz`` — per-request span timelines from the request tracer
   (monitor/request_trace.py): recent completions, slowest exemplars, and
   the tail-attribution summary.  ``?n=`` bounds the lists;
@@ -120,10 +130,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif path in ("/healthz", "/healthz/"):
             # READINESS, not liveness: 503 while draining (or any other
             # not-ready reason) is the router's stop-sending signal —
-            # liveness is this server answering at all.
-            from deepspeed_tpu.monitor.health import get_health
+            # liveness is this server answering at all.  A server-scoped
+            # HealthState (multi-replica hosts) wins over the global one.
+            health = getattr(self.server, "health", None)
+            if health is None:
+                from deepspeed_tpu.monitor.health import get_health
 
-            snap = get_health().snapshot()
+                health = get_health()
+            snap = health.snapshot()
             body = json.dumps(snap, sort_keys=True).encode()
             self.send_response(200 if snap["ready"] else 503)
             self.send_header("Content-Type", "application/json")
@@ -134,13 +148,42 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/":
             body = json.dumps({"endpoints": ["/healthz", "/metrics",
                                              "/statz", "/profilez",
-                                             "/requestz"]}).encode()
+                                             "/requestz", "/generate"]}
+                              ).encode()
             ctype = "application/json"
         else:
             self.send_error(404)
             return
         self.send_response(200)
         self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        path, _, _ = self.path.partition("?")
+        if path not in ("/generate", "/generate/"):
+            self.send_error(404)
+            return
+        handler = getattr(self.server, "generate_handler", None)
+        if handler is None:
+            code, payload = 503, {"error": "no serving engine attached "
+                                           "to this metrics server"}
+        else:
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError) as exc:
+                code, payload = 400, {"error": f"bad JSON body: {exc}"}
+            else:
+                # blocks this worker thread until the request completes
+                # (ThreadingHTTPServer: scrapes stay responsive)
+                code, payload = handler(payload)
+        body = json.dumps(payload, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -210,10 +253,13 @@ class MetricsServer:
     """Serve ``/metrics`` + ``/statz`` for a registry on a daemon thread."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1", health=None):
         self.registry = registry if registry is not None else get_registry()
         self._requested_port = port
         self.host = host
+        # replica-scoped readiness (None = the process-global HealthState)
+        self.health = health
+        self._generate_handler = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -237,12 +283,22 @@ class MetricsServer:
         # per-window-key previous snapshots for /statz?window= deltas
         self._httpd.window_state = {}
         self._httpd.window_lock = threading.Lock()
+        self._httpd.health = self.health
+        self._httpd.generate_handler = self._generate_handler
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="ds-metrics-http", daemon=True)
         self._thread.start()
         logger.info("metrics server: %s/metrics (Prometheus), %s/statz "
                     "(JSON)", self.url, self.url)
         return self
+
+    def set_generate_handler(self, fn) -> None:
+        """Attach the serving engine's ``POST /generate`` handler
+        (``fn(payload: dict) -> (status_code, json_payload)``); None
+        detaches (subsequent POSTs get 503)."""
+        self._generate_handler = fn
+        if self._httpd is not None:
+            self._httpd.generate_handler = fn
 
     def stop(self) -> None:
         if self._httpd is None:

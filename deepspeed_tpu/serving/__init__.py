@@ -16,14 +16,24 @@ inference engine).
   latency stays bounded, an active-slot mask so the compiled step keeps a
   static shape while occupancy varies, and device-resident pos/active
   carries so neither no-EOS nor EOS workloads sync the host per step.
+- :mod:`deepspeed_tpu.serving.prefix_cache` — :class:`PrefixCache`:
+  copy-on-write prefix caching over the page pool (page-granular radix
+  trie; shared system prompts / multi-turn histories skip prefill).
+- :mod:`deepspeed_tpu.serving.router` — :class:`Router` /
+  :class:`RouterServer`: the multi-replica front-end (least-loaded
+  dispatch off live ``/statz`` gauges, session affinity for prefix
+  locality, ``/healthz``-driven membership, drain-aware redistribution).
+  jax-free; ``tools/router.py`` runs it standalone on an operator box.
 """
 
 from deepspeed_tpu.serving.scheduler import (FINISHED, PREFILLING, QUEUED,
                                              RUNNING, IterationScheduler,
                                              Request)
 from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.router import Router, RouterServer
 
 __all__ = ["Request", "IterationScheduler", "ServingEngine", "PagedKVPool",
-           "init_paged_kv_cache", "QUEUED", "PREFILLING", "RUNNING",
-           "FINISHED"]
+           "init_paged_kv_cache", "PrefixCache", "Router", "RouterServer",
+           "QUEUED", "PREFILLING", "RUNNING", "FINISHED"]

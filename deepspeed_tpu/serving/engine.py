@@ -54,6 +54,7 @@ page 0 in the paged layout (a released slot's page table points there).
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from collections import deque
 from typing import Any, List, Optional
@@ -67,11 +68,13 @@ from deepspeed_tpu.inference.engine import InferenceEngine, pow2_bucket
 from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
                                            sample_token)
 from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.health import get_health
 from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.monitor.request_trace import get_request_tracer
 from deepspeed_tpu.profiling.trace import annotate
 from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
-from deepspeed_tpu.serving.scheduler import (PREFILLING, RUNNING,
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
+from deepspeed_tpu.serving.scheduler import (PREFILLING, QUEUED, RUNNING,
                                              IterationScheduler, Request)
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -106,7 +109,8 @@ class ServingEngine:
                  num_slots: int = 0, prefill_chunk: int = 0,
                  decode_block_tokens: int = 0, params: Any = None, mesh=None,
                  do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, top_p: float = 1.0):
+                 top_k: int = 0, top_p: float = 1.0, registry=None,
+                 health=None):
         if engine is None:
             if config is None:
                 config = {}
@@ -129,7 +133,15 @@ class ServingEngine:
         self.max_prefill_chunks = max(1, int(self._config.max_prefill_chunks))
         self._sample = (bool(do_sample), float(temperature), int(top_k),
                         float(top_p))
-        self.scheduler = IterationScheduler(self.num_slots)
+        # replica-scoped observability: by default both land on the
+        # process-global registry / health flag (single-replica processes,
+        # the existing contract); a multi-replica host passes one
+        # MetricsRegistry + HealthState PER engine so the router's /statz
+        # poll and /healthz drain signal stay per-replica truths
+        self._registry = registry if registry is not None else get_registry()
+        self.health = health if health is not None else get_health()
+        self.scheduler = IterationScheduler(self.num_slots,
+                                            registry=self._registry)
 
         cfg = self.module.config
         self.paged = bool(self._config.paged_kv_cache)
@@ -153,6 +165,11 @@ class ServingEngine:
             # cache_len is the PHYSICAL depth (init_kv_cache rounds up to a
             # flash-decode block multiple)
             self.cache_len = int(self._cache["k"].shape[-2])
+        # copy-on-write prefix caching over the page pool (a fixed-slot
+        # engine has no pages to share — the knob is paged-only)
+        self.prefix_cache = (
+            PrefixCache(self.pool, registry=self._registry)
+            if self.paged and self._config.prefix_caching else None)
         # max_out is the configured LOGICAL budget — generation bounds use
         # max_out so serving stays token-identical to generate(), which
         # never sees the physical rounding
@@ -185,6 +202,14 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(self._config.seed + 1)
         self._block_fn = None
         self._prefill_fns = {}
+        self._cow_copy = None    # compiled COW page copy (prefix cache)
+        # background serving loop (start_loop/stop_loop): drives step()
+        # so HTTP /generate handlers can block on request completion
+        self._loop_thread: Optional[threading.Thread] = None
+        self._loop_stop: Optional[threading.Event] = None
+        # cross-thread abort requests (abort()): consumed at the top of
+        # step() so slot/page teardown always runs on the engine thread
+        self._aborts = deque()
         # deferred token blocks: device [K, B] arrays kept un-fetched until
         # scheduling needs their values.  No-EOS requests hold refcounted
         # (idx, n) refs resolved at finish; EOS requests are drain
@@ -214,7 +239,7 @@ class ServingEngine:
         # compute-side lifecycle metrics (queue-side spans live in the
         # scheduler; all are one-branch no-ops while the registry is
         # disabled — see docs/OBSERVABILITY.md for the schema)
-        reg = get_registry()
+        reg = self._registry
         self._m_ttft = reg.histogram(
             "ds_serve_ttft_seconds", "submit -> first-token dispatch")
         self._m_tpot = reg.histogram(
@@ -264,6 +289,17 @@ class ServingEngine:
             "ds_serve_kv_cache_util_ratio",
             "per-step live-tokens / allocated-page-tokens (paged pool)",
             buckets=tuple(i / 16 for i in range(1, 17)))
+        # prefix-cache effectiveness (registered unconditionally for the
+        # namespace guard; the hit/miss counters only move while a
+        # PrefixCache is attached).  hit = prompt tokens whose prefill
+        # was SKIPPED (served from cached pages), miss = tokens actually
+        # computed — hit / (hit + miss) is the prefix hit ratio
+        self._m_prefix_hit = reg.counter(
+            "ds_serve_prefix_hit_tokens_total",
+            "prefix tokens served from the cache (prefill skipped)")
+        self._m_prefix_miss = reg.counter(
+            "ds_serve_prefix_miss_tokens_total",
+            "prefix tokens computed by prefill (cache miss or cache off)")
         from deepspeed_tpu.models.fused_decode import supports_fused_decode
         fused_ok = (self._config.use_fused_decode is not False
                     and supports_fused_decode(
@@ -317,13 +353,22 @@ class ServingEngine:
         if self.engine._params is None:
             raise RuntimeError("no weights: set_params() first")
         self._profilez_begin()
+        # 0. cross-thread aborts (504'd /generate handlers): tear down on
+        #    THIS thread so slot parking / page release / deferred-block
+        #    unref never race a dispatch
+        while self._aborts:
+            self._process_abort(self._aborts.popleft())
         done_before = len(self.scheduler.finished)
-        # 1. admission: freed slots pick up the oldest queued requests
+        # 1. admission: freed slots pick up the oldest queued requests;
+        #    a prefix-cache hit pre-populates the slot's page table with
+        #    shared pages and moves the prefill frontier past them
         with annotate("ds_serve_admit"):
             for req in self.scheduler.admit():
                 self._pos[req.slot] = 0
                 self._active[req.slot] = False
                 self._limit[req.slot] = 0
+                if self.prefix_cache is not None:
+                    self._admit_prefix(req)
         # 2. chunked prefill, oldest admissions first (bounded per
         #    iteration so running slots' decode latency stays bounded)
         with annotate("ds_serve_prefill"):
@@ -389,15 +434,20 @@ class ServingEngine:
         process is about to go away); call :meth:`resume_admission` to
         take traffic again.  Returns the requests that finished during
         the drain; with ``timeout`` (seconds) the loop stops early and
-        returns what finished, leaving the rest in flight."""
-        from deepspeed_tpu.monitor.health import get_health
+        returns what finished, leaving the rest in flight.
 
+        With a background serving loop attached (:meth:`start_loop`) the
+        loop keeps stepping and this call only WAITS for occupancy to
+        reach zero (two threads must not both dispatch); the loop also
+        drains the finished list continuously, so the return value is []
+        in that mode — callers watching a loop-driven drain observe
+        ``/healthz`` and their own request handles instead."""
         if self._draining:
             return []
         self._draining = True
         self.scheduler.pause_admission()
         self._m_draining.set(1)
-        get_health().set_not_ready("draining")
+        self.health.set_not_ready("draining")
         inflight = self.scheduler.running() + self.scheduler.prefilling()
         if self._flight.enabled:
             self._flight.record("serve_drain_start",
@@ -407,12 +457,23 @@ class ServingEngine:
         done_before = len(self.scheduler.finished)
         t0 = time.perf_counter()
         timed_out = False
+        loop_is_stepping = self._loop_alive()
         try:
             while self.scheduler.num_occupied > 0:
                 if timeout is not None and time.perf_counter() - t0 > timeout:
                     timed_out = True
                     break
-                self.step()
+                if loop_is_stepping and not self._loop_alive():
+                    # the loop thread died mid-drain (stop_loop or a
+                    # crash): join so its in-flight step fully retires,
+                    # then take over stepping instead of sleeping forever
+                    if self._loop_thread is not None:
+                        self._loop_thread.join(timeout=30)
+                    loop_is_stepping = False
+                if loop_is_stepping:
+                    time.sleep(0.002)     # the loop thread dispatches
+                else:
+                    self.step()
         finally:
             self._m_draining.set(0)
             self._draining = False
@@ -436,14 +497,148 @@ class ServingEngine:
         """Undo :meth:`drain`: admission resumes and ``/healthz`` reports
         ready again (a drained-but-not-terminated replica rejoining the
         router pool)."""
-        from deepspeed_tpu.monitor.health import get_health
-
         self.scheduler.resume_admission()
-        get_health().set_ready()
+        self.health.set_ready()
 
     @property
     def draining(self) -> bool:
         return self._draining
+
+    # ------------------------------------------------------------------
+    # background serving loop + HTTP /generate handler (the replica side
+    # of serving/router.py — docs/OBSERVABILITY.md "Router")
+    # ------------------------------------------------------------------
+    def _loop_alive(self) -> bool:
+        return self._loop_thread is not None and self._loop_thread.is_alive()
+
+    def start_loop(self, idle_sleep: float = 0.002) -> "ServingEngine":
+        """Drive :meth:`step` on a daemon thread so requests submitted
+        from other threads (the ``POST /generate`` HTTP handler) make
+        progress without a caller-owned serving loop.  The loop drains
+        ``scheduler.finished`` every iteration (long-lived processes must
+        not accumulate history); handlers keep their own Request
+        references.  Idempotent; :meth:`stop_loop` stops it."""
+        if self._loop_alive():
+            return self
+        stop = self._loop_stop = threading.Event()
+
+        def loop():
+            try:
+                while not stop.is_set():
+                    idle = True
+                    if self.scheduler.has_work and not (
+                            self.scheduler.admission_paused
+                            and self.scheduler.num_occupied == 0
+                            and not self._outstanding):
+                        self.step()
+                        self.scheduler.drain_finished()
+                        idle = False
+                    if idle:
+                        time.sleep(idle_sleep)
+            except Exception as exc:    # noqa: BLE001 - must not die silent
+                # a crashed loop is a DEAD replica, not a busy one: flip
+                # readiness so the router stops sending (a 200 /healthz
+                # over a thread that no longer steps would strand every
+                # dispatch in the requeue-grace path forever)
+                self.health.set_not_ready(f"serving loop crashed: {exc!r}")
+                log_dist(f"serving loop crashed (replica marked not-ready;"
+                         f" /healthz 503): {exc!r}", ranks=[0])
+                raise
+
+        self._loop_thread = threading.Thread(
+            target=loop, name="ds-serving-loop", daemon=True)
+        self._loop_thread.start()
+        return self
+
+    def stop_loop(self, timeout: float = 30.0) -> None:
+        if self._loop_stop is not None:
+            self._loop_stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=timeout)
+        self._loop_thread = None
+        self._loop_stop = None
+
+    def abort(self, req: Request) -> None:
+        """Request teardown of an abandoned request (the ``/generate``
+        handler's 504 path: the client stopped waiting, so decoding to
+        ``max_new_tokens`` for nobody would burn the slot).  Safe from
+        any thread — the actual cancel/release runs at the next
+        :meth:`step` boundary on the engine thread."""
+        self._aborts.append(req)
+
+    def _process_abort(self, req: Request) -> None:
+        """Engine-thread half of :meth:`abort`: a still-queued request is
+        cancelled; an admitted one is released with reason ``cancelled``
+        (its deferred token blocks are materialized first so refcounted
+        blocks drop; an EOS drain participant released early is already
+        skipped-and-unref'd by ``_drain_one``'s state check)."""
+        if req.state == QUEUED:
+            self.scheduler.cancel(req)
+            return
+        if (req.state in (PREFILLING, RUNNING)
+                and req.slot >= 0
+                and self.scheduler.request_in(req.slot) is req):
+            self._materialize(req)
+            self._release(req, "cancelled")
+
+    def _http_generate(self, payload: dict):
+        """``POST /generate`` handler (wired by ``init_serving(
+        metrics_port=...)``): submit, block this HTTP worker until the
+        request finishes, return its tokens.  Returns ``(status, body)``.
+
+        Drain-aware redistribution: while the engine drains, ``submit``
+        raises (503 — the router sends elsewhere), and a request that was
+        QUEUED but never admitted when the drain hit is CANCELLED and
+        503'd back so the router re-dispatches it to a healthy replica —
+        zero requests are dropped on a drain."""
+        try:
+            prompt = payload["prompt"]
+            max_new = int(payload.get("max_new_tokens", 128))
+            eos = payload.get("eos_token_id")
+            timeout = float(payload.get("timeout", 300.0))
+        except (KeyError, TypeError, ValueError) as exc:
+            return 400, {"error": f"bad /generate payload: {exc!r}"}
+        try:
+            req = self.submit(prompt, max_new_tokens=max_new,
+                              eos_token_id=eos)
+        except RuntimeError as exc:        # draining: stop-sending signal
+            return 503, {"error": str(exc), "draining": True}
+        except (TypeError, ValueError) as exc:
+            return 400, {"error": str(exc)}
+        now = time.monotonic()
+        deadline = now + timeout
+        last_steps, last_progress = self.steps, now
+        while not req.done:
+            now = time.monotonic()
+            if self.steps != last_steps:      # SOMETHING is stepping —
+                last_steps = self.steps       # background loop or a
+                last_progress = now           # caller-driven step() loop
+            # hand the request back for router re-dispatch when nothing
+            # will admit it: immediately on a drain (admission paused),
+            # or once no scheduler step has run for a grace second and
+            # no loop thread exists — a busy caller-driven loop keeps
+            # making steps and is never mistaken for a dead replica
+            if req.state == QUEUED and (
+                    self.scheduler.admission_paused
+                    or (not self._loop_alive()
+                        and now - last_progress > 1.0)):
+                if self.scheduler.cancel(req):
+                    return 503, {"error": "request requeued: replica "
+                                          "draining/stopped before "
+                                          "admission", "requeued": True}
+            if now > deadline:
+                # the client is gone: don't decode to max_new_tokens for
+                # nobody — the engine thread tears the request down at
+                # its next step boundary and the slot frees
+                self.abort(req)
+                return 504, {"error": "generation timed out (request "
+                                      "aborted; slot reclaimed)",
+                             "request_id": req.request_id}
+            time.sleep(0.001)
+        return 200, {"tokens": [int(t) for t in req.output_tokens],
+                     "request_id": req.request_id,
+                     "finish_reason": req.finish_reason,
+                     "prefix_hit_tokens": req.prefix_hit_tokens}
 
     # ------------------------------------------------------------------
     # /profilez: on-demand device-true capture over scheduler iterations
@@ -492,20 +687,104 @@ class ServingEngine:
         self._pz_broker.resolve(req, summary=summary)
 
     # ------------------------------------------------------------------
+    # prefix caching (serving/prefix_cache.py)
+    # ------------------------------------------------------------------
+    def _admit_prefix(self, req: Request) -> None:
+        """Match the request's prefix (prompt — plus produced tokens on a
+        preempt-resume) against the cache at admission: fully-matched
+        pages are ADOPTED into the slot's page table read-only
+        (refcounted; the kernel's page-table indirection reads them with
+        zero changes) and ``prefill_pos`` jumps to the match frontier.  A
+        partially-matched boundary page — the page the request will write
+        its first computed token into — is COPY-ON-WRITTEN: a private
+        page is allocated, the cached page's KV is copied device-side,
+        and the table points at the copy, so the shared original is never
+        written.  At least one prefix token is always left to compute
+        (the final chunk's logits feed first-token sampling)."""
+        prefix = req.prefix
+        n = req.prefix_len
+        page = self.pool.page
+        pages = self.prefix_cache.match(prefix)
+        matched = min(len(pages) * page, n - 1)
+        if matched <= 0:
+            self._m_prefix_miss.inc(n)
+            return
+        j, r = divmod(matched, page)
+        self.pool.adopt(req.slot, pages[:j])
+        if r:
+            # boundary-page COW: allocate the private copy now (under
+            # light pressure, evict LRU cached pages; if the pool still
+            # has nothing, fall back to the page-aligned frontier and
+            # recompute the boundary page instead of preempting anyone
+            # at admission time)
+            while not self.pool.ensure(req.slot, matched + 1):
+                if not self.prefix_cache.evict_lru():
+                    matched, r = j * page, 0
+                    break
+            if r:
+                src = pages[j]
+                dst = int(self.pool.page_table[req.slot, j])
+                # even if the eviction loop above just unpinned ``src``
+                # and handed it back as ``dst``, the copy stays correct:
+                # a freed page's KV is intact until reallocated, and
+                # dst==src copies in place
+                self._cache = self._cow_fn()(
+                    self._cache, jnp.asarray(dst, jnp.int32),
+                    jnp.asarray(src, jnp.int32))
+        if matched <= 0:          # COW fallback collapsed the whole match
+            self._m_prefix_miss.inc(n)
+            return
+        req.prefill_pos = matched
+        req.prefix_hit_tokens += matched
+        self._m_prefix_hit.inc(matched)
+        self._m_prefix_miss.inc(n - matched)
+        # mirror the frontier onto host + device pos: the decode block's
+        # parked junk write for this row must land AT the frontier (junk
+        # page or the private COW page, both overwritten/never-read
+        # before any query attends them) — NEVER inside a shared page
+        self._pos[req.slot] = matched
+        self._pos_dev = self._setpos_fn(
+            self._pos_dev, jnp.asarray(req.slot, jnp.int32),
+            jnp.asarray(matched, jnp.int32))
+        self._m_pages_used.set(self.pool.pages_used)
+        self._m_pages_free.set(self.pool.pages_free)
+        self._tracer.span(req.request_id, "prefix_hit", req.t_admit,
+                          req.t_admit, matched)
+
+    def _cow_fn(self):
+        """One compiled device-side page copy: every 5-dim cache array
+        (K/V payloads and, quantized, their scales) copies physical page
+        ``src`` over page ``dst``; scalars pass through."""
+        if self._cow_copy is None:
+            self._m_compiles.inc()
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def cow(cache, dst, src):
+                return {k: (v.at[:, dst].set(v[:, src]) if v.ndim == 5
+                            else v) for k, v in cache.items()}
+
+            self._cow_copy = cow
+        return self._cow_copy
+
+    # ------------------------------------------------------------------
     # paged-pool allocation + preemption
     # ------------------------------------------------------------------
     def _ensure_pages(self, req: Request, tokens: int) -> bool:
         """Allocate pages so ``req``'s slot covers ``tokens`` tokens.
         Under pool pressure, first drain any deferred finish events (a
-        pending EOS release may free pages for free), then preempt the
-        YOUNGEST-admitted occupant (LIFO — possibly ``req`` itself, in
-        which case False is returned and the caller skips this dispatch)
-        and requeue it at the queue head.  The oldest request always keeps
-        its pages, so progress is guaranteed and the pool cannot
-        livelock."""
+        pending EOS release may free pages for free), then evict
+        refcount-0 prefix-cache pages (LRU — cached history is
+        reclaimed BEFORE any live request suffers), and only then preempt
+        the YOUNGEST-admitted occupant (LIFO — possibly ``req`` itself,
+        in which case False is returned and the caller skips this
+        dispatch) and requeue it at the queue head.  The oldest request
+        always keeps its pages, so progress is guaranteed and the pool
+        cannot livelock."""
         while not self.pool.ensure(req.slot, tokens):
             if self._outstanding:
                 self._flush_outstanding()
+                continue
+            if self.prefix_cache is not None and self.prefix_cache.evict_lru():
                 continue
             victim = self._youngest_victim()
             if victim is None:
@@ -541,6 +820,20 @@ class ServingEngine:
         self._eos[b] = -1
         self._pos_dev, self._act_dev = self._park_fn(
             self._pos_dev, self._act_dev, jnp.asarray(b, jnp.int32))
+        if self.prefix_cache is not None:
+            # the victim's already-computed prompt pages go into the cache
+            # BEFORE release reclaims them: its requeue-front resume (and
+            # anyone sharing the prompt) re-prefills through the cache, so
+            # LIFO preemption costs the boundary/output tokens, not the
+            # whole prompt.  Under the very pressure that triggered this
+            # preempt these pages are the NEWEST LRU entries — the
+            # requester evicts older history first and takes these only
+            # as a last resort.
+            resident = min(victim.prefill_pos, victim.prompt_len)
+            full = resident // self.pool.page
+            if full:
+                self.prefix_cache.insert(victim.prompt,
+                                         self.pool.owned(b)[:full])
         freed = self.pool.release(b)
         victim.preemptions += 1
         self.scheduler.requeue_front(victim)   # records the preempt edge
@@ -865,6 +1158,20 @@ class ServingEngine:
         self._pos_dev, self._act_dev = self._park_fn(
             self._pos_dev, self._act_dev, jnp.asarray(b, jnp.int32))
         if self.paged:
+            if self.prefix_cache is not None:
+                # insert the request's FULL prompt pages (the pages whose
+                # every row holds a prompt token — the boundary page mixes
+                # in generated tokens and is not cacheable) before release
+                # decrefs them; newly-inserted pages are pinned and
+                # survive, already-cached chunks keep their existing page.
+                # Bounded by the prefill frontier: an ABORTED mid-prefill
+                # request must not cache pages it never computed (every
+                # natural finish path has the whole prompt resident)
+                resident = min(req.prefill_pos, req.prompt_len)
+                full = resident // self.pool.page
+                if full:
+                    self.prefix_cache.insert(
+                        req.prompt, self.pool.owned(b)[:full])
             self.pool.release(b)
             self._m_pages_used.set(self.pool.pages_used)
             self._m_pages_free.set(self.pool.pages_free)
@@ -962,11 +1269,13 @@ class ServingEngine:
 
     # ------------------------------------------------------------------
     def close(self) -> None:
-        """Release host-side resources: stops the attached metrics HTTP
+        """Release host-side resources: stops the background serving loop
+        (if :meth:`start_loop` started one) and the attached metrics HTTP
         server (if ``init_serving(metrics_port=...)`` started one).  The
         device-side state (cache, programs) is freed by GC as usual; a
         dropped engine's server is also stopped by a GC finalizer, so
         ``close()`` is for deterministic shutdown, not a leak guard."""
+        self.stop_loop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

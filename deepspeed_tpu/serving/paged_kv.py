@@ -27,9 +27,26 @@ step) land somewhere no live slot ever reads.
 Allocation is host-side bookkeeping only (``ensure`` before a dispatch
 covers the tokens it will write; ``release`` on finish) — the pool's
 device arrays are owned and donated by the engine.  When the pool runs
-dry the engine preempts the youngest-admitted slot (LIFO) and requeues it
-at the head of the wait queue; the oldest request always keeps its pages,
-so admission pressure cannot livelock the pool.
+dry the engine first evicts unreferenced prefix-cache pages (LRU), then
+preempts the youngest-admitted slot (LIFO) and requeues it at the head of
+the wait queue; the oldest request always keeps its pages, so admission
+pressure cannot livelock the pool.
+
+Prefix caching (``serving/prefix_cache.py``) rides on two extensions:
+
+- **per-page refcounts** — a physical page may appear in several slots'
+  page tables at once (``adopt`` INCREFs pages a new request shares
+  read-only; ``release`` DECREFs, returning a page to the free list only
+  when its last referencing slot lets go).  Shared pages are never
+  written: prefill (re)starts at the match frontier, decode writes only
+  at/after it, and a partially-matched boundary page is copied to a
+  private page before the slot writes into it (copy-on-write — the
+  engine's device-side page copy; the pool only swaps the bookkeeping).
+- **cache pins** — pages held by the prefix cache (``pin``/``unpin``) are
+  kept OFF the free list even at refcount 0, so a finished request's
+  prompt KV survives for future admissions; eviction (``unpin``) is the
+  cache's LRU decision, taken under pool pressure BEFORE any live slot is
+  preempted.
 """
 
 from __future__ import annotations
@@ -110,6 +127,13 @@ class PagedKVPool:
         # unallocated entries point at the junk page
         self.page_table = np.zeros((num_slots, self.slot_pages), np.int32)
         self._owned: List[List[int]] = [[] for _ in range(num_slots)]
+        # per-page SLOT refcount (prefix-cache sharing: the same physical
+        # page may sit in several slots' tables); the junk page is never
+        # counted
+        self._ref = np.zeros(self.num_pages, np.int32)
+        # pages pinned by the prefix cache: kept off the free list even at
+        # refcount 0 until the cache evicts them (unpin)
+        self._cached: set = set()
         # LIFO free list: released pages are reused first (locality, and
         # deterministic reuse for the preempt-resume tests)
         self._free: List[int] = list(range(usable, 0, -1))
@@ -118,7 +142,8 @@ class PagedKVPool:
     def ensure(self, slot: int, tokens: int) -> bool:
         """Grow the slot's table to cover ``tokens`` logical tokens.
         Returns False when the pool is exhausted — pages already granted
-        stay with the slot (the caller preempts a victim and retries)."""
+        stay with the slot (the caller evicts cached pages / preempts a
+        victim and retries)."""
         if tokens > self.cache_len:
             raise ValueError(f"slot needs {tokens} tokens > per-slot budget "
                              f"{self.cache_len}")
@@ -130,42 +155,112 @@ class PagedKVPool:
             p = self._free.pop()
             self.page_table[slot, len(owned)] = p
             owned.append(p)
+            self._ref[p] += 1
         return True
 
-    def release(self, slot: int) -> int:
-        """Free every page the slot owns and park its table rows on the
-        junk page; returns the number of pages released."""
+    def adopt(self, slot: int, pages: List[int]) -> None:
+        """Pre-populate a freshly-admitted slot's table with pages another
+        request already computed (prefix-cache hit): each page is INCREF'd
+        and shared READ-ONLY — the adopting request's prefill starts past
+        them and its decode writes only into later, privately-allocated
+        pages.  The slot must not own anything yet (admission-time only)."""
         owned = self._owned[slot]
-        n = len(owned)
-        self._free.extend(owned)
+        assert not owned, f"adopt into non-empty slot {slot}: {owned}"
+        for p in pages:
+            assert p != 0, "cannot adopt the junk page"
+            self.page_table[slot, len(owned)] = p
+            owned.append(p)
+            self._ref[p] += 1
+
+    def release(self, slot: int) -> int:
+        """DECREF every page the slot references and park its table rows
+        on the junk page; returns the number of pages actually returned to
+        the free list (shared/cache-pinned pages survive their owners)."""
+        owned = self._owned[slot]
+        freed = 0
+        for p in owned:
+            self._ref[p] -= 1
+            if self._ref[p] == 0 and p not in self._cached:
+                self._free.append(p)
+                freed += 1
         owned.clear()
         self.page_table[slot, :] = 0
-        return n
+        return freed
+
+    # -- prefix-cache pins ---------------------------------------------
+    def pin(self, page: int) -> None:
+        """Keep ``page`` alive for the prefix cache: once its last slot
+        releases it, it parks as a cached page instead of going free."""
+        assert page != 0, "cannot pin the junk page"
+        self._cached.add(page)
+
+    def unpin(self, page: int) -> None:
+        """Cache eviction: drop the pin; a page no slot references goes
+        straight to the free list (its KV content stays intact until the
+        page is reallocated and overwritten)."""
+        self._cached.discard(page)
+        if self._ref[page] == 0:
+            self._free.append(page)
+
+    def ref(self, page: int) -> int:
+        """Live-slot references on ``page`` (the prefix cache's eviction
+        eligibility check: only refcount-0 pages may be evicted)."""
+        return int(self._ref[page])
 
     # -- accounting ----------------------------------------------------
     @property
     def pages_used(self) -> int:
-        return sum(len(o) for o in self._owned)
+        """Distinct physical pages referenced by at least one slot (a
+        shared page counts once — it occupies one page of HBM)."""
+        return int((self._ref > 0).sum())
 
     @property
     def pages_free(self) -> int:
         return len(self._free)
 
+    @property
+    def pages_cached(self) -> int:
+        """Pages pinned by the prefix cache (shared pages a live slot
+        also references are included — the pin is what outlives them)."""
+        return len(self._cached)
+
     def slot_pages_used(self, slot: int) -> int:
         return len(self._owned[slot])
+
+    def owned(self, slot: int) -> List[int]:
+        """The slot's page ids in logical order (a copy — the engine's
+        prefix-cache insertion reads the prompt's page span from here)."""
+        return list(self._owned[slot])
 
     def utilization(self, live_tokens: int) -> float:
         """live-tokens / allocated-page-tokens (1.0 = every allocated page
         row holds a live token; the fixed-slot layout's equivalent is
-        live / (num_slots * cache_len))."""
+        live / (num_slots * cache_len)).  With prefix sharing the ratio
+        can exceed 1 — several slots' live tokens backed by one physical
+        page is precisely the memory the cache saves."""
         alloc = self.pages_used * self.page
         return (live_tokens / alloc) if alloc else 0.0
 
     def check_no_leak(self) -> None:
-        """Invariant probe (tests): every non-junk page is either owned by
-        exactly one slot or on the free list."""
-        owned = [p for o in self._owned for p in o]
-        assert len(owned) == len(set(owned)), "page owned twice"
-        assert 0 not in owned and 0 not in self._free, "junk page allocated"
-        assert sorted(owned + self._free) == list(range(1, self.num_pages)), \
-            f"leaked pages: used={sorted(owned)} free={sorted(self._free)}"
+        """Invariant probe (tests): every non-junk page is accounted for
+        exactly once across {slot-referenced, cache-pinned, free} —
+        refcounts equal the number of owning slots, pages no slot or cache
+        holds are all on the free list, and nothing live is free."""
+        counts: Dict[int, int] = {}
+        for o in self._owned:
+            assert len(o) == len(set(o)), f"slot owns a page twice: {o}"
+            for p in o:
+                counts[p] = counts.get(p, 0) + 1
+        assert 0 not in counts and 0 not in self._free \
+            and 0 not in self._cached, "junk page allocated"
+        for p in range(1, self.num_pages):
+            assert self._ref[p] == counts.get(p, 0), (
+                f"page {p}: refcount {self._ref[p]} != "
+                f"{counts.get(p, 0)} owning slot(s)")
+        free = set(self._free)
+        assert len(free) == len(self._free), "page on the free list twice"
+        live = set(counts) | self._cached
+        assert not (free & live), f"live pages on the free list: {free & live}"
+        assert sorted(free | live) == list(range(1, self.num_pages)), (
+            f"leaked pages: referenced={sorted(counts)} "
+            f"cached={sorted(self._cached)} free={sorted(free)}")

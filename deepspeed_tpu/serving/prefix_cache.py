@@ -1,0 +1,190 @@
+"""Copy-on-write prefix caching over the paged KV pool.
+
+Repeated-prefix traffic (shared system prompts, multi-turn chat, a
+preempted request re-prefilling its own history) re-computes prefill for
+tokens whose KV already sits in the page pool.  This module is the
+vLLM/SGLang radix-cache idiom mapped onto ``serving/paged_kv.py``: a
+**page-granular trie** over prompt token ids whose nodes name physical
+pages, so a new request's admission can pre-populate its page table with
+pages another request already computed and start prefill at the match
+frontier.  The flash-decode kernel already indirects every read through
+the per-slot page table, so the read path needs ZERO kernel changes —
+sharing is purely allocator bookkeeping (refcounts) plus one device-side
+page copy for the partially-matched boundary page a request will write
+into (copy-on-write; the engine owns the copy, this module only the
+matching).
+
+Structure: one trie node per ``page_tokens``-sized chunk of token ids
+(children keyed by the exact chunk tuple — a radix tree whose edge labels
+are all page-length, which makes every match page-aligned by
+construction).  ``match`` walks the prompt down the trie and returns the
+pages of the longest cached prefix; ``insert`` (at request finish) adds
+the request's full-prompt pages, pinning newly-added pages in the pool so
+they survive the request's release.  Under pool pressure the engine calls
+``evict_lru``: the least-recently-used LEAF whose page no live slot
+references is unpinned back to the free list — cached pages are
+reclaimed BEFORE any live request is preempted, and leaf-first eviction
+keeps every remaining root-path intact (a match can never dangle).
+
+Host-side bookkeeping only — no jax, and importable without the
+``deepspeed_tpu`` package (``tools/router.py`` does not need it, but the
+no-jax loading idiom is shared with ``serving/router.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    """One cached page: the chunk of token ids it holds, the physical
+    page, and its LRU tick (monotone counter, not wall time — eviction
+    order is deterministic under test)."""
+
+    __slots__ = ("chunk", "page", "parent", "children", "tick")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"]):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.tick = 0
+
+
+class PrefixCache:
+    """Page-granular radix/trie prefix cache over a :class:`~deepspeed_tpu.
+    serving.paged_kv.PagedKVPool`.
+
+    The cache owns no device memory: it maps token-id prefixes to
+    physical page ids and pins those pages in the pool
+    (:meth:`~deepspeed_tpu.serving.paged_kv.PagedKVPool.pin`) so the
+    allocator parks them instead of freeing.  All mutation happens on the
+    engine's scheduling thread.
+    """
+
+    def __init__(self, pool, registry=None):
+        self.pool = pool
+        self.page = pool.page
+        self._children: Dict[Tuple[int, ...], _Node] = {}   # root level
+        self._nodes = 0
+        self._tick = itertools.count(1)
+        if registry is None:
+            from deepspeed_tpu.monitor.metrics import get_registry
+
+            registry = get_registry()
+        self._m_pages = registry.gauge(
+            "ds_serve_prefix_cache_pages",
+            "physical pages pinned by the prefix cache")
+        self._m_evictions = registry.counter(
+            "ds_serve_prefix_evictions_total",
+            "cached pages evicted (LRU) under pool pressure")
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    # ------------------------------------------------------------------
+    def match(self, tokens: np.ndarray) -> List[int]:
+        """Pages of the longest cached prefix of ``tokens`` (whole pages
+        only — the trie's edges are page-length, so the returned length
+        is ``len(result) * page_tokens`` by construction).  Touches the
+        matched path's LRU ticks."""
+        pages: List[int] = []
+        children = self._children
+        tick = next(self._tick)
+        toks = np.asarray(tokens)
+        for i in range(len(toks) // self.page):
+            chunk = tuple(int(t) for t in
+                          toks[i * self.page:(i + 1) * self.page])
+            node = children.get(chunk)
+            if node is None:
+                break
+            node.tick = tick
+            pages.append(node.page)
+            children = node.children
+        return pages
+
+    def insert(self, tokens: np.ndarray, pages: List[int]) -> int:
+        """Insert the full-page prefix of ``tokens`` backed by ``pages``
+        (the finishing request's first ``len(pages)`` page-table entries,
+        in order).  Chunks already cached keep their EXISTING page — a
+        concurrent duplicate computation's page simply is not pinned and
+        frees with its request; only genuinely new pages are pinned.
+        Returns how many pages were newly added."""
+        toks = np.asarray(tokens)
+        n_full = min(len(toks) // self.page, len(pages))
+        children = self._children
+        parent: Optional[_Node] = None
+        tick = next(self._tick)
+        added = 0
+        for i in range(n_full):
+            chunk = tuple(int(t) for t in
+                          toks[i * self.page:(i + 1) * self.page])
+            node = children.get(chunk)
+            if node is None:
+                node = _Node(chunk, int(pages[i]), parent)
+                children[chunk] = node
+                self.pool.pin(node.page)
+                self._nodes += 1
+                added += 1
+            node.tick = tick
+            parent = node
+            children = node.children
+        if added:
+            self._m_pages.set(self.pool.pages_cached)
+        return added
+
+    # ------------------------------------------------------------------
+    def evict_lru(self) -> int:
+        """Evict the least-recently-used LEAF whose page no live slot
+        references (refcount 0): unpin it back to the pool's free list.
+        Returns the number of pages freed (0 = nothing evictable — every
+        cached page is either shared by a live slot or an interior node
+        with live descendants; the caller falls back to preemption).
+        Leaf-first keeps all remaining root-paths matchable.
+
+        The victim search is a full O(nodes) walk per eviction — a
+        deliberate trade at today's pool scales (hundreds to low
+        thousands of tiny nodes; microseconds on the admission path,
+        and evictions only happen under pool pressure).  If pools grow
+        to where bulk reclaim matters, keep evictable leaves in an
+        incrementally-maintained tick-ordered structure instead."""
+        victim: Optional[_Node] = None
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif self.pool.ref(node.page) == 0 and (
+                    victim is None or node.tick < victim.tick):
+                victim = node
+        if victim is None:
+            return 0
+        siblings = (victim.parent.children if victim.parent is not None
+                    else self._children)
+        del siblings[victim.chunk]
+        self._nodes -= 1
+        self.pool.unpin(victim.page)
+        self._m_evictions.inc()
+        self._m_pages.set(self.pool.pages_cached)
+        return 1
+
+    def clear(self) -> int:
+        """Drop every cached page (tests / explicit cache reset); returns
+        pages unpinned."""
+        n = 0
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self.pool.unpin(node.page)
+            n += 1
+        self._children = {}
+        self._nodes = 0
+        self._m_pages.set(self.pool.pages_cached)
+        return n

@@ -1,0 +1,486 @@
+"""Multi-replica serving router: least-loaded dispatch over ServingEngine
+replicas (the front-end ABOVE one engine — ROADMAP item 3).
+
+One ServingEngine serves one process's slots; millions-of-users traffic
+needs N replicas and something to spread load across them.  This module
+is that something, built from signals the replicas already export:
+
+- **membership / drain** — ``GET /healthz`` per replica (200 = ready,
+  503 = draining or otherwise not accepting work; unreachable = down).
+  A replica that stops being ready simply stops receiving dispatches —
+  ``ServingEngine.drain()`` needs no router-side coordination.
+- **least-loaded dispatch** — each replica's live ``/statz`` gauges
+  (``ds_serve_queue_depth``, ``ds_serve_active_slots``,
+  ``ds_serve_kv_pages_used/free``) plus the router's own in-flight count
+  (polls are eventually-consistent; the in-flight term keeps a burst
+  between polls from piling onto one replica).  Score = requests in the
+  system (queue + active + in-flight) with KV-pool pressure as the
+  fractional tie-break.
+- **session affinity** — a ``session`` key in the request pins follow-up
+  turns to the same replica while it stays healthy (TTL-bounded), so a
+  conversation's prefix-cache pages (serving/prefix_cache.py) are HIT
+  instead of recomputed on a cold replica.
+- **no dropped requests** — a failed dispatch (connection error, 503
+  while draining, or the replica handing back a request that was still
+  queued when its drain hit) is retried on another replica; the request
+  is only failed back to the client after every round is exhausted.
+
+The router dispatches ``POST /generate`` (the endpoint
+``init_serving(metrics_port=...)`` attaches to the replica's metrics
+server) and is itself a stdlib HTTP front-end (:class:`RouterServer`)
+exposing the same ``/generate`` + ``/healthz`` + ``/statz`` shapes, so
+routers can be health-checked and scraped exactly like replicas.
+
+jax-free by construction: the metrics module is resolved through the
+package only when it is already importable, else loaded by file path
+(the ``tools/fleet_dump.py`` idiom) — ``tools/router.py`` runs this file
+standalone on an operator box with no jax installed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
+
+
+def _load_metrics():
+    """The repo's stdlib-only metrics module: via the package when it is
+    importable in this process (so the router and any in-process engines
+    share ONE registry), else exec'd by file path (operator box, no
+    jax)."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.monitor import metrics
+
+        return metrics
+    mod = sys.modules.get("_ds_router_metrics")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "monitor", "metrics.py")
+    spec = importlib.util.spec_from_file_location("_ds_router_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_router_metrics"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_metrics = _load_metrics()
+
+__all__ = ["Replica", "Router", "RouterServer"]
+
+
+class Replica:
+    """One backend ServingEngine endpoint and the router's view of it."""
+
+    def __init__(self, name: str, base_url: str):
+        self.name = name
+        self.base = base_url.rstrip("/")
+        if not self.base.startswith("http"):
+            self.base = "http://" + self.base
+        self.ready = False
+        self.reason: Optional[str] = "unpolled"
+        self.queue_depth = 0.0
+        self.active_slots = 0.0
+        self.kv_busy = 0.0           # pages_used / (used + free), in [0, 1]
+        self.inflight = 0            # router-side: dispatches awaiting reply
+        self.last_poll = 0.0
+
+    def score(self) -> float:
+        """Lower = less loaded.  Whole requests in the system dominate;
+        KV-pool pressure (always < 1) breaks ties between otherwise-equal
+        replicas."""
+        return (self.queue_depth + self.active_slots + self.inflight
+                + min(self.kv_busy, 0.99))
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"name": self.name, "base": self.base, "ready": self.ready,
+                "reason": self.reason, "queue_depth": self.queue_depth,
+                "active_slots": self.active_slots,
+                "kv_busy": round(self.kv_busy, 4),
+                "inflight": self.inflight, "score": round(self.score(), 4)}
+
+
+class Router:
+    """Least-loaded, drain-aware dispatch across N replicas.
+
+    ``replicas`` is a list of URLs (or ``name=url`` pairs) pointing at
+    replica metrics servers (``init_serving(metrics_port=...)``).
+    ``dispatch`` POSTs ``/generate`` to the best ready replica and
+    retries elsewhere on failure; ``refresh`` polls ``/healthz`` +
+    ``/statz``; ``start()`` polls on a background thread.
+    """
+
+    def __init__(self, replicas: List[str], *, poll_interval: float = 0.25,
+                 poll_timeout: float = 2.0, affinity_ttl: float = 300.0,
+                 max_sessions: int = 65536, dispatch_rounds: int = 8,
+                 retry_backoff: float = 0.05,
+                 request_timeout: float = 300.0, registry=None):
+        self.replicas: List[Replica] = []
+        for i, spec in enumerate(replicas):
+            name, sep, rest = spec.partition("=")
+            if sep and not name.startswith("http") and "/" not in name:
+                self.replicas.append(Replica(name, rest))
+            else:
+                self.replicas.append(Replica(f"r{i}", spec))
+        if not self.replicas:
+            raise ValueError("router needs at least one replica URL")
+        self._by_name = {r.name: r for r in self.replicas}
+        if len(self._by_name) != len(self.replicas):
+            raise ValueError("duplicate replica names")
+        self.poll_interval = float(poll_interval)
+        self.poll_timeout = float(poll_timeout)
+        self.affinity_ttl = float(affinity_ttl)
+        self.max_sessions = int(max_sessions)
+        self.dispatch_rounds = int(dispatch_rounds)
+        self.retry_backoff = float(retry_backoff)
+        self.request_timeout = float(request_timeout)
+        self._affinity: Dict[str, Tuple[str, float]] = {}
+        self._lock = threading.Lock()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop: Optional[threading.Event] = None
+        self.registry = (registry if registry is not None
+                         else _metrics.get_registry())
+        self._m_retries = self.registry.counter(
+            "ds_router_retries_total",
+            "dispatches retried on another replica (connection failure, "
+            "drain 503, or drain-requeue)")
+        self._m_dispatch = {
+            r.name: self.registry.counter(
+                "ds_router_dispatch_total",
+                "requests dispatched, by replica",
+                labels={"replica": r.name})
+            for r in self.replicas}
+        self._m_depth = {
+            r.name: self.registry.gauge(
+                "ds_router_replica_queue_depth",
+                "last-polled ds_serve_queue_depth, by replica",
+                labels={"replica": r.name})
+            for r in self.replicas}
+
+    # -- membership + load polling -------------------------------------
+    def poll_one(self, rep: Replica) -> None:
+        """One replica's ``/healthz`` + ``/statz`` poll; failures mark it
+        not-ready (it rejoins on the next successful poll)."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            # readiness: the status code IS the signal (503 raises)
+            with urllib.request.urlopen(rep.base + "/healthz",
+                                        timeout=self.poll_timeout):
+                pass
+            rep.ready, rep.reason = True, None
+        except urllib.error.HTTPError as exc:
+            body = {}
+            try:
+                body = json.load(exc)
+            except Exception:
+                pass
+            rep.ready = False
+            rep.reason = body.get("reason") or f"healthz {exc.code}"
+        except OSError as exc:
+            rep.ready, rep.reason = False, f"unreachable: {exc}"
+        rep.last_poll = time.monotonic()
+        if not rep.ready:
+            return
+        try:
+            with urllib.request.urlopen(rep.base + "/statz",
+                                        timeout=self.poll_timeout) as resp:
+                m = json.load(resp).get("metrics", {})
+        except (OSError, ValueError):
+            return                       # keep the last load view
+        rep.queue_depth = float(m.get("ds_serve_queue_depth") or 0)
+        rep.active_slots = float(m.get("ds_serve_active_slots") or 0)
+        used = float(m.get("ds_serve_kv_pages_used") or 0)
+        free = float(m.get("ds_serve_kv_pages_free") or 0)
+        rep.kv_busy = used / (used + free) if used + free else 0.0
+        self._m_depth[rep.name].set(rep.queue_depth)
+
+    def refresh(self) -> None:
+        for rep in self.replicas:
+            self.poll_one(rep)
+
+    def start(self) -> "Router":
+        """Poll membership/load on a background daemon thread."""
+        if self._poll_thread is not None and self._poll_thread.is_alive():
+            return self
+        self.refresh()                   # synchronous first poll
+        stop = self._poll_stop = threading.Event()
+
+        def poll():
+            while not stop.wait(self.poll_interval):
+                self.refresh()
+
+        self._poll_thread = threading.Thread(target=poll, daemon=True,
+                                             name="ds-router-poll")
+        self._poll_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._poll_stop is not None:
+            self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=10)
+        self._poll_thread = None
+        self._poll_stop = None
+
+    # -- dispatch ------------------------------------------------------
+    def ready_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if r.ready]
+
+    def pick(self, session: Optional[str] = None,
+             exclude: Tuple[str, ...] = ()) -> Optional[Replica]:
+        """Session-affine when possible (prefix-cache locality), else the
+        lowest-score ready replica (name as the deterministic final
+        tie-break)."""
+        now = time.monotonic()
+        ready = [r for r in self.replicas
+                 if r.ready and r.name not in exclude]
+        if not ready:
+            return None
+        if session is not None:
+            with self._lock:
+                ent = self._affinity.get(session)
+            if ent is not None and now - ent[1] < self.affinity_ttl:
+                rep = self._by_name.get(ent[0])
+                if rep is not None and rep.ready and rep.name not in exclude:
+                    return rep
+        return min(ready, key=lambda r: (r.score(), r.name))
+
+    def _post(self, rep: Replica, payload: dict) -> Tuple[int, dict]:
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            rep.base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        # the socket deadline must OUTLAST the replica's own generation
+        # deadline (the payload's "timeout", which the engine honors with
+        # its 504-and-abort path) — a router that times out first would
+        # mistake a still-generating replica for a dead one and
+        # double-generate the prompt elsewhere
+        deadline = self.request_timeout
+        try:
+            deadline = max(deadline, float(payload.get("timeout")) + 30.0)
+        except (TypeError, ValueError):
+            pass
+        try:
+            with urllib.request.urlopen(req, timeout=deadline) as resp:
+                return resp.status, json.load(resp)
+        except urllib.error.HTTPError as exc:
+            try:
+                return exc.code, json.load(exc)
+            except Exception:
+                return exc.code, {"error": f"replica returned {exc.code}"}
+
+    def dispatch(self, payload: dict) -> Tuple[int, dict]:
+        """Route one ``/generate`` payload: pick → POST → retry elsewhere
+        on failure.  Returns ``(status, body)``; 200 bodies carry the
+        serving replica's name under ``"replica"``.  A request is only
+        failed (503) after ``dispatch_rounds`` picks found no replica
+        that would take it — drain-aware redistribution means a replica
+        draining mid-request hands its queued-never-admitted requests
+        back as 503s, and they land here for a second life elsewhere."""
+        session = payload.get("session")
+        last_err: Optional[dict] = None
+        tried: set = set()
+        for attempt in range(self.dispatch_rounds):
+            rep = self.pick(session=session, exclude=tuple(tried))
+            if rep is None and tried:
+                # every ready replica already refused this request this
+                # round; start a fresh round over re-polled membership
+                tried.clear()
+                rep = self.pick(session=session)
+            if rep is None:
+                self.refresh()
+                time.sleep(self.retry_backoff * (attempt + 1))
+                continue
+            with self._lock:
+                rep.inflight += 1
+            try:
+                try:
+                    code, body = self._post(rep, payload)
+                except OSError as exc:
+                    # a TIMEOUT is not "unreachable": the replica may
+                    # still be mid-generation, and re-dispatching would
+                    # double-generate the prompt — surface it like the
+                    # replica's own 504 (no retry); genuine connection
+                    # failures fall through to retry-elsewhere
+                    reason = getattr(exc, "reason", exc)
+                    if isinstance(exc, TimeoutError) or isinstance(
+                            reason, TimeoutError):
+                        return 504, {"error": "router-side timeout; the "
+                                              "replica may still be "
+                                              "generating (not retried)",
+                                     "replica": rep.name}
+                    code, body = -1, {"error": f"unreachable: {exc}"}
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            if code == 200:
+                self._m_dispatch[rep.name].inc()
+                if session is not None:
+                    with self._lock:
+                        self._affinity[session] = (rep.name,
+                                                   time.monotonic())
+                    if len(self._affinity) > self.max_sessions:
+                        self._expire_affinity()
+                body["replica"] = rep.name
+                return 200, body
+            if code == 400:
+                # the payload itself is bad — no replica will differ
+                return 400, body
+            if code == 504:
+                # the replica timed out mid-generation: re-dispatching
+                # could double-generate; surface it
+                body["replica"] = rep.name
+                return 504, body
+            # -1 (unreachable) / 503 (draining or requeued): take the
+            # replica out until the next healthz poll and retry elsewhere
+            rep.ready = False
+            rep.reason = body.get("error") or f"generate -> {code}"
+            if session is not None:
+                with self._lock:
+                    self._affinity.pop(session, None)
+            self._m_retries.inc()
+            tried.add(rep.name)
+            last_err = body
+        return 503, {"error": "no replica accepted the request after "
+                              f"{self.dispatch_rounds} rounds",
+                     "last": last_err}
+
+    def _expire_affinity(self) -> None:
+        """Enforce the session-map bound: drop TTL-expired entries, then
+        — if live sessions alone exceed the cap — evict oldest-touched
+        down to 7/8 of ``max_sessions``, so the scan amortizes instead of
+        re-running on every over-bound dispatch while the dict grows."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [s for s, (_, t) in self._affinity.items()
+                    if now - t >= self.affinity_ttl]
+            for s in dead:
+                del self._affinity[s]
+            over = len(self._affinity) - (self.max_sessions * 7) // 8
+            if over > 0:
+                oldest = sorted(self._affinity.items(),
+                                key=lambda kv: kv[1][1])[:over]
+                for s, _ in oldest:
+                    del self._affinity[s]
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"replicas": [r.snapshot() for r in self.replicas],
+                "ready": sum(1 for r in self.replicas if r.ready),
+                "sessions": len(self._affinity)}
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------------
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: Router   # set by the server subclass
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        path, _, _ = self.path.partition("?")
+        if path not in ("/generate", "/generate/"):
+            self.send_error(404)
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as exc:
+            self._send(400, {"error": f"bad JSON body: {exc}"})
+            return
+        code, body = self.router.dispatch(payload)
+        self._send(code, body)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path, _, query = self.path.partition("?")
+        if path in ("/healthz", "/healthz/"):
+            # the router is ready while ANY replica is (same 200/503
+            # shape as a replica's /healthz, so routers stack/chain)
+            snap = self.router.snapshot()
+            ready = snap["ready"] > 0
+            self._send(200 if ready else 503,
+                       {"ready": ready, "replicas": snap["replicas"]})
+        elif path in ("/replicaz", "/replicaz/"):
+            self._send(200, self.router.snapshot())
+        elif path in ("/statz", "/statz/"):
+            qs = parse_qs(query)
+            reg = self.router.registry
+            payload = {"enabled": reg.enabled, "metrics": reg.snapshot()}
+            if "kinds" in qs:
+                payload["kinds"] = {name: kind for (name, _), (kind, _) in
+                                    reg.typed_snapshot().items()}
+            self._send(200, payload)
+        elif path == "/":
+            self._send(200, {"endpoints": ["/generate", "/healthz",
+                                           "/replicaz", "/statz"]})
+        else:
+            self.send_error(404)
+
+    def log_message(self, fmt, *args):   # dispatches are not log lines
+        pass
+
+
+class RouterServer:
+    """Serve the router over HTTP on a daemon thread (the ``MetricsServer``
+    shape: ``port=0`` binds an ephemeral port, read it back from
+    ``server.port``)."""
+
+    def __init__(self, router: Router, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.router = router
+        self._requested_port = port
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else \
+            self._requested_port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "RouterServer":
+        if self._httpd is not None:
+            return self
+        handler = type("Handler", (_RouterHandler,), {"router": self.router})
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="ds-router-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        self._thread = None

@@ -69,6 +69,11 @@ class Request:
     # paged-KV preempt-and-requeue (engine._preempt): times this request
     # lost its pages to pool pressure and went back to the queue head
     preemptions: int = 0
+    # prefix-cache hits (engine._admit_prefix): prefix tokens whose
+    # prefill was SKIPPED because their KV pages were adopted from the
+    # cache — accumulated across admissions (a preempt-resume that
+    # re-prefills through the cache adds its resume hit here too)
+    prefix_hit_tokens: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -110,7 +115,7 @@ class IterationScheduler:
     not submit time — early-EOS rows drain first).
     """
 
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int, registry=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
@@ -128,8 +133,10 @@ class IterationScheduler:
         self._flight = get_flight_recorder()
         # lifecycle metrics (no-ops while the registry is disabled; the
         # scheduler owns the queue-side spans, the engine owns the
-        # compute-side ones — see docs/OBSERVABILITY.md)
-        reg = get_registry()
+        # compute-side ones — see docs/OBSERVABILITY.md).  A replica-
+        # scoped registry may be passed so N engines in one process keep
+        # per-replica series (the router's least-loaded signal).
+        reg = registry if registry is not None else get_registry()
         self._m_submitted = reg.counter(
             "ds_serve_submitted_total", "requests enqueued")
         self._m_admitted = reg.counter(
@@ -144,7 +151,8 @@ class IterationScheduler:
             r: reg.counter("ds_serve_finished_total",
                            "finished requests by reason",
                            labels={"reason": r})
-            for r in ("eos", "length", "cache_budget", "unknown")}
+            for r in ("eos", "length", "cache_budget", "cancelled",
+                      "unknown")}
 
     # -- admission -----------------------------------------------------
     def submit(self, req: Request) -> Request:
@@ -178,9 +186,13 @@ class IterationScheduler:
             return []
         admitted = []
         for slot in self.free_slots():
-            if not self._queue:
+            try:
+                req = self._queue.popleft()
+            except IndexError:
+                # empty — including the race where a cancel() from an
+                # HTTP /generate worker removed the last queued request
+                # between our emptiness check and the pop
                 break
-            req = self._queue.popleft()
             req.slot = slot
             req.state = PREFILLING
             req.prefill_pos = 0
@@ -243,6 +255,32 @@ class IterationScheduler:
         # which silent folding into "length" would hide
         self._m_finished.get(req.finish_reason,
                              self._m_finished["unknown"]).inc()
+
+    def cancel(self, req: Request) -> bool:
+        """Withdraw a still-QUEUED request (it never ran; no slot, no
+        pages, no output).  The router's drain-redistribution path: a
+        request parked in a draining replica's queue is cancelled here
+        and re-dispatched to a healthy replica, so a drain drops nothing.
+        Thread-safe against a concurrent ``admit``: once admit pops the
+        request the ``deque.remove`` below raises and this returns False
+        (the request runs where it is).  Cancelled requests close their
+        trace timeline with reason ``cancelled`` and are NOT appended to
+        ``finished`` (they were never served here)."""
+        if req.state != QUEUED:
+            return False
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            return False
+        req.state = FINISHED
+        req.finish_reason = "cancelled"
+        req.t_finish = time.perf_counter()
+        self._tracer.finish(req.request_id, req.t_finish, "cancelled", 0)
+        if self._flight.enabled:
+            self._flight.record("serve_cancel", rid=req.request_id)
+        self._m_finished["cancelled"].inc()
+        self._m_queue_depth.set(len(self._queue))
+        return True
 
     def requeue_front(self, req: Request) -> None:
         """Preempt-and-requeue (paged KV pool pressure): the request loses

@@ -47,3 +47,32 @@ def test_serving_bench_scenario(capsys):
               f"({out['goodput_speedup']}x); p99 "
               f"{out['continuous']['p99_latency_s']}s vs "
               f"{out['static']['p99_latency_s']}s")
+
+
+def test_prefix_serving_bench_scenario(capsys):
+    """Shared-prefix scenario (bench_prefix_serving): the tentpole
+    acceptance pair at tiny/CPU scale — prefill tokens computed drop
+    >= 40% with prefix caching on, and outputs are token-identical to
+    the cache-off run of the identical trace."""
+    from bench import bench_prefix_serving
+
+    out = bench_prefix_serving(num_requests=16, num_slots=4, qps=200.0,
+                               tiny=True)
+    for side in ("cache_on", "cache_off"):
+        assert out[side]["goodput_tok_s"] > 0
+        assert out[side]["prefill_tokens_computed"] > 0
+    # identical trace, identical tokens delivered on both sides
+    assert out["cache_on"]["tokens"] == out["cache_off"]["tokens"]
+    assert out["outputs_token_identical"] is True
+    # the acceptance floor: >= 40% of prefill compute skipped
+    assert out["prefill_savings_ratio"] >= 0.40, out["prefill_savings_ratio"]
+    assert 0 < out["prefix_hit_ratio"] <= 1
+    assert out["cache_on"]["prefix_cache_pages"] > 0
+    with capsys.disabled():
+        print(f"\nprefix-caching bench (tiny/CPU): prefill "
+              f"{out['cache_on']['prefill_tokens_computed']} vs "
+              f"{out['cache_off']['prefill_tokens_computed']} tokens "
+              f"computed ({100 * out['prefill_savings_ratio']:.0f}% saved, "
+              f"hit ratio {out['prefix_hit_ratio']}), goodput "
+              f"{out['prefix_goodput_speedup']}x, outputs identical: "
+              f"{out['outputs_token_identical']}")

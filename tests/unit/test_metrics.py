@@ -281,7 +281,17 @@ def test_bench_summary_last_line_roundtrips_json():
     finally:
         sys.path.pop(0)
     record = {"metric": "m", "value": 1.5, "unit": "tok/s",
-              "vs_baseline": 0.5, "detail": {"mfu": 0.4, "backend": "cpu"}}
+              "vs_baseline": 0.5,
+              "detail": {"mfu": 0.4, "backend": "cpu",
+                         # the prefix-caching acceptance rung rides the
+                         # record detail and surfaces in the summary
+                         "prefix_serving_125m": {
+                             "prefill_savings_ratio": 0.64,
+                             "prefix_hit_ratio": 0.64,
+                             "outputs_token_identical": True,
+                             "prefix_goodput_speedup": 1.04,
+                             "cache_on": {"ttft_p99_s": 0.017},
+                             "cache_off": {"ttft_p99_s": 0.019}}}}
     serving = {"goodput_speedup": 2.0,
                "continuous": {"goodput_tok_s": 100.0, "p99_latency_s": 0.5},
                "metrics": {"ttft_p50_s": 0.01, "ttft_p99_s": 0.05,
@@ -300,11 +310,45 @@ def test_bench_summary_last_line_roundtrips_json():
     # the ISSUE 7 tail-attribution sub-object rides BENCH_JSON verbatim
     ta = parsed["serving_metrics"]["tail_attribution"]
     assert ta["dominant_phase"] == "queue" and ta["exemplars"] == [7, 3]
+    # the prefix-caching acceptance pair rides BENCH_JSON (round-trip
+    # pinned: savings ratio + token-identity + hit ratio)
+    pf = parsed["serving_prefix"]
+    assert pf["prefill_savings_ratio"] == 0.64
+    assert pf["outputs_token_identical"] is True
+    assert pf["prefix_hit_ratio"] == 0.64
+    assert pf["ttft_p99_on_s"] == 0.017 and pf["ttft_p99_off_s"] == 0.019
     # the human-greppable prefixed line stays, directly above it
     assert lines[-2] == "BENCH_JSON: " + lines[-1]
     # no serving rung (CPU smoke): still a parseable bare last line
-    parsed = json.loads(bench.summary_lines(record, None)[-1])
-    assert "serving_metrics" not in parsed
+    bare = {"metric": "m", "value": 1.5, "unit": "tok/s",
+            "vs_baseline": 0.5, "detail": {"mfu": 0.4, "backend": "cpu"}}
+    parsed = json.loads(bench.summary_lines(bare, None)[-1])
+    assert "serving_metrics" not in parsed and "serving_prefix" not in parsed
+
+
+def test_metrics_dump_serving_prefix_hit_ratio_line():
+    """--serving renders the prefix-cache hit-ratio line from the
+    ds_serve_prefix_* series (and omits it when the cache never ran)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    try:
+        import metrics_dump
+    finally:
+        sys.path.pop(0)
+    m = {"ds_serve_kv_pages_used": 6, "ds_serve_kv_pages_free": 2,
+         "ds_serve_preempted_total": 1,
+         "ds_serve_prefix_hit_tokens_total": 300,
+         "ds_serve_prefix_miss_tokens_total": 100,
+         "ds_serve_prefix_cache_pages": 7,
+         "ds_serve_prefix_evictions_total": 2}
+    out = metrics_dump.serving_kv_summary(m)
+    assert "kv pages: 6 used / 2 free (8 total)" in out
+    assert "prefix cache: 75.0% hit ratio (300 hit / 100 computed" in out
+    assert "7 cached pages" in out and "2 evictions" in out
+    # cache never ran (off or fixed-slot): no prefix line at all
+    cold = metrics_dump.serving_kv_summary(
+        {"ds_serve_kv_pages_used": 1, "ds_serve_kv_pages_free": 7})
+    assert "prefix cache" not in cold
 
 
 def test_metrics_dump_renders_snapshot_and_csv(tmp_path):

@@ -157,7 +157,8 @@ def test_preempt_correlates_flight_events_with_request_timelines(setup,
     reg = get_registry()
     serve = _serve(model, params, kv_pool_tokens=80)    # 5 usable pages
     flight.enable()
-    reg.enable()
+    flight.reset()      # the ring is process-global: drop any residue a
+    reg.enable()        # previous test's enabled window left behind
     reg.reset()
     tracer.reset()
     tracer.enable()

@@ -1,0 +1,384 @@
+"""Multi-replica router (serving/router.py + tools/router.py): the
+no-jax tool selftest wired tier-1, router unit behavior against synthetic
+endpoints, and the live two-replica e2e — a shared-prefix trace dispatched
+least-loaded over TWO real ServingEngines (each with its own registry,
+health flag, serving loop, and ``/generate`` endpoint), one replica
+drained mid-trace via the ``/healthz`` signal: every request completes
+token-identically to ``generate()`` and none is dropped."""
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.models import causal_lm
+from deepspeed_tpu.monitor.health import HealthState
+from deepspeed_tpu.monitor.metrics import MetricsRegistry
+from deepspeed_tpu.serving import Router, RouterServer
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# offline tool: selftest wired tier-1 + the no-jax contract
+# ---------------------------------------------------------------------------
+
+def test_router_tool_selftest():
+    """tools/router.py --selftest drives the REAL Router through
+    least-loaded picks, session affinity, drain redistribution with zero
+    drops, and the HTTP front-end, against two synthetic replicas."""
+    router_tool = _tool("router")
+    assert router_tool.main(["router", "--selftest"]) == 0
+
+
+def test_router_tool_runs_without_jax():
+    """The operator-box contract stated in the tool's docstring: running
+    ``tools/router.py --selftest`` in a fresh interpreter must never
+    import jax OR the deepspeed_tpu package (the router module loads by
+    file path; the selftest itself asserts on sys.modules)."""
+    import subprocess
+
+    script = os.path.join(_TOOLS, "router.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--selftest"], capture_output=True,
+        text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    assert "router selftest: OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# router units against synthetic replicas (the tool's fixture, reused)
+# ---------------------------------------------------------------------------
+
+def test_router_least_loaded_and_inflight_tiebreak():
+    """Dispatch follows the live load gauges, and the router's own
+    in-flight accounting spreads a burst BETWEEN polls (the /statz view
+    is eventually-consistent)."""
+    router_tool = _tool("router")
+    reps = [router_tool._FakeReplica("a"), router_tool._FakeReplica("b")]
+    a, b = reps
+    reg = MetricsRegistry().enable()
+    router = Router([f"a={a.url}", f"b={b.url}"], registry=reg,
+                    dispatch_rounds=3, retry_backoff=0.01)
+    try:
+        a.queue_depth = 3
+        router.refresh()
+        picks = [router.pick().name for _ in range(3)]
+        assert picks == ["b", "b", "b"]
+        # in-flight tiebreak: with b carrying 4 un-acked dispatches, the
+        # next pick prefers a (3 queued) over b (0 queued + 4 in flight)
+        router._by_name["b"].inflight = 4
+        assert router.pick().name == "a"
+        router._by_name["b"].inflight = 0
+        # unreachable replica drops out of membership on poll
+        b.stop()
+        router.refresh()
+        assert [r.ready for r in router.replicas] == [True, False]
+        assert router.pick().name == "a"
+        code, body = router.dispatch({"prompt": [1], "max_new_tokens": 2})
+        assert code == 200 and body["replica"] == "a"
+    finally:
+        a.stop()
+
+
+# ---------------------------------------------------------------------------
+# live two-replica e2e on the CPU mesh
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet(devices):
+    """(ref InferenceEngine, [replica ServingEngines], Router,
+    RouterServer): two real replicas sharing one set of weights, each
+    with a PRIVATE registry + health flag (per-replica /statz and
+    /healthz truths in one process), background serving loops, and live
+    /generate endpoints."""
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))
+    ref = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 64})
+    ref.set_params(params)
+    replicas = []
+    for _ in range(2):
+        reg = MetricsRegistry().enable()
+        serve = deepspeed_tpu.init_serving(
+            model, config={"dtype": "float32", "max_out_tokens": 64,
+                           "kv_page_tokens": 16},
+            num_slots=2, prefill_chunk=8, decode_block_tokens=3,
+            metrics_port=0, registry=reg, private_health=True,
+            serve_loop=True)
+        serve.set_params(params)
+        replicas.append(serve)
+    assert replicas[0].health is not replicas[1].health
+    assert isinstance(replicas[0].health, HealthState)
+    router = Router(
+        [f"repl{i}={s.metrics_server.url}" for i, s in enumerate(replicas)],
+        registry=MetricsRegistry().enable(), dispatch_rounds=8,
+        retry_backoff=0.02, poll_interval=0.05)
+    router.refresh()
+    front = RouterServer(router).start()
+    yield ref, replicas, router, front
+    front.stop()
+    router.stop()
+    for s in replicas:
+        s.close()
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+def test_live_replica_generate_endpoint(fleet, rng):
+    """One replica's POST /generate returns generate()-identical tokens
+    (the loop thread steps; the HTTP worker blocks on completion)."""
+    ref, replicas, _, _ = fleet
+    prompt = np.asarray(jax.random.randint(rng, (9,), 0, 256))
+    want = np.asarray(ref.generate(prompt[None], max_new_tokens=6,
+                                   do_sample=False))[0, 9:]
+    code, body = _post(replicas[0].metrics_server.url,
+                       {"prompt": prompt.tolist(), "max_new_tokens": 6})
+    assert code == 200
+    np.testing.assert_array_equal(np.asarray(body["tokens"]), want)
+    assert body["finish_reason"] == "length"
+
+
+def test_two_replica_trace_with_middrain_zero_dropped(fleet, rng):
+    """THE acceptance e2e: a bimodal shared-prefix trace through the
+    router front-end over two live replicas; replica 0 drains mid-trace
+    (its /healthz flips 503 and its /generate starts refusing) — every
+    request still completes token-identically to generate(), none are
+    dropped, and post-drain traffic lands on replica 1 only."""
+    ref, replicas, router, front = fleet
+    for s in replicas:
+        s.resume_admission()          # clean membership from prior tests
+    router.refresh()
+    assert sum(r.ready for r in router.replicas) == 2
+
+    keys = jax.random.split(rng, 24)
+    shared = np.asarray(jax.random.randint(keys[0], (32,), 0, 256))
+    prompts, news = [], []
+    for i in range(16):
+        if i % 4 == 3:                # bimodal: every 4th is a cold long
+            p = np.asarray(jax.random.randint(keys[i + 1], (20,), 0, 256))
+            n = 8
+        else:                         # shared 2-page prefix + unique tail
+            tail = np.asarray(jax.random.randint(keys[i + 1],
+                                                 (3 + i % 5,), 0, 256))
+            p = np.concatenate([shared, tail])
+            n = 3 + i % 4
+        prompts.append(p)
+        news.append(n)
+    want = [np.asarray(ref.generate(p[None], max_new_tokens=n,
+                                    do_sample=False))[0, len(p):]
+            for p, n in zip(prompts, news)]
+
+    results = [None] * len(prompts)
+    errors = []
+
+    def client(i):
+        try:
+            results[i] = _post(front.url,
+                               {"prompt": prompts[i].tolist(),
+                                "max_new_tokens": news[i],
+                                "session": f"sess-{i % 3}"})
+        except Exception as exc:          # noqa: BLE001 - collected below
+            errors.append((i, repr(exc)))
+
+    router.start()                        # live membership polling
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads[:8]:
+        t.start()
+    # drain replica 0 mid-trace on a side thread (the loop keeps
+    # stepping; drain only waits) — /healthz flips immediately
+    drainer = threading.Thread(target=replicas[0].drain)
+    drainer.start()
+    for t in threads[8:]:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    drainer.join(timeout=180)
+
+    assert not errors, errors
+    assert all(r is not None for r in results), "client thread hung"
+    # ZERO dropped: every request came back 200 with exact tokens
+    by_replica = {"repl0": 0, "repl1": 0}
+    for i, (code, body) in enumerate(results):
+        assert code == 200, (i, body)
+        np.testing.assert_array_equal(
+            np.asarray(body["tokens"]), want[i],
+            err_msg=f"request {i} diverged through the router "
+                    f"(served by {body['replica']})")
+        by_replica[body["replica"]] += 1
+    assert by_replica["repl1"] > 0
+    # replica 0 is out of membership; new traffic goes to replica 1 only
+    assert not replicas[0].health.ready
+    router.refresh()
+    r0 = [r for r in router.replicas if r.name == "repl0"][0]
+    assert not r0.ready and "drain" in (r0.reason or "")
+    code, body = _post(front.url, {"prompt": prompts[0].tolist(),
+                                   "max_new_tokens": news[0]})
+    assert code == 200 and body["replica"] == "repl1"
+    np.testing.assert_array_equal(np.asarray(body["tokens"]), want[0])
+    # the router front /healthz stays ready on one live replica
+    with urllib.request.urlopen(front.url + "/healthz", timeout=5) as resp:
+        assert json.load(resp)["ready"] is True
+    # rejoin: resume_admission flips repl0's private health back
+    replicas[0].resume_admission()
+    router.refresh()
+    assert sum(r.ready for r in router.replicas) == 2
+    # per-replica leak probe after the full trace (drain included)
+    for s in replicas:
+        s.pool.check_no_leak()
+
+
+def test_replica_scoped_statz_and_health(fleet):
+    """The multi-replica-per-process enablers: each replica's /statz is
+    ITS registry (disjoint counters) and /healthz is ITS health flag —
+    draining one replica must not flip the other's readiness."""
+    _, replicas, _, _ = fleet
+    for s in replicas:
+        s.resume_admission()
+    urls = [s.metrics_server.url for s in replicas]
+    with urllib.request.urlopen(urls[0] + "/healthz", timeout=5) as resp:
+        assert json.load(resp)["ready"] is True
+    replicas[0].scheduler.pause_admission()
+    replicas[0].health.set_not_ready("draining")
+    try:
+        code0 = urllib.request.urlopen(
+            urls[1] + "/healthz", timeout=5).status
+        assert code0 == 200, "replica 1's health flipped with replica 0"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urls[0] + "/healthz", timeout=5)
+        assert exc.value.code == 503
+    finally:
+        replicas[0].resume_admission()
+
+    # disjoint registries: submitting on replica 1 moves only ITS counter
+    def submitted(u):
+        with urllib.request.urlopen(u + "/statz", timeout=5) as resp:
+            return json.load(resp)["metrics"].get(
+                "ds_serve_submitted_total", 0)
+
+    base0, base1 = submitted(urls[0]), submitted(urls[1])
+    _post(urls[1], {"prompt": [1, 2, 3], "max_new_tokens": 2})
+    assert submitted(urls[1]) == base1 + 1
+    assert submitted(urls[0]) == base0
+
+
+def test_http_timeout_aborts_request_and_frees_slot(fleet, rng):
+    """A /generate whose client deadline expires gets 504 AND the engine
+    tears the abandoned request down at the next step boundary — the
+    slot and its pages free instead of decoding to max_new_tokens for
+    nobody (review finding: orphan requests must not saturate slots)."""
+    import time
+
+    _, replicas, _, _ = fleet
+    serve = replicas[1]
+    serve.resume_admission()
+    prompt = np.asarray(jax.random.randint(rng, (8,), 0, 256)).tolist()
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(serve.metrics_server.url,
+              {"prompt": prompt, "max_new_tokens": 48, "timeout": 0.0})
+    assert exc.value.code == 504
+    assert json.load(exc.value)["error"].startswith("generation timed out")
+    deadline = time.monotonic() + 30
+    while (serve.scheduler.num_occupied or serve.pool.pages_used) \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert serve.scheduler.num_occupied == 0
+    assert serve.pool.pages_used == 0
+    serve.pool.check_no_leak()
+    # the replica still serves normally afterwards
+    code, body = _post(serve.metrics_server.url,
+                       {"prompt": prompt, "max_new_tokens": 3})
+    assert code == 200 and len(body["tokens"]) == 3
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_loop_crash_flips_health_and_requeues(devices):
+    """A crashed serving loop must read as a DEAD replica: /healthz flips
+    503 (the router stops sending / drops it from membership) and a
+    request stuck queued behind the dead loop is handed back 503 after
+    the no-progress grace — never a silent zombie (review finding)."""
+    import time
+
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    # no set_params(): the first step() raises and the loop dies — the
+    # engineered stand-in for any fatal step error
+    serve = deepspeed_tpu.init_serving(
+        model, config={"dtype": "float32", "max_out_tokens": 64,
+                       "kv_page_tokens": 16},
+        num_slots=1, metrics_port=0, registry=MetricsRegistry().enable(),
+        private_health=True, serve_loop=True)
+    try:
+        url = serve.metrics_server.url
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                        "timeout": 30})
+        assert exc.value.code == 503
+        assert json.load(exc.value).get("requeued") is True
+        deadline = time.monotonic() + 10
+        while serve.health.ready and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not serve.health.ready
+        assert "crashed" in (serve.health.reason or "")
+        with pytest.raises(urllib.error.HTTPError) as hexc:
+            urllib.request.urlopen(url + "/healthz", timeout=5)
+        assert hexc.value.code == 503
+    finally:
+        serve.close()
+
+
+def test_affinity_cap_actually_bounds_sessions():
+    """The session map is LRU-capped for real: sustained fresh sessions
+    inside the TTL cannot grow it past max_sessions (review finding: the
+    old bound only dropped TTL-expired entries)."""
+    router_tool = _tool("router")
+    fake = router_tool._FakeReplica("a")
+    try:
+        router = Router([f"a={fake.url}"], registry=MetricsRegistry().enable(),
+                        max_sessions=8, affinity_ttl=3600.0)
+        router.refresh()
+        for i in range(20):
+            code, _ = router.dispatch({"prompt": [i], "max_new_tokens": 2,
+                                       "session": f"sess-{i}"})
+            assert code == 200
+            assert len(router._affinity) <= 8
+        # the survivors are the most recently used sessions
+        assert f"sess-19" in router._affinity
+        assert f"sess-0" not in router._affinity
+    finally:
+        fake.stop()

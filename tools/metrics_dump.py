@@ -171,8 +171,9 @@ def render_comms(rows: List[List[str]]) -> str:
 
 
 def serving_kv_summary(metrics: Dict[str, object]) -> str:
-    """Paged-KV pool health lines from the ``ds_serve_kv_*`` series
-    (docs/OBSERVABILITY.md 'Serving — paged KV pool')."""
+    """Paged-KV pool health lines from the ``ds_serve_kv_*`` series plus
+    the prefix-cache line from ``ds_serve_prefix_*`` (docs/OBSERVABILITY.md
+    'Serving — paged KV pool' / 'Serving — prefix cache')."""
     used = metrics.get("ds_serve_kv_pages_used")
     free = metrics.get("ds_serve_kv_pages_free")
     util = metrics.get("ds_serve_kv_cache_util_ratio") or {}
@@ -190,6 +191,15 @@ def serving_kv_summary(metrics: Dict[str, object]) -> str:
                      f"p99 {100 * util['p99']:.1f}%  "
                      f"({util['count']} steps)")
     lines.append(f"preemptions: {int(pre)}")
+    hit = float(metrics.get("ds_serve_prefix_hit_tokens_total", 0) or 0)
+    miss = float(metrics.get("ds_serve_prefix_miss_tokens_total", 0) or 0)
+    if hit or miss:
+        cached = int(metrics.get("ds_serve_prefix_cache_pages", 0) or 0)
+        ev = int(metrics.get("ds_serve_prefix_evictions_total", 0) or 0)
+        lines.append(f"prefix cache: {100 * hit / (hit + miss):.1f}% hit "
+                     f"ratio ({int(hit)} hit / {int(miss)} computed "
+                     f"prefill tokens), {cached} cached pages, "
+                     f"{ev} evictions")
     return "\n".join(lines)
 
 

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Multi-replica serving router CLI: least-loaded, drain-aware dispatch.
+
+Runs the repo's router (``deepspeed_tpu/serving/router.py``) as a
+standalone HTTP front-end over N replica endpoints (each a
+``init_serving(metrics_port=...)`` metrics server exposing ``/healthz`` +
+``/statz`` + ``POST /generate``):
+
+    python tools/router.py http://host:9101 http://host:9102
+    python tools/router.py r0=host:9101 r1=host:9102   # named replicas
+    python tools/router.py --port 9200 url...          # fixed front port
+    python tools/router.py --selftest                  # synthetic 2-replica check
+
+The router serves ``POST /generate`` (dispatched least-loaded with
+session affinity and retry-elsewhere on drain/failure — no dropped
+requests), ``GET /healthz`` (ready while ANY replica is), ``GET
+/replicaz`` (membership + per-replica load view), and ``GET /statz``
+(its own ``ds_router_*`` counters/gauges, scrapeable by
+``tools/fleet_dump.py`` like any other endpoint).
+
+``--selftest`` spins up two synthetic stdlib replicas and drives the
+real Router through least-loaded picks, session affinity, a mid-trace
+drain with redistribution, and the full HTTP front-end (wired as a
+tier-1 unit test so this offline tool cannot silently rot).  Zero
+dependencies beyond the repo's stdlib-only modules — **no jax import**
+(asserted by the selftest), same contract as ``tools/fleet_dump.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_router_module():
+    """``deepspeed_tpu/serving/router.py`` WITHOUT importing the package
+    (no jax on an operator box): reuse the module when the package is
+    already loaded (in-process tests), else exec it by file path."""
+    mod = sys.modules.get("deepspeed_tpu.serving.router")
+    if mod is not None:
+        return mod
+    mod = sys.modules.get("_ds_router")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "deepspeed_tpu", "serving", "router.py")
+    spec = importlib.util.spec_from_file_location("_ds_router", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_router"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_router = _load_router_module()
+Router = _router.Router
+RouterServer = _router.RouterServer
+
+
+# ---------------------------------------------------------------------------
+# selftest (synthetic replicas; tier-1 wired)
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    """Stdlib stand-in for a ServingEngine replica: settable readiness
+    and load gauges, and a deterministic ``/generate`` (tokens are a pure
+    function of the prompt, so 'token-identical across replicas' is
+    checkable without any model)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ready = True
+        self.reason = None
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.served: List[int] = []      # request ids this replica served
+        self.requeue_next = 0            # N next /generate calls -> 503
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.partition("?")[0]
+                if path == "/healthz":
+                    if fake.ready:
+                        self._send(200, {"ready": True})
+                    else:
+                        self._send(503, {"ready": False,
+                                         "reason": fake.reason or "draining"})
+                elif path == "/statz":
+                    self._send(200, {"enabled": True, "metrics": {
+                        "ds_serve_queue_depth": fake.queue_depth,
+                        "ds_serve_active_slots": fake.active_slots,
+                        "ds_serve_kv_pages_used": 0,
+                        "ds_serve_kv_pages_free": 8}})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path.partition("?")[0] != "/generate":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if not fake.ready:
+                    self._send(503, {"error": "draining"})
+                    return
+                if fake.requeue_next > 0:
+                    fake.requeue_next -= 1
+                    self._send(503, {"error": "request requeued: replica "
+                                              "draining", "requeued": True})
+                    return
+                prompt = payload.get("prompt") or []
+                max_new = int(payload.get("max_new_tokens", 4))
+                fake.served.append(int(payload.get("rid", -1)))
+                self._send(200, {"tokens": _fake_tokens(prompt, max_new),
+                                 "finish_reason": "length"})
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _fake_tokens(prompt: List[int], max_new: int) -> List[int]:
+    seed = sum(int(t) for t in prompt) % 997
+    return [(seed + i) % 997 for i in range(max_new)]
+
+
+def selftest() -> int:
+    if os.path.basename(sys.argv[0]).startswith("router"):
+        # standalone contract: this tool must never drag jax in
+        assert "jax" not in sys.modules, "tools/router.py imported jax"
+    reps = [_FakeReplica("a"), _FakeReplica("b")]
+    a, b = reps
+    # a private enabled registry: the selftest must not flip the
+    # process-global one (in-process tier-1 runs share it)
+    reg = _router._metrics.MetricsRegistry().enable()
+    router = Router([f"a={a.url}", f"b={b.url}"], dispatch_rounds=4,
+                    retry_backoff=0.01, registry=reg)
+    try:
+        # membership: both come up ready on the first poll
+        router.refresh()
+        assert [r.ready for r in router.replicas] == [True, True]
+        # least-loaded: load up a -> picks land on b
+        a.queue_depth, b.queue_depth = 6, 0
+        router.refresh()
+        code, body = router.dispatch({"prompt": [1, 2, 3],
+                                      "max_new_tokens": 4})
+        assert code == 200 and body["replica"] == "b", body
+        assert body["tokens"] == _fake_tokens([1, 2, 3], 4)
+        # session affinity: pin a session to the (now) least-loaded a,
+        # then make a look MORE loaded — the session sticks anyway
+        # (prefix-cache locality beats a small load delta)
+        a.queue_depth = 0
+        router.refresh()
+        code, body = router.dispatch({"prompt": [7], "max_new_tokens": 2,
+                                      "session": "chat-1"})
+        assert code == 200 and body["replica"] == "a", body
+        a.queue_depth = 50
+        router.refresh()
+        code, body = router.dispatch({"prompt": [7, 8], "max_new_tokens": 2,
+                                      "session": "chat-1"})
+        assert code == 200 and body["replica"] == "a", body
+        # drain redistribution: a flips not-ready -> the session MOVES,
+        # nothing is dropped
+        a.ready = True               # healthz still 200 (drain just hit):
+        a.requeue_next = 1           # /generate hands the request back
+        code, body = router.dispatch({"prompt": [7, 8, 9],
+                                      "max_new_tokens": 2,
+                                      "session": "chat-1"})
+        assert code == 200 and body["replica"] == "b", body
+        retries = router.registry.get("ds_router_retries_total")
+        assert retries is not None and retries.value >= 1
+        # a now fully draining (healthz 503): excluded from membership,
+        # a full trace completes on b alone — zero dropped
+        a.ready, a.reason = False, "draining"
+        router.refresh()
+        assert router.pick() is not None
+        results = []
+        for i in range(6):
+            code, body = router.dispatch({"prompt": [i, i + 1],
+                                          "max_new_tokens": 3, "rid": i})
+            results.append((code, body))
+        assert all(c == 200 for c, _ in results), results
+        assert all(bd["replica"] == "b" for _, bd in results)
+        assert all(bd["tokens"] == _fake_tokens([i, i + 1], 3)
+                   for i, (_, bd) in enumerate(results))
+        # dispatch accounting: per-replica counters moved
+        da = router.registry.get("ds_router_dispatch_total",
+                                 labels={"replica": "a"})
+        db = router.registry.get("ds_router_dispatch_total",
+                                 labels={"replica": "b"})
+        assert da.value >= 2 and db.value >= 8, (da.value, db.value)
+        # the HTTP front-end end-to-end: /generate routed, /healthz ready,
+        # /replicaz shows the drained member
+        front = RouterServer(router).start()
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                front.url + "/generate",
+                data=json.dumps({"prompt": [5, 5],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.load(resp)
+            assert out["tokens"] == _fake_tokens([5, 5], 2)
+            with urllib.request.urlopen(front.url + "/healthz",
+                                        timeout=5) as resp:
+                assert json.load(resp)["ready"] is True
+            with urllib.request.urlopen(front.url + "/replicaz",
+                                        timeout=5) as resp:
+                snap = json.load(resp)
+            assert snap["ready"] == 1
+            drained = [r for r in snap["replicas"] if r["name"] == "a"][0]
+            assert not drained["ready"]
+            with urllib.request.urlopen(front.url + "/statz",
+                                        timeout=5) as resp:
+                statz = json.load(resp)
+            assert "ds_router_retries_total" in statz["metrics"]
+        finally:
+            front.stop()
+        # every replica's /healthz back up -> membership heals
+        a.ready = True
+        router.refresh()
+        assert sum(r.ready for r in router.replicas) == 2
+    finally:
+        for r in reps:
+            r.stop()
+    print("router selftest: OK (least-loaded, affinity, drain "
+          "redistribution with zero drops, HTTP front-end)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: List[str]) -> int:
+    # flags take '--port=9200' or '--port 9200'; everything else is a
+    # replica URL
+    args: List[str] = []
+    flags: Dict[str, str] = {}
+    rest = list(argv[1:])
+    while rest:
+        a = rest.pop(0)
+        if not a.startswith("--"):
+            args.append(a)
+            continue
+        name, sep, val = a.partition("=")
+        if not sep and name == "--port" and rest:
+            val = rest.pop(0)
+        flags[name] = val
+    if "--selftest" in flags:
+        return selftest()
+    if not args or "--help" in flags or "-h" in argv[1:]:
+        print(__doc__.strip())
+        return 0 if args else 2
+    port = int(flags.get("--port") or 0)
+    router = Router(args)
+    router.registry.enable()
+    router.start()
+    server = RouterServer(router, port=port).start()
+    ready = sum(r.ready for r in router.replicas)
+    print(f"router: {server.url}/generate over {len(router.replicas)} "
+          f"replica(s) ({ready} ready); /healthz /replicaz /statz")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
