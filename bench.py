@@ -115,7 +115,8 @@ def capture_device_profile(step_fn, steps: int = 2, tag: str = "train"):
     return out
 
 
-def bench_8b_rung(budget_s: float = 900.0):
+def bench_8b_rung(budget_s: float = 900.0, int8: bool = True,
+                  prefetch: bool = True):
     """Llama-3-8B single-chip rung (BASELINE configs[2] / VERDICT r3 item 1).
 
     8B bf16 params (16.1GB) exceed the 15.75GB v5e HBM, so this exercises
@@ -125,9 +126,14 @@ def bench_8b_rung(budget_s: float = 900.0):
     buffer (params OR grads) ever exists on device, which is also why the
     whole-program form cannot even compile here (a 16GB grad output cannot
     be placed).  Measured: fwd+bwd tokens/sec per chip, bounded on this
-    runner by the relay's host<->device bandwidth (recorded in the note).
-    The full CPU-Adam step is not timed: fp32 master+moments for 8B are
-    96GB on top of the streaming buffers.
+    runner by the relay's host<->device bandwidth — which the ISSUE 11
+    streaming layer attacks: ``int8`` ships each layer as blockwise int8 +
+    scales with a fused on-device dequant (~2x fewer relay bytes than
+    bf16), ``prefetch`` double-buffers layer i+1's transfer under layer
+    i's compute.  The record carries the effective relay MB/s (relay
+    bytes / step wall, honest on a relay-bound rung) next to the
+    BENCH_r05 14MB/s baseline.  The full CPU-Adam step is not timed: fp32
+    master+moments for 8B are 96GB on top of the streaming buffers.
     """
     import numpy as np
     import ml_dtypes
@@ -136,6 +142,7 @@ def bench_8b_rung(budget_s: float = 900.0):
     t_start = time.perf_counter()
     try:
         from deepspeed_tpu.models import causal_lm
+        from deepspeed_tpu.monitor.metrics import get_registry
         from deepspeed_tpu.runtime.zero.partition import (params_pspecs,
                                                           shardings_from_pspecs)
         from deepspeed_tpu.runtime.zero.stream_grad import StreamedFwdBwd
@@ -160,23 +167,39 @@ def bench_8b_rung(budget_s: float = 900.0):
         specs = params_pspecs(params_np, mesh, shard=False)
         seg = model.stream_segments()
         sfb = StreamedFwdBwd.from_param_specs(seg, specs, mesh, gas=1,
-                                              use_dropout=False)
+                                              use_dropout=False,
+                                              int8=int8, prefetch=prefetch)
         # bf16 host accumulators (fp32 would be 32GB on top of the params)
         acc = jax.tree.map(lambda a: np.zeros(a.shape, ml_dtypes.bfloat16),
                            params_np)
         tokens = jax.random.randint(jax.random.PRNGKey(1), (micro, seq), 0,
                                     cfg.vocab_size)
         key = jax.random.PRNGKey(2)
-        loss = sfb.run(params_np, tokens, tokens, None, key, acc)
-        loss0 = float(loss)               # compile + first step
-        steps = 0
-        t0 = time.perf_counter()
-        while steps < 2 and (steps == 0
-                             or time.perf_counter() - t0 < budget_s):
+        registry = get_registry()
+        was_enabled = registry.enabled
+        registry.enable()
+        try:
             loss = sfb.run(params_np, tokens, tokens, None, key, acc)
-            float(loss)
-            steps += 1
-        dt = (time.perf_counter() - t0) / steps
+            loss0 = float(loss)           # compile + first step
+            registry.reset()
+            steps = 0
+            t0 = time.perf_counter()
+            while steps < 2 and (steps == 0
+                                 or time.perf_counter() - t0 < budget_s):
+                loss = sfb.run(params_np, tokens, tokens, None, key, acc)
+                float(loss)
+                steps += 1
+            wall = time.perf_counter() - t0
+            dt = wall / steps
+            snap = registry.snapshot()
+        finally:
+            # a raise must not leave the process-global registry hot (the
+            # 125M headline and later rungs run in this process)
+            if not was_enabled:
+                registry.disable()
+        relay = snap.get("ds_offload_relay_bytes_total", {}) or {}
+        h2d = relay.get('{dir="h2d"}', 0)
+        d2h = relay.get('{dir="d2h"}', 0)
         tps = micro * seq / dt
         fpt = 6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq
         return {"status": "ok", "tokens_per_sec_fwd_bwd": round(tps, 2),
@@ -184,14 +207,172 @@ def bench_8b_rung(budget_s: float = 900.0):
                 "micro_batch": micro, "seq": seq, "steps": steps,
                 "step_ms": round(dt * 1e3, 1), "loss": round(loss0, 3),
                 "mfu_fwd_bwd": round(tps * fpt / peak_flops(), 4),
+                "int8_relay": bool(int8), "prefetch": bool(prefetch),
+                "relay": {
+                    "h2d_bytes_per_step": int(h2d / steps),
+                    "d2h_bytes_per_step": int(d2h / steps),
+                    "effective_MBps": round((h2d + d2h) / wall / 1e6, 2),
+                    "prefetch_hits": int(snap.get(
+                        "ds_offload_prefetch_hits_total", 0)),
+                },
+                "baseline_r05": {"tokens_per_sec_fwd_bwd": 0.31,
+                                 "relay_MBps": 14.0,
+                                 "note": "bf16 relay, 2026-07-30, same "
+                                         "runner class"},
+                "speedup_vs_r05": round(tps / 0.31, 2),
                 "note": ("ZeRO-Infinity streamed fwd+bwd: host-resident "
                          "params stream per layer H2D, grads stream per "
                          "layer D2H into host accumulators; bounded by the "
                          "relay's host<->device bandwidth on this runner. "
-                         "Optimizer step not timed: 96GB fp32 Adam states")}
+                         "Optimizer step not timed: 96GB fp32 Adam states "
+                         "(int8_masters would cut that to ~24GB)")}
     except Exception as exc:  # the 125M headline must still be emitted
         return {"status": f"failed: {type(exc).__name__}",
                 "error": str(exc)[:200],
+                "elapsed_s": round(time.perf_counter() - t_start, 1)}
+
+
+def bench_streamed_rung(steps: int = 3, warmup: int = 1,
+                        tiny: bool = None) -> dict:
+    """Offload streaming ablation (ISSUE 11 / ROADMAP item 3): the SAME
+    streamed-offload training workload with the bf16 relay vs the int8
+    relay (+ int8 host masters), prefetch on both sides.
+
+    Per side: tokens/s, relay bytes per step by direction, effective
+    relay MB/s (bytes / wall — on a relay-bound rung the two are equal),
+    prefetch hits, final loss.  Headlines: ``streamed_speedup`` (int8 /
+    bf16 tokens/s — the acceptance number on relay-bound hardware),
+    ``relay_bytes_ratio`` (bf16 / int8 H2D bytes, machine-independent),
+    ``loss_parity`` vs a plain NON-offloaded engine at the same seed
+    (rtol 5e-2 — int8 masters are a lossy code, the bound is the
+    contract), and the device-profile ``gap_share`` on the offload path
+    (``ds_profile_gap`` semantics — the overlap headroom the prefetch is
+    eating).  On CPU runners the model scales to smoke size (mechanics +
+    byte ratios are what the CPU row pins; absolute rates need TPU)."""
+    import gc
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    t_start = time.perf_counter()
+    try:
+        on_tpu = jax.default_backend() != "cpu"
+        if tiny is None:
+            tiny = not on_tpu
+        mesh = build_mesh(devices=jax.devices()[:1])
+        set_global_mesh(mesh)
+        if tiny:
+            over = dict(num_layers=4, hidden_size=128, intermediate_size=256,
+                        num_heads=4, num_kv_heads=4, vocab_size=512,
+                        max_seq_len=128)
+            micro, seq = 2, 64
+        else:
+            over = {}
+            micro, seq = 1, 1024
+        registry = get_registry()
+        was_enabled = registry.enabled
+        registry.enable()
+        results = {}
+        losses = {}
+        gap_share = None
+        try:
+            for side in ("plain", "bf16", "int8"):
+                model = causal_lm("llama-1b4", mesh=mesh, **over)
+                cfg_m = model.config
+                zero = {"stage": 3}
+                if side != "plain":
+                    zero["offload_optimizer"] = {
+                        "device": "cpu", "int8_masters": side == "int8"}
+                    zero["offload_param"] = {
+                        "device": "cpu", "prefetch": True,
+                        "int8_stream": side == "int8"}
+                ds_config = {
+                    "train_micro_batch_size_per_gpu": micro,
+                    "gradient_accumulation_steps": 1,
+                    "bf16": {"enabled": True},
+                    "zero_optimization": zero,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 2e-4}},
+                    "gradient_clipping": 1.0, "steps_per_print": 10**9}
+                engine, _, _, _ = deepspeed_tpu.initialize(
+                    model=model, config=ds_config, mesh=mesh,
+                    rng=jax.random.PRNGKey(11))
+                tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                            (micro, seq), 0,
+                                            cfg_m.vocab_size)
+                batch = (tokens, tokens)
+
+                def one_step():
+                    loss = engine.forward(batch)
+                    engine.step()
+                    return loss
+
+                for _ in range(warmup):
+                    one_step()
+                registry.reset()
+                t1 = time.perf_counter()
+                loss = None
+                for _ in range(steps):
+                    loss = one_step()
+                loss = float(loss)
+                wall = time.perf_counter() - t1
+                losses[side] = loss
+                if side == "plain":
+                    engine = model = None
+                    gc.collect()
+                    continue
+                snap = registry.snapshot()
+                relay = snap.get("ds_offload_relay_bytes_total", {}) or {}
+                h2d = relay.get('{dir="h2d"}', 0)
+                d2h = relay.get('{dir="d2h"}', 0)
+                row = {
+                    "tokens_per_sec": round(steps * micro * seq / wall, 1),
+                    "step_ms": round(1e3 * wall / steps, 1),
+                    "loss": round(loss, 5),
+                    "h2d_bytes_per_step": int(h2d / steps),
+                    "d2h_bytes_per_step": int(d2h / steps),
+                    "relay_MBps": round((h2d + d2h) / wall / 1e6, 2),
+                    "prefetch_hits": int(snap.get(
+                        "ds_offload_prefetch_hits_total", 0)),
+                    "relay_stall_s": round(
+                        (snap.get("ds_offload_relay_seconds") or {}
+                         ).get("sum", 0.0), 4),
+                }
+                if side == "int8":
+                    # ds_profile_gap share on the offload path: a short
+                    # device capture over the streamed step
+                    dp = capture_device_profile(one_step, steps=2,
+                                                tag="streamed")
+                    if dp and dp.get("gap_share") is not None:
+                        gap_share = dp["gap_share"]
+                        row["device_profile"] = dp
+                results[side] = row
+                engine = model = None
+                gc.collect()
+        finally:
+            if not was_enabled:
+                registry.disable()
+        bf16_b = results["bf16"]["h2d_bytes_per_step"]
+        int8_b = results["int8"]["h2d_bytes_per_step"]
+        plain = losses["plain"]
+        parity = bool(np.isfinite(plain) and abs(losses["int8"] - plain)
+                      <= 5e-2 * abs(plain))
+        return {"status": "ok", "tiny": bool(tiny), "steps": steps,
+                "micro_batch": micro, "seq": seq,
+                "backend": jax.default_backend(),
+                "bf16": results["bf16"], "int8": results["int8"],
+                "loss_plain": round(plain, 5),
+                "streamed_speedup": round(
+                    results["int8"]["tokens_per_sec"]
+                    / max(results["bf16"]["tokens_per_sec"], 1e-9), 3),
+                "relay_bytes_ratio": round(bf16_b / max(int8_b, 1), 3),
+                "loss_parity": parity,
+                "gap_share": gap_share}
+    except Exception as exc:
+        return {"status": f"failed: {type(exc).__name__}",
+                "error": str(exc)[:300],
                 "elapsed_s": round(time.perf_counter() - t_start, 1)}
 
 
@@ -595,6 +776,152 @@ def bench_prefix_serving(num_requests: int = 48, num_slots: int = 8,
         "prefix_goodput_speedup": round(
             sides["cache_on"]["goodput_tok_s"]
             / max(sides["cache_off"]["goodput_tok_s"], 1e-9), 2),
+    }
+
+
+def bench_host_tier_serving(num_requests: int = 32, num_slots: int = 4,
+                            qps: float = 50.0, seed: int = 0,
+                            tiny: bool = False) -> dict:
+    """KV host tier at a THRASH-sized pool (ISSUE 11): the identical
+    shared-prefix trace with ``kv_host_tier_pages`` off vs on, on a pool
+    deliberately too small to keep cached history resident — the regime
+    where PR 9's evict-to-drop forgot every cold prefix and the host tier
+    keeps them promotable.
+
+    Recorded per side: prefix hit ratio, prefill tokens computed,
+    goodput, TTFT p99, demotes/promotes/host pages (tier side).
+    Headlines: ``hit_ratio_on`` strictly above ``hit_ratio_off`` +
+    ``outputs_token_identical`` (promotion is a byte-identical KV copy,
+    so greedy outputs cannot change) — the acceptance pair."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    mesh = build_mesh(devices=jax.devices()[:1])
+    set_global_mesh(mesh)
+    rng = np.random.default_rng(seed + 13)
+    if tiny:  # CPU smoke scale (tests/perf/test_serving_bench.py)
+        model = causal_lm("gpt2-small", mesh=mesh, num_layers=2,
+                          hidden_size=128, intermediate_size=256,
+                          num_heads=4, vocab_size=512)
+        max_out, page_tokens = 96, 16
+        sys_len, tail = 32, (3, 8)
+        n_short, n_long = (4, 8), (10, 16)
+        # pool ~ live-slot working set: cached prefixes always under
+        # pressure (the drop-vs-demote regime at smoke scale)
+        n_prefixes, pool_tokens, host_pages = 4, num_slots * 80, 24
+    else:
+        model = causal_lm("gpt2-small", mesh=mesh, vocab_size=50304)
+        max_out, page_tokens = 1024, 0
+        sys_len, tail = 256, (16, 96)
+        n_short, n_long = (16, 96), (192, 256)
+        # pool = exactly the live-slot budget: every cached page is under
+        # pressure the moment slots fill, so cached history always
+        # evicts — the drop-vs-demote regime
+        n_prefixes, pool_tokens, host_pages = 6, num_slots * 1024, 512
+    params = jax.jit(model.init)(jax.random.PRNGKey(seed))
+    V = model.config.vocab_size
+
+    sys_prompts = [rng.integers(0, V, size=sys_len).astype(np.int32)
+                   for _ in range(n_prefixes)]
+    long_mask = rng.random(num_requests) < 0.25
+    prompts, news = [], []
+    for i in range(num_requests):
+        t = rng.integers(0, V, size=int(rng.integers(tail[0], tail[1] + 1))
+                         ).astype(np.int32)
+        # round-robin over MANY shared prefixes: each re-visit arrives
+        # after the pool pressure evicted the prefix's pages
+        prompts.append(np.concatenate([sys_prompts[i % n_prefixes], t]))
+        news.append(int(rng.integers(n_long[0], n_long[1] + 1)
+                        if long_mask[i]
+                        else rng.integers(n_short[0], n_short[1] + 1)))
+    arrivals = np.cumsum(rng.exponential(1.0 / qps, size=num_requests))
+    arrivals -= arrivals[0]
+
+    def make_serve(host_on: bool):
+        s = deepspeed_tpu.init_serving(
+            model, config={"dtype": "bfloat16", "max_out_tokens": max_out,
+                           "kv_page_tokens": page_tokens,
+                           "kv_pool_tokens": pool_tokens,
+                           "kv_host_tier_pages": host_pages if host_on
+                           else 0},
+            num_slots=num_slots, decode_block_tokens=8)
+        s.set_params(params)
+        return s
+
+    def run_trace(serve):
+        t0 = time.perf_counter()
+        reqs, i = [], 0
+        while i < num_requests or serve.scheduler.has_work:
+            now = time.perf_counter() - t0
+            while i < num_requests and arrivals[i] <= now:
+                reqs.append(serve.submit(prompts[i], max_new_tokens=news[i]))
+                i += 1
+            if not serve.scheduler.has_work:
+                time.sleep(max(0.0, arrivals[i] - now))
+                continue
+            serve.step()
+        makespan = time.perf_counter() - t0
+        outs = [list(r.output_tokens) for r in reqs]
+        serve.scheduler.drain_finished()
+        return sum(len(o) for o in outs), makespan, outs
+
+    registry = get_registry()
+    was_enabled = registry.enabled
+    registry.enable()
+    sides, outputs = {}, {}
+    try:
+        for side, on in (("tier_off", False), ("tier_on", True)):
+            serve = make_serve(on)
+            run_trace(serve)            # compile-warm passes
+            run_trace(serve)
+            serve.prefix_cache.clear()  # measure intra-trace behavior
+            registry.reset()
+            toks, span, outs = run_trace(serve)
+            outputs[side] = outs
+            snap = registry.snapshot()
+            hit = int(snap.get("ds_serve_prefix_hit_tokens_total", 0))
+            miss = int(snap.get("ds_serve_prefix_miss_tokens_total", 0))
+            ttft = snap.get("ds_serve_ttft_seconds") or {}
+            sides[side] = {
+                "goodput_tok_s": round(toks / span, 1),
+                "makespan_s": round(span, 3),
+                "ttft_p99_s": round(ttft.get("p99", 0.0), 4),
+                "prefix_hit_ratio": round(hit / max(hit + miss, 1), 4),
+                "prefix_hit_tokens": hit,
+                "prefill_tokens_computed":
+                    int(snap.get("ds_serve_prefill_tokens_total", 0)),
+                "evictions": int(snap.get(
+                    "ds_serve_prefix_evictions_total", 0)),
+                "demotes": int(snap.get("ds_serve_kv_demote_total", 0)),
+                "promotes": int(snap.get("ds_serve_kv_promote_total", 0)),
+                "host_pages": int(snap.get("ds_serve_kv_host_pages", 0)),
+            }
+            serve.pool.check_no_leak()
+            serve.prefix_cache.check_no_leak()
+            serve.close()
+    finally:
+        if not was_enabled:
+            registry.disable()
+    return {
+        "workload": {"num_requests": num_requests, "num_slots": num_slots,
+                     "qps": qps, "shared_prefixes": n_prefixes,
+                     "system_prompt_tokens": sys_len,
+                     "pool_tokens": pool_tokens, "host_pages": host_pages,
+                     "arrivals": "poisson", "seed": seed},
+        "tier_off": sides["tier_off"],
+        "tier_on": sides["tier_on"],
+        "hit_ratio_on": sides["tier_on"]["prefix_hit_ratio"],
+        "hit_ratio_off": sides["tier_off"]["prefix_hit_ratio"],
+        "demotes": sides["tier_on"]["demotes"],
+        "promotes": sides["tier_on"]["promotes"],
+        "outputs_token_identical": outputs["tier_on"] ==
+                                   outputs["tier_off"],
+        "goodput_speedup": round(
+            sides["tier_on"]["goodput_tok_s"]
+            / max(sides["tier_off"]["goodput_tok_s"], 1e-9), 2),
     }
 
 
@@ -1015,6 +1342,20 @@ def _run_1b4_subprocess() -> dict:
 
 
 def main():
+    if os.environ.get("DSTPU_BENCH_EMIT_ONLY"):
+        # subprocess pin for the stdout contract (tests/unit/
+        # test_metrics.py): emit a synthetic record through the REAL
+        # final-line path and exit — the last stdout line must be the
+        # parseable bare BENCH_JSON summary, with nothing after it
+        record = {"metric": "emit_selftest", "value": 0.0,
+                  "unit": "tokens/sec", "vs_baseline": 0.0,
+                  "detail": {"mfu": 0.0, "backend": jax.default_backend(),
+                             "note": "DSTPU_BENCH_EMIT_ONLY=1",
+                             # oversized filler: the cap must truncate
+                             # blocks, never the line
+                             "metrics": {"filler": "x" * 4000}}}
+        emit_summary(record, None)
+        return
     if os.environ.get("DSTPU_BENCH_1B4_OUT"):
         # child mode: run ONE ladder rung, write the result, exit
         if jax.default_backend() == "cpu":
@@ -1049,6 +1390,13 @@ def main():
         rung_overlap = _run_overlap_subprocess()
 
     on_tpu = jax.default_backend() != "cpu"
+
+    # streamed-offload relay ablation (ISSUE 11 / ROADMAP item 3): bf16 vs
+    # int8 relay on the same streamed workload; runs on CPU at smoke scale
+    rung_streamed = None
+    if os.environ.get("DSTPU_BENCH_SKIP_STREAMED") != "1":
+        rung_streamed = bench_streamed_rung()
+
     mesh = build_mesh(devices=jax.devices()[:1])
     set_global_mesh(mesh)
 
@@ -1178,9 +1526,16 @@ def main():
         except Exception as exc:
             rung_prefix = {"status": f"failed: {type(exc).__name__}",
                            "error": str(exc)[:200]}
+        # thrash-sized prefix cache: host tier on/off hit-ratio row
+        try:
+            rung_host_tier = bench_host_tier_serving()
+        except Exception as exc:
+            rung_host_tier = {"status": f"failed: {type(exc).__name__}",
+                              "error": str(exc)[:200]}
     else:
         rung_serving = None
         rung_prefix = None
+        rung_host_tier = None
 
     tokens_per_step = batch * seq
     tps = steps * tokens_per_step / dt
@@ -1230,11 +1585,32 @@ def main():
                    **({"serving_125m": rung_serving} if rung_serving
                       else {}),
                    **({"prefix_serving_125m": rung_prefix} if rung_prefix
-                      else {})},
+                      else {}),
+                   **({"host_tier_serving": rung_host_tier}
+                      if rung_host_tier else {}),
+                   **({"streamed_offload": rung_streamed}
+                      if rung_streamed else {})},
     })
-    print(json.dumps(record))
-    for line in summary_lines(record, rung_serving):
-        print(line)
+    emit_summary(record, rung_serving)
+
+
+# Hard byte cap on the bare final stdout line.  BENCH_r05 recorded
+# ``"parsed": null`` because the runner reads (and truncates around ~2000
+# chars) the LAST stdout line: an oversized summary line truncates into
+# non-JSON and the whole record is lost.  The cap is enforced by
+# progressively dropping the bulkiest optional sub-objects (everything
+# still rides, in full, in the first-line record).
+BENCH_SUMMARY_MAX_CHARS = 1800
+
+
+def _strip_bulky(obj):
+    """Drop per-capture payloads (device_profile) from a summary
+    sub-object — they belong to the record line, not the capped final
+    line."""
+    if isinstance(obj, dict):
+        return {k: _strip_bulky(v) for k, v in obj.items()
+                if k != "device_profile"}
+    return obj
 
 
 def summary_lines(record: dict, rung_serving) -> list:
@@ -1242,14 +1618,17 @@ def summary_lines(record: dict, rung_serving) -> list:
     ``BENCH_JSON:``-prefixed line followed by the SAME summary as a bare
     JSON object on the FINAL line — the runner ``json.loads``-parses the
     last stdout line into its ``parsed`` field (a prefixed final line
-    parses to nothing, which is exactly the BENCH_r05 ``"parsed": null``
-    bug).  tests/unit/test_metrics.py round-trips the last line."""
+    parses to nothing, and an oversized line truncates to garbage — both
+    are the BENCH_r05 ``"parsed": null`` bug).  The bare line is capped
+    at :data:`BENCH_SUMMARY_MAX_CHARS`; tests/unit/test_metrics.py
+    round-trips the last line and pins the cap with a real subprocess
+    (``DSTPU_BENCH_EMIT_ONLY``)."""
     summary = {"metric": record["metric"], "value": record["value"],
                "unit": record["unit"], "vs_baseline": record["vs_baseline"],
                "mfu": record["detail"]["mfu"],
                "backend": record["detail"]["backend"]}
     if record["detail"].get("metrics"):
-        summary["train_metrics"] = record["detail"]["metrics"]
+        summary["train_metrics"] = _strip_bulky(record["detail"]["metrics"])
     ov = record["detail"].get("overlap_1b4")
     if ov and "overlap_speedup" in ov:
         # the ROADMAP item 1 acceptance row: both ablation sides' device
@@ -1275,7 +1654,8 @@ def summary_lines(record: dict, rung_serving) -> list:
         # serving-health row (TTFT/queue-wait/occupancy from the metrics
         # registry) so BENCH_r*.json tracks latency attribution, not just
         # aggregate goodput
-        summary["serving_metrics"] = rung_serving.get("metrics")
+        summary["serving_metrics"] = _strip_bulky(
+            rung_serving.get("metrics"))
     pf = record["detail"].get("prefix_serving_125m")
     if pf and "prefill_savings_ratio" in pf:
         # the prefix-caching acceptance row: prefill-token savings (>=
@@ -1289,8 +1669,52 @@ def summary_lines(record: dict, rung_serving) -> list:
             "ttft_p99_on_s": pf["cache_on"]["ttft_p99_s"],
             "ttft_p99_off_s": pf["cache_off"]["ttft_p99_s"],
         }
+    st = record["detail"].get("streamed_offload")
+    if st and st.get("status") == "ok":
+        # the ISSUE 11 streamed-rung acceptance row: relay MB/s + bytes
+        # ratio + speedup + loss parity travel with the headline
+        summary["streamed_offload"] = {
+            k: st[k] for k in ("streamed_speedup", "relay_bytes_ratio",
+                               "loss_parity", "gap_share")
+            if st.get(k) is not None}
+        summary["streamed_offload"]["relay_MBps"] = {
+            side: st[side].get("relay_MBps")
+            for side in ("bf16", "int8") if isinstance(st.get(side), dict)}
+    ht = record["detail"].get("host_tier_serving")
+    if ht and "hit_ratio_on" in ht:
+        # the KV-host-tier acceptance row: strictly-higher hit ratio at a
+        # thrash-sized pool, with token-identical outputs
+        summary["serving_host_tier"] = {
+            k: ht[k] for k in ("hit_ratio_on", "hit_ratio_off",
+                               "outputs_token_identical", "demotes",
+                               "promotes", "goodput_speedup")
+            if ht.get(k) is not None}
     line = json.dumps(summary, separators=(",", ":"))
+    # enforce the final-line cap: drop the bulkiest optional blocks first
+    # (the record line keeps everything); the minimal summary always fits
+    for victim in ("serving_metrics", "train_metrics", "overlap_ablation",
+                   "serving_prefix", "streamed_offload",
+                   "serving_host_tier"):
+        if len(line) <= BENCH_SUMMARY_MAX_CHARS:
+            break
+        if summary.pop(victim, None) is not None:
+            summary.setdefault("truncated", []).append(victim)
+            line = json.dumps(summary, separators=(",", ":"))
     return ["BENCH_JSON: " + line, line]
+
+
+def emit_summary(record: dict, rung_serving) -> None:
+    """THE bench stdout contract: the full record line, the
+    ``BENCH_JSON:``-prefixed summary, then the SAME summary as the
+    literal LAST stdout line — every line flushed, and nothing may print
+    after this (the runner parses the final line).  ``main`` calls this
+    as its last statement."""
+    import sys
+
+    print(json.dumps(record), flush=True)
+    for line in summary_lines(record, rung_serving):
+        print(line, flush=True)
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

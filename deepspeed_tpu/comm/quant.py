@@ -1,0 +1,167 @@
+"""Blockwise int8 quantization — the shared transport codec for every
+host<->device relay and (future) quantized collective.
+
+The ZeRO-Infinity / ZeRO-Offload streaming wall (ROADMAP item 3) and the
+EQuARX-style quantized-collective layer (ROADMAP item 2) both need the same
+primitive: an absmax-scaled int8 code per fixed-size block, cheap enough to
+fuse into the producing/consuming program.  This module is that primitive,
+in TWO twinned implementations with identical numerics:
+
+- ``quantize_blockwise`` / ``dequantize_blockwise`` — jax-traceable, for
+  the fused on-device dequant stage of the offload streaming path
+  (``runtime/zero/streaming.py``) and for in-kernel stages a quantized
+  collective wraps around all-gather / reduce-scatter;
+- ``quantize_blockwise_np`` / ``dequantize_blockwise_np`` — numpy, for the
+  host side of the relay (``OffloadedOptimizer`` int8 masters quantize on
+  host; only ``q`` + ``scale`` travel the wire).
+
+Code layout per array: the flat array is padded to a multiple of ``block``
+and stored as ``q`` int8 ``[nb, block]`` plus ``scale`` fp32 ``[nb, 1]``
+(scale = per-block absmax / 127).  This is the Adam8bit storage convention
+(``ops/adam/adam8bit.py``), so host int8 optimizer moments round-trip
+through the exact same code.  ``v``-style non-negative state uses the
+sqrt-space trick from the same module (quantize sqrt(v), square on
+dequant) via ``sqrt_space=True``.
+
+Tree helpers carry a parallel (q_tree, scale_tree) pair with the SAME
+treedef as the source so ``jax.tree.map`` composes, plus a static spec
+tree (shape/dtype) for reassembly.
+
+Worst-case relative error of one quantize/dequantize round-trip is
+1/254 per element (half a code step at absmax scale); exact zeros stay
+exact, and re-quantizing an already-dequantized block is lossless (the
+values are exactly ``scale * int`` and the block absmax is unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BLOCK = 256
+
+
+# ---------------------------------------------------------------------------
+# numpy twins (host side of the relay)
+# ---------------------------------------------------------------------------
+
+def quantize_blockwise_np(arr: np.ndarray, block: int = DEFAULT_BLOCK,
+                          sqrt_space: bool = False
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Flat fp array -> (q int8 [nb, block], scale fp32 [nb, 1])."""
+    flat = np.asarray(arr, np.float32).reshape(-1)
+    if sqrt_space:
+        flat = np.sqrt(flat)
+    n = flat.size
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    blocks = flat.reshape(nb, block)
+    absmax = np.abs(blocks).max(axis=1, keepdims=True)
+    scale = (absmax / 127.0).astype(np.float32)
+    inv = np.where(scale > 0, 1.0 / np.where(scale > 0, scale, 1.0), 0.0)
+    q = np.rint(blocks * inv).astype(np.int8)
+    return q, scale
+
+
+def dequantize_blockwise_np(q: np.ndarray, scale: np.ndarray, n: int,
+                            sqrt_space: bool = False,
+                            out: np.ndarray = None) -> np.ndarray:
+    """(q, scale) -> flat fp32 [n] (into ``out`` when given)."""
+    flat = (q.astype(np.float32) * scale).reshape(-1)[:n]
+    if sqrt_space:
+        flat = flat * flat
+    if out is not None:
+        out[:] = flat
+        return out
+    return flat
+
+
+# ---------------------------------------------------------------------------
+# jax twins (fused on-device dequant / future quantized collectives)
+# ---------------------------------------------------------------------------
+
+def quantize_blockwise(x: jax.Array, block: int = DEFAULT_BLOCK
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Traceable twin of :func:`quantize_blockwise_np` (linear space)."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    nb = -(-n // block)
+    flat = jnp.pad(flat, (0, nb * block - n))
+    blocks = flat.reshape(nb, block)
+    absmax = jnp.abs(blocks).max(axis=1, keepdims=True)
+    scale = absmax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.rint(blocks * inv).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_blockwise(q: jax.Array, scale: jax.Array, shape,
+                         dtype=jnp.float32) -> jax.Array:
+    """(q [nb, block], scale [nb, 1]) -> array of ``shape``/``dtype``.
+    Fuses into the consuming program — the int8 bytes are what crossed
+    the relay; the wide value only ever exists as a device transient."""
+    n = int(np.prod(shape)) if shape else 1
+    out = (q.astype(jnp.float32) * scale).reshape(-1)[:n]
+    return out.reshape(shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# pytree transport form
+# ---------------------------------------------------------------------------
+
+class QuantizedTree(NamedTuple):
+    """A pytree quantized leaf-by-leaf: ``q``/``scale`` mirror the source
+    treedef; ``spec`` holds static ShapeDtypeStructs for reassembly (and
+    is NOT shipped — shapes are compile-time constants)."""
+
+    q: Any
+    scale: Any
+    spec: Any
+
+    @property
+    def nbytes(self) -> int:
+        """Relay payload bytes (q + scale) — the wire cost this codec
+        exists to shrink."""
+        return sum(int(np.prod(a.shape))
+                   for a in jax.tree.leaves(self.q)) \
+            + 4 * sum(int(np.prod(a.shape))
+                      for a in jax.tree.leaves(self.scale))
+
+
+def quantize_tree_np(tree: Any, block: int = DEFAULT_BLOCK) -> QuantizedTree:
+    """Host-side: numpy pytree -> :class:`QuantizedTree` (numpy leaves)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    qs, ss, specs = [], [], []
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        q, s = quantize_blockwise_np(a, block)
+        qs.append(q)
+        ss.append(s)
+        specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    unflat = treedef.unflatten
+    return QuantizedTree(unflat(qs), unflat(ss), unflat(specs))
+
+
+def dequantize_tree(qt_q: Any, qt_scale: Any, spec: Any,
+                    dtype=None) -> Any:
+    """Traceable: (q_tree, scale_tree) -> value tree per ``spec``.  This
+    is the fused dequant stage the streamed layer programs open with —
+    pass ``dtype`` to override the spec dtypes (e.g. compute bf16)."""
+    return jax.tree.map(
+        lambda q, s, sp: dequantize_blockwise(
+            q, s, sp.shape, dtype or sp.dtype),
+        qt_q, qt_scale, spec)
+
+
+def dequantize_tree_np(qt: QuantizedTree, dtype=None) -> Any:
+    """Host twin of :func:`dequantize_tree` (numpy in, numpy out)."""
+    def one(q, s, sp):
+        flat = dequantize_blockwise_np(q, s, int(np.prod(sp.shape)))
+        return flat.reshape(sp.shape).astype(dtype or sp.dtype)
+
+    return jax.tree.map(one, qt.q, qt.scale, qt.spec)

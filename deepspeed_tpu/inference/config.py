@@ -85,6 +85,16 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # cache on or off.  Paged engines only (ignored on the fixed-slot
     # layout).
     prefix_caching: bool = True
+    # KV host tier (serving/host_tier.py — the ZeRO-Infinity move applied
+    # to serving): > 0 bounds an LRU host-RAM store of that many pages;
+    # prefix-cache eviction victims DEMOTE into it (device->host copy)
+    # instead of dropping their KV, and a later admission that matches a
+    # demoted chunk PROMOTES it back (host->device, byte-identical — greedy
+    # outputs cannot change), so the effective prefix cache is host-RAM
+    # sized and a preempt-resume re-adopts instead of re-prefilling.
+    # 0 (default) = off: eviction drops, the PR 9 semantics.  Paged +
+    # prefix_caching only.
+    kv_host_tier_pages: int = 0
 
     def __init__(self, **kwargs):
         # legacy alias: mp_size -> tensor_parallel.tp_size

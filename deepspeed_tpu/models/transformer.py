@@ -534,16 +534,33 @@ class CausalLM:
         elif cfg.scan_layers:
             x, auxes = jax.lax.scan(scan_body, x, (params["layers"], keys))
             aux_loss = jnp.sum(auxes)
+        elif cfg.param_offload:
+            # unrolled layers with host-tiered params: to_dev IS the
+            # prefetch hook — layer i+1's host->device move is emitted
+            # tied (optimization_barrier) to layer i's INPUT, so XLA may
+            # run the copy concurrent with layer i's matmuls but cannot
+            # hoist the whole stacked tree to the program head (the PR 6
+            # barrier-tied bucket idiom applied to the memory tier;
+            # double-buffered: at most two layers' params are in flight)
+            aux_loss = jnp.zeros((), jnp.float32)
+            lspecs = (jax.tree.map(lambda s: P(*tuple(s)[1:]),
+                                   getattr(self, "_offload_specs",
+                                           {}).get("layers"))
+                      if getattr(self, "_offload_specs", None) else None)
+            nxt = self._offload_to_dev(
+                jax.tree.map(lambda a: a[0], params["layers"]), lspecs)
+            for i in range(cfg.num_layers):
+                lp = nxt
+                if i + 1 < cfg.num_layers:
+                    sl = jax.tree.map(lambda a: a[i + 1], params["layers"])
+                    x, sl = jax.lax.optimization_barrier((x, sl))
+                    nxt = self._offload_to_dev(sl, lspecs)
+                x, aux = body(lp, x, keys[i])
+                aux_loss = aux_loss + aux
         else:
             aux_loss = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
                 lp = jax.tree.map(lambda a: a[i], params["layers"])
-                if cfg.param_offload:
-                    lspecs = (jax.tree.map(lambda s: P(*tuple(s)[1:]),
-                                           getattr(self, "_offload_specs",
-                                                   {}).get("layers"))
-                              if getattr(self, "_offload_specs", None) else None)
-                    lp = self._offload_to_dev(lp, lspecs)
                 x, aux = body(lp, x, keys[i])
                 aux_loss = aux_loss + aux
 

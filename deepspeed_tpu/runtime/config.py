@@ -102,6 +102,17 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     # grads off-device per parameter as autograd produces them; the
     # whole-program jax path can't — see runtime/zero/stream_grad.py).
     stream_grads: bool = True
+    # Streaming-relay knobs (runtime/zero/streaming.py — ROADMAP item 3):
+    # prefetch double-buffers layer i+1's H2D while layer i computes
+    # (loss-identical on/off — the transport order never changes the math);
+    # int8_stream ships each layer as blockwise int8 + scales with a fused
+    # on-device dequant stage (~2x fewer relay bytes than bf16; bounded
+    # quantization noise — pair with offload_optimizer.int8_masters);
+    # staging_slots pre-allocates that many persistent device staging
+    # buffers reused by donation instead of fresh per-layer allocations.
+    prefetch: bool = True
+    int8_stream: bool = False
+    staging_slots: int = 2
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
@@ -116,6 +127,13 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_write: bool = True
     fast_init: bool = False
     ratio: float = 1.0
+    # TPU extension (ROADMAP item 3, ZeRO-Offload/Infinity bandwidth wall):
+    # keep fp32 masters + moments as blockwise int8 on host (cpu backend;
+    # ~4x less host RAM) and ship int8+scales across the host->device relay
+    # with a fused on-device dequant (~2x fewer relay bytes than bf16).
+    # quant_block is the blockwise code granularity (comm/quant.py).
+    int8_masters: bool = False
+    quant_block: int = 256
 
 
 class DeepSpeedZeroConfig(DeepSpeedConfigModel):

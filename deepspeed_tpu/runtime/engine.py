@@ -197,6 +197,19 @@ def _owned_copy(sharding):
     return jax.jit(lambda x: x.copy(), out_shardings=sharding)
 
 
+@functools.lru_cache(maxsize=None)
+def _dequant_put(shape, dtype_name, sharding):
+    """Memoized compiled blockwise dequant for the int8 offload relay:
+    (q int8 [nb, block], scale fp32 [nb, 1]) -> compute-dtype param leaf.
+    Only the int8 payload crosses host->device; the wide array exists as a
+    runtime-owned program output (safe to donate downstream)."""
+    from deepspeed_tpu.comm.quant import dequantize_blockwise
+
+    dt = jnp.dtype(dtype_name)
+    return jax.jit(lambda q, s: dequantize_blockwise(q, s, shape, dt),
+                   out_shardings=sharding)
+
+
 def _owned_device_put(x, sharding):
     """``device_put`` that returns RUNTIME-OWNED buffers.
 
@@ -272,6 +285,7 @@ class DeepSpeedEngine:
         self._offload_device = off_cfg.device if off_cfg is not None else "none"
         self._offload = self._offload_device in ("cpu", "nvme")
         self._offload_opt = None
+        self._relay_meter = None
         self._streamed = None
         self._np_params = None
         self._pinned_stale = False
@@ -1199,6 +1213,11 @@ class DeepSpeedEngine:
 
     def _build_offload_optimizer(self, params) -> None:
         from deepspeed_tpu.runtime.zero.offload import OffloadedOptimizer
+        from deepspeed_tpu.runtime.zero.streaming import RelayMeter
+
+        # one ds_offload_* relay ledger per process; the streamed path's
+        # ParamStreamer registers the same instruments (same registry keys)
+        self._relay_meter = RelayMeter()
 
         p = dict(self.config.optimizer.params) if self.config.optimizer else {}
         betas = tuple(p.get("betas", (0.9, 0.999)))
@@ -1212,7 +1231,10 @@ class DeepSpeedEngine:
             swap_dir=off.nvme_path, aio_config=self.config.aio,
             pipeline=off.pipeline_read,
             pipeline_write=off.pipeline_write,
-            opt_type=getattr(self, "_offload_opt_type", "adam"))
+            opt_type=getattr(self, "_offload_opt_type", "adam"),
+            int8_masters=bool(getattr(off, "int8_masters", False)
+                              and self._offload_device == "cpu"),
+            quant_block=int(getattr(off, "quant_block", 256)))
 
     def lazy_init_from_batch(self, batch: Any) -> None:
         """zero.Init-equivalent: abstract-init then shard-on-create
@@ -2180,14 +2202,24 @@ class DeepSpeedEngine:
             return
         from deepspeed_tpu.runtime.zero.stream_grad import StreamedFwdBwd
 
+        off_opt = self.config.zero_config.offload_optimizer
         self._streamed = StreamedFwdBwd.from_param_specs(
-            seg, self._param_specs, self.mesh, gas=gas, use_dropout=True)
+            seg, self._param_specs, self.mesh, gas=gas, use_dropout=True,
+            prefetch=bool(getattr(p_off, "prefetch", True)),
+            int8=bool(getattr(p_off, "int8_stream", False)),
+            staging_slots=int(getattr(p_off, "staging_slots", 2)),
+            quant_block=int(getattr(off_opt, "quant_block", 256)
+                            if off_opt is not None else 256))
         # numpy compute-dtype copy for the per-layer H2D slices — built only
         # now that streaming is actually active (a second host-resident model
         # copy is wasted memory on the whole-program fallback)
         self._np_params = jax.device_get(self.state.params)
         log_dist("offload_param: streamed per-layer fwd/bwd active "
-                 "(device grads bounded to one layer)", ranks=[0])
+                 "(device grads bounded to one layer"
+                 + (", int8 relay" if self._streamed.streamer.int8 else "")
+                 + (", prefetch off" if not
+                    self._streamed.streamer.prefetch_enabled else "")
+                 + ")", ranks=[0])
 
     @staticmethod
     def _unpack_lm_batch(batch):
@@ -2354,6 +2386,7 @@ class DeepSpeedEngine:
         import ml_dtypes
 
         state = self.state
+        t_relay = time.perf_counter()
         grads, gnorm, overflow = self._offload_prep_fn(state)
         # The host optimizer step forces a sync anyway; reading the overflow
         # flag here costs nothing extra (reference offload is host-synced too).
@@ -2367,26 +2400,48 @@ class DeepSpeedEngine:
                     pass
             lr = self.get_lr()[0]
             opt = self._offload_opt
+            meter = self._relay_meter
+            metered = meter is not None and meter.registry.enabled
             np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
                         jnp.float16: np.float16}.get(self.compute_dtype, np.float32)
             use_bf16g = (opt.opt_type == "adam"
                          and self.compute_dtype == jnp.bfloat16
-                         and opt.adam is not None)
+                         and opt.adam is not None
+                         and not opt.int8_masters)
             shardings = jax.tree_util.tree_leaves(self._param_shardings)
             opt.begin_step(lr=lr)
             new_leaves = []
+            h2d = d2h = 0
             for i, leaf in enumerate(flat):
                 g = np.asarray(leaf)
+                d2h += g.nbytes
                 if use_bf16g and str(g.dtype) == "bfloat16":
                     # fresh buffer per leaf: device_put is async, so a reused
                     # buffer could be overwritten mid-transfer
                     out = opt.step_leaf_bf16(i, g.reshape(-1),
                                              np.empty(opt._sizes[i],
                                                       ml_dtypes.bfloat16))
+                elif opt.int8_masters:
+                    # int8 relay: the host step requantized the master; only
+                    # the blockwise code + scales travel H2D, and a memoized
+                    # compiled dequant materializes the compute-dtype param
+                    # on device (~2x fewer relay bytes than bf16).  The
+                    # dequant OUTPUT is runtime-owned, so donating it into
+                    # the accum fn is safe (the _owned_device_put concern).
+                    opt.step_leaf(
+                        i, np.ascontiguousarray(g, np.float32).reshape(-1),
+                        return_master=False)
+                    q, s = opt.relay_leaf(i)
+                    h2d += q.nbytes + s.nbytes
+                    new_leaves.append(_dequant_put(
+                        tuple(opt._shapes[i]), np.dtype(np_dtype).name,
+                        shardings[i])(q, s))
+                    continue
                 else:
                     master = opt.step_leaf(
                         i, np.ascontiguousarray(g, np.float32).reshape(-1))
                     out = master.astype(np_dtype)
+                h2d += out.nbytes
                 # per-leaf async H2D overlaps with the next leaf's host
                 # step; the OWNED put matters: these params are donated
                 # into the accum fn next micro-batch, and donating a
@@ -2395,6 +2450,10 @@ class DeepSpeedEngine:
                 new_leaves.append(_owned_device_put(
                     out.reshape(opt._shapes[i]), shardings[i]))
             opt.end_step()
+            if metered:
+                meter.h2d_bytes.inc(h2d)
+                meter.d2h_bytes.inc(d2h)
+                meter.stall.record(time.perf_counter() - t_relay)
             new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         else:
             new_params = state.params

@@ -54,15 +54,26 @@ class OffloadedOptimizer:
     N_AUX = {"adam": 2, "adagrad": 1, "lion": 1}
     AUX_NAMES = {"adam": ("exp_avg", "exp_avg_sq"), "adagrad": ("exp_avg_sq",),
                  "lion": ("exp_avg",)}
+    # which aux slots hold a non-negative second moment (quantized in sqrt
+    # space under int8_masters — the Adam8bit convention: sqrt halves the
+    # dynamic range a 127-level code must span)
+    SQRT_AUX = {"adam": (False, True), "adagrad": (True,), "lion": (False,)}
 
     def __init__(self, params_host: Any, *, backend: str = "cpu",
                  lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adamw_mode: bool = True,
                  swap_dir: Optional[str] = None, aio_config=None,
                  pipeline: bool = True, pipeline_write: bool = True,
-                 opt_type: str = "adam"):
+                 opt_type: str = "adam", int8_masters: bool = False,
+                 quant_block: int = 256):
         assert backend in ("cpu", "nvme"), backend
         assert opt_type in self.N_AUX, opt_type
+        if int8_masters and backend != "cpu":
+            raise ValueError("offload_optimizer.int8_masters supports the "
+                             "cpu backend (nvme state files stay fp32 — the "
+                             "aio path already pipelines its bandwidth)")
+        self.int8_masters = bool(int8_masters)
+        self.quant_block = int(quant_block)
         self.backend = backend
         self.opt_type = opt_type
         if opt_type == "adam":
@@ -92,7 +103,30 @@ class OffloadedOptimizer:
         self._shapes = [np.asarray(l).shape for l in leaves]
         self._sizes = [int(np.asarray(l).size) for l in leaves]
 
-        if backend == "cpu":
+        if backend == "cpu" and self.int8_masters:
+            # ZeRO-Infinity int8 host tier: master + moments live as
+            # blockwise int8 (q + fp32 block scales) — ~(1+n_aux) bytes/param
+            # of host RAM instead of 4*(1+n_aux), and the relay ships the
+            # int8 code (engine._step_offload / ParamStreamer dequantize on
+            # device).  The step dequantizes one leaf to fp32, runs the
+            # native kernel, and requantizes — only O(leaf) fp32 ever exists.
+            from deepspeed_tpu.comm.quant import quantize_blockwise_np
+
+            self._master = None
+            self._aux = None
+            self._swapper = None
+            self._master_q: List = []
+            self._aux_q: List[List] = [[] for _ in range(self.n_aux)]
+            sqrt_aux = self.SQRT_AUX[opt_type]
+            for l in leaves:
+                a = np.asarray(l, np.float32).reshape(-1)
+                self._master_q.append(
+                    quantize_blockwise_np(a, self.quant_block))
+                for k in range(self.n_aux):
+                    self._aux_q[k].append(quantize_blockwise_np(
+                        np.zeros_like(a), self.quant_block,
+                        sqrt_space=sqrt_aux[k]))
+        elif backend == "cpu":
             # explicit copy: device_get hands back read-only buffers, and the
             # C++ step writes through raw pointers
             self._master: List[np.ndarray] = [
@@ -114,8 +148,10 @@ class OffloadedOptimizer:
             self._master = None
             self._aux = None
         logger.info("offloaded optimizer: %d tensors, %.1fM elements, "
-                    "backend=%s, type=%s", len(leaves),
-                    sum(self._sizes) / 1e6, backend, opt_type)
+                    "backend=%s, type=%s%s", len(leaves),
+                    sum(self._sizes) / 1e6, backend, opt_type,
+                    ", int8 blockwise masters+moments" if self.int8_masters
+                    else "")
 
     # legacy accessors (adam layout) kept for checkpoints/tests
     @property
@@ -125,6 +161,43 @@ class OffloadedOptimizer:
     @property
     def _v(self):
         return self._aux[1] if self._aux is not None and self.n_aux > 1 else None
+
+    # -- int8 host-tier codec (comm/quant.py blockwise transport) ------
+    def _dequant_master(self, i: int) -> np.ndarray:
+        from deepspeed_tpu.comm.quant import dequantize_blockwise_np
+
+        q, s = self._master_q[i]
+        return dequantize_blockwise_np(q, s, self._sizes[i])
+
+    def _dequant_aux(self, i: int) -> List[np.ndarray]:
+        from deepspeed_tpu.comm.quant import dequantize_blockwise_np
+
+        sqrt_aux = self.SQRT_AUX[self.opt_type]
+        return [dequantize_blockwise_np(*self._aux_q[k][i],
+                                        n=self._sizes[i],
+                                        sqrt_space=sqrt_aux[k])
+                for k in range(self.n_aux)]
+
+    def _requant_leaf(self, i: int, master: np.ndarray,
+                      aux: List[np.ndarray]) -> None:
+        from deepspeed_tpu.comm.quant import quantize_blockwise_np
+
+        sqrt_aux = self.SQRT_AUX[self.opt_type]
+        self._master_q[i] = quantize_blockwise_np(master, self.quant_block)
+        for k in range(self.n_aux):
+            a = aux[k]
+            if sqrt_aux[k]:
+                # guard tiny negative fp noise out of the sqrt-space code
+                a = np.maximum(a, 0.0)
+            self._aux_q[k][i] = quantize_blockwise_np(
+                a, self.quant_block, sqrt_space=sqrt_aux[k])
+
+    def relay_leaf(self, i: int):
+        """(q int8 [nb, block], scale fp32 [nb, 1]) of master leaf ``i`` —
+        the int8 relay payload the engine ships H2D with an on-device
+        dequant stage instead of a wide compute-dtype array."""
+        assert self.int8_masters
+        return self._master_q[i]
 
     def _step_leaf(self, master: np.ndarray, g: np.ndarray, aux: List[np.ndarray]):
         st = self._stepper
@@ -153,7 +226,13 @@ class OffloadedOptimizer:
             self._swapper.prefetch(0)
 
     def _fetch_leaf(self, i: int):
-        """(master, aux, nvme_buf|None) for leaf i, with read-ahead."""
+        """(master, aux, release_token|None) for leaf i, with read-ahead.
+        Under ``int8_masters`` the fp32 views are transient dequants of the
+        int8 store; the token routes them back through requantization."""
+        if self.backend == "cpu" and self.int8_masters:
+            master = self._dequant_master(i)
+            aux = self._dequant_aux(i)
+            return master, aux, ("q", master, aux)
         if self.backend == "cpu":
             return self._master[i], [a[i] for a in self._aux], None
         buf = self._swapper.wait_fetch(i)
@@ -167,17 +246,38 @@ class OffloadedOptimizer:
     def _release_leaf(self, i: int, buf) -> None:
         if buf is None:
             return
+        if isinstance(buf, tuple) and buf[0] == "q":
+            self._requant_leaf(i, buf[1], buf[2])
+            return
         if self.pipeline_write:
             self._swapper.writeback(i, buf)
         else:
             self._swapper.write_sync(i, buf)
 
-    def step_leaf(self, i: int, g: np.ndarray) -> np.ndarray:
-        """Step one leaf from an fp32 flat grad; returns the fp32 master."""
+    def step_leaf(self, i: int, g: np.ndarray,
+                  return_master: bool = True) -> Optional[np.ndarray]:
+        """Step one leaf from an fp32 flat grad; returns the fp32 master.
+        Under ``int8_masters`` the returned master is the post-requant
+        view — exactly what the int8 store (and the relay) now holds, so
+        device params and host masters can never drift apart.  A caller
+        that only needs the side effect (the engine's int8 relay ships
+        ``relay_leaf`` instead) passes ``return_master=False`` to skip
+        that O(leaf) dequant."""
+        assert g.size == self._sizes[i], (
+            f"leaf {i} grad size {g.size} != {self._sizes[i]} (grads must "
+            f"follow tree-leaf order — the native kernel would read past "
+            f"a short buffer)")
         master, aux, buf = self._fetch_leaf(i)
         self._step_leaf(master, g, aux)
+        if not return_master:
+            self._release_leaf(i, buf)
+            return None
+        # copy BEFORE release: an nvme writeback may recycle the buffer the
+        # master view aliases into a concurrent prefetch
         out = master if buf is None else master.copy()
         self._release_leaf(i, buf)
+        if self.int8_masters:
+            return self._dequant_master(i)
         return out
 
     def step_leaf_bf16(self, i: int, g_bf16: np.ndarray,
@@ -189,7 +289,10 @@ class OffloadedOptimizer:
 
         assert self.opt_type == "adam" and self.adam is not None
         lib = self.adam._native
-        if lib is None:  # numpy fallback: convert and take the fp32 path
+        if lib is None or self.int8_masters:
+            # numpy fallback, and the int8 store: convert and take the fp32
+            # path (the int8 fetch/requant seam lives there; the engine's
+            # int8 relay ships relay_leaf(), not this bf16 buffer)
             master = self.step_leaf(i, np.asarray(g_bf16, np.float32).reshape(-1))
             out_bf16[:] = master.astype(out_bf16.dtype)
             return out_bf16
@@ -226,7 +329,10 @@ class OffloadedOptimizer:
 
     # ------------------------------------------------------------------
     def masters(self) -> List[np.ndarray]:
-        """Current fp32 masters (reads from NVMe for the nvme backend)."""
+        """Current fp32 masters (reads from NVMe for the nvme backend;
+        dequantized views of the int8 store under ``int8_masters``)."""
+        if self.backend == "cpu" and self.int8_masters:
+            return [self._dequant_master(i) for i in range(len(self._sizes))]
         if self.backend == "cpu":
             return self._master
         out = []
@@ -236,7 +342,12 @@ class OffloadedOptimizer:
         return out
 
     def _leaf_states(self, i: int) -> List[np.ndarray]:
-        """[master, *aux] flat views/copies for leaf i."""
+        """[master, *aux] flat views/copies for leaf i (fp32 — checkpoints
+        stay format-compatible across int8_masters on/off; the int8 store
+        requantizes losslessly on load, since dequantized values are exact
+        multiples of their block scale)."""
+        if self.backend == "cpu" and self.int8_masters:
+            return [self._dequant_master(i)] + self._dequant_aux(i)
         if self.backend == "cpu":
             return [self._master[i]] + [a[i] for a in self._aux]
         buf = self._swapper.read_sync(i)
@@ -245,7 +356,9 @@ class OffloadedOptimizer:
 
     def _set_leaf_states(self, i: int, states: List[np.ndarray]) -> None:
         states = [np.ascontiguousarray(s, np.float32).reshape(-1) for s in states]
-        if self.backend == "cpu":
+        if self.backend == "cpu" and self.int8_masters:
+            self._requant_leaf(i, states[0], states[1:])
+        elif self.backend == "cpu":
             self._master[i][:] = states[0]
             for a, s in zip(self._aux, states[1:]):
                 a[i][:] = s

@@ -19,11 +19,14 @@ and dispatched per layer:
                recomputes the layer forward: per-layer remat]
   embed_bwd   (embed, tokens, ct) -> d(embed)
 
-Per layer, the host: H2D-copies one layer's params (double-buffered — layer
-i+1's transfer is in flight while layer i computes), runs the segment, and
-D2H-copies the layer's grads straight into the fp32 numpy accumulators the
-host optimizer consumes.  Peak device memory is O(boundary activations +
-2 layers' params + 1 layer's grads) — never O(model).
+Per layer, the host: H2D-copies one layer's params through the
+:class:`~deepspeed_tpu.runtime.zero.streaming.ParamStreamer` transport
+(double-buffered prefetch — layer i+1's transfer is in flight while layer
+i computes; persistent staging slots; optional pinned-host routing and
+int8 relay with a fused on-device dequant stage), runs the segment, and
+D2H-copies the layer's grads straight into the fp32 numpy accumulators
+the host optimizer consumes.  Peak device memory is O(boundary
+activations + 2 layers' params + 1 layer's grads) — never O(model).
 """
 
 from __future__ import annotations
@@ -34,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.runtime.zero.streaming import ParamStreamer, _tree_nbytes
+
 
 class StreamedFwdBwd:
     """Drives per-layer streamed forward+backward for a segmented model.
@@ -42,11 +47,17 @@ class StreamedFwdBwd:
     ``layer_shardings`` / ``embed_shardings`` / ``head_shardings`` are
     device-memory NamedSharding trees used for the per-segment H2D puts
     (one layer's specs = stacked specs with the leading [L] dim stripped).
+
+    ``prefetch`` / ``int8`` / ``staging_slots`` / ``quant_block`` are the
+    relay knobs threaded into the :class:`ParamStreamer` (config:
+    ``offload_param.{prefetch,int8_stream,staging_slots}`` +
+    ``offload_optimizer.quant_block``).
     """
 
     @classmethod
     def from_param_specs(cls, segments: Dict[str, Any], specs, mesh, *,
-                         gas: int, use_dropout: bool) -> "StreamedFwdBwd":
+                         gas: int, use_dropout: bool,
+                         **stream_kw) -> "StreamedFwdBwd":
         """Build from a full param-tree PartitionSpec tree (the engine's
         ``_param_specs`` shape): one layer's specs are the stacked specs
         with the leading [L] dim stripped; the head is the tok table when
@@ -66,11 +77,13 @@ class StreamedFwdBwd:
                    layer_shardings=shardings_from_pspecs(layer_specs, mesh),
                    embed_shardings=shardings_from_pspecs(specs["embed"], mesh),
                    head_shardings=shardings_from_pspecs(head_specs, mesh),
-                   use_dropout=use_dropout)
+                   use_dropout=use_dropout, **stream_kw)
 
     def __init__(self, segments: Dict[str, Any], *, gas: int,
                  layer_shardings, embed_shardings, head_shardings,
-                 use_dropout: bool):
+                 use_dropout: bool, prefetch: bool = True, int8: bool = False,
+                 staging_slots: int = 2, quant_block: int = 256,
+                 registry=None):
         self.seg = segments
         self.gas = gas
         self.L = segments["num_layers"]
@@ -81,19 +94,31 @@ class StreamedFwdBwd:
         self._embed_sh = embed_shardings
         self._head_sh = head_shardings
         self._rope_cache: Dict[Any, Any] = {}
+        self.streamer = ParamStreamer(
+            layer_shardings, int8=int8, quant_block=quant_block,
+            prefetch=prefetch, staging_slots=staging_slots,
+            registry=registry)
+        self._src_id = None          # identity of the bound host layer tree
 
         layer_fwd = segments["layer_fwd"]
         head_loss = segments["head_loss"]
         embed_fwd = segments["embed_fwd"]
         use_drop = self.use_drop
+        mat = self.streamer.materialize
 
         def lfwd(lp, x, key, cos, sin):
-            return layer_fwd(lp, x, key, cos, sin, use_drop)
+            # mat() is the streamer's fused consumer stage: pinned->device
+            # move and/or blockwise dequant, traced INTO this program
+            return layer_fwd(mat(lp), x, key, cos, sin, use_drop)
 
         def lbwd(lp, x, key, cos, sin, ct_y, ct_aux):
+            # grads are taken w.r.t. the MATERIALIZED (compute-dtype) layer
+            # tree — quantization is a transport codec, not part of the
+            # differentiated function
+            lp_c = mat(lp)
             _, vjp = jax.vjp(
                 lambda lp_, x_: layer_fwd(lp_, x_, key, cos, sin, use_drop),
-                lp, x)
+                lp_c, x)
             g_lp, ct_x = vjp((ct_y, ct_aux))
             return ct_x, g_lp
 
@@ -142,10 +167,20 @@ class StreamedFwdBwd:
                 lambda: self.seg["rope"](S, dtype))()
         return self._rope_cache[key]
 
-    def _put_layer(self, np_layers, i: int):
-        """Async H2D of layer i's params (numpy slice views -> device)."""
-        sl = jax.tree.map(lambda a: a[i], np_layers)
-        return jax.device_put(sl, self._layer_sh)
+    def _bind_source(self, np_layers) -> None:
+        """Refresh the streamer when the host tree changed (the engine
+        swaps in a new compute tree every optimizer step; the micro-batches
+        within a step reuse one binding — and one int8 quantization)."""
+        if self._src_id != id(np_layers):
+            self.streamer.refresh(np_layers)
+            self._src_id = id(np_layers)
+
+    def _put_nonlayer(self, tree, shardings):
+        """Embed/head H2D (outside the layer streamer; counted on the same
+        relay ledger)."""
+        if self.streamer.meter.registry.enabled:
+            self.streamer.meter.h2d_bytes.inc(_tree_nbytes(tree))
+        return jax.device_put(tree, shardings)
 
     @staticmethod
     def _acc(buf_tree, grad_tree):
@@ -160,8 +195,8 @@ class StreamedFwdBwd:
 
         jax.tree.map(add, buf_tree, grad_tree)
 
-    @staticmethod
-    def _d2h_async(tree):
+    def _d2h_async(self, tree):
+        self.streamer.record_d2h(tree)
         for leaf in jax.tree.leaves(tree):
             try:
                 leaf.copy_to_host_async()
@@ -182,19 +217,22 @@ class StreamedFwdBwd:
         else:
             keys = [jnp.zeros((2,), jnp.uint32)] * L
 
-        embed_dev = jax.device_put(np_params["embed"], self._embed_sh)
+        self._bind_source(np_params["layers"])
+        embed_dev = self._put_nonlayer(np_params["embed"], self._embed_sh)
         x = self._embed_fwd(embed_dev, tokens)
         del embed_dev
 
-        # ---- forward: double-buffered layer streaming ----------------
+        # ---- forward: double-buffered layer streaming (ParamStreamer:
+        # prefetch i+1 while i computes; staging slots; int8/pinned) -----
         xs = [x]            # boundary activations (device)
         auxes = []
         lp_last = None      # keep the final layer's device copy for backward
-        pending = self._put_layer(np_params["layers"], 0)
+        stream = self.streamer
+        stream.prefetch(0)
         for i in range(L):
-            lp = pending
             if i + 1 < L:   # overlap next layer's H2D with this compute
-                pending = self._put_layer(np_params["layers"], i + 1)
+                stream.prefetch(i + 1)
+            lp = stream.take(i)
             if i == 0 and "layer_fwd" not in self.probes:
                 self.probes["layer_fwd"] = (
                     self._layer_fwd, self._abstract((lp, x, keys[i], cos, sin)))
@@ -211,7 +249,7 @@ class StreamedFwdBwd:
         ht = {"final_norm": np_params["final_norm"], "head": head_np}
         if "lm_head_bias" in np_params:
             ht["head_bias"] = np_params["lm_head_bias"]
-        head_tree = jax.device_put(ht, self._head_sh)
+        head_tree = self._put_nonlayer(ht, self._head_sh)
         if "head_vag" not in self.probes:
             self.probes["head_vag"] = (
                 self._head_vag,
@@ -237,14 +275,14 @@ class StreamedFwdBwd:
 
         # ---- backward: stream layers in reverse (layer L-1's device
         # copy from the forward is still live — no re-upload) -----------
-        pending = lp_last
-        lp_last = None
+        stream.drop_inflight()   # forward-direction prefetches are stale
         prev_grads: Optional[Any] = None
         prev_idx = -1
         for i in range(L - 1, -1, -1):
-            lp = pending
             if i - 1 >= 0:
-                pending = self._put_layer(np_params["layers"], i - 1)
+                stream.prefetch(i - 1)
+            lp = lp_last if i == L - 1 else stream.take(i)
+            lp_last = None
             if "layer_bwd" not in self.probes:
                 self.probes["layer_bwd"] = (
                     self._layer_bwd,
@@ -259,7 +297,7 @@ class StreamedFwdBwd:
         if prev_grads is not None:
             self._acc_indexed(acc_tree["layers"], prev_idx, prev_grads)
 
-        embed_dev = jax.device_put(np_params["embed"], self._embed_sh)
+        embed_dev = self._put_nonlayer(np_params["embed"], self._embed_sh)
         if "embed_bwd" not in self.probes:
             self.probes["embed_bwd"] = (
                 self._embed_bwd, self._abstract((embed_dev, tokens, ct)))

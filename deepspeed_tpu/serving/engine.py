@@ -72,6 +72,7 @@ from deepspeed_tpu.monitor.health import get_health
 from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.monitor.request_trace import get_request_tracer
 from deepspeed_tpu.profiling.trace import annotate
+from deepspeed_tpu.serving.host_tier import HostPageStore
 from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.scheduler import (PREFILLING, QUEUED, RUNNING,
@@ -166,10 +167,24 @@ class ServingEngine:
             # flash-decode block multiple)
             self.cache_len = int(self._cache["k"].shape[-2])
         # copy-on-write prefix caching over the page pool (a fixed-slot
-        # engine has no pages to share — the knob is paged-only)
-        self.prefix_cache = (
-            PrefixCache(self.pool, registry=self._registry)
-            if self.paged and self._config.prefix_caching else None)
+        # engine has no pages to share — the knob is paged-only), with an
+        # optional HOST TIER: kv_host_tier_pages > 0 bounds an LRU host
+        # store that eviction victims demote into (instead of dropping)
+        # and admissions promote back out of — the effective prefix cache
+        # becomes host-RAM-sized (docs/OBSERVABILITY.md "KV host tier")
+        if self.paged and self._config.prefix_caching:
+            host_pages = int(getattr(self._config, "kv_host_tier_pages", 0))
+            self.host_store = (
+                HostPageStore(host_pages, registry=self._registry)
+                if host_pages > 0 else None)
+            self.prefix_cache = PrefixCache(
+                self.pool, registry=self._registry,
+                host_store=self.host_store,
+                fetch_page=(self._fetch_page_host
+                            if self.host_store is not None else None))
+        else:
+            self.host_store = None
+            self.prefix_cache = None
         # max_out is the configured LOGICAL budget — generation bounds use
         # max_out so serving stays token-identical to generate(), which
         # never sees the physical rounding
@@ -203,6 +218,7 @@ class ServingEngine:
         self._block_fn = None
         self._prefill_fns = {}
         self._cow_copy = None    # compiled COW page copy (prefix cache)
+        self._host_write = None  # compiled host->device page write (tier)
         # background serving loop (start_loop/stop_loop): drives step()
         # so HTTP /generate handlers can block on request completion
         self._loop_thread: Optional[threading.Thread] = None
@@ -692,46 +708,74 @@ class ServingEngine:
     def _admit_prefix(self, req: Request) -> None:
         """Match the request's prefix (prompt — plus produced tokens on a
         preempt-resume) against the cache at admission: fully-matched
-        pages are ADOPTED into the slot's page table read-only
-        (refcounted; the kernel's page-table indirection reads them with
-        zero changes) and ``prefill_pos`` jumps to the match frontier.  A
-        partially-matched boundary page — the page the request will write
-        its first computed token into — is COPY-ON-WRITTEN: a private
-        page is allocated, the cached page's KV is copied device-side,
-        and the table points at the copy, so the shared original is never
-        written.  At least one prefix token is always left to compute
-        (the final chunk's logits feed first-token sampling)."""
+        DEVICE-resident pages are ADOPTED into the slot's page table
+        read-only (refcounted; the kernel's page-table indirection reads
+        them with zero changes), HOST-resident chunks are PROMOTED first
+        (a fresh page is allocated and the demoted payload streams back
+        host->device — byte-identical KV, then re-pinned and shared), and
+        ``prefill_pos`` jumps to the match frontier.  A partially-matched
+        boundary page — the page the request will write its first
+        computed token into — is COPY-ON-WRITTEN: a private page is
+        allocated and the cached KV lands in it (one compiled device page
+        copy, or a host->device write when the boundary chunk lives in
+        the host tier), so the shared original is never written.  At
+        least one prefix token is always left to compute (the final
+        chunk's logits feed first-token sampling)."""
         prefix = req.prefix
         n = req.prefix_len
         page = self.pool.page
-        pages = self.prefix_cache.match(prefix)
-        matched = min(len(pages) * page, n - 1)
-        if matched <= 0:
-            self._m_prefix_miss.inc(n)
-            return
-        j, r = divmod(matched, page)
-        self.pool.adopt(req.slot, pages[:j])
+        nodes = self.prefix_cache.match_nodes(prefix)
+        cap = n - 1
+        want_full = min(len(nodes), cap // page)
+        adopted = 0
+        for node in nodes[:want_full]:
+            pid = node.page           # read LIVE per iteration: an earlier
+            if pid == -2:             # promotion's eviction pressure may
+                break                 # have demoted (-1) or pruned (-2,
+            if pid < 0:               # tombstone) nodes in this snapshot
+                pid = self._promote_node(node)
+                if pid is None:       # pool/store pressure: stop here
+                    break
+            self.pool.append_shared(req.slot, pid)
+            adopted += 1
+        matched = adopted * page
+        r = cap - matched if (adopted == want_full
+                              and want_full < len(nodes)) else 0
         if r:
             # boundary-page COW: allocate the private copy now (under
-            # light pressure, evict LRU cached pages; if the pool still
-            # has nothing, fall back to the page-aligned frontier and
-            # recompute the boundary page instead of preempting anyone
-            # at admission time)
+            # light pressure, evict/demote LRU cached pages; if the pool
+            # still has nothing, fall back to the page-aligned frontier
+            # and recompute the boundary page instead of preempting
+            # anyone at admission time)
+            boundary = nodes[want_full]
+            ok = True
             while not self.pool.ensure(req.slot, matched + 1):
                 if not self.prefix_cache.evict_lru():
-                    matched, r = j * page, 0
+                    ok = False
                     break
-            if r:
-                src = pages[j]
-                dst = int(self.pool.page_table[req.slot, j])
-                # even if the eviction loop above just unpinned ``src``
-                # and handed it back as ``dst``, the copy stays correct:
-                # a freed page's KV is intact until reallocated, and
-                # dst==src copies in place
-                self._cache = self._cow_fn()(
-                    self._cache, jnp.asarray(dst, jnp.int32),
-                    jnp.asarray(src, jnp.int32))
-        if matched <= 0:          # COW fallback collapsed the whole match
+            if ok:
+                dst = int(self.pool.page_table[req.slot, adopted])
+                if boundary.page >= 0:
+                    # even if the eviction loop above just unpinned the
+                    # source and handed it back as ``dst``, the copy stays
+                    # correct: a freed page's KV is intact until
+                    # reallocated, and dst==src copies in place.  (With a
+                    # host tier the same race instead demotes the
+                    # boundary, which the branch below serves.)
+                    self._cache = self._cow_fn()(
+                        self._cache, jnp.asarray(dst, jnp.int32),
+                        jnp.asarray(boundary.page, jnp.int32))
+                    matched += r
+                else:
+                    payload = self.prefix_cache.host_payload(boundary)
+                    if payload is not None:
+                        # boundary lives in the host tier: stream it into
+                        # the slot's PRIVATE page (the node itself stays
+                        # host-resident for future matches)
+                        self._write_page(dst, payload)
+                        self.host_store.m_promote.inc()
+                        matched += r
+        if matched <= 0:          # nothing usable survived the pressure
             self._m_prefix_miss.inc(n)
             return
         req.prefill_pos = matched
@@ -765,6 +809,62 @@ class ServingEngine:
 
             self._cow_copy = cow
         return self._cow_copy
+
+    # -- KV host tier (serving/host_tier.py): demote/promote page IO ----
+    def _fetch_page_host(self, page: int):
+        """Device->host payload of one physical page (every 5-dim cache
+        plane) — the demote reader the prefix cache calls from
+        ``evict_lru`` when the host tier is attached."""
+        return {k: np.asarray(v[:, page])
+                for k, v in self._cache.items() if v.ndim == 5}
+
+    def _host_write_fn(self):
+        """One compiled host->device page write: the demoted payload
+        (K/V planes and, quantized, their scales) lands in physical page
+        ``dst``.  The payload is NOT donated — only the cache is (the
+        ``_cow_fn`` pattern), so the numpy-aliased host arrays never meet
+        a donated argument."""
+        if self._host_write is None:
+            self._m_compiles.inc()
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def wr(cache, dst, payload):
+                return {k: (v.at[:, dst].set(payload[k]) if k in payload
+                            else v) for k, v in cache.items()}
+
+            self._host_write = wr
+        return self._host_write
+
+    def _write_page(self, dst: int, payload) -> None:
+        self._cache = self._host_write_fn()(
+            self._cache, jnp.asarray(dst, jnp.int32), payload)
+
+    def _promote_node(self, node) -> Optional[int]:
+        """Promote one host-resident chunk back to the device tier: pop a
+        free page (demoting other LRU cached pages under pressure —
+        never this one: a host node is not in the device LRU list, and
+        the whole match path was just touched MRU), stream the payload
+        in, and re-pin the node onto it.  None = could not promote (pool
+        dry with nothing evictable, or the entry aged out of the bounded
+        store) — the caller caps the match at the frontier reached."""
+        payload = self.prefix_cache.host_payload(node)
+        if payload is None:
+            return None
+        dst = self.pool.alloc_page()
+        while dst is None:
+            if not self.prefix_cache.evict_lru():
+                return None
+            if node.host_key is None or node.page != -1:
+                # the eviction's demote overflowed the bounded store and
+                # pushed out THIS node's entry (deterministic at
+                # kv_host_tier_pages=1): the node was pruned from the
+                # trie — promoting it would pin an orphan page
+                return None
+            dst = self.pool.alloc_page()
+        self._write_page(dst, payload)
+        self.prefix_cache.promote(node, dst)
+        self.host_store.m_promote.inc()
+        return dst
 
     # ------------------------------------------------------------------
     # paged-pool allocation + preemption

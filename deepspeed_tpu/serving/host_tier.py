@@ -1,0 +1,107 @@
+"""Host tier for KV pages: a bounded LRU store of demoted page payloads.
+
+The prefix cache (``serving/prefix_cache.py``) pins finished prompts' KV
+pages in the device pool; under pool pressure those pins are the first
+thing evicted — and before this tier existed, eviction DROPPED the KV, so
+the effective prefix cache was HBM-sized and a re-admission re-prefilled
+from scratch.  This module is the ZeRO-Infinity move applied to serving
+(ROADMAP item 3): an evicted page's payload is copied device->host into
+this store ("demote") instead of being discarded, and a later admission
+that matches the chunk streams it back host->device into a freshly
+allocated page ("promote") — byte-identical KV, so greedy outputs cannot
+change.  The effective prefix cache becomes host-RAM-sized, and a
+preempt-resume re-adopts instead of re-prefilling.
+
+The store holds opaque payloads (dicts of numpy arrays — K/V planes and,
+quantized, their scales; the ENGINE owns the device<->host copies) keyed
+by a monotone handle.  Capacity is page-count-bounded; inserting past the
+bound evicts the least-recently-used entries and returns their keys so
+the owner (the prefix-cache trie) can invalidate the nodes that pointed
+at them.  Host-side bookkeeping only — no jax.
+
+Metrics (docs/OBSERVABILITY.md "Serving — KV host tier"):
+``ds_serve_kv_host_pages`` (gauge), ``ds_serve_kv_demote_total`` /
+``ds_serve_kv_promote_total`` (counters — promote is counted by the
+engine at the moment the payload lands back in a device page).
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["HostPageStore"]
+
+
+class HostPageStore:
+    """Bounded LRU {key -> page payload} host store."""
+
+    def __init__(self, max_pages: int, registry=None):
+        if max_pages < 1:
+            raise ValueError(f"kv host tier needs >= 1 page, got {max_pages}")
+        self.max_pages = int(max_pages)
+        self._data: "OrderedDict[int, Dict[str, np.ndarray]]" = OrderedDict()
+        self._next = itertools.count(1)
+        if registry is None:
+            from deepspeed_tpu.monitor.metrics import get_registry
+
+            registry = get_registry()
+        self._m_pages = registry.gauge(
+            "ds_serve_kv_host_pages",
+            "KV pages resident in the host tier (demoted, promotable)")
+        self.m_demote = registry.counter(
+            "ds_serve_kv_demote_total",
+            "KV pages demoted device->host instead of dropped")
+        self.m_promote = registry.counter(
+            "ds_serve_kv_promote_total",
+            "KV pages promoted host->device on a prefix re-admission")
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def put(self, payload: Dict[str, np.ndarray]
+            ) -> Tuple[int, List[int]]:
+        """Insert a demoted page; returns ``(key, evicted_keys)`` — the
+        keys this insert pushed out of the bounded store (oldest first),
+        which the owner must invalidate."""
+        key = next(self._next)
+        self._data[key] = payload
+        evicted: List[int] = []
+        while len(self._data) > self.max_pages:
+            old, _ = self._data.popitem(last=False)
+            evicted.append(old)
+        self.m_demote.inc()
+        self._m_pages.set(len(self._data))
+        return key, evicted
+
+    def get(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        """The payload for ``key`` (LRU-touched), or None if it aged out."""
+        payload = self._data.get(key)
+        if payload is not None:
+            self._data.move_to_end(key)
+        return payload
+
+    def touch(self, key: Optional[int]) -> bool:
+        """LRU-touch without fetching; False when the entry aged out."""
+        if key not in self._data:
+            return False
+        self._data.move_to_end(key)
+        return True
+
+    def drop(self, key: int) -> None:
+        """Remove ``key`` (promotion re-homed it to a device page, or the
+        owning trie node was cleared)."""
+        self._data.pop(key, None)
+        self._m_pages.set(len(self._data))
+
+    def keys(self) -> List[int]:
+        return list(self._data)
+
+    def clear(self) -> int:
+        n = len(self._data)
+        self._data.clear()
+        self._m_pages.set(0)
+        return n

@@ -158,6 +158,18 @@ class PagedKVPool:
             self._ref[p] += 1
         return True
 
+    def append_shared(self, slot: int, page: int) -> None:
+        """Append ONE already-populated page to the slot's table, shared
+        READ-ONLY (INCREF'd) — the unit step :meth:`adopt` loops, exposed
+        separately so the host-tier admission can interleave adopting
+        device-resident pages with promoting host-resident ones."""
+        assert page != 0, "cannot adopt the junk page"
+        owned = self._owned[slot]
+        assert len(owned) < self.slot_pages, f"slot {slot} table full"
+        self.page_table[slot, len(owned)] = page
+        owned.append(page)
+        self._ref[page] += 1
+
     def adopt(self, slot: int, pages: List[int]) -> None:
         """Pre-populate a freshly-admitted slot's table with pages another
         request already computed (prefix-cache hit): each page is INCREF'd
@@ -167,10 +179,18 @@ class PagedKVPool:
         owned = self._owned[slot]
         assert not owned, f"adopt into non-empty slot {slot}: {owned}"
         for p in pages:
-            assert p != 0, "cannot adopt the junk page"
-            self.page_table[slot, len(owned)] = p
-            owned.append(p)
-            self._ref[p] += 1
+            self.append_shared(slot, p)
+
+    def alloc_page(self) -> Optional[int]:
+        """Pop one free page WITHOUT binding it to a slot (refcount 0,
+        unpinned) — the host-tier promotion target: the engine streams the
+        demoted payload into it, then the cache pins it and the admitting
+        slot adopts it, all within one admission (the page is never left
+        dangling across a scheduler step).  None when the pool is dry —
+        the caller evicts/demotes and retries."""
+        if not self._free:
+            return None
+        return self._free.pop()
 
     def release(self, slot: int) -> int:
         """DECREF every page the slot references and park its table rows

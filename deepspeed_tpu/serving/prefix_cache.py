@@ -1,4 +1,4 @@
-"""Copy-on-write prefix caching over the paged KV pool.
+"""Copy-on-write prefix caching over the paged KV pool, with a host tier.
 
 Repeated-prefix traffic (shared system prompts, multi-turn chat, a
 preempted request re-prefilling its own history) re-computes prefill for
@@ -17,18 +17,35 @@ matching).
 Structure: one trie node per ``page_tokens``-sized chunk of token ids
 (children keyed by the exact chunk tuple — a radix tree whose edge labels
 are all page-length, which makes every match page-aligned by
-construction).  ``match`` walks the prompt down the trie and returns the
-pages of the longest cached prefix; ``insert`` (at request finish) adds
-the request's full-prompt pages, pinning newly-added pages in the pool so
-they survive the request's release.  Under pool pressure the engine calls
-``evict_lru``: the least-recently-used LEAF whose page no live slot
-references is unpinned back to the free list — cached pages are
-reclaimed BEFORE any live request is preempted, and leaf-first eviction
-keeps every remaining root-path intact (a match can never dangle).
+construction).  ``match``/``match_nodes`` walk the prompt down the trie;
+``insert`` (at request finish/preempt) adds the request's full-prompt
+pages, pinning newly-added pages in the pool so they survive the
+request's release.
 
-Host-side bookkeeping only — no jax, and importable without the
-``deepspeed_tpu`` package (``tools/router.py`` does not need it, but the
-no-jax loading idiom is shared with ``serving/router.py``).
+**Eviction** (``evict_lru``, called by the engine under pool pressure)
+walks an INTRUSIVE LRU list over cached device pages — every match/insert
+moves the touched nodes to the MRU tail, so the victim scan starts at the
+genuine LRU head and only skips the (rare) entries a live slot still
+references, replacing the PR 9 O(nodes) full-trie walk (deliberate then;
+the host tier makes eviction hot).  What eviction DOES depends on the
+tier:
+
+- no host tier (``kv_host_tier_pages=0``): the LRU **leaf** whose page no
+  live slot references is unpinned back to the free list and its node
+  removed (leaf-first keeps every remaining root-path matchable) — the
+  PR 9 semantics, bit-for-bit;
+- host tier attached: the LRU ref-0 node's page payload is copied
+  device->host into the bounded :class:`~deepspeed_tpu.serving.host_tier.
+  HostPageStore` ("demote") and the node STAYS in the trie, now
+  host-resident (``page == -1``) — the trie structure is preserved, so
+  interior nodes demote as freely as leaves.  A later admission that
+  matches the chunk allocates a fresh device page, streams the payload
+  back ("promote"), and re-pins it — byte-identical KV, so greedy outputs
+  cannot change.  The effective prefix cache is host-RAM-sized.
+
+Host-side bookkeeping only — no jax; the engine owns all device<->host
+copies and hands them in as ``fetch_page`` (demote reader).  All mutation
+happens on the engine's scheduling thread.
 """
 
 from __future__ import annotations
@@ -42,38 +59,56 @@ __all__ = ["PrefixCache"]
 
 
 class _Node:
-    """One cached page: the chunk of token ids it holds, the physical
-    page, and its LRU tick (monotone counter, not wall time — eviction
-    order is deterministic under test)."""
+    """One cached chunk: the token ids it holds and WHERE its KV lives —
+    a device page (``page >= 0``, pinned in the pool, linked into the
+    LRU list) or the host tier (``page == -1``, ``host_key`` names the
+    :class:`HostPageStore` entry).  ``tick`` is a monotone touch counter
+    kept for introspection; eviction order is the intrusive list."""
 
-    __slots__ = ("chunk", "page", "parent", "children", "tick")
+    __slots__ = ("chunk", "page", "host_key", "parent", "children", "tick",
+                 "lru_prev", "lru_next")
 
     def __init__(self, chunk: Tuple[int, ...], page: int,
                  parent: Optional["_Node"]):
         self.chunk = chunk
         self.page = page
+        self.host_key: Optional[int] = None
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.tick = 0
+        self.lru_prev: Optional["_Node"] = None
+        self.lru_next: Optional["_Node"] = None
 
 
 class PrefixCache:
     """Page-granular radix/trie prefix cache over a :class:`~deepspeed_tpu.
-    serving.paged_kv.PagedKVPool`.
+    serving.paged_kv.PagedKVPool`, optionally backed by a
+    :class:`~deepspeed_tpu.serving.host_tier.HostPageStore`.
 
     The cache owns no device memory: it maps token-id prefixes to
     physical page ids and pins those pages in the pool
     (:meth:`~deepspeed_tpu.serving.paged_kv.PagedKVPool.pin`) so the
-    allocator parks them instead of freeing.  All mutation happens on the
-    engine's scheduling thread.
+    allocator parks them instead of freeing.  With a host tier attached
+    (``host_store`` + the engine's ``fetch_page`` device->host reader),
+    eviction demotes instead of dropping.
     """
 
-    def __init__(self, pool, registry=None):
+    def __init__(self, pool, registry=None, host_store=None, fetch_page=None):
         self.pool = pool
         self.page = pool.page
+        self.host_store = host_store
+        self._fetch_page = fetch_page
+        if host_store is not None and fetch_page is None:
+            raise ValueError("host_store needs the engine's fetch_page "
+                             "(device->host page reader)")
         self._children: Dict[Tuple[int, ...], _Node] = {}   # root level
         self._nodes = 0
         self._tick = itertools.count(1)
+        self._host_nodes: Dict[int, _Node] = {}   # store key -> node
+        # intrusive LRU list over DEVICE-paged nodes: head = LRU victim,
+        # tail = MRU; sentinel closes the ring
+        self._lru = _Node((), -2, None)
+        self._lru.lru_prev = self._lru.lru_next = self._lru
         if registry is None:
             from deepspeed_tpu.monitor.metrics import get_registry
 
@@ -83,39 +118,98 @@ class PrefixCache:
             "physical pages pinned by the prefix cache")
         self._m_evictions = registry.counter(
             "ds_serve_prefix_evictions_total",
-            "cached pages evicted (LRU) under pool pressure")
+            "cached pages evicted from the device pool (LRU) under pool "
+            "pressure (demoted to the host tier when one is attached, "
+            "dropped otherwise)")
 
     def __len__(self) -> int:
         return self._nodes
 
+    @property
+    def host_pages(self) -> int:
+        return len(self.host_store) if self.host_store is not None else 0
+
+    # -- intrusive LRU list -------------------------------------------
+    def _lru_remove(self, node: _Node) -> None:
+        p, n = node.lru_prev, node.lru_next
+        if p is not None:
+            p.lru_next = n
+            n.lru_prev = p
+        node.lru_prev = node.lru_next = None
+
+    def _lru_append(self, node: _Node) -> None:
+        tail = self._lru.lru_prev
+        tail.lru_next = node
+        node.lru_prev = tail
+        node.lru_next = self._lru
+        self._lru.lru_prev = node
+
+    def _lru_touch(self, node: _Node) -> None:
+        self._lru_remove(node)
+        self._lru_append(node)
+
     # ------------------------------------------------------------------
-    def match(self, tokens: np.ndarray) -> List[int]:
-        """Pages of the longest cached prefix of ``tokens`` (whole pages
-        only — the trie's edges are page-length, so the returned length
-        is ``len(result) * page_tokens`` by construction).  Touches the
-        matched path's LRU ticks."""
-        pages: List[int] = []
+    def _walk(self, tokens: np.ndarray):
+        """Yield matched nodes chunk by chunk (no touching)."""
         children = self._children
-        tick = next(self._tick)
         toks = np.asarray(tokens)
         for i in range(len(toks) // self.page):
             chunk = tuple(int(t) for t in
                           toks[i * self.page:(i + 1) * self.page])
             node = children.get(chunk)
             if node is None:
-                break
-            node.tick = tick
-            pages.append(node.page)
+                return
+            yield node
             children = node.children
+
+    def match_nodes(self, tokens: np.ndarray) -> List[_Node]:
+        """Nodes of the longest cached prefix of ``tokens`` (whole pages
+        only).  Touches the matched path (LRU) in both tiers; a node
+        whose host entry aged out of the bounded store ends the match and
+        is pruned (with its now-unreachable subtree)."""
+        out: List[_Node] = []
+        tick = next(self._tick)
+        for node in self._walk(tokens):
+            if node.page < 0:
+                if (self.host_store is None
+                        or not self.host_store.touch(node.host_key)):
+                    self._drop_subtree(node)
+                    break
+            else:
+                self._lru_touch(node)
+            node.tick = tick
+            out.append(node)
+        return out
+
+    def match(self, tokens: np.ndarray) -> List[int]:
+        """Device pages of the longest DEVICE-resident cached prefix (the
+        pre-host-tier contract: page ids ready to adopt as-is; a
+        host-resident chunk ends the walk — promoting is the engine's
+        call, via :meth:`match_nodes`)."""
+        pages: List[int] = []
+        for node in self.match_nodes(tokens):
+            if node.page < 0:
+                break
+            pages.append(node.page)
         return pages
 
+    def host_payload(self, node: _Node):
+        """The demoted payload backing a host-resident node (None if it
+        aged out — the caller should treat the match as ended)."""
+        if self.host_store is None or node.host_key is None:
+            return None
+        return self.host_store.get(node.host_key)
+
+    # ------------------------------------------------------------------
     def insert(self, tokens: np.ndarray, pages: List[int]) -> int:
         """Insert the full-page prefix of ``tokens`` backed by ``pages``
         (the finishing request's first ``len(pages)`` page-table entries,
         in order).  Chunks already cached keep their EXISTING page — a
         concurrent duplicate computation's page simply is not pinned and
-        frees with its request; only genuinely new pages are pinned.
-        Returns how many pages were newly added."""
+        frees with its request; a chunk that was DEMOTED to host is
+        re-homed onto the newcomer's freshly-computed device page (the
+        data is identical; the host entry drops).  Returns how many pages
+        were newly pinned."""
         toks = np.asarray(tokens)
         n_full = min(len(toks) // self.page, len(pages))
         children = self._children
@@ -130,8 +224,19 @@ class PrefixCache:
                 node = _Node(chunk, int(pages[i]), parent)
                 children[chunk] = node
                 self.pool.pin(node.page)
+                self._lru_append(node)
                 self._nodes += 1
                 added += 1
+            elif node.page < 0:
+                # host-resident chunk re-computed by this request: promote
+                # in place to the newcomer's device page (same bytes)
+                self._drop_host_entry(node)
+                node.page = int(pages[i])
+                self.pool.pin(node.page)
+                self._lru_append(node)
+                added += 1
+            else:
+                self._lru_touch(node)
             node.tick = tick
             parent = node
             children = node.children
@@ -140,51 +245,161 @@ class PrefixCache:
         return added
 
     # ------------------------------------------------------------------
-    def evict_lru(self) -> int:
-        """Evict the least-recently-used LEAF whose page no live slot
-        references (refcount 0): unpin it back to the pool's free list.
-        Returns the number of pages freed (0 = nothing evictable — every
-        cached page is either shared by a live slot or an interior node
-        with live descendants; the caller falls back to preemption).
-        Leaf-first keeps all remaining root-paths matchable.
+    def _drop_host_entry(self, node: _Node) -> None:
+        if node.host_key is not None:
+            self._host_nodes.pop(node.host_key, None)
+            if self.host_store is not None:
+                self.host_store.drop(node.host_key)
+            node.host_key = None
 
-        The victim search is a full O(nodes) walk per eviction — a
-        deliberate trade at today's pool scales (hundreds to low
-        thousands of tiny nodes; microseconds on the admission path,
-        and evictions only happen under pool pressure).  If pools grow
-        to where bulk reclaim matters, keep evictable leaves in an
-        incrementally-maintained tick-ordered structure instead."""
-        victim: Optional[_Node] = None
-        stack = list(self._children.values())
+    def _detach(self, node: _Node) -> None:
+        siblings = (node.parent.children if node.parent is not None
+                    else self._children)
+        if siblings.get(node.chunk) is node:
+            del siblings[node.chunk]
+
+    def _drop_subtree(self, node: _Node) -> None:
+        """Remove ``node`` and everything under it (unpin device pages,
+        drop host entries) — used when a host entry ages out of the
+        bounded store, making the path unmatchable.  Dropped nodes are
+        TOMBSTONED (``page == -2``): an admission holding a stale
+        ``match_nodes`` snapshot must not adopt a page that just went
+        back to the free list (an eviction triggered by the admission's
+        OWN promotion pressure can land here mid-walk)."""
+        self._detach(node)
+        stack = [node]
         while stack:
-            node = stack.pop()
-            if node.children:
-                stack.extend(node.children.values())
-            elif self.pool.ref(node.page) == 0 and (
-                    victim is None or node.tick < victim.tick):
+            cur = stack.pop()
+            stack.extend(cur.children.values())
+            cur.children = {}
+            if cur.page >= 0:
+                self._lru_remove(cur)
+                self.pool.unpin(cur.page)
+            self._drop_host_entry(cur)
+            cur.page = -2
+            self._nodes -= 1
+        self._m_pages.set(self.pool.pages_cached)
+
+    def invalidate_host_keys(self, keys: List[int]) -> None:
+        """The bounded host store evicted these entries (oldest-first
+        overflow): prune the trie paths that pointed at them."""
+        for key in keys:
+            node = self._host_nodes.pop(key, None)
+            if node is not None:
+                node.host_key = None      # store already dropped it
+                self._drop_subtree(node)
+
+    # ------------------------------------------------------------------
+    def evict_lru(self) -> int:
+        """Reclaim ONE device page from the cache under pool pressure:
+        the least-recently-used pinned page no live slot references
+        (refcount 0), found by walking the intrusive LRU list from its
+        head (live-referenced entries are skipped in place).  Without a
+        host tier the victim must also be a LEAF and its node is removed
+        (the PR 9 drop semantics); with one, the payload demotes to the
+        host store and the node stays matchable.  Returns pages freed
+        (0 = nothing evictable — the caller falls back to preemption)."""
+        demote = self.host_store is not None
+        node = self._lru.lru_next
+        victim: Optional[_Node] = None
+        while node is not self._lru:
+            if self.pool.ref(node.page) == 0 and (demote
+                                                  or not node.children):
                 victim = node
+                break
+            node = node.lru_next
         if victim is None:
             return 0
-        siblings = (victim.parent.children if victim.parent is not None
-                    else self._children)
-        del siblings[victim.chunk]
-        self._nodes -= 1
-        self.pool.unpin(victim.page)
+        page = victim.page
+        if demote:
+            payload = self._fetch_page(page)
+            key, overflow = self.host_store.put(payload)
+            self._host_nodes[key] = victim
+            victim.host_key = key
+            victim.page = -1
+            self._lru_remove(victim)
+            self.pool.unpin(page)
+            # the bounded store may have pushed out older host entries;
+            # their paths are no longer matchable
+            self.invalidate_host_keys(overflow)
+        else:
+            self._detach(victim)
+            self._lru_remove(victim)
+            self._nodes -= 1
+            self.pool.unpin(page)
         self._m_evictions.inc()
         self._m_pages.set(self.pool.pages_cached)
         return 1
 
+    def promote(self, node: _Node, page: int) -> None:
+        """Re-home a host-resident node onto ``page`` (the engine just
+        streamed the payload into it): pin it, drop the host entry, and
+        rejoin the device LRU.  The engine counts the promote on the
+        store's ``ds_serve_kv_promote_total``."""
+        assert node.page < 0, "promote of a device-resident node"
+        self._drop_host_entry(node)
+        node.page = int(page)
+        self.pool.pin(node.page)
+        self._lru_append(node)
+        self._m_pages.set(self.pool.pages_cached)
+
     def clear(self) -> int:
-        """Drop every cached page (tests / explicit cache reset); returns
-        pages unpinned."""
+        """Drop every cached page, both tiers (tests / explicit cache
+        reset); returns device pages unpinned."""
         n = 0
         stack = list(self._children.values())
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            self.pool.unpin(node.page)
-            n += 1
+            if node.page >= 0:
+                self._lru_remove(node)
+                self.pool.unpin(node.page)
+                n += 1
+            self._drop_host_entry(node)
         self._children = {}
         self._nodes = 0
+        self._host_nodes = {}
+        if self.host_store is not None:
+            self.host_store.clear()
         self._m_pages.set(self.pool.pages_cached)
         return n
+
+    # ------------------------------------------------------------------
+    def check_no_leak(self) -> None:
+        """Invariant probe over the {device, host} node partition (tests;
+        the pool-side probe is ``PagedKVPool.check_no_leak``): every
+        device node's page is pinned in the pool and linked into the LRU
+        list exactly once; every host node's key is live in the store;
+        store entries and host nodes are in bijection; node count adds
+        up."""
+        dev_pages, host_keys, total = [], [], 0
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            total += 1
+            if node.page >= 0:
+                assert node.host_key is None, "node resident in both tiers"
+                dev_pages.append(node.page)
+            else:
+                assert self.host_store is not None and node.host_key is not None
+                host_keys.append(node.host_key)
+                assert self._host_nodes.get(node.host_key) is node
+        assert total == self._nodes, (total, self._nodes)
+        assert sorted(host_keys) == sorted(self._host_nodes), \
+            "host-node map out of sync with the trie"
+        if self.host_store is not None:
+            assert sorted(host_keys) == sorted(self.host_store.keys()), (
+                f"store/trie mismatch: {sorted(host_keys)} vs "
+                f"{sorted(self.host_store.keys())}")
+        assert len(set(dev_pages)) == len(dev_pages), "page cached twice"
+        assert set(dev_pages) == set(self.pool._cached), (
+            f"pins out of sync: trie={sorted(dev_pages)} "
+            f"pool={sorted(self.pool._cached)}")
+        linked = []
+        node = self._lru.lru_next
+        while node is not self._lru:
+            linked.append(node.page)
+            node = node.lru_next
+        assert sorted(linked) == sorted(dev_pages), (
+            f"LRU list out of sync: {sorted(linked)} vs {sorted(dev_pages)}")

@@ -76,3 +76,53 @@ def test_prefix_serving_bench_scenario(capsys):
               f"hit ratio {out['prefix_hit_ratio']}), goodput "
               f"{out['prefix_goodput_speedup']}x, outputs identical: "
               f"{out['outputs_token_identical']}")
+
+
+def test_host_tier_bench_scenario(capsys):
+    """KV-host-tier thrash scenario (bench_host_tier_serving): at a pool
+    that always evicts cached history, tier-on shows a strictly higher
+    prefix hit ratio with token-identical outputs (ISSUE 11 acceptance
+    pair at tiny/CPU scale)."""
+    from bench import bench_host_tier_serving
+
+    out = bench_host_tier_serving(num_requests=14, num_slots=2, qps=200.0,
+                                  tiny=True)
+    assert out["outputs_token_identical"] is True
+    assert out["hit_ratio_on"] > out["hit_ratio_off"], out
+    assert out["demotes"] > 0 and out["promotes"] > 0
+    assert out["tier_off"]["demotes"] == 0
+    # fewer prefill tokens actually computed with the tier on
+    assert (out["tier_on"]["prefill_tokens_computed"]
+            < out["tier_off"]["prefill_tokens_computed"])
+    with capsys.disabled():
+        print(f"\nkv-host-tier bench (tiny/CPU): hit ratio "
+              f"{out['hit_ratio_on']} (tier on) vs {out['hit_ratio_off']} "
+              f"(off), {out['demotes']} demotes / {out['promotes']} "
+              f"promotes, outputs identical: "
+              f"{out['outputs_token_identical']}")
+
+
+def test_streamed_rung_scenario(capsys):
+    """Streamed-offload relay ablation (bench_streamed_rung) at tiny/CPU
+    scale: int8 relay ships measurably fewer H2D bytes, prefetch hits
+    register, and the loss stays within the parity bound of the plain
+    (non-offloaded) engine."""
+    from bench import bench_streamed_rung
+
+    out = bench_streamed_rung(steps=2, warmup=1, tiny=True)
+    assert out["status"] == "ok", out
+    assert out["relay_bytes_ratio"] > 1.3, out["relay_bytes_ratio"]
+    assert out["loss_parity"] is True
+    for side in ("bf16", "int8"):
+        assert out[side]["tokens_per_sec"] > 0
+        assert out[side]["prefetch_hits"] > 0
+        assert out[side]["h2d_bytes_per_step"] > 0
+        assert out[side]["d2h_bytes_per_step"] > 0
+    assert (out["int8"]["h2d_bytes_per_step"]
+            < out["bf16"]["h2d_bytes_per_step"])
+    with capsys.disabled():
+        print(f"\nstreamed-offload bench (tiny/CPU): relay bytes ratio "
+              f"{out['relay_bytes_ratio']}x (bf16 {out['bf16']['relay_MBps']}"
+              f" MB/s vs int8 {out['int8']['relay_MBps']} MB/s), speedup "
+              f"{out['streamed_speedup']}x (relay-bound only on TPU), "
+              f"loss parity: {out['loss_parity']}")

@@ -1,0 +1,358 @@
+"""KV host tier (serving/host_tier.py + the prefix cache's demote/promote
+paths) and the intrusive-LRU eviction rewrite.
+
+Contracts pinned here:
+- ``HostPageStore`` bound + LRU eviction returns the overflowed keys;
+- demote preserves the trie (interior nodes included) and promote
+  re-homes byte-identically — greedy serving outputs are token-identical
+  with the tier on/off at a pool size that previously evicted-to-drop,
+  while the prefix hit ratio is STRICTLY higher with the tier on;
+- store overflow invalidates exactly the trie paths that pointed at the
+  dropped entries;
+- the leak probe covers the {device, host} page partition after every
+  scenario (pool partition exact AND trie/store/LRU-list bijections).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.models import causal_lm
+from deepspeed_tpu.monitor.metrics import get_registry
+from deepspeed_tpu.serving import PagedKVPool, PrefixCache
+from deepspeed_tpu.serving.host_tier import HostPageStore
+
+
+@pytest.fixture(autouse=True)
+def _no_unknown_finish_reasons():
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    yield
+    c = get_registry().get("ds_serve_finished_total",
+                           labels={"reason": "unknown"})
+    assert c is None or c.value == 0
+
+
+# ---------------------------------------------------------------------------
+# HostPageStore units (no jax)
+# ---------------------------------------------------------------------------
+
+def test_host_store_bound_and_lru_overflow():
+    store = HostPageStore(2)
+    k1, ev = store.put({"k": np.ones(3)})
+    assert ev == [] and len(store) == 1
+    k2, ev = store.put({"k": np.full(3, 2.0)})
+    assert ev == []
+    assert store.touch(k1)                 # k1 now MRU -> k2 is LRU
+    k3, ev = store.put({"k": np.full(3, 3.0)})
+    assert ev == [k2] and len(store) == 2
+    assert store.get(k2) is None and not store.touch(k2)
+    assert (store.get(k1)["k"] == 1).all()
+    store.drop(k3)
+    assert len(store) == 1 and store.keys() == [k1]
+    with pytest.raises(ValueError):
+        HostPageStore(0)
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache tier bookkeeping over a synthetic pool (no engine)
+# ---------------------------------------------------------------------------
+
+def _pages_payload(pages):
+    """Synthetic per-page payloads keyed by page id so promote targets
+    can be verified byte-for-byte."""
+    return {p: {"k": np.full((2, 4), float(p))} for p in pages}
+
+
+def _tiered_cache(pool, max_host=8):
+    payloads = {}
+
+    def fetch(page):
+        return {"k": np.full((2, 4), float(page))}
+
+    store = HostPageStore(max_host)
+    cache = PrefixCache(pool, host_store=store, fetch_page=fetch)
+    return cache, store, payloads
+
+
+def test_demote_keeps_trie_matchable_and_promote_rehomes():
+    pool = PagedKVPool(2, 64, page_tokens=4)
+    cache, store, _ = _tiered_cache(pool)
+    prompt = np.arange(1, 13, dtype=np.int32)          # 3 full pages
+    assert pool.ensure(0, 12)
+    pages = pool.owned(0)
+    cache.insert(prompt, pages)
+    pool.release(0)
+    cache.check_no_leak()
+    # demote ALL three (interior nodes included — structure preserved)
+    freed_before = pool.pages_free
+    for _ in range(3):
+        assert cache.evict_lru() == 1
+        cache.check_no_leak()
+        pool.check_no_leak()
+    assert pool.pages_free == freed_before + 3
+    assert pool.pages_cached == 0 and len(store) == 3
+    assert len(cache) == 3                              # trie intact
+    # device-only legacy match sees nothing; node match sees all three
+    assert cache.match(prompt) == []
+    nodes = cache.match_nodes(prompt)
+    assert len(nodes) == 3 and all(n.page < 0 for n in nodes)
+    # promote the first chunk onto a fresh page
+    dst = pool.alloc_page()
+    payload = cache.host_payload(nodes[0])
+    assert (payload["k"] == pages[0]).all()             # demoted bytes
+    cache.promote(nodes[0], dst)
+    cache.check_no_leak()
+    assert nodes[0].page == dst and nodes[0].host_key is None
+    assert len(store) == 2 and pool.pages_cached == 1
+    assert cache.match(prompt) == [dst]                 # device again
+    pool.check_no_leak()
+
+
+def test_store_overflow_invalidates_trie_paths():
+    pool = PagedKVPool(2, 64, page_tokens=4)
+    cache, store, _ = _tiered_cache(pool, max_host=2)
+    a = np.arange(1, 9, dtype=np.int32)                 # 2 pages
+    b = np.arange(101, 109, dtype=np.int32)             # 2 pages
+    for prompt in (a, b):
+        assert pool.ensure(0, 8)
+        cache.insert(prompt, pool.owned(0))
+        pool.release(0)
+    # demote a's two pages (LRU first), filling the 2-entry store
+    cache.match_nodes(b)                                # b = MRU
+    assert cache.evict_lru() == 1 and cache.evict_lru() == 1
+    assert len(store) == 2 and len(cache) == 4
+    # demoting b's pages overflows the store: a's entries drop and their
+    # trie path is pruned
+    assert cache.evict_lru() == 1
+    cache.check_no_leak()
+    pool.check_no_leak()
+    assert len(cache) < 4
+    assert cache.match_nodes(a) == [] or all(
+        n.host_key is not None and store.touch(n.host_key)
+        for n in cache.match_nodes(a))
+    # everything still consistent after clearing
+    cache.clear()
+    assert len(store) == 0 and pool.pages_cached == 0
+    pool.check_no_leak()
+    cache.check_no_leak()
+
+
+def test_intrusive_lru_eviction_order_drop_mode():
+    """Tier off: the intrusive list must reproduce the PR 9 semantics —
+    LRU leaf-first, live-referenced pages skipped in place."""
+    pool = PagedKVPool(2, 64, page_tokens=4)
+    cache = PrefixCache(pool)
+    old = np.arange(100, 108, dtype=np.int32)
+    new = np.arange(200, 208, dtype=np.int32)
+    for prompt in (old, new):
+        assert pool.ensure(0, 8)
+        cache.insert(prompt, pool.owned(0))
+        pool.release(0)
+    new_pages = cache.match(new)
+    _ = cache.match(old)
+    _ = cache.match(new)                                # new = freshest
+    assert cache.evict_lru() == 1                       # old's LEAF only
+    assert len(cache.match(old)) == 1
+    pool.adopt(1, new_pages)                            # protect 'new'
+    evicted = 0
+    while cache.evict_lru():
+        evicted += 1
+        pool.check_no_leak()
+        cache.check_no_leak()
+    assert evicted == 1                                 # old's root
+    assert cache.match(new) == new_pages
+    assert cache.match(old) == []
+    pool.release(1)
+    pool.check_no_leak()
+
+
+def test_insert_upgrades_host_resident_chunk():
+    """A request that re-computes a demoted chunk re-homes the node onto
+    its freshly-computed device page (the host entry drops)."""
+    pool = PagedKVPool(2, 64, page_tokens=4)
+    cache, store, _ = _tiered_cache(pool)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    assert pool.ensure(0, 8)
+    first = pool.owned(0)
+    cache.insert(prompt, first)
+    pool.release(0)
+    assert cache.evict_lru() == 1 and cache.evict_lru() == 1
+    assert len(store) == 2
+    # a new computation of the same prompt inserts device pages
+    assert pool.ensure(1, 8)
+    second = pool.owned(1)
+    added = cache.insert(prompt, second)
+    assert added == 2 and len(store) == 0
+    assert cache.match(prompt) == second
+    pool.release(1)
+    cache.check_no_leak()
+    pool.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end serving parity at a thrash-sized pool
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def setup(devices):
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))
+    ref = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 64})
+    ref.set_params(params)
+    return model, params, ref
+
+
+def _serve(model, params, **over):
+    cfg = {"dtype": "float32", "max_out_tokens": 64, "kv_page_tokens": 16,
+           **over}
+    s = deepspeed_tpu.init_serving(model, config=cfg, num_slots=2,
+                                   prefill_chunk=8, decode_block_tokens=3)
+    s.set_params(params)
+    return s
+
+
+def _ref_out(ref, prompt, n):
+    return np.asarray(ref.generate(np.asarray(prompt)[None],
+                                   max_new_tokens=n,
+                                   do_sample=False))[0, len(prompt):]
+
+
+def test_host_tier_demote_promote_serving_parity(setup, rng):
+    """THE acceptance scenario: a pool sized so cached history always
+    evicts (previously: dropped), three distinct 2-page shared prefixes
+    revisited across waves.  With the host tier on, outputs stay
+    token-identical to generate() AND to the tier-off run, the hit ratio
+    is STRICTLY higher, demote/promote counters move, and both leak
+    probes hold after every wave."""
+    model, params, ref = setup
+    reg = get_registry()
+    reg.enable()
+    keys = jax.random.split(rng, 9)
+    prefixes = [np.asarray(jax.random.randint(k, (32,), 0, 256))
+                for k in keys[:3]]
+    prompts = [np.concatenate(
+        [prefixes[i % 3],
+         np.asarray(jax.random.randint(k, (5 + i,), 0, 256))])
+        for i, k in enumerate(keys[3:])]
+    news = [6] * len(prompts)
+    want = [_ref_out(ref, p, n) for p, n in zip(prompts, news)]
+    res = {}
+    try:
+        for tier in (0, 16):
+            reg.reset()
+            # 96 pool tokens = 6 usable pages; 3 shared prefixes of 2
+            # pages each + 2 live slots -> cached history always evicts
+            serve = _serve(model, params, kv_pool_tokens=96,
+                           kv_host_tier_pages=tier)
+            assert (serve.host_store is not None) == bool(tier)
+            outs = []
+            for wave in range(2):
+                for p, n in zip(prompts, news):
+                    r = serve.submit(p, max_new_tokens=n)
+                    serve.run()
+                    outs.append(list(r.output_tokens))
+                serve.scheduler.drain_finished()
+                serve.pool.check_no_leak()
+                serve.prefix_cache.check_no_leak()
+            snap = reg.snapshot()
+            hit = snap.get("ds_serve_prefix_hit_tokens_total", 0)
+            miss = snap.get("ds_serve_prefix_miss_tokens_total", 0)
+            res[tier] = {"outs": outs, "ratio": hit / max(hit + miss, 1),
+                         "demote": snap.get("ds_serve_kv_demote_total", 0),
+                         "promote": snap.get("ds_serve_kv_promote_total", 0)}
+            serve.close()
+    finally:
+        reg.reset()
+        reg.disable()
+    expect = [list(w) for w in want] * 2
+    for tier in (0, 16):
+        assert res[tier]["outs"] == expect, \
+            f"tier={tier} outputs diverged from generate()"
+    assert res[16]["ratio"] > res[0]["ratio"], res
+    assert res[16]["demote"] > 0 and res[16]["promote"] > 0
+    assert res[0]["demote"] == 0 and res[0]["promote"] == 0
+
+
+def test_host_tier_one_page_store_overflow_under_promotion(setup, rng):
+    """Adversarial sizing (review finding): a ONE-page host store means
+    any demote triggered by a promotion's own pool pressure pushes the
+    promoting node's entry out of the store mid-admission.  The
+    promotion must abort cleanly (no orphan pins, no adoption of
+    freed pages — tombstoned nodes are skipped) and outputs stay
+    token-identical through the chaos."""
+    model, params, ref = setup
+    keys = jax.random.split(rng, 8)
+    prefixes = [np.asarray(jax.random.randint(k, (32,), 0, 256))
+                for k in keys[:3]]
+    prompts = [np.concatenate(
+        [prefixes[i % 3],
+         np.asarray(jax.random.randint(k, (4 + i,), 0, 256))])
+        for i, k in enumerate(keys[3:])]
+    want = [_ref_out(ref, p, 6) for p in prompts]
+    serve = _serve(model, params, kv_pool_tokens=96,    # 6 usable pages
+                   kv_host_tier_pages=1)
+    try:
+        for wave in range(3):
+            for p, w in zip(prompts, want):
+                r = serve.submit(p, max_new_tokens=6)
+                serve.run()
+                np.testing.assert_array_equal(
+                    np.asarray(r.output_tokens), w,
+                    err_msg=f"wave {wave} diverged under 1-page store "
+                            f"overflow pressure")
+                serve.pool.check_no_leak()
+                serve.prefix_cache.check_no_leak()
+            serve.scheduler.drain_finished()
+        assert len(serve.host_store) <= 1
+    finally:
+        serve.close()
+
+
+def test_host_tier_off_by_default(setup):
+    model, params, _ = setup
+    serve = _serve(model, params)
+    try:
+        assert serve.host_store is None
+        assert serve.prefix_cache.host_store is None
+    finally:
+        serve.close()
+
+
+def test_host_tier_preempt_resume_through_host(setup, rng):
+    """A preempted request whose just-cached prompt pages were demoted
+    under the very pressure that preempted it re-adopts them through the
+    host tier on resume — token-identical continuation."""
+    model, params, ref = setup
+    serve = _serve(model, params, kv_pool_tokens=80,   # 5 usable pages
+                   kv_host_tier_pages=16)
+    try:
+        k1, k2 = jax.random.split(rng)
+        prompts = [np.asarray(jax.random.randint(k1, (18,), 0, 256)),
+                   np.asarray(jax.random.randint(k2, (19,), 0, 256))]
+        want = [_ref_out(ref, p, 30) for p in prompts]
+        reqs = [serve.submit(p, max_new_tokens=30) for p in prompts]
+        serve.run()
+        assert sum(r.preemptions for r in reqs) >= 1
+        for i, (req, w) in enumerate(zip(reqs, want)):
+            np.testing.assert_array_equal(
+                np.asarray(req.output_tokens), w,
+                err_msg=f"request {i} diverged across preempt-resume "
+                        f"through the host tier")
+        victims = [r for r in reqs if r.preemptions]
+        assert all(v.prefix_hit_tokens >= 16 for v in victims)
+        serve.scheduler.drain_finished()
+        serve.pool.check_no_leak()
+        serve.prefix_cache.check_no_leak()
+    finally:
+        serve.close()

@@ -326,6 +326,94 @@ def test_bench_summary_last_line_roundtrips_json():
     assert "serving_metrics" not in parsed and "serving_prefix" not in parsed
 
 
+def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
+    """ISSUE 11 blocks ride BENCH_JSON (streamed_offload relay +
+    serving_host_tier acceptance pair), and per-capture device_profile
+    payloads are STRIPPED from the capped final line (they stay in the
+    record line)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    record = {"metric": "m", "value": 1.5, "unit": "tok/s",
+              "vs_baseline": 0.5,
+              "detail": {
+                  "mfu": 0.4, "backend": "cpu",
+                  "metrics": {"tflops": 1.0,
+                              "device_profile": {"huge": "x" * 500}},
+                  "streamed_offload": {
+                      "status": "ok", "streamed_speedup": 1.6,
+                      "relay_bytes_ratio": 1.9, "loss_parity": True,
+                      "gap_share": 0.31,
+                      "bf16": {"relay_MBps": 14.0,
+                               "device_profile": {"huge": "y" * 500}},
+                      "int8": {"relay_MBps": 27.0}},
+                  "host_tier_serving": {
+                      "hit_ratio_on": 0.61, "hit_ratio_off": 0.42,
+                      "outputs_token_identical": True, "demotes": 6,
+                      "promotes": 5, "goodput_speedup": 1.1}}}
+    lines = bench.summary_lines(record, None)
+    parsed = json.loads(lines[-1])
+    st = parsed["streamed_offload"]
+    assert st["streamed_speedup"] == 1.6
+    assert st["relay_bytes_ratio"] == 1.9 and st["loss_parity"] is True
+    assert st["gap_share"] == 0.31
+    assert st["relay_MBps"] == {"bf16": 14.0, "int8": 27.0}
+    ht = parsed["serving_host_tier"]
+    assert ht["hit_ratio_on"] == 0.61 and ht["hit_ratio_off"] == 0.42
+    assert ht["outputs_token_identical"] is True
+    assert ht["demotes"] == 6 and ht["promotes"] == 5
+    # bulky capture payloads never reach the final line
+    assert "device_profile" not in json.dumps(parsed)
+    assert lines[-2] == "BENCH_JSON: " + lines[-1]
+
+
+def test_bench_summary_line_capped():
+    """An oversized summary drops optional blocks (recorded under
+    ``truncated``) instead of emitting a line the runner would truncate
+    into non-JSON — the BENCH_r05 ``"parsed": null`` regression class."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    record = {"metric": "m", "value": 1.5, "unit": "tok/s",
+              "vs_baseline": 0.5,
+              "detail": {"mfu": 0.4, "backend": "cpu",
+                         "metrics": {"filler": "x" * 4000}}}
+    line = bench.summary_lines(record, None)[-1]
+    assert len(line) <= bench.BENCH_SUMMARY_MAX_CHARS
+    parsed = json.loads(line)
+    assert parsed["truncated"] == ["train_metrics"]
+    assert parsed["metric"] == "m"       # headline survives the cap
+
+
+def test_bench_emit_contract_subprocess():
+    """THE handshake pin: run bench.py in emit-only mode as a REAL
+    subprocess and assert the literal last stdout line is the parseable
+    bare summary (flushed, nothing after it), with the prefixed twin
+    directly above."""
+    import subprocess
+
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        "..", ".."))
+    env = dict(os.environ, DSTPU_BENCH_EMIT_ONLY="1", JAX_PLATFORMS="cpu",
+               DS_ACCELERATOR="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py")], env=env,
+        capture_output=True, text=True, timeout=300, cwd=root)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert proc.stdout.endswith("\n")
+    lines = proc.stdout.rstrip("\n").split("\n")
+    last = lines[-1]
+    parsed = json.loads(last)            # the runner's exact read
+    assert parsed["metric"] == "emit_selftest"
+    assert len(last) <= 1800
+    assert lines[-2] == "BENCH_JSON: " + last
+    json.loads(lines[-3])                # the full record line parses too
+
+
 def test_metrics_dump_serving_prefix_hit_ratio_line():
     """--serving renders the prefix-cache hit-ratio line from the
     ds_serve_prefix_* series (and omits it when the cache never ran)."""
@@ -348,7 +436,35 @@ def test_metrics_dump_serving_prefix_hit_ratio_line():
     # cache never ran (off or fixed-slot): no prefix line at all
     cold = metrics_dump.serving_kv_summary(
         {"ds_serve_kv_pages_used": 1, "ds_serve_kv_pages_free": 7})
-    assert "prefix cache" not in cold
+    assert "prefix cache" not in cold and "host tier" not in cold
+    # host tier ran: one line with resident/demoted/promoted counts
+    tier = metrics_dump.serving_kv_summary(
+        {**m, "ds_serve_kv_host_pages": 3, "ds_serve_kv_demote_total": 9,
+         "ds_serve_kv_promote_total": 6})
+    assert "kv host tier: 3 pages resident, 9 demoted, 6 promoted" in tier
+
+
+def test_metrics_dump_offload_relay_line():
+    """--comms renders the offload relay one-liner from ds_offload_*
+    (and nothing when the offload path never ran)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    try:
+        import metrics_dump
+    finally:
+        sys.path.pop(0)
+    m = {"ds_offload_relay_bytes_total": {'{dir="h2d"}': 3 * 2**20,
+                                          '{dir="d2h"}': 2**20},
+         "ds_offload_prefetch_hits_total": 30,
+         "ds_offload_prefetch_misses_total": 10,
+         "ds_offload_relay_seconds": {"count": 40, "sum": 0.25}}
+    line = metrics_dump.offload_relay_line(m)
+    assert "3.00 MiB h2d / 1.00 MiB d2h" in line
+    assert "prefetch 75% hit (30/40)" in line
+    assert "0.25s stalled" in line
+    assert metrics_dump.offload_relay_line({}) == ""
+    assert metrics_dump.offload_relay_line(
+        {"ds_offload_relay_bytes_total": {}}) == ""
 
 
 def test_metrics_dump_renders_snapshot_and_csv(tmp_path):

@@ -14,9 +14,13 @@ p50 / p99 / busbw from the ``ds_comm_*`` family — the training-side comm
 ledger, docs/OBSERVABILITY.md) with the device-truth columns
 (``ds_comm_<op>_device_seconds`` p50 + recomputed device busbw, when a
 ``/profilez``/watchdog capture populated them) alongside the analytic
-attribution for side-by-side error reading.  ``--serving`` prints the paged-KV pool
+attribution for side-by-side error reading, plus the offload-relay line
+(bytes by direction / prefetch hit rate / stall, from ``ds_offload_*``)
+when the offload path ran.  ``--serving`` prints the paged-KV pool
 summary (pages used/free, cache-utilization percentiles, preemptions from
-the ``ds_serve_kv_*`` / ``ds_serve_preempted_total`` series).  ``--requests``
+the ``ds_serve_kv_*`` / ``ds_serve_preempted_total`` series, the
+prefix-cache hit-ratio line, and the KV host-tier line — resident /
+demoted / promoted pages — when a host tier ran).  ``--requests``
 prints the slowest-exemplar table from the same host's ``/requestz``
 endpoint (or a saved ``/requestz`` snapshot file passed as the source):
 per request id, latency, the queue/prefill/decode/preempted-wait phase
@@ -157,6 +161,30 @@ def overlap_line(metrics: Dict[str, object]) -> str:
     return line + ")"
 
 
+def offload_relay_line(metrics: Dict[str, object]) -> str:
+    """One-line offload host<->device relay summary from the
+    ``ds_offload_*`` series (docs/OBSERVABILITY.md 'Training — offload
+    streaming relay'); empty string when the offload path never ran."""
+    fam = metrics.get("ds_offload_relay_bytes_total") or {}
+    if not isinstance(fam, dict) or not fam:
+        return ""
+    h2d = float(fam.get('{dir="h2d"}', 0) or 0)
+    d2h = float(fam.get('{dir="d2h"}', 0) or 0)
+    if not (h2d or d2h):
+        return ""
+    hits = int(metrics.get("ds_offload_prefetch_hits_total", 0) or 0)
+    misses = int(metrics.get("ds_offload_prefetch_misses_total", 0) or 0)
+    stall = metrics.get("ds_offload_relay_seconds") or {}
+    line = (f"offload relay: {human_bytes(h2d)} h2d / "
+            f"{human_bytes(d2h)} d2h")
+    if hits or misses:
+        line += (f", prefetch {100 * hits / (hits + misses):.0f}% hit "
+                 f"({hits}/{hits + misses})")
+    if isinstance(stall, dict) and stall.get("count"):
+        line += f", {stall['sum']:.4g}s stalled"
+    return line
+
+
 def render_comms(rows: List[List[str]]) -> str:
     header = ["collective", "calls", "bytes", "p50_s", "p99_s", "busbw",
               "dev_p50_s", "dev_busbw"]
@@ -200,6 +228,12 @@ def serving_kv_summary(metrics: Dict[str, object]) -> str:
                      f"ratio ({int(hit)} hit / {int(miss)} computed "
                      f"prefill tokens), {cached} cached pages, "
                      f"{ev} evictions")
+    demote = int(metrics.get("ds_serve_kv_demote_total", 0) or 0)
+    promote = int(metrics.get("ds_serve_kv_promote_total", 0) or 0)
+    host = int(metrics.get("ds_serve_kv_host_pages", 0) or 0)
+    if demote or promote or host:
+        lines.append(f"kv host tier: {host} pages resident, "
+                     f"{demote} demoted, {promote} promoted")
     return "\n".join(lines)
 
 
@@ -334,6 +368,9 @@ def main(argv: List[str]) -> int:
         print(render_comms(rows) if rows
               else "(no ds_comm_* traffic recorded)")
         print(overlap_line(metrics))
+        relay = offload_relay_line(metrics)
+        if relay:
+            print(relay)
     if "--serving" in flags:
         print()
         print(serving_kv_summary(metrics))
