@@ -1,0 +1,17 @@
+# Single-command entries the builder's verify recipe runs before the
+# suite (see ROADMAP.md for the canonical tier-1 line).
+
+.PHONY: lint lint-json tier1
+
+# dslint: AST-level invariant checker (docs/LINT.md) — no jax needed
+lint:
+	python tools/dslint.py deepspeed_tpu tools bench.py
+
+lint-json:
+	python tools/dslint.py --json deepspeed_tpu tools bench.py
+
+# lint first (seconds), then the tier-1 suite (minutes)
+tier1: lint
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly

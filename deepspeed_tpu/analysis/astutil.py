@@ -1,0 +1,87 @@
+"""Shared AST helpers for the dslint rules (stdlib only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+SCOPE_NODES = FUNC_NODES + (ast.Lambda,)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def tail_name(node: ast.AST) -> Optional[str]:
+    """Last attribute segment of a callee (``device_put`` for any
+    ``*.device_put``), or the bare Name."""
+    d = dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Literal int / tuple-of-ints (``donate_argnums`` values)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                vals.append(el.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def walk_no_nested(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does NOT descend into nested function/lambda
+    bodies — their code runs in a different regime (usually inside jit,
+    where host-sync heuristics don't apply)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, SCOPE_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def contains(node: ast.AST, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+def functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    """Every function/method def in the module, at any nesting."""
+    for node in ast.walk(tree):
+        if isinstance(node, FUNC_NODES):
+            yield node
+
+
+def terminates(stmts: Sequence[ast.stmt]) -> bool:
+    """Whether a block always leaves the enclosing suite (return / raise /
+    continue / break as its last statement)."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))

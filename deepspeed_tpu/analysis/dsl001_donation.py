@@ -1,0 +1,241 @@
+"""DSL001 — donation safety.
+
+Originating incidents: PR 2 (make_array_from_callback shim), PR 4
+(test_offload NaN'd with a warm /tmp/dstpu_xla_cache), PR 10 (offload
+relay).  On the CPU runtime ``jax.device_put`` zero-copies aligned host
+numpy arrays, so the returned Array ALIASES the caller's buffer — and
+donating that alias into a persistent-cache-DESERIALIZED executable
+corrupts it.  Every device_put whose result can reach a
+``donate_argnums`` callee must route through an owned-copy seam
+(``_owned_device_put`` / a compiled producer whose output is
+runtime-owned).
+
+Static approximation (per function scope):
+
+- *donated callables*: names/attributes assigned from ``jax.jit(...,
+  donate_argnums=...)`` and functions decorated with
+  ``functools.partial(jax.jit, donate_argnums=...)`` — the donated
+  argument positions are recorded;
+- *tainted values*: results of raw ``device_put`` /
+  ``make_array_from_callback`` calls (owned seams exempt), propagated
+  through simple assignment and ``list.append``;
+- *sinks*: a tainted value (or inline raw put) passed at a donated
+  position, or — in any file that compiles donated callables — fed into a
+  ``params=`` keyword of a ``_replace``/``TrainState`` call, because the
+  engine's train states are what the donated accum/apply fns consume next
+  dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import (FUNC_NODES, contains, dotted, functions, int_tuple,
+                      keyword, tail_name)
+from .engine import FileContext, Finding, Project, Rule, register_rule
+
+RAW_PUTS = {"device_put", "make_array_from_callback"}
+# seams whose OUTPUT is runtime-owned (compiled copy / compiled dequant):
+# a call whose dotted name mentions one of these is never a raw put, even
+# if a segment collides with RAW_PUTS (e.g. ``seams.device_put`` renamed)
+OWNED_SEAMS = {"_owned_device_put", "_owned_device_put_tree", "_owned_copy",
+               "_dequant_put"}
+STATE_SINK_CALLEES = {"_replace", "TrainState"}
+STATE_SINK_KEYWORDS = {"params", "opt_state", "grad_acc"}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and tail_name(node.func) in ("jit", "pjit"))
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    kw = keyword(call, "donate_argnums")
+    if kw is None:
+        return None
+    return int_tuple(kw)
+
+
+def _jit_with_donate(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """donate positions when ``node`` is ``jax.jit(..., donate_argnums=)``
+    or ``functools.partial(jax.jit, donate_argnums=)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_call(node):
+        return _donated_positions(node)
+    if tail_name(node.func) == "partial" and node.args \
+            and tail_name(node.args[0]) in ("jit", "pjit"):
+        return _donated_positions(node)
+    return None
+
+
+def _collect_donated(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """callee key (bare name or attribute name) -> donated positions."""
+    donated: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            pos = _jit_with_donate(node.value)
+            if pos:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    donated[t.id] = pos
+                elif isinstance(t, ast.Attribute):
+                    donated[t.attr] = pos
+        elif isinstance(node, FUNC_NODES):
+            for dec in node.decorator_list:
+                pos = _jit_with_donate(dec)
+                if pos:
+                    donated[node.name] = pos
+    return donated
+
+
+def _raw_put_call(node: ast.AST) -> Optional[ast.Call]:
+    """The node itself, when it is a raw (un-owned) put call."""
+    if isinstance(node, ast.Call) and tail_name(node.func) in RAW_PUTS:
+        name = dotted(node.func) or ""
+        if any(seam in name.split(".") for seam in OWNED_SEAMS):
+            return None
+        return node
+    return None
+
+
+def _expr_taints(node: ast.AST, tainted: Set[str]) -> bool:
+    """Whether evaluating ``node`` can yield a raw-put-aliased value."""
+    for sub in ast.walk(node):
+        if _raw_put_call(sub) is not None:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted \
+                and isinstance(sub.ctx, ast.Load):
+            return True
+    return False
+
+
+class DonationSafetyRule(Rule):
+    id = "DSL001"
+    title = "donation safety: raw device_put must not reach donated callees"
+    incident = ("PR 2/4/10 — donating a zero-copy numpy-aliased device_put "
+                "result into a cache-deserialized executable corrupts it "
+                "(offload train went NaN with a warm XLA cache)")
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> Iterable[Finding]:
+        donated = _collect_donated(ctx.tree)
+        findings: List[Finding] = []
+        has_donated = bool(donated) or contains(
+            ctx.tree, lambda n: isinstance(n, ast.keyword)
+            and n.arg == "donate_argnums")
+        for fn in list(functions(ctx.tree)) + [ctx.tree]:
+            body = fn.body if hasattr(fn, "body") else []
+            if fn is ctx.tree:
+                body = ctx.tree.body
+            findings.extend(self._check_scope(ctx, body, donated,
+                                              has_donated))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_scope(self, ctx: FileContext, body, donated,
+                     has_donated) -> List[Finding]:
+        tainted: Set[str] = set()
+        findings: List[Finding] = []
+
+        def visit_stmt(stmt: ast.stmt) -> None:
+            # taint bookkeeping first (flow order within the scope)
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                if _expr_taints(stmt.value, tainted):
+                    tainted.add(stmt.targets[0].id)
+                else:
+                    tainted.discard(stmt.targets[0].id)
+            elif isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                           ast.Call):
+                call = stmt.value
+                # list.append(tainted) taints the list
+                if tail_name(call.func) == "append" \
+                        and isinstance(call.func, ast.Attribute) \
+                        and isinstance(call.func.value, ast.Name) \
+                        and call.args \
+                        and _expr_taints(call.args[0], tainted):
+                    tainted.add(call.func.value.id)
+            # sink scan on every expression in the statement
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    self._check_call(ctx, node, donated, has_donated,
+                                     tainted, findings)
+            # recurse into compound statements (NOT nested defs: their
+            # scope is checked separately, without this scope's taints)
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt) \
+                        and not isinstance(child, FUNC_NODES):
+                    visit_stmt(child)
+
+        for stmt in body:
+            if not isinstance(stmt, FUNC_NODES):
+                visit_stmt(stmt)
+        return findings
+
+    def _check_call(self, ctx, call, donated, has_donated, tainted,
+                    findings) -> None:
+        key = tail_name(call.func)
+        pos = donated.get(key)
+        if pos:
+            for p in pos:
+                if p < len(call.args) and _expr_taints(call.args[p],
+                                                       tainted):
+                    findings.append(Finding(
+                        self.id, ctx.rel, call.lineno, call.col_offset,
+                        f"raw device_put result reaches donated arg {p} of "
+                        f"{key!r} — route through _owned_device_put (or a "
+                        f"compiled producer); donating a numpy-aliased "
+                        f"buffer into a cache-deserialized executable "
+                        f"corrupts it (PR 2/4/10)",
+                        end_line=call.end_lineno or call.lineno))
+        if has_donated and key in STATE_SINK_CALLEES:
+            for kw in call.keywords:
+                if kw.arg in STATE_SINK_KEYWORDS \
+                        and _expr_taints(kw.value, tainted):
+                    findings.append(Finding(
+                        self.id, ctx.rel, call.lineno, call.col_offset,
+                        f"raw device_put result stored into "
+                        f"{key}({kw.arg}=...) — this state is donated into "
+                        f"the compiled accum/apply path next dispatch; "
+                        f"route through _owned_device_put (PR 2/4/10)",
+                        end_line=call.end_lineno or call.lineno))
+
+
+register_rule(DonationSafetyRule())
+
+
+# --- selftest fixtures -----------------------------------------------------
+SELFTEST_BAD = '''\
+import functools
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def accum(state, batch):
+    return state + batch
+
+
+def step(state, host_grads, shardings):
+    g = jax.device_put(host_grads, shardings)      # numpy-aliased on CPU
+    return accum(g, 1.0)                           # donated arg 0  <- BAD
+'''
+
+SELFTEST_GOOD = '''\
+import functools
+import jax
+
+from engine_seams import _owned_device_put
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def accum(state, batch):
+    return state + batch
+
+
+def step(state, host_grads, shardings):
+    g = _owned_device_put(host_grads, shardings)   # runtime-owned copy
+    extra = jax.device_put(host_grads, shardings)  # non-donated position
+    return accum(g, extra)
+'''

@@ -1,0 +1,262 @@
+"""DSL004 — metric-namespace literals + the bench summary-block ledger.
+
+Originating incidents: PR 2 established the runtime namespace guard
+(every REGISTERED metric must be ``ds_``-prefixed and documented in
+docs/OBSERVABILITY.md) — but the runtime guard only sees a name when its
+registration branch executes; a metric born behind a rarely-taken branch
+escapes until production takes that branch.  This rule extracts every
+``Counter``/``Gauge``/``Histogram`` name LITERAL (and every f-string
+prefix) statically and applies the same two checks at parse time.
+
+Second half (PR 10's bench handshake): the runner parses — and truncates
+around ~2k chars — the LAST stdout line of bench.py, so
+``summary_lines`` caps the final line at ``BENCH_SUMMARY_MAX_CHARS`` by
+dropping optional blocks from an explicit victim list.  A NEW dict-valued
+summary block that is not in that list silently re-opens the BENCH_r05
+``"parsed": null`` bug the first time it pushes the line over budget.
+This rule cross-checks every ``summary["<key>"] = <dict-ish>`` in
+``summary_lines`` against the victim tuple of the cap loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .astutil import const_str, tail_name
+from .engine import FileContext, Finding, Project, Rule, register_rule
+
+FAMILY_METHODS = {"counter", "gauge", "histogram"}
+FAMILY_CLASSES = {"Counter", "Gauge", "Histogram"}
+DOCS_REL = "docs/OBSERVABILITY.md"
+PREFIX = "ds_"
+
+# files that mint names from caller input rather than literals (the
+# registry itself, and the dump/render tools)
+EXEMPT_SUFFIXES = ("deepspeed_tpu/monitor/metrics.py",)
+
+_WILD = "\x00"  # internal wildcard marker for f-string segments
+
+
+def _extract_name(call: ast.Call) -> Optional[Tuple[str, bool]]:
+    """(name_pattern, is_literal) for a family-creating call; the pattern
+    uses a wildcard marker for formatted f-string fields."""
+    func = call.func
+    is_family = False
+    if isinstance(func, ast.Attribute) and func.attr in FAMILY_METHODS:
+        is_family = True
+    elif isinstance(func, ast.Name) and func.id in FAMILY_CLASSES:
+        is_family = True
+    if not is_family or not call.args:
+        return None
+    arg = call.args[0]
+    s = const_str(arg)
+    if s is not None:
+        return s, True
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append(_WILD)
+        return "".join(parts), False
+    return None   # dynamic name: the runtime guard owns it
+
+
+def _docs_patterns(text: str) -> Set[str]:
+    """Normalized metric tokens from the docs: backtick tokens starting
+    with ds_, label blocks stripped, ``<op>``-style holes -> wildcard."""
+    out: Set[str] = set()
+    for tok in re.findall(r"`([^`]+)`", text):
+        tok = tok.strip()
+        if not tok.startswith(PREFIX):
+            continue
+        tok = re.sub(r"\{[^}]*\}", "", tok)          # label blocks
+        tok = re.sub(r"<[^>]*>", _WILD, tok)         # <op> holes
+        tok = tok.strip()
+        if tok:
+            out.add(tok)
+    return out
+
+
+def _pattern_matches(name: str, patterns: Set[str], raw_text: str) -> bool:
+    if _WILD not in name:
+        if name in patterns or name in raw_text:
+            return True
+        # a literal name may be documented as a <hole> pattern row
+        for p in patterns:
+            if _WILD in p and re.fullmatch(
+                    re.escape(p).replace(re.escape(_WILD), r"[A-Za-z0-9_]+"),
+                    name):
+                return True
+        return False
+    # f-string: compare skeletons (wildcards collapse)
+    skel = re.sub(_WILD + "+", _WILD, name)
+    for p in patterns:
+        if re.sub(_WILD + "+", _WILD, p) == skel:
+            return True
+    # fall back: the static prefix must at least appear in the docs
+    prefix = name.split(_WILD, 1)[0]
+    return bool(prefix) and prefix in raw_text
+
+
+class MetricNamespaceRule(Rule):
+    id = "DSL004"
+    title = "metric name literals: ds_ prefix + documented; bench summary ledger"
+    incident = ("PR 2's runtime namespace guard only fires when the "
+                "registration branch executes; PR 10's BENCH_r05 record "
+                "was lost to an uncapped final-line summary block")
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        if not ctx.rel.endswith(EXEMPT_SUFFIXES):
+            findings.extend(self._check_names(ctx, project))
+        if ctx.rel.endswith("bench.py"):
+            findings.extend(self._check_bench_summary(ctx))
+        return findings
+
+    @staticmethod
+    def _docs(project: Project):
+        """(docs text, normalized pattern set), cached per Project — the
+        docs depend only on the root, not on the file being checked."""
+        cached = getattr(project, "_dsl004_docs", None)
+        if cached is not None:
+            return cached
+        docs_text = ""
+        docs_path = os.path.join(project.root, DOCS_REL)
+        if os.path.isfile(docs_path):
+            with open(docs_path, encoding="utf-8") as fh:
+                docs_text = fh.read()
+        patterns = _docs_patterns(docs_text) if docs_text else set()
+        project._dsl004_docs = (docs_text, patterns)
+        return project._dsl004_docs
+
+    # -- metric name literals ------------------------------------------
+    def _check_names(self, ctx: FileContext,
+                     project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        docs_text, patterns = self._docs(project)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            got = _extract_name(node)
+            if got is None:
+                continue
+            name, literal = got
+            display = name.replace(_WILD, "{...}")
+            lead = name.split(_WILD, 1)[0]
+            if not lead.startswith(PREFIX):
+                findings.append(Finding(
+                    self.id, ctx.rel, node.lineno, node.col_offset,
+                    f"metric name {display!r} outside the ds_ namespace "
+                    f"(docs/OBSERVABILITY.md contract; the runtime guard "
+                    f"only sees executed branches)",
+                    end_line=node.end_lineno or node.lineno))
+                continue
+            if docs_text and not _pattern_matches(name, patterns,
+                                                  docs_text):
+                findings.append(Finding(
+                    self.id, ctx.rel, node.lineno, node.col_offset,
+                    f"metric name {display!r} not documented in "
+                    f"{DOCS_REL} — add its schema row",
+                    end_line=node.end_lineno or node.lineno))
+        return findings
+
+    # -- bench summary-block ledger ------------------------------------
+    def _check_bench_summary(self, ctx: FileContext) -> List[Finding]:
+        fn = None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name == "summary_lines":
+                fn = node
+                break
+        if fn is None:
+            return []
+        block_assigns: List[Tuple[str, ast.Assign]] = []
+        victims: Set[str] = set()
+        victim_node = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "summary":
+                    key = const_str(t.slice)
+                    # a "block" is a dict-valued entry: dict literal /
+                    # comprehension / a call to a known dict builder
+                    # (_strip_bulky).  Attribute calls (``ov.get(...)``)
+                    # and scalar builtins (``len(...)``) are cap-exempt.
+                    dictish = isinstance(node.value,
+                                         (ast.Dict, ast.DictComp)) or (
+                        isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Name)
+                        and node.value.func.id in ("dict", "_strip_bulky"))
+                    if key is not None and dictish:
+                        block_assigns.append((key, node))
+            elif isinstance(node, ast.For):
+                if isinstance(node.target, ast.Name) \
+                        and node.target.id == "victim" \
+                        and isinstance(node.iter, (ast.Tuple, ast.List)):
+                    victim_node = node
+                    for el in node.iter.elts:
+                        s = const_str(el)
+                        if s:
+                            victims.add(s)
+        findings: List[Finding] = []
+        if block_assigns and victim_node is None:
+            a = block_assigns[0][1]
+            return [Finding(
+                self.id, ctx.rel, a.lineno, a.col_offset,
+                "summary_lines writes summary blocks but has no "
+                "'for victim in (...)' cap loop — the final-line byte "
+                "budget (BENCH_SUMMARY_MAX_CHARS) is unenforced")]
+        for key, node in block_assigns:
+            if key not in victims:
+                findings.append(Finding(
+                    self.id, ctx.rel, node.lineno, node.col_offset,
+                    f"BENCH_JSON summary block {key!r} is not in the "
+                    f"final-line cap's victim list — an oversized line "
+                    f"truncates to non-JSON and the whole record is lost "
+                    f"(the BENCH_r05 'parsed: null' bug)",
+                    end_line=node.end_lineno or node.lineno))
+        return findings
+
+
+register_rule(MetricNamespaceRule())
+
+
+# --- selftest fixtures -----------------------------------------------------
+SELFTEST_BAD = '''\
+from deepspeed_tpu.monitor.metrics import get_registry
+
+reg = get_registry()
+bad = reg.counter("serve_requests_total", "missing ds_ prefix")  # <- BAD
+'''
+
+SELFTEST_GOOD = '''\
+from deepspeed_tpu.monitor.metrics import get_registry
+
+reg = get_registry()
+ok = reg.counter("ds_serve_requests_total", "documented name")
+dyn = reg.counter(name_variable)          # dynamic: runtime guard owns it
+'''
+
+SELFTEST_BAD_BENCH = '''\
+import json
+
+
+def summary_lines(record, rung_serving):
+    summary = {"metric": record["metric"]}
+    summary["big_new_block"] = {"a": 1, "b": 2}      # <- not a victim
+    line = json.dumps(summary)
+    for victim in ("train_metrics",):
+        if len(line) <= 1800:
+            break
+        summary.pop(victim, None)
+        line = json.dumps(summary)
+    return [line]
+'''

@@ -1,0 +1,164 @@
+"""DSL005 — unconditional ``ds_comm_<op>`` named_scope on collective wrappers.
+
+Originating incident: PR 3's compiled-program-stability contract — every
+collective wrapper emits its ``ds_comm_<op>`` ``jax.named_scope``
+UNCONDITIONALLY, so toggling telemetry never changes the compiled
+program (a scope behind an ``if registry.enabled`` would recompile every
+cached executable on toggle, and the device-trace matcher
+(profiling/device_trace.py) would lose its rows exactly when you turn
+profiling on).
+
+Scope of the rule: files under a ``comm/`` directory (the wrapper
+layers: ``deepspeed_tpu/comm/``, ``deepspeed_tpu/runtime/comm/``).  A
+function there that calls a ``lax`` collective must wrap it in a
+``with``-scope (``named_scope``/``scope``/``_scope``) whose literal
+starts with ``ds_comm_``, and neither the collective nor its scope may
+sit inside an ``if`` that tests a telemetry-enabled flag.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence
+
+from .astutil import FUNC_NODES, const_str, tail_name
+from .engine import FileContext, Finding, Project, Rule, register_rule
+
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+               "psum_scatter", "all_to_all", "ppermute"}
+SCOPE_FUNCS = {"named_scope", "scope", "_scope"}
+SCOPE_PREFIX = "ds_comm_"
+COMM_DIRS = ("deepspeed_tpu/comm/", "deepspeed_tpu/runtime/comm/")
+
+
+def _is_collective(node: ast.Call) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in COLLECTIVES:
+        return False
+    recv = tail_name(func.value)
+    return recv in ("lax", "jax.lax")
+
+
+def _scope_of(withitem: ast.withitem) -> Optional[str]:
+    ce = withitem.context_expr
+    if isinstance(ce, ast.Call) and tail_name(ce.func) in SCOPE_FUNCS \
+            and ce.args:
+        return const_str(ce.args[0])
+    return None
+
+
+def _enabled_test(node: ast.AST) -> bool:
+    return any(isinstance(s, ast.Attribute) and s.attr == "enabled"
+               for s in ast.walk(node))
+
+
+class UnconditionalScopeRule(Rule):
+    id = "DSL005"
+    title = "comm wrappers: ds_comm_<op> named_scope, outside telemetry ifs"
+    incident = ("PR 3 — toggling telemetry must never change the compiled "
+                "program; the device-trace matcher keys on the scope name")
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> Iterable[Finding]:
+        if not any(d in ctx.rel for d in COMM_DIRS):
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FUNC_NODES):
+                self._check_fn(ctx, node, findings)
+        return findings
+
+    def _check_fn(self, ctx: FileContext, fn, findings) -> None:
+
+        def walk(stmts: Sequence[ast.stmt], scopes: List[str],
+                 in_enabled_if: bool) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, FUNC_NODES):
+                    continue   # nested defs get their own visit
+                if isinstance(stmt, ast.With):
+                    names = [s for s in (_scope_of(i) for i in stmt.items)
+                             if s]
+                    ds = [s for s in names if s.startswith(SCOPE_PREFIX)]
+                    if ds and in_enabled_if:
+                        findings.append(Finding(
+                            self.id, ctx.rel, stmt.lineno, stmt.col_offset,
+                            f"named_scope {ds[0]!r} emitted inside a "
+                            f"telemetry-enabled conditional — the scope "
+                            f"must be unconditional (compiled-program "
+                            f"stability, PR 3)"))
+                    walk(stmt.body, scopes + ds, in_enabled_if)
+                    continue
+                if isinstance(stmt, ast.If):
+                    enab = _enabled_test(stmt.test)
+                    walk(stmt.body, scopes, in_enabled_if or enab)
+                    walk(stmt.orelse, scopes, in_enabled_if or enab)
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list) and sub \
+                            and isinstance(sub[0], ast.stmt):
+                        walk(sub, scopes, in_enabled_if)
+                if isinstance(stmt, ast.Try):
+                    for h in stmt.handlers:
+                        walk(h.body, scopes, in_enabled_if)
+                # expression scan for collectives (skip nested defs)
+                stack = [stmt]
+                while stack:
+                    n = stack.pop()
+                    if isinstance(n, FUNC_NODES + (ast.Lambda, ast.With,
+                                                   ast.If)) \
+                            and n is not stmt:
+                        continue
+                    if isinstance(n, ast.Call) and _is_collective(n):
+                        if not scopes:
+                            findings.append(Finding(
+                                self.id, ctx.rel, n.lineno, n.col_offset,
+                                f"lax.{n.func.attr} without an enclosing "
+                                f"'with {SCOPE_PREFIX}<op>' named_scope — "
+                                f"the device-trace matcher and xplane "
+                                f"rows key on the scope name (PR 3)",
+                                end_line=n.end_lineno or n.lineno))
+                        elif in_enabled_if:
+                            findings.append(Finding(
+                                self.id, ctx.rel, n.lineno, n.col_offset,
+                                f"lax.{n.func.attr} dispatched inside a "
+                                f"telemetry-enabled conditional — the "
+                                f"compiled program must not change when "
+                                f"telemetry toggles (PR 3)",
+                                end_line=n.end_lineno or n.lineno))
+                    stack.extend(ast.iter_child_nodes(n))
+
+        walk(fn.body, [], False)
+
+
+register_rule(UnconditionalScopeRule())
+
+
+# --- selftest fixtures -----------------------------------------------------
+SELFTEST_BAD = '''\
+from jax import lax
+
+from deepspeed_tpu.profiling.trace import scope as _scope
+
+
+def all_reduce(x, axis):
+    return lax.psum(x, axis)          # <- no ds_comm_ scope
+
+
+def all_gather(x, axis, registry):
+    if registry.enabled:
+        with _scope("ds_comm_all_gather"):    # <- conditional scope
+            return lax.all_gather(x, axis, axis=0, tiled=True)
+    return lax.all_gather(x, axis, axis=0, tiled=True)
+'''
+
+SELFTEST_GOOD = '''\
+from jax import lax
+
+from deepspeed_tpu.profiling.trace import scope as _scope
+
+
+def all_reduce(x, axis):
+    with _scope("ds_comm_all_reduce"):
+        return lax.psum(x, axis)
+'''

@@ -1,0 +1,305 @@
+"""DSL006 — flight/trace shared-structure mutation discipline.
+
+Originating incident: PR 7's scrape-race class — the metrics HTTP thread
+iterating the request tracer's ring/heap while the engine thread mutated
+them, and the perfetto clock anchor being patched field-by-field under a
+reader.  The repaired contract, per structure kind:
+
+- ``swap``   — the published object is immutable; writers REBIND the
+  whole attribute (``self.f = new``), never mutate in place.  The clock
+  anchor and any snapshot-published dict use this.
+- ``atomic`` — single-writer structures read by snapshot-copy
+  (``list(self._ring)``): each mutation must be ONE GIL-atomic operation
+  (method call like ``append``/``heappush``, whole rebind, or a
+  single-level slot store ``self.f[i] = rec``).  Mutating a PUBLISHED
+  element in place (``self.f[i]["k"] = v``, ``self.f[i].x = v``,
+  augmented assigns) races every reader that copied the container.
+- ``lock:<attr>`` — every write happens inside ``with self.<attr>:``.
+
+Structures opt in via annotations the analyzer evaluates literally:
+
+    class RequestTracer:
+        _dslint_shared = {"_ring": "atomic", "_slowest": "atomic"}
+
+    _DSLINT_SHARED_GLOBALS = {"_ANCHOR": "swap"}        # module level
+
+The attribute-write-site analysis then audits every method of the class
+(and every module function for globals).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .astutil import FUNC_NODES, tail_name, walk_no_nested
+from .engine import FileContext, Finding, Project, Rule, register_rule
+
+CLASS_TAG = "_dslint_shared"
+GLOBAL_TAG = "_DSLINT_SHARED_GLOBALS"
+HEAPQ_MUTATORS = {"heappush", "heappop", "heapreplace", "heapify",
+                  "heappushpop"}
+
+
+def _literal_str_dict(node: ast.AST) -> Optional[Dict[str, str]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, str] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)):
+            return None
+        out[k.value] = v.value
+    return out
+
+
+def _field_ref(node: ast.AST, owner: str, field: str) -> bool:
+    """``self.field`` (owner='self') or bare ``field`` (owner='')."""
+    if owner:
+        return (isinstance(node, ast.Attribute) and node.attr == field
+                and isinstance(node.value, ast.Name)
+                and node.value.id == owner)
+    return isinstance(node, ast.Name) and node.id == field
+
+
+class SharedMutationRule(Rule):
+    id = "DSL006"
+    title = "tagged shared structures: swap-whole / atomic op / under lock"
+    incident = ("PR 7 — /statz scrape thread racing the engine thread on "
+                "the tracer ring/heap; the clock anchor must swap whole")
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # module-level globals
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == GLOBAL_TAG:
+                tags = _literal_str_dict(stmt.value)
+                if tags:
+                    self._check_scope(ctx, ctx.tree, "", tags, findings)
+        # classes
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and stmt.targets[0].id == CLASS_TAG:
+                    tags = _literal_str_dict(stmt.value)
+                    if tags:
+                        self._check_scope(ctx, node, "self", tags,
+                                          findings)
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_scope(self, ctx: FileContext, scope_node, owner: str,
+                     tags: Dict[str, str], findings: List[Finding]) -> None:
+        init_name = "__init__" if owner else None
+
+        for fn in ast.walk(scope_node):
+            if not isinstance(fn, FUNC_NODES):
+                continue
+            in_init = fn.name == init_name
+            fn_tags = tags
+            if not owner:
+                # module globals: a bare Name is only THE global inside a
+                # function that declares ``global <name>`` or never binds
+                # it locally — a same-named local temp is out of scope
+                fn_tags = {f: p for f, p in tags.items()
+                           if self._names_global(fn, f)}
+                if not fn_tags:
+                    continue
+            self._check_fn(ctx, fn, owner, fn_tags, in_init, findings)
+
+    @staticmethod
+    def _names_global(fn, name: str) -> bool:
+        declared = any(isinstance(s, ast.Global) and name in s.names
+                       for s in walk_no_nested(fn))
+        if declared:
+            return True
+        bound_locally = any(
+            isinstance(n, ast.Name) and n.id == name
+            and isinstance(n.ctx, (ast.Store, ast.Del))
+            for n in walk_no_nested(fn))
+        return not bound_locally
+
+    def _check_fn(self, ctx, fn, owner, tags, in_init, findings) -> None:
+
+        def report(node, field, policy, what) -> None:
+            hint = {
+                "swap": "rebind the whole object instead "
+                        "(readers hold the old snapshot)",
+                "atomic": "use one GIL-atomic op (append/heappush/whole "
+                          "slot store) or swap the whole object",
+            }.get(policy.split(":")[0],
+                  f"wrap the write in 'with {owner}.{policy.split(':', 1)[-1]}:'")
+            findings.append(Finding(
+                self.id, ctx.rel, node.lineno, node.col_offset,
+                f"shared structure {field!r} (policy {policy!r}) mutated "
+                f"via {what} — {hint} (scrape-race class, PR 7)",
+                end_line=getattr(node, "end_lineno", None) or node.lineno))
+
+        def walk(stmts: Sequence[ast.stmt],
+                 held_locks: Tuple[str, ...]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, FUNC_NODES):
+                    continue
+                if isinstance(stmt, ast.With):
+                    locks = []
+                    for item in stmt.items:
+                        ce = item.context_expr
+                        if isinstance(ce, ast.Attribute) \
+                                and isinstance(ce.value, ast.Name) \
+                                and (not owner or ce.value.id == owner):
+                            locks.append(ce.attr)
+                        elif isinstance(ce, ast.Name):
+                            locks.append(ce.id)
+                    walk(stmt.body, held_locks + tuple(locks))
+                    continue
+                self._check_stmt(ctx, stmt, owner, tags, in_init,
+                                 held_locks, report)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list) and sub \
+                            and isinstance(sub[0], ast.stmt):
+                        walk(sub, held_locks)
+                if isinstance(stmt, ast.Try):
+                    for h in stmt.handlers:
+                        walk(h.body, held_locks)
+
+        walk(fn.body, ())
+
+    # ------------------------------------------------------------------
+    def _check_stmt(self, ctx, stmt, owner, tags, in_init, held_locks,
+                    report) -> None:
+
+        def policy_violation(field: str, policy: str, node, what: str,
+                             atomic_ok: bool) -> None:
+            kind = policy.split(":")[0]
+            if kind == "lock":
+                lock_attr = policy.split(":", 1)[1]
+                if lock_attr not in held_locks:
+                    report(node, field, policy, what)
+            elif kind == "swap":
+                if what != "whole rebind":
+                    report(node, field, policy, what)
+            elif kind == "atomic":
+                if not atomic_ok and what != "whole rebind":
+                    report(node, field, policy, what)
+
+        def match_field(node) -> Optional[str]:
+            for f in tags:
+                if _field_ref(node, owner, f):
+                    return f
+            return None
+
+        def unwind(t) -> Tuple[Optional[str], int]:
+            """(tagged field, store depth) when ``t`` writes into one:
+            depth 0 = whole rebind, 1 = slot store, >1 = nested."""
+            node, depth = t, 0
+            while True:
+                f = match_field(node)
+                if f is not None:
+                    return f, depth
+                if isinstance(node, (ast.Subscript, ast.Attribute)):
+                    node = node.value
+                    depth += 1
+                else:
+                    return None, 0
+
+        # assignment targets
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            aug = isinstance(stmt, ast.AugAssign)
+            for t in targets:
+                f, depth = unwind(t)
+                if f is None:
+                    continue
+                if depth == 0 and not aug:
+                    if not in_init:
+                        policy_violation(f, tags[f], stmt, "whole rebind",
+                                         True)
+                    continue
+                what = ("augmented assign" if aug else
+                        "single-level slot store" if depth == 1 else
+                        "nested element mutation")
+                policy_violation(f, tags[f], stmt, what,
+                                 atomic_ok=(depth == 1 and not aug))
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                base = t
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                f = match_field(base)
+                if f is not None:
+                    policy_violation(f, tags[f], stmt, "del", False)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            func = call.func
+            # self.field.method(...)
+            if isinstance(func, ast.Attribute):
+                f = match_field(func.value)
+                if f is not None:
+                    policy_violation(f, tags[f], call,
+                                     f"method call .{func.attr}()",
+                                     atomic_ok=True)
+                    return
+            # heapq.heappush(self.field, ...)
+            if tail_name(func) in HEAPQ_MUTATORS:
+                for arg in call.args[:1]:
+                    f = match_field(arg)
+                    if f is not None:
+                        policy_violation(f, tags[f], call,
+                                         f"{tail_name(func)}()",
+                                         atomic_ok=True)
+
+
+register_rule(SharedMutationRule())
+
+
+# --- selftest fixtures -----------------------------------------------------
+SELFTEST_BAD = '''\
+import heapq
+
+
+class Tracer:
+    _dslint_shared = {"_ring": "atomic", "_anchor": "swap",
+                      "_pending": "lock:_lock"}
+
+    def __init__(self):
+        self._ring = []
+        self._anchor = {"perf": 0.0}
+        self._pending = None
+
+    def record(self, rec):
+        self._ring.append(rec)              # atomic op: fine
+        self._ring[0]["t"] = 1.0            # <- nested element mutation
+        self._anchor["perf"] = 2.0          # <- swap policy: no in-place
+        self._pending = rec                 # <- lock policy: not held
+'''
+
+SELFTEST_GOOD = '''\
+import heapq
+
+
+class Tracer:
+    _dslint_shared = {"_ring": "atomic", "_anchor": "swap",
+                      "_pending": "lock:_lock"}
+
+    def __init__(self):
+        self._ring = []
+        self._anchor = {"perf": 0.0}
+        self._pending = None
+
+    def record(self, rec):
+        self._ring.append(rec)
+        heapq.heappush(self._ring, rec)
+        self._ring[3] = rec                 # whole-slot swap: atomic
+        self._anchor = {"perf": 2.0}        # whole rebind
+        with self._lock:
+            self._pending = rec
+'''

@@ -54,9 +54,12 @@ from collections import defaultdict
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
-from deepspeed_tpu.monitor.metrics import MetricsRegistry, get_registry
-from deepspeed_tpu.utils.logging import logger
+# relative imports: this stdlib-only subgraph (comms/flight_recorder/
+# metrics/utils.logging) is loaded by file path under stub parents on
+# jax-less operator boxes (tools/trace_report.py; dslint DSL003)
+from .flight_recorder import get_flight_recorder
+from .metrics import MetricsRegistry, get_registry
+from ..utils.logging import logger
 
 __all__ = ["CommMetrics", "comm_metrics", "busbw_factor", "KNOWN_OPS"]
 

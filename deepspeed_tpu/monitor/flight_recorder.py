@@ -32,7 +32,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
-from deepspeed_tpu.utils.logging import logger
+from ..utils.logging import logger
 
 __all__ = ["FlightRecorder", "get_flight_recorder"]
 
@@ -41,6 +41,12 @@ _UNSET = object()   # enable(): "dump_dir not mentioned" vs "reset to cwd"
 
 
 class FlightRecorder:
+    # dslint DSL006: dump()/events() may run on a crashing or signal
+    # thread while the engine thread records — ring writes must stay
+    # single-slot swaps (self._buf[i] = ev); records are immutable once
+    # published
+    _dslint_shared = {"_buf": "atomic"}
+
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.capacity = max(1, int(capacity))
         self.enabled = False

@@ -86,6 +86,12 @@ DEFAULT_MAX_SPANS = 512
 _ANCHOR: Dict[str, Any] = {"perf": time.perf_counter(), "unix": time.time(),
                            "source": "process"}
 
+# dslint DSL006 contract (enforced statically, tools/dslint.py): the
+# anchor is read lock-free by /requestz and the perfetto exporter — it
+# may only be REBOUND whole, never patched field-by-field (a torn
+# perf/unix pair was the PR 7 scrape-race class)
+_DSLINT_SHARED_GLOBALS = {"_ANCHOR": "swap"}
+
 
 def set_trace_clock_anchor() -> Dict[str, Any]:
     """Stamp 'now' as the trace-session clock epoch; returns a copy.
@@ -116,6 +122,11 @@ class RequestTracer:
     Single-writer like the metrics instruments: all hooks run on the
     engine thread; ``/requestz`` scrapes read completed timelines, which
     are append-only dicts swapped in whole (GIL-atomic)."""
+
+    # dslint DSL006: scrape threads snapshot-copy these (list(self._ring))
+    # — every writer-side mutation must be ONE GIL-atomic op (append /
+    # heappush / whole rebind); published records are immutable
+    _dslint_shared = {"_ring": "atomic", "_slowest": "atomic"}
 
     def __init__(self, ring: int = DEFAULT_RING,
                  slowest_k: int = DEFAULT_SLOWEST_K,

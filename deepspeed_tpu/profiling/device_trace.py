@@ -58,8 +58,21 @@ import re
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from deepspeed_tpu.monitor.comms import KNOWN_OPS, busbw_factor
-from deepspeed_tpu.profiling.trace import perfetto_supported  # noqa: F401
+# RELATIVE imports, deliberately: tools/trace_report.py loads this module
+# by file path under stub parent packages so an operator box never
+# executes the jax-pulling ``deepspeed_tpu/__init__`` (dslint DSL003);
+# monitor.comms / monitor.metrics are stdlib-only.
+from ..monitor.comms import KNOWN_OPS, busbw_factor
+
+
+def perfetto_supported() -> bool:
+    """Whether this jax writes perfetto trace-event JSON (delegates to
+    profiling/trace.py).  Lazy on purpose: only LIVE capture paths (the
+    broker, TraceCapture) need jax — the offline parse half of this
+    module stays importable with no jax installed."""
+    from .trace import perfetto_supported as _probe  # dslint: disable=DSL003 -- live-capture path only; the offline parse (tools/trace_report.py) never calls it, and on an engine box jax is already present
+
+    return _probe()
 
 __all__ = ["find_perfetto_trace", "load_trace_events", "summarize_trace",
            "publish_summary", "analyze_capture", "ensure_registered",
@@ -475,7 +488,7 @@ def publish_summary(summary: Dict[str, Any], registry=None,
     lands only in ``*_device_*`` names.
     """
     if registry is None:
-        from deepspeed_tpu.monitor.metrics import get_registry
+        from ..monitor.metrics import get_registry
 
         registry = get_registry()
     phases = summary["phases"]
@@ -568,6 +581,12 @@ class ProfileBroker:
     a step boundary; the claimer runs the windowed capture, post-processes,
     and resolves the request.  One capture at a time: jax has a single
     global profiler session."""
+
+    # dslint DSL006: the HTTP thread and N engine threads race on the
+    # single slot — every transition holds the lock (``pending`` is READ
+    # lock-free as the engines' one-attribute-load fast path; writes are
+    # what must serialize)
+    _dslint_shared = {"pending": "lock:_lock", "_claimed": "lock:_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()

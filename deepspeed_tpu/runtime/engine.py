@@ -228,6 +228,20 @@ def _owned_device_put(x, sharding):
     return _owned_copy(sharding)(arr)
 
 
+def _owned_device_put_tree(tree, shardings):
+    """Tree-valued :func:`_owned_device_put`: ``device_put`` a whole host
+    tree, then (CPU only) reroute every leaf through the memoized compiled
+    copy so no leaf aliases caller memory.  Used on every path that
+    rebuilds ``state`` leaves from HOST arrays — checkpoint load, the
+    pinned-refresh ``state`` property, the param-offload optimizer commit —
+    because those leaves are donated into the compiled accum/apply fns on
+    the next dispatch (dslint rule DSL001)."""
+    arr = jax.device_put(tree, shardings)
+    if jax.default_backend() != "cpu":
+        return arr
+    return jax.tree.map(lambda a: _owned_copy(a.sharding)(a), arr)
+
+
 def _flight_guard(fn):
     """Dump the flight recorder (once) before re-raising an unhandled
     exception out of an engine entry point."""
@@ -814,8 +828,11 @@ class DeepSpeedEngine:
         current weights."""
         if self._pinned_stale:
             self._pinned_stale = False
+            # owned put: _np_params are live host masters; an aliased
+            # refresh leaf reaching a donated fn is the PR 2/4/10 class
             self._state = self._state._replace(
-                params=jax.device_put(self._np_params, self._param_shardings))
+                params=_owned_device_put_tree(self._np_params,
+                                              self._param_shardings))
         return self._state
 
     @state.setter
@@ -1179,7 +1196,7 @@ class DeepSpeedEngine:
             grad_acc = jax.jit(
                 lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, self._acc_dtype(x.dtype)), p),
                 out_shardings=self._acc_shardings)(params)
-        self.state = TrainState(params=params, opt_state=opt_state, grad_acc=grad_acc,
+        self.state = TrainState(params=params, opt_state=opt_state, grad_acc=grad_acc,  # dslint: disable=DSL001 -- every leaf here is a jit OUTPUT (runtime-owned); the device_put above only re-homes a compiled cast to the pinned-host space, no host-numpy alias exists
                                 global_steps=jnp.zeros((), jnp.int32),
                                 scaler=scaler_lib.make_state(self.config.fp16))
         self._compile_steps()
@@ -2364,7 +2381,12 @@ class DeepSpeedEngine:
                 global_steps=self._state.global_steps + 1)
             self._pinned_stale = True
         else:
-            new_params = jax.device_put(compute, self._param_shardings)
+            # owned put (dslint DSL001): ``compute`` is host numpy, and on
+            # the non-streamed param-offload path these leaves are donated
+            # into the accum fn next micro-batch — the exact corruption
+            # _step_offload hit in PR 4
+            new_params = _owned_device_put_tree(compute,
+                                                self._param_shardings)
             self.state = self._state._replace(
                 params=new_params, global_steps=self._state.global_steps + 1)
         for g in leaves:
@@ -2629,7 +2651,7 @@ class DeepSpeedEngine:
 
     def _report(self, steps: int) -> None:
         lr = self.get_lr()[0]
-        loss = float(self._last_loss) if self._last_loss is not None else float("nan")
+        loss = float(self._last_loss) if self._last_loss is not None else float("nan")  # dslint: disable=DSL002 -- the log line below needs the value; runs once per steps_per_print boundary, not per step
         log_dist(f"step={steps} loss={loss:.4f} lr={lr:.3e} "
                  f"loss_scale={self.loss_scale:.0f} "
                  f"samples/sec={self.tput_timer.avg_samples_per_sec():.2f}", ranks=[0])
@@ -2888,8 +2910,13 @@ class DeepSpeedEngine:
         params_host = legacy.load(
             os.path.join(ckpt_dir, "model_states.msgpack"),
             target=jax.device_get(self.state.params))
+        # owned puts (dslint DSL001): msgpack-loaded host arrays become
+        # state leaves that the donated accum/apply fns consume on the
+        # first post-resume step — an aliased leaf meeting a
+        # cache-DESERIALIZED executable is the PR 2/4 corruption
         new_state = self.state._replace(
-            params=jax.device_put(params_host, self._param_shardings))
+            params=_owned_device_put_tree(params_host,
+                                          self._param_shardings))
         meta = {}
         meta_path = os.path.join(ckpt_dir, "client_state.json")
         if os.path.exists(meta_path):
@@ -2907,8 +2934,10 @@ class DeepSpeedEngine:
             if self._offload and "offload" in opt_host:
                 self._offload_opt.load_state_dict(opt_host["offload"])
             new_state = new_state._replace(
-                opt_state=jax.device_put(opt_host["opt_state"], self._opt_shardings),
-                grad_acc=jax.device_put(opt_host["grad_acc"], self._acc_shardings),
+                opt_state=_owned_device_put_tree(opt_host["opt_state"],
+                                                 self._opt_shardings),
+                grad_acc=_owned_device_put_tree(opt_host["grad_acc"],
+                                                self._acc_shardings),
                 global_steps=jnp.asarray(opt_host["global_steps"], jnp.int32),
                 scaler=scaler_lib.LossScaleState(
                     *[jnp.asarray(x) for x in opt_host["scaler"]]))

@@ -1201,8 +1201,8 @@ class ServingEngine:
         the sync-free tests instrument."""
         entry = self._block_np.get(idx)
         if entry is None:
-            toks = np.asarray(self._blocks[idx])
-            valid = (np.asarray(self._block_valid[idx])
+            toks = np.asarray(self._blocks[idx])  # dslint: disable=DSL002 -- THE deliberate deferred fetch: drains run >=1 block behind dispatch (lag 1), finish-fetches overlap queued blocks; pinned structurally in test_paged_kv
+            valid = (np.asarray(self._block_valid[idx])  # dslint: disable=DSL002 -- same deferred-fetch seam (valid mask rides the same memoized entry)
                      if idx in self._block_valid else None)
             entry = self._block_np[idx] = (toks, valid)
         return entry

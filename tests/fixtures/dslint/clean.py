@@ -1,0 +1,36 @@
+"""Clean twin: hot-path code that honors every rule — zero findings.
+Parsed by the analyzer only — never imported or executed."""
+
+import functools
+import time
+
+import jax
+
+from engine_seams import _owned_device_put
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def accum(state, batch):
+    return state + batch
+
+
+def step(state, host_grads, shardings):
+    g = _owned_device_put(host_grads, shardings)     # owned copy seam
+    return accum(g, 1.0)
+
+
+class Engine:
+    _dslint_shared = {"_ring": "atomic", "_anchor": "swap"}
+
+    def __init__(self):
+        self._ring = []
+        self._anchor = {"perf": 0.0}
+
+    def _decode_block(self):   # dslint: hot
+        toks = self._dispatch()
+        t0 = time.perf_counter()
+        if self.registry.enabled:
+            self._m.record(float(toks[0]))           # enabled-only branch
+        self._ring.append({"t": t0})                 # GIL-atomic append
+        self._anchor = {"perf": t0}                  # whole-object swap
+        return toks

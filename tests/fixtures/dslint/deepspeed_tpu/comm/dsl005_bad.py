@@ -1,0 +1,20 @@
+"""Seeded DSL005 violations (lives under a ``comm/`` path on purpose —
+the rule scopes to collective-wrapper directories): a bare collective
+with no ``ds_comm_`` scope, and a scope nested inside a telemetry
+conditional (the PR 3 compiled-program-stability contract).  Parsed by
+the analyzer only — never imported or executed."""
+
+from jax import lax
+
+from deepspeed_tpu.profiling.trace import scope as _scope
+
+
+def all_reduce(x, axis):
+    return lax.psum(x, axis)                       # <- DSL005 (no scope)
+
+
+def all_gather(x, axis, registry):
+    if registry.enabled:
+        with _scope("ds_comm_all_gather"):         # <- DSL005 (conditional)
+            return lax.all_gather(x, axis, axis=0, tiled=True)
+    return lax.all_gather(x, axis, axis=0, tiled=True)

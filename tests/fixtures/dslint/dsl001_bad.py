@@ -1,0 +1,23 @@
+"""Seeded DSL001 violation: a raw ``jax.device_put`` result reaching a
+``donate_argnums`` callee (the PR 2/4/10 corruption class).  Parsed by
+the analyzer only — never imported or executed."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def accum(state, batch):
+    return state + batch
+
+
+def step(state, host_grads, shardings):
+    g = jax.device_put(host_grads, shardings)   # numpy-aliased on CPU
+    return accum(g, 1.0)                        # donated arg 0  <- DSL001
+
+
+def commit(self, compute):
+    new_params = jax.device_put(compute, self._shardings)
+    # the engine-state sink: these leaves are donated next dispatch
+    self.state = self._state._replace(params=new_params)   # <- DSL001

@@ -1,0 +1,16 @@
+"""Seeded DSL002 violations: device syncs on a hot path, including one
+hiding in the telemetry-DISABLED branch (the PR 3/7 class).  Parsed by
+the analyzer only — never imported or executed."""
+
+import numpy as np
+
+
+class Engine:
+    def _decode_block(self):   # dslint: hot
+        toks = self._dispatch()
+        if not self.registry.enabled:
+            # this branch only runs with metrics OFF — no test times it
+            self._last = float(toks.sum())              # <- DSL002
+        vals = np.asarray(toks)                         # <- DSL002
+        got = toks.item()                               # <- DSL002
+        return vals, got

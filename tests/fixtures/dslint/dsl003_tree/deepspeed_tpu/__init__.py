@@ -1,0 +1,1 @@
+import jax  # noqa: F401  (the package init every normal import executes)

@@ -1,0 +1,1 @@
+import json  # noqa: F401  (stdlib-only leaf)

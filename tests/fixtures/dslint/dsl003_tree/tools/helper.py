@@ -1,0 +1,1 @@
+from deepspeed_tpu.monitor import metrics  # noqa: F401  <- pulls __init__

@@ -1,0 +1,5 @@
+"""Seeded DSL003 violation tree: a 'jax-free' tool whose closure reaches
+jax through a helper that imports the package the normal way (the
+fleet_dump incident, PR 7).  Parsed by the analyzer only."""
+
+import helper  # noqa: F401

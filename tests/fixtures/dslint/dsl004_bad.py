@@ -1,0 +1,12 @@
+"""Seeded DSL004 violation: a metric literal outside the ``ds_``
+namespace, born behind a branch the runtime guard may never execute.
+Parsed by the analyzer only — never imported or executed."""
+
+from deepspeed_tpu.monitor.metrics import get_registry
+
+
+def register(flag):
+    reg = get_registry()
+    if flag:   # rarely-taken branch: the runtime guard never sees it
+        return reg.counter("serve_shadow_requests_total", "no ds_ prefix")
+    return reg.gauge("ds_serve_documented_ok", "fine if documented")

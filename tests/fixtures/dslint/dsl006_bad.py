@@ -1,0 +1,21 @@
+"""Seeded DSL006 violations: tagged shared structures mutated outside
+their declared discipline (the PR 7 scrape-race class).  Parsed by the
+analyzer only — never imported or executed."""
+
+import time
+
+
+class Tracer:
+    _dslint_shared = {"_ring": "atomic", "_anchor": "swap",
+                      "_pending": "lock:_lock"}
+
+    def __init__(self):
+        self._ring = []
+        self._anchor = {"perf": 0.0}
+        self._pending = None
+
+    def record(self, rec):
+        self._ring.append(rec)                  # atomic op: fine
+        self._ring[0]["t"] = time.time()        # <- DSL006 (published rec)
+        self._anchor["perf"] = time.time()      # <- DSL006 (torn anchor)
+        self._pending = rec                     # <- DSL006 (lock not held)

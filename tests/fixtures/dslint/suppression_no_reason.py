@@ -1,0 +1,11 @@
+"""Seeded DSL000 case: a suppression WITHOUT the required ``-- reason``
+tail neither suppresses the finding nor passes itself.  Parsed by the
+analyzer only — never imported or executed."""
+
+import numpy as np
+
+
+class Engine:
+    def _drain_one(self):   # dslint: hot
+        toks = self._fetch()
+        return np.asarray(toks)  # dslint: disable=DSL002

@@ -50,10 +50,12 @@ def test_router_tool_selftest():
 
 
 def test_router_tool_runs_without_jax():
-    """The operator-box contract stated in the tool's docstring: running
-    ``tools/router.py --selftest`` in a fresh interpreter must never
-    import jax OR the deepspeed_tpu package (the router module loads by
-    file path; the selftest itself asserts on sys.modules)."""
+    """The router's ONE fresh-interpreter smoke: the STATIC half of the
+    no-jax contract is owned by dslint rule DSL003's whole-import-graph
+    check (tests/unit/test_dslint.py::test_jax_free_tools_import_graph,
+    covering all six operator tools in one pass); this subprocess pins
+    the RUNTIME half for router specifically (the selftest asserts on
+    sys.modules in a fresh interpreter)."""
     import subprocess
 
     script = os.path.join(_TOOLS, "router.py")

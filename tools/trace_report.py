@@ -19,8 +19,11 @@ dispatch-slack numbers when ``ds_serve_*`` ranges are present.
 the full parse + render on it, asserting the phase partition (wired as a
 tier-1 unit test so this offline tool cannot silently rot).
 
-Needs this repo (and its jax dependency) importable; the trace file
-itself is plain gzip'd trace-event JSON, parsed with stdlib only.
+Zero dependencies beyond the repo's stdlib-only modules — **no jax
+import** (the analysis module loads by file path, the fleet_dump idiom;
+dslint rule DSL003 pins the whole closure): the trace file itself is
+plain gzip'd trace-event JSON, so a scraped ``/profilez`` capture can be
+analyzed on an operator box with no jax install.
 """
 
 from __future__ import annotations
@@ -30,9 +33,50 @@ import os
 import sys
 from typing import List
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-from deepspeed_tpu.profiling import device_trace  # noqa: E402
+
+def _load_device_trace():
+    """The device-truth post-processor, WITHOUT jax: when the package is
+    already imported in this process, reuse its module (one broker, one
+    registry); otherwise load ``device_trace.py`` by file path under STUB
+    parent packages, so the jax-pulling ``deepspeed_tpu/__init__`` never
+    executes — device_trace and its stdlib-only dependency chain
+    (monitor.comms / flight_recorder / metrics, utils.logging) use
+    relative imports precisely so this works (dslint rule DSL003 keeps
+    that closure jax-free)."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.profiling import device_trace
+
+        return device_trace
+    mod = sys.modules.get("_dst.profiling.device_trace")
+    if mod is not None:
+        return mod
+    import importlib.util
+    import types
+
+    # PRIVATE root name ("_dst", like router's "_ds_router"): registering
+    # stubs under the real package names would shadow a later genuine
+    # `import deepspeed_tpu` in this process with contentless modules
+    pkg_dir = os.path.join(_REPO, "deepspeed_tpu")
+    for name, sub in (("_dst", None),
+                      ("_dst.monitor", "monitor"),
+                      ("_dst.utils", "utils"),
+                      ("_dst.profiling", "profiling")):
+        if name not in sys.modules:
+            stub = types.ModuleType(name)
+            stub.__path__ = [os.path.join(pkg_dir, sub) if sub else pkg_dir]
+            sys.modules[name] = stub
+    path = os.path.join(pkg_dir, "profiling", "device_trace.py")
+    spec = importlib.util.spec_from_file_location(
+        "_dst.profiling.device_trace", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_dst.profiling.device_trace"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+device_trace = _load_device_trace()
 
 
 def _table(header: List[str], rows: List[List[str]]) -> str:
