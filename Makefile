@@ -1,7 +1,7 @@
 # Single-command entries the builder's verify recipe runs before the
 # suite (see ROADMAP.md for the canonical tier-1 line).
 
-.PHONY: lint lint-json tier1
+.PHONY: lint lint-json tier1 chaos
 
 # dslint: AST-level invariant checker (docs/LINT.md) — no jax needed
 lint:
@@ -13,5 +13,12 @@ lint-json:
 # lint first (seconds), then the tier-1 suite (minutes)
 tier1: lint
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+# the slow-marked serving chaos suite (outside tier-1): randomized
+# fleet chaos + the bench_fleet_chaos rung at CPU smoke scale
+chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m slow -k chaos \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

@@ -925,6 +925,244 @@ def bench_host_tier_serving(num_requests: int = 32, num_slots: int = 4,
     }
 
 
+def bench_fleet_chaos(num_requests: int = 24, num_slots: int = 2,
+                      seed: int = 0, tiny: bool = False) -> dict:
+    """Fleet resilience rung (ISSUE 13): the bimodal shared-prefix trace
+    through the ROUTER over two live replicas, run twice — a clean pass,
+    and a CHAOS pass where replica 1's serving loop is killed mid-trace
+    and revived by a supervisor-style watcher (restart + resume; the
+    in-process analog of ``tools/serve_supervisor.py``'s process
+    restart).  Recorded per side: goodput, client-latency p50/p99, TTFT
+    p99 (max over the replicas' registries), answered/shed counts.
+    Headlines: ``goodput_retention`` (chaos/clean), ``restarts_observed``
+    (must be >= 1 on the chaos side), ``answered_exactly_once`` +
+    ``outputs_token_identical`` (every 200 matches ``generate()``;
+    200 + 429 partition the trace — zero drops, zero duplicates)."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import MetricsRegistry
+    from deepspeed_tpu.serving import Router, RouterServer
+    from deepspeed_tpu.testing.chaos import crash_on_call
+
+    mesh = build_mesh(devices=jax.devices()[:1])
+    set_global_mesh(mesh)
+    rng = np.random.default_rng(seed + 17)
+    if tiny:  # CPU smoke scale (tests/perf/test_fleet_chaos_bench.py)
+        model = causal_lm("gpt2-small", mesh=mesh, num_layers=2,
+                          hidden_size=128, intermediate_size=256,
+                          num_heads=4, vocab_size=512)
+        max_out, page_tokens = 96, 16
+        sys_len, tail = 32, (3, 8)
+        n_short, n_long = (3, 6), (8, 12)
+    else:
+        model = causal_lm("gpt2-small", mesh=mesh, vocab_size=50304)
+        max_out, page_tokens = 1024, 0
+        sys_len, tail = 256, (16, 96)
+        n_short, n_long = (16, 64), (128, 192)
+    params = jax.jit(model.init)(jax.random.PRNGKey(seed))
+    V = model.config.vocab_size
+
+    shared = rng.integers(0, V, size=sys_len).astype(np.int32)
+    long_mask = rng.random(num_requests) < 0.25
+    prompts, news = [], []
+    for i in range(num_requests):
+        t = rng.integers(0, V, size=int(rng.integers(tail[0], tail[1] + 1))
+                         ).astype(np.int32)
+        if rng.random() < 0.7:
+            prompts.append(np.concatenate([shared, t]))
+        else:
+            prompts.append(rng.integers(
+                0, V, size=sys_len // 2 + len(t)).astype(np.int32))
+        news.append(int(rng.integers(n_long[0], n_long[1] + 1)
+                        if long_mask[i]
+                        else rng.integers(n_short[0], n_short[1] + 1)))
+    ref = deepspeed_tpu.init_inference(
+        model, config={"dtype": "bfloat16", "max_out_tokens": max_out})
+    ref.set_params(params)
+    want = [[int(t) for t in np.asarray(ref.generate(
+                p[None], max_new_tokens=n, do_sample=False))[0, len(p):]]
+            for p, n in zip(prompts, news)]
+
+    def run_side(kill: bool) -> dict:
+        replicas = []
+        router = front = None
+        try:
+            for _ in range(2):
+                s = deepspeed_tpu.init_serving(
+                    model, config={"dtype": "bfloat16",
+                                   "max_out_tokens": max_out,
+                                   "kv_page_tokens": page_tokens,
+                                   "max_queue_depth": max(4, num_requests // 3),
+                                   "shed_retry_after_s": 0.2},
+                    num_slots=num_slots, decode_block_tokens=4,
+                    metrics_port=0, registry=MetricsRegistry().enable(),
+                    private_health=True, serve_loop=True)
+                s.set_params(params)
+                # warm the serving programs BEFORE the measured trace (one
+                # long + one short prompt covers the pow2 prefill buckets +
+                # the decode block): the recorded TTFT must not be compile
+                # time
+                warms = [s.submit(prompts[0], max_new_tokens=2),
+                         s.submit(prompts[0][:20], max_new_tokens=2)]
+                deadline = time.perf_counter() + 240
+                while not all(w.done for w in warms) \
+                        and time.perf_counter() < deadline:
+                    time.sleep(0.005)
+                s._registry.reset()
+                replicas.append(s)
+            router = Router(
+                [f"r{i}={s.metrics_server.url}"
+                 for i, s in enumerate(replicas)],
+                registry=MetricsRegistry().enable(), dispatch_rounds=8,
+                retry_backoff=0.02, poll_interval=0.05, request_timeout=120.0)
+            router.refresh()
+            router.start()
+            front = RouterServer(router).start()
+            results = [None] * num_requests
+            client_lat = [None] * num_requests
+
+            def client(i):
+                # a well-behaved client: waits out 429 Retry-After and backs
+                # off on router-level 503 (both mean "no answer produced") —
+                # bounded retries, then the last status stands
+                t0 = time.perf_counter()
+                req = urllib.request.Request(
+                    front.url + "/generate",
+                    data=_json.dumps(
+                        {"prompt": prompts[i].tolist(),
+                         "max_new_tokens": news[i],
+                         "session": f"sess-{i % 4}",
+                         "timeout": 90}).encode(),
+                    headers={"Content-Type": "application/json"})
+                for _attempt in range(8):
+                    try:
+                        with urllib.request.urlopen(req, timeout=120) as resp:
+                            results[i] = (resp.status, _json.load(resp))
+                        break
+                    except urllib.error.HTTPError as exc:
+                        try:
+                            body = _json.load(exc)
+                        except Exception:
+                            body = {}
+                        results[i] = (exc.code, body)
+                        if exc.code == 429:
+                            time.sleep(min(float(
+                                body.get("retry_after_s", 0.2)), 0.5))
+                            continue
+                        if exc.code == 503:
+                            time.sleep(0.2)
+                            continue
+                        break
+                    except OSError:
+                        break
+                client_lat[i] = time.perf_counter() - t0
+
+            restarts = {"n": 0}
+            stop = threading.Event()
+
+            def watcher():
+                while not stop.is_set():
+                    for s in replicas:
+                        if s._loop_crashed and not s._loop_alive():
+                            time.sleep(0.1)
+                            s.start_loop()
+                            s.resume_admission()
+                            restarts["n"] += 1
+                    time.sleep(0.02)
+
+            wt = threading.Thread(target=watcher, daemon=True)
+            wt.start()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(num_requests)]
+            t0 = time.perf_counter()
+
+            def launch_staggered():
+                for t in threads:
+                    t.start()
+                    time.sleep(0.03)
+                for t in threads:
+                    t.join(timeout=240)
+
+            try:
+                if kill:
+                    with crash_on_call(replicas[1], "step", n=3):
+                        launch_staggered()
+                else:
+                    launch_staggered()
+            finally:
+                stop.set()
+                wt.join(timeout=10)
+            span = time.perf_counter() - t0
+            answered, sheds, identical, toks = 0, 0, True, 0
+            for i, r in enumerate(results):
+                if r is None:
+                    continue
+                code, body = r
+                if code == 200:
+                    answered += 1
+                    toks += len(body.get("tokens", []))
+                    identical = identical and body.get("tokens") == want[i]
+                elif code == 429:
+                    sheds += 1
+            ttft_p99 = 0.0
+            for s in replicas:
+                snap = s._registry.snapshot()
+                ttft = snap.get("ds_serve_ttft_seconds") or {}
+                ttft_p99 = max(ttft_p99, float(ttft.get("p99", 0.0)))
+            lat = sorted(x for x in client_lat if x is not None)
+            out = {
+                "goodput_tok_s": round(toks / max(span, 1e-9), 1),
+                "makespan_s": round(span, 3),
+                "answered": answered, "shed_429": sheds,
+                "exactly_once": answered + sheds == num_requests,
+                "token_identical": identical,
+                "ttft_p99_s": round(ttft_p99, 4),
+                "client_p50_s": round(lat[len(lat) // 2], 4) if lat else 0.0,
+                "client_p99_s": round(lat[(len(lat) * 99) // 100], 4)
+                if lat else 0.0,
+                "restarts_observed": restarts["n"],
+                "router_retries": int(
+                    router.registry.get("ds_router_retries_total").value),
+            }
+            return out
+        finally:
+            # a mid-side exception (client assertion, registry miss)
+            # must not leak two live engines + loops + HTTP servers
+            # into the rest of the bench run
+            if front is not None:
+                front.stop()
+            if router is not None:
+                router.stop()
+            for s in replicas:
+                s.close()
+
+    clean = run_side(kill=False)
+    chaos = run_side(kill=True)
+    return {
+        "workload": {"num_requests": num_requests, "num_slots": num_slots,
+                     "replicas": 2, "shared_prefix_frac": 0.7,
+                     "system_prompt_tokens": sys_len, "seed": seed},
+        "clean": clean,
+        "chaos": chaos,
+        "goodput_retention": round(
+            chaos["goodput_tok_s"] / max(clean["goodput_tok_s"], 1e-9), 3),
+        "ttft_p99_clean_s": clean["ttft_p99_s"],
+        "ttft_p99_chaos_s": chaos["ttft_p99_s"],
+        "restarts_observed": chaos["restarts_observed"],
+        "answered_exactly_once": clean["exactly_once"]
+        and chaos["exactly_once"],
+        "outputs_token_identical": clean["token_identical"]
+        and chaos["token_identical"],
+    }
+
+
 def bench_overlap_rung(steps: int = 4, warmup: int = 2) -> dict:
     """ZeRO-3 compute/collective overlap on/off ablation on the 1.34B
     training scenario (ROADMAP open item 1; runtime/zero/overlap.py).
@@ -1532,10 +1770,18 @@ def main():
         except Exception as exc:
             rung_host_tier = {"status": f"failed: {type(exc).__name__}",
                               "error": str(exc)[:200]}
+        # fleet resilience: goodput + TTFT p99 through the router with
+        # and without one replica kill + supervisor restart mid-trace
+        try:
+            rung_fleet_chaos = bench_fleet_chaos()
+        except Exception as exc:
+            rung_fleet_chaos = {"status": f"failed: {type(exc).__name__}",
+                                "error": str(exc)[:200]}
     else:
         rung_serving = None
         rung_prefix = None
         rung_host_tier = None
+        rung_fleet_chaos = None
 
     tokens_per_step = batch * seq
     tps = steps * tokens_per_step / dt
@@ -1588,6 +1834,8 @@ def main():
                       else {}),
                    **({"host_tier_serving": rung_host_tier}
                       if rung_host_tier else {}),
+                   **({"fleet_chaos": rung_fleet_chaos}
+                      if rung_fleet_chaos else {}),
                    **({"streamed_offload": rung_streamed}
                       if rung_streamed else {})},
     })
@@ -1689,12 +1937,28 @@ def summary_lines(record: dict, rung_serving) -> list:
                                "outputs_token_identical", "demotes",
                                "promotes", "goodput_speedup")
             if ht.get(k) is not None}
+    fc = record["detail"].get("fleet_chaos")
+    if fc and "goodput_retention" in fc:
+        # the ISSUE 13 resilience row: goodput/TTFT with vs without a
+        # replica kill + supervisor restart mid-trace, and the
+        # exactly-once / token-identity acceptance bits
+        summary["fleet_chaos"] = {
+            "goodput_retention": fc["goodput_retention"],
+            "goodput_clean_tok_s": fc["clean"]["goodput_tok_s"],
+            "goodput_chaos_tok_s": fc["chaos"]["goodput_tok_s"],
+            "ttft_p99_clean_s": fc["ttft_p99_clean_s"],
+            "ttft_p99_chaos_s": fc["ttft_p99_chaos_s"],
+            "restarts_observed": fc["restarts_observed"],
+            "shed_429": fc["chaos"]["shed_429"],
+            "answered_exactly_once": fc["answered_exactly_once"],
+            "outputs_token_identical": fc["outputs_token_identical"],
+        }
     line = json.dumps(summary, separators=(",", ":"))
     # enforce the final-line cap: drop the bulkiest optional blocks first
     # (the record line keeps everything); the minimal summary always fits
     for victim in ("serving_metrics", "train_metrics", "overlap_ablation",
                    "serving_prefix", "streamed_offload",
-                   "serving_host_tier"):
+                   "serving_host_tier", "fleet_chaos"):
         if len(line) <= BENCH_SUMMARY_MAX_CHARS:
             break
         if summary.pop(victim, None) is not None:

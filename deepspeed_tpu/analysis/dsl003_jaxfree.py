@@ -37,8 +37,8 @@ from .engine import FileContext, Finding, Project, Rule, register_rule
 # the operator-tool entry points under tools/ that carry the no-jax
 # contract (each states it in its docstring; dslint itself is one)
 JAXFREE_TOOLS = ("router.py", "fleet_dump.py", "ckpt_verify.py",
-                 "train_supervisor.py", "trace_report.py",
-                 "metrics_dump.py", "dslint.py")
+                 "train_supervisor.py", "serve_supervisor.py",
+                 "trace_report.py", "metrics_dump.py", "dslint.py")
 BANNED_ROOTS = {"jax", "jaxlib", "flax", "optax"}
 PACKAGE = "deepspeed_tpu"
 

@@ -1,0 +1,102 @@
+"""Shared restart/backoff state machine for process supervisors.
+
+`tools/train_supervisor.py` (PR 8) and `tools/serve_supervisor.py` (the
+serving-fleet replica supervisor) enforce the SAME exit-code contract —
+clean exit, preempt-exit-after-emergency-save, crash-with-backoff,
+budget exhaustion — and two hand-rolled copies of that ladder WILL
+drift (different backoff caps, preemptions silently burning the crash
+budget on one side).  This module is the single source of truth both
+tools load (via the package when it is importable, else by file path —
+the ``tools/router.py`` idiom), so the contract cannot fork.
+
+Stdlib-only by design: supervisors run on operator boxes with no jax
+install (dslint rule DSL003 pins the whole import closure).
+
+The state machine (:class:`RestartPolicy`) is deliberately process-free:
+``decide(exit_code)`` consumes one child exit and returns what to do
+(``done`` / ``restart`` after ``delay`` / ``give_up``), mutating the
+restart counters exactly once per exit.  The caller owns spawning,
+waiting, and sleeping — which is what differs between a single training
+job and an N-replica serving fleet.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, NamedTuple, Optional
+
+# runtime/preemption.py carries the same default; every side reads the
+# env override so the contract cannot drift silently in a deployment
+PREEMPT_EXIT_CODE = int(os.environ.get("DS_PREEMPT_EXIT_CODE", "243"))
+
+__all__ = ["PREEMPT_EXIT_CODE", "RestartDecision", "RestartPolicy"]
+
+
+class RestartDecision(NamedTuple):
+    """One consumed child exit: what the supervisor should do next."""
+
+    action: str          # "done" | "restart" | "give_up"
+    delay: float         # backoff seconds before the restart (0 = now)
+    kind: str            # "completed" | "preempt" | "crash" | "exhausted"
+
+
+class RestartPolicy:
+    """Bounded-retry + exponential-backoff restart ladder.
+
+    - exit ``0`` — done.
+    - exit ``preempt_exit_code`` — the child took its SIGTERM emergency
+      save and left ON PURPOSE: restart immediately, do NOT burn the
+      crash budget (preemptions are routine scheduling events; N of
+      them must never abandon a healthy job).
+    - any other exit — a crash: restart after ``backoff_base * 2^n``
+      seconds (capped at ``backoff_max``) until ``max_restarts`` crash
+      restarts are exhausted, then give up.
+
+    ``healthy_reset_s`` (optional): a child that ran at least this long
+    before crashing resets the crash ladder first — a replica that
+    crashes once a day must not exhaust a lifetime budget (the serving
+    fleet's long-horizon mode; the train supervisor keeps the strict
+    PR 8 ladder by leaving it ``None``).
+
+    Counters (``restarts`` / ``crash_restarts`` / ``preempt_restarts`` /
+    ``backoffs``) mutate exactly once per :meth:`decide` and carry the
+    same meanings the PR 8 ``TrainSupervisor`` exposed.
+    """
+
+    def __init__(self, max_restarts: int = 3, backoff_base: float = 1.0,
+                 backoff_max: float = 60.0,
+                 preempt_exit_code: int = PREEMPT_EXIT_CODE,
+                 healthy_reset_s: Optional[float] = None):
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.preempt_exit_code = int(preempt_exit_code)
+        self.healthy_reset_s = healthy_reset_s
+        self.restarts = 0            # restarts performed (any reason)
+        self.crash_restarts = 0      # restarts that burned backoff budget
+        self.preempt_restarts = 0
+        self.backoffs: List[float] = []
+
+    def decide(self, exit_code: int,
+               ran_s: Optional[float] = None) -> RestartDecision:
+        """Consume one child exit code; returns the action + backoff.
+
+        ``ran_s`` (optional) is how long the incarnation ran — only used
+        by the ``healthy_reset_s`` ladder reset."""
+        if exit_code == 0:
+            return RestartDecision("done", 0.0, "completed")
+        if exit_code == self.preempt_exit_code:
+            self.restarts += 1
+            self.preempt_restarts += 1
+            return RestartDecision("restart", 0.0, "preempt")
+        if (self.healthy_reset_s is not None and ran_s is not None
+                and ran_s >= self.healthy_reset_s):
+            self.crash_restarts = 0
+        if self.crash_restarts >= self.max_restarts:
+            return RestartDecision("give_up", 0.0, "exhausted")
+        self.restarts += 1
+        self.crash_restarts += 1
+        delay = min(self.backoff_max,
+                    self.backoff_base * (2 ** (self.crash_restarts - 1)))
+        self.backoffs.append(delay)
+        return RestartDecision("restart", delay, "crash")

@@ -95,6 +95,19 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # 0 (default) = off: eviction drops, the PR 9 semantics.  Paged +
     # prefix_caching only.
     kv_host_tier_pages: int = 0
+    # Overload protection (serving/scheduler.py, docs/RESILIENCE.md
+    # "Serving fleet"): max_queue_depth bounds the admission queue — a
+    # submit past the watermark sheds (QueueFull -> HTTP 429 with
+    # Retry-After = shed_retry_after_s) instead of growing latency
+    # without bound (0 = unbounded, the legacy behavior).
+    # request_deadline_s is the DEFAULT per-request service deadline
+    # applied at submit when the caller gives none (0 = none): a request
+    # still queued past its deadline is cancelled with finish reason
+    # "deadline" rather than burning a slot on an answer nobody is
+    # waiting for.
+    max_queue_depth: int = 0
+    shed_retry_after_s: float = 1.0
+    request_deadline_s: float = 0.0
 
     def __init__(self, **kwargs):
         # legacy alias: mp_size -> tensor_parallel.tp_size

@@ -185,6 +185,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if code == 429 and isinstance(payload, dict) \
+                and payload.get("retry_after_s") is not None:
+            # the overload-shed contract (scheduler.QueueFull -> 429):
+            # well-behaved clients honor the standard header; the body
+            # carries the same value for the router's JSON path
+            self.send_header("Retry-After",
+                             str(max(1, int(payload["retry_after_s"]))))
         self.end_headers()
         self.wfile.write(body)
 

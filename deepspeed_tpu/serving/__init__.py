@@ -28,13 +28,14 @@ inference engine).
 
 from deepspeed_tpu.serving.scheduler import (FINISHED, PREFILLING, QUEUED,
                                              RUNNING, IterationScheduler,
-                                             Request)
+                                             QueueFull, Request)
 from deepspeed_tpu.serving.host_tier import HostPageStore
 from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.engine import ServingEngine
 from deepspeed_tpu.serving.router import Router, RouterServer
 
-__all__ = ["Request", "IterationScheduler", "ServingEngine", "PagedKVPool",
-           "init_paged_kv_cache", "PrefixCache", "HostPageStore", "Router",
-           "RouterServer", "QUEUED", "PREFILLING", "RUNNING", "FINISHED"]
+__all__ = ["Request", "IterationScheduler", "QueueFull", "ServingEngine",
+           "PagedKVPool", "init_paged_kv_cache", "PrefixCache",
+           "HostPageStore", "Router", "RouterServer", "QUEUED",
+           "PREFILLING", "RUNNING", "FINISHED"]

@@ -76,7 +76,8 @@ from deepspeed_tpu.serving.host_tier import HostPageStore
 from deepspeed_tpu.serving.paged_kv import PagedKVPool, init_paged_kv_cache
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.scheduler import (PREFILLING, QUEUED, RUNNING,
-                                             IterationScheduler, Request)
+                                             IterationScheduler, QueueFull,
+                                             Request)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -105,6 +106,12 @@ class ServingEngine:
     slots against a fixed HBM budget; pool pressure preempts the
     youngest-admitted slot LIFO and requeues it at the queue head).
     """
+
+    # HTTP /generate worker threads share the idempotent-dispatch map
+    # with each other (reserve-then-fill): every _idem write holds the
+    # lock (dslint DSL006, docs/LINT.md)
+    _dslint_shared = {"_idem": "lock:_idem_lock",
+                      "_idem_order": "lock:_idem_lock"}
 
     def __init__(self, model=None, config=None, *, engine: Optional[InferenceEngine] = None,
                  num_slots: int = 0, prefill_chunk: int = 0,
@@ -141,8 +148,10 @@ class ServingEngine:
         # poll and /healthz drain signal stay per-replica truths
         self._registry = registry if registry is not None else get_registry()
         self.health = health if health is not None else get_health()
-        self.scheduler = IterationScheduler(self.num_slots,
-                                            registry=self._registry)
+        self.scheduler = IterationScheduler(
+            self.num_slots, registry=self._registry,
+            max_queue_depth=int(self._config.max_queue_depth),
+            shed_retry_after_s=float(self._config.shed_retry_after_s))
 
         cfg = self.module.config
         self.paged = bool(self._config.paged_kv_cache)
@@ -223,6 +232,21 @@ class ServingEngine:
         # so HTTP /generate handlers can block on request completion
         self._loop_thread: Optional[threading.Thread] = None
         self._loop_stop: Optional[threading.Event] = None
+        # set by the loop's crash handler BEFORE health flips: /generate
+        # handlers watching a request on a crashed loop hand it back for
+        # router re-dispatch instead of stranding it until client timeout
+        self._loop_crashed = False
+        # idempotent dispatch (docs/RESILIENCE.md "Serving fleet"): a
+        # router retry after an ambiguous socket death carries the same
+        # idempotency_key, JOINS the original in-flight request here, and
+        # cannot double-generate.  Bounded insertion-order map; entries
+        # are {"req": Request|None, "ready": Event} — the reservation is
+        # taken under the lock BEFORE submit so two racing duplicates
+        # cannot both generate.
+        self._idem = {}
+        self._idem_order = deque()
+        self._idem_cap = 4096
+        self._idem_lock = threading.Lock()
         # cross-thread abort requests (abort()): consumed at the top of
         # step() so slot/page teardown always runs on the engine thread
         self._aborts = deque()
@@ -316,6 +340,14 @@ class ServingEngine:
         self._m_prefix_miss = reg.counter(
             "ds_serve_prefix_miss_tokens_total",
             "prefix tokens computed by prefill (cache miss or cache off)")
+        self._m_idem_hits = reg.counter(
+            "ds_serve_idem_hits_total",
+            "/generate dispatches that joined an existing request via "
+            "their idempotency key (router retry de-duplicated)")
+        self._m_crash_requeues = reg.counter(
+            "ds_serve_crash_requeued_total",
+            "in-flight requests handed back (503) because the serving "
+            "loop crashed under them")
         from deepspeed_tpu.models.fused_decode import supports_fused_decode
         fused_ok = (self._config.use_fused_decode is not False
                     and supports_fused_decode(
@@ -339,9 +371,18 @@ class ServingEngine:
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 128,
-               eos_token_id: Optional[int] = None) -> Request:
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Enqueue one request; returns the live Request handle (its
-        ``output_tokens`` fill in as the scheduler serves it)."""
+        ``output_tokens`` fill in as the scheduler serves it).
+
+        ``deadline_s`` (or the config default ``request_deadline_s``)
+        sets the request's service deadline: still QUEUED past it, the
+        scheduler cancels it with finish reason ``deadline`` instead of
+        burning a slot on an answer nobody is waiting for.  Raises
+        :class:`~deepspeed_tpu.serving.scheduler.QueueFull` when the
+        bounded admission queue (``max_queue_depth``) is at its
+        watermark — the overload shed the HTTP surface maps to 429."""
         if self._draining or self.scheduler.admission_paused:
             raise RuntimeError(
                 "engine is draining/drained: not admitting new requests "
@@ -356,9 +397,14 @@ class ServingEngine:
             raise ValueError(
                 f"prompt length {prompt.size} exceeds the per-slot cache "
                 f"budget max_out_tokens={self.max_out}")
+        if deadline_s is None:
+            cfg_dl = float(self._config.request_deadline_s)
+            deadline_s = cfg_dl if cfg_dl > 0 else None
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       eos_token_id=(-1 if eos_token_id is None
                                     else int(eos_token_id)))
+        if deadline_s is not None:
+            req.deadline = time.perf_counter() + float(deadline_s)
         return self.scheduler.submit(req)
 
     # ------------------------------------------------------------------
@@ -486,6 +532,15 @@ class ServingEngine:
                     if self._loop_thread is not None:
                         self._loop_thread.join(timeout=30)
                     loop_is_stepping = False
+                    if self._loop_crashed:
+                        # drain racing a KILL: the loop crashed under the
+                        # drain — stepping a crashed engine would only
+                        # re-raise, and the in-flight requests are being
+                        # handed back (503) to the router by their own
+                        # /generate handlers.  Return what finished; the
+                        # replica is dead, not draining.
+                        timed_out = True
+                        break
                 if loop_is_stepping:
                     time.sleep(0.002)     # the loop thread dispatches
                 else:
@@ -536,6 +591,7 @@ class ServingEngine:
         references.  Idempotent; :meth:`stop_loop` stops it."""
         if self._loop_alive():
             return self
+        self._loop_crashed = False       # a restart clears the crash latch
         stop = self._loop_stop = threading.Event()
 
         def loop():
@@ -555,7 +611,12 @@ class ServingEngine:
                 # a crashed loop is a DEAD replica, not a busy one: flip
                 # readiness so the router stops sending (a 200 /healthz
                 # over a thread that no longer steps would strand every
-                # dispatch in the requeue-grace path forever)
+                # dispatch in the requeue-grace path forever).  The crash
+                # flag goes first: /generate handlers watching admitted
+                # requests hand them back (503 requeue) the moment they
+                # see it — a dead loop must not strand in-flight work
+                # until client timeout (chaos-harness class)
+                self._loop_crashed = True
                 self.health.set_not_ready(f"serving loop crashed: {exc!r}")
                 log_dist(f"serving loop crashed (replica marked not-ready;"
                          f" /healthz 503): {exc!r}", ranks=[0])
@@ -606,29 +667,138 @@ class ServingEngine:
         raises (503 — the router sends elsewhere), and a request that was
         QUEUED but never admitted when the drain hit is CANCELLED and
         503'd back so the router re-dispatches it to a healthy replica —
-        zero requests are dropped on a drain."""
+        zero requests are dropped on a drain.
+
+        Overload protection: a submit shed by the bounded admission
+        queue returns ``429`` with ``retry_after_s`` (the server adds the
+        ``Retry-After`` header); a request whose service deadline
+        (``deadline_s``) expires while queued returns ``504`` with
+        ``deadline_expired`` (the router does NOT retry — the deadline
+        has passed everywhere).
+
+        Idempotent dispatch: a payload ``idempotency_key`` reserves a
+        slot in the engine's bounded dedup map BEFORE submitting; a
+        second dispatch with the same key (the router retrying after an
+        ambiguous socket death) JOINS the original request instead of
+        generating again, and a key whose request already finished
+        replays its tokens — one generation per key, however many times
+        the network made the router ask."""
         try:
             prompt = payload["prompt"]
             max_new = int(payload.get("max_new_tokens", 128))
             eos = payload.get("eos_token_id")
             timeout = float(payload.get("timeout", 300.0))
+            deadline_s = payload.get("deadline_s")
+            deadline_s = None if deadline_s is None else float(deadline_s)
+            idem = payload.get("idempotency_key")
+            if idem is not None and not isinstance(idem, str):
+                raise ValueError("idempotency_key must be a string")
         except (KeyError, TypeError, ValueError) as exc:
             return 400, {"error": f"bad /generate payload: {exc!r}"}
-        try:
-            req = self.submit(prompt, max_new_tokens=max_new,
-                              eos_token_id=eos)
-        except RuntimeError as exc:        # draining: stop-sending signal
-            return 503, {"error": str(exc), "draining": True}
-        except (TypeError, ValueError) as exc:
-            return 400, {"error": str(exc)}
+        deadline = time.monotonic() + timeout
+        # the reservation loop converges: each pass either owns the key
+        # (submits exactly once) or joins an existing in-flight entry; a
+        # joined entry whose owner FAILED to submit re-loops to take the
+        # key over.  Bounded to keep a pathological churn from spinning.
+        for _attempt in range(4):
+            entry = None
+            owner = True
+            if idem is not None:
+                with self._idem_lock:
+                    entry = self._idem.get(idem)
+                    if entry is None:
+                        entry = {"req": None, "ready": threading.Event()}
+                        self._idem[idem] = entry
+                        # the order deque holds (key, entry) so cap
+                        # eviction can verify IDENTITY: a key that was
+                        # dropped and re-reserved appears twice, and
+                        # popping the stale first occurrence must not
+                        # delete the LIVE entry (that would re-enable
+                        # the double-generation this map exists to stop)
+                        self._idem_order.append((idem, entry))
+                        while len(self._idem_order) > self._idem_cap:
+                            old_key, old_entry = self._idem_order.popleft()
+                            if self._idem.get(old_key) is old_entry:
+                                del self._idem[old_key]
+                    else:
+                        owner = False
+            if not owner:
+                self._m_idem_hits.inc()
+                if not entry["ready"].wait(
+                        max(0.0, deadline - time.monotonic())):
+                    return 504, {"error": "timed out joining the "
+                                          "in-flight idempotent request",
+                                 "idempotency_key": idem}
+                req = entry["req"]
+                if req is None:
+                    continue       # the original submit failed: take over
+                return self._await_request(req, deadline, owns=False,
+                                           idem=idem, entry=entry)
+            try:
+                req = self.submit(prompt, max_new_tokens=max_new,
+                                  eos_token_id=eos, deadline_s=deadline_s)
+            except QueueFull as exc:       # overload shed -> 429 + backoff
+                self._idem_drop(idem, entry)
+                return 429, {"error": str(exc), "shed": True,
+                             "retry_after_s": exc.retry_after_s}
+            except RuntimeError as exc:    # draining: stop-sending signal
+                self._idem_drop(idem, entry)
+                return 503, {"error": str(exc), "draining": True}
+            except (TypeError, ValueError) as exc:
+                self._idem_drop(idem, entry)
+                return 400, {"error": str(exc)}
+            if entry is not None:
+                entry["req"] = req         # published by the event below
+                entry["ready"].set()
+            return self._await_request(req, deadline, owns=True,
+                                       idem=idem, entry=entry)
+        return 503, {"error": "idempotency reservation kept churning "
+                              "(original submits failing); try again",
+                     "requeued": True}
+
+    def _idem_drop(self, idem, entry) -> None:
+        """Remove a reservation whose request failed/was torn down, and
+        wake joiners (they re-loop and take the key over)."""
+        if idem is None or entry is None:
+            return
+        with self._idem_lock:
+            if self._idem.get(idem) is entry:
+                del self._idem[idem]
+        entry["ready"].set()
+
+    def _await_request(self, req: Request, deadline: float, *, owns: bool,
+                       idem=None, entry=None):
+        """Block one HTTP worker until ``req`` finishes; maps every
+        terminal state to the router-facing status contract.  ``owns``
+        is False for a joined idempotent duplicate — it must not abort a
+        request another handler owns when ITS deadline passes."""
         now = time.monotonic()
-        deadline = now + timeout
         last_steps, last_progress = self.steps, now
         while not req.done:
             now = time.monotonic()
             if self.steps != last_steps:      # SOMETHING is stepping —
                 last_steps = self.steps       # background loop or a
                 last_progress = now           # caller-driven step() loop
+            if self._loop_crashed:
+                # the serving loop DIED under this request (kill/chaos
+                # class): hand it back for router re-dispatch instead of
+                # stranding it until client timeout.  An admitted
+                # request is aborted locally — the teardown runs when
+                # the replica revives, so its pages free then.
+                if req.state == QUEUED and self.scheduler.cancel(req):
+                    self._m_crash_requeues.inc()
+                    self._idem_drop(idem, entry)
+                    return 503, {"error": "request requeued: serving "
+                                          "loop crashed before admission",
+                                 "requeued": True}
+                if req.state in (PREFILLING, RUNNING):
+                    self.abort(req)
+                    self._m_crash_requeues.inc()
+                    self._idem_drop(idem, entry)
+                    return 503, {"error": "request requeued: serving "
+                                          "loop crashed mid-request "
+                                          "(aborted locally)",
+                                 "requeued": True}
             # hand the request back for router re-dispatch when nothing
             # will admit it: immediately on a drain (admission paused),
             # or once no scheduler step has run for a grace second and
@@ -639,10 +809,17 @@ class ServingEngine:
                     or (not self._loop_alive()
                         and now - last_progress > 1.0)):
                 if self.scheduler.cancel(req):
+                    self._idem_drop(idem, entry)
                     return 503, {"error": "request requeued: replica "
                                           "draining/stopped before "
                                           "admission", "requeued": True}
             if now > deadline:
+                if not owns:
+                    return 504, {"error": "timed out waiting on the "
+                                          "in-flight idempotent request "
+                                          "(not aborted: another handler "
+                                          "owns it)",
+                                 "request_id": req.request_id}
                 # the client is gone: don't decode to max_new_tokens for
                 # nobody — the engine thread tears the request down at
                 # its next step boundary and the slot frees
@@ -651,6 +828,19 @@ class ServingEngine:
                                       "aborted; slot reclaimed)",
                              "request_id": req.request_id}
             time.sleep(0.001)
+        if req.finish_reason == "deadline":
+            # expired while queued: too late everywhere — no retry
+            return 504, {"error": "service deadline expired before "
+                                  "admission; request cancelled",
+                         "deadline_expired": True,
+                         "request_id": req.request_id}
+        if req.finish_reason == "cancelled":
+            # torn down without an answer (abort/crash teardown): let the
+            # router re-dispatch; the dropped reservation makes a retry
+            # here generate fresh
+            self._idem_drop(idem, entry)
+            return 503, {"error": "request cancelled before completion",
+                         "requeued": True, "request_id": req.request_id}
         return 200, {"tokens": [int(t) for t in req.output_tokens],
                      "request_id": req.request_id,
                      "finish_reason": req.finish_reason,

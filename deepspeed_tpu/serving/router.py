@@ -24,6 +24,29 @@ is that something, built from signals the replicas already export:
   while draining, or the replica handing back a request that was still
   queued when its drain hit) is retried on another replica; the request
   is only failed back to the client after every round is exhausted.
+- **circuit breaking** — a replica that keeps failing dispatches
+  (``breaker_threshold`` consecutive) trips its breaker and is skipped
+  even while its ``/healthz`` still answers 200 (the sick-but-alive
+  case: 500s out of a live process).  After ``breaker_cooldown`` the
+  breaker goes HALF-OPEN: exactly one probe dispatch is allowed through;
+  success closes it, failure re-opens with the cooldown doubled (capped).
+- **retry budget** — retries draw from a token bucket refilled by
+  first-attempt traffic (``retry_budget_ratio`` per dispatch, capped at
+  ``retry_budget_cap``): when the whole fleet is failing, the router
+  stops amplifying load instead of DDoS'ing its own sick replicas.
+- **429-aware backoff** — an overloaded replica's shed (HTTP 429 from
+  the bounded admission queue) is NOT a failure: the replica stays in
+  membership and its breaker untouched; the router tries the others and,
+  if every ready replica is shedding, surfaces 429 with the largest
+  ``Retry-After`` — clients slow down, the fleet degrades gracefully.
+- **idempotent dispatch** — every dispatch carries an
+  ``idempotency_key`` (caller-supplied or router-generated).  The
+  replica de-duplicates on it, so a retry after an AMBIGUOUS failure —
+  a socket that died after the request may have been delivered, or a
+  router-side timeout on a wedged replica — can join the original
+  in-flight generation instead of producing a second one.  This is what
+  makes timeouts retry-elsewhere-safe (previously they had to surface
+  as 504 precisely because a retry could double-generate).
 
 The router dispatches ``POST /generate`` (the endpoint
 ``init_serving(metrics_port=...)`` attaches to the replica's metrics
@@ -39,6 +62,7 @@ standalone on an operator box with no jax installed.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import sys
@@ -78,7 +102,20 @@ __all__ = ["Replica", "Router", "RouterServer"]
 
 
 class Replica:
-    """One backend ServingEngine endpoint and the router's view of it."""
+    """One backend ServingEngine endpoint and the router's view of it:
+    membership/load from the polls, plus a per-replica CIRCUIT BREAKER
+    over dispatch outcomes (closed -> open on consecutive failures ->
+    half-open single probe after the cooldown -> closed on success /
+    re-open with doubled cooldown on failure)."""
+
+    # breaker state is written from every dispatch thread AND the poll
+    # thread; all transitions hold the replica's own lock (dslint
+    # DSL006, docs/LINT.md)
+    _dslint_shared = {"fail_streak": "lock:_lock",
+                      "breaker_open_until": "lock:_lock",
+                      "breaker_trips": "lock:_lock",
+                      "_cooldown": "lock:_lock",
+                      "_probe_inflight": "lock:_lock"}
 
     def __init__(self, name: str, base_url: str):
         self.name = name
@@ -92,6 +129,12 @@ class Replica:
         self.kv_busy = 0.0           # pages_used / (used + free), in [0, 1]
         self.inflight = 0            # router-side: dispatches awaiting reply
         self.last_poll = 0.0
+        self._lock = threading.Lock()
+        self.fail_streak = 0         # consecutive dispatch failures
+        self.breaker_open_until = 0.0    # monotonic; 0 = closed
+        self.breaker_trips = 0
+        self._cooldown = 0.0         # current trip's cooldown (doubles)
+        self._probe_inflight = False     # half-open: one probe at a time
 
     def score(self) -> float:
         """Lower = less loaded.  Whole requests in the system dominate;
@@ -100,12 +143,69 @@ class Replica:
         return (self.queue_depth + self.active_slots + self.inflight
                 + min(self.kv_busy, 0.99))
 
+    # -- circuit breaker ------------------------------------------------
+    def breaker_state(self, now: float) -> str:
+        until = self.breaker_open_until
+        if until <= 0:
+            return "closed"
+        return "open" if now < until else "half-open"
+
+    def try_probe(self, now: float) -> bool:
+        """Half-open admission: exactly ONE probe dispatch may pass per
+        half-open window; its outcome closes or re-opens the breaker."""
+        with self._lock:
+            if self.breaker_open_until <= 0:
+                return True              # closed: not a probe
+            if now < self.breaker_open_until or self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def note_success(self) -> None:
+        with self._lock:
+            self.fail_streak = 0
+            self.breaker_open_until = 0.0
+            self._cooldown = 0.0
+            self._probe_inflight = False
+
+    def release_probe(self) -> None:
+        """Give back a half-open probe reservation whose dispatch ended
+        INCONCLUSIVELY (429 shed, 400, replica 504, retry budget dry):
+        neither success nor failure, so the breaker state is untouched —
+        but the reservation must free or no probe can ever run again."""
+        with self._lock:
+            self._probe_inflight = False
+
+    def note_failure(self, now: float, threshold: int,
+                     cooldown_base: float, cooldown_max: float) -> bool:
+        """One dispatch failure; returns True when it TRIPS the breaker
+        (first trip at ``threshold`` consecutive failures; a failed
+        half-open probe re-trips immediately with the cooldown doubled,
+        capped at ``cooldown_max``)."""
+        with self._lock:
+            self.fail_streak += 1
+            probe_failed = self._probe_inflight
+            self._probe_inflight = False
+            if probe_failed or (self.fail_streak >= threshold
+                                and self.breaker_open_until <= 0):
+                self._cooldown = (cooldown_base if self._cooldown <= 0
+                                  else min(cooldown_max,
+                                           self._cooldown * 2))
+                self.breaker_open_until = now + self._cooldown
+                self.breaker_trips += 1
+                return True
+            return False
+
     def snapshot(self) -> Dict[str, object]:
+        now = time.monotonic()
         return {"name": self.name, "base": self.base, "ready": self.ready,
                 "reason": self.reason, "queue_depth": self.queue_depth,
                 "active_slots": self.active_slots,
                 "kv_busy": round(self.kv_busy, 4),
-                "inflight": self.inflight, "score": round(self.score(), 4)}
+                "inflight": self.inflight, "score": round(self.score(), 4),
+                "breaker": self.breaker_state(now),
+                "breaker_trips": self.breaker_trips,
+                "fail_streak": self.fail_streak}
 
 
 class Router:
@@ -118,11 +218,19 @@ class Router:
     ``/statz``; ``start()`` polls on a background thread.
     """
 
+    # the retry-budget token bucket is drawn on by every dispatch
+    # thread: all writes hold the router lock (dslint DSL006)
+    _dslint_shared = {"_retry_tokens": "lock:_lock"}
+
     def __init__(self, replicas: List[str], *, poll_interval: float = 0.25,
                  poll_timeout: float = 2.0, affinity_ttl: float = 300.0,
                  max_sessions: int = 65536, dispatch_rounds: int = 8,
                  retry_backoff: float = 0.05,
-                 request_timeout: float = 300.0, registry=None):
+                 request_timeout: float = 300.0,
+                 breaker_threshold: int = 3, breaker_cooldown: float = 2.0,
+                 breaker_cooldown_max: float = 30.0,
+                 retry_budget_ratio: float = 0.25,
+                 retry_budget_cap: float = 16.0, registry=None):
         self.replicas: List[Replica] = []
         for i, spec in enumerate(replicas):
             name, sep, rest = spec.partition("=")
@@ -142,6 +250,20 @@ class Router:
         self.dispatch_rounds = int(dispatch_rounds)
         self.retry_backoff = float(retry_backoff)
         self.request_timeout = float(request_timeout)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown = float(breaker_cooldown)
+        self.breaker_cooldown_max = float(breaker_cooldown_max)
+        # gRPC-style retry budget: first-attempt traffic refills the
+        # bucket at retry_budget_ratio per dispatch; each re-POST costs
+        # one token — a fleet-wide outage throttles the router's own
+        # retry amplification to ~ratio x offered load
+        self.retry_budget_ratio = float(retry_budget_ratio)
+        self.retry_budget_cap = float(retry_budget_cap)
+        self._retry_tokens = self.retry_budget_cap
+        # idempotency keys: unique per logical dispatch across router
+        # restarts (pid + start-stamp prefix, counter suffix)
+        self._idem_prefix = f"rt-{os.getpid():x}-{int(time.time() * 1e3):x}"
+        self._idem_seq = itertools.count()
         self._affinity: Dict[str, Tuple[str, float]] = {}
         self._lock = threading.Lock()
         self._poll_thread: Optional[threading.Thread] = None
@@ -164,6 +286,25 @@ class Router:
                 "last-polled ds_serve_queue_depth, by replica",
                 labels={"replica": r.name})
             for r in self.replicas}
+        self._m_breaker_trips = self.registry.counter(
+            "ds_router_breaker_trips_total",
+            "circuit-breaker trips (open/re-open) across replicas")
+        self._m_breaker_open = {
+            r.name: self.registry.gauge(
+                "ds_router_breaker_open",
+                "1 while the replica's circuit breaker is open or "
+                "half-open, by replica",
+                labels={"replica": r.name})
+            for r in self.replicas}
+        self._m_budget_exhausted = self.registry.counter(
+            "ds_router_retry_budget_exhausted_total",
+            "retries suppressed because the retry-budget token bucket "
+            "was empty (sick-fleet retry-amplification guard)")
+        self._m_shed_429 = self.registry.counter(
+            "ds_router_shed_429_total",
+            "dispatches answered 429 by an overloaded replica's "
+            "admission shed (not a failure: membership/breaker "
+            "untouched, backoff honored)")
 
     # -- membership + load polling -------------------------------------
     def poll_one(self, rep: Replica) -> None:
@@ -240,20 +381,47 @@ class Router:
              exclude: Tuple[str, ...] = ()) -> Optional[Replica]:
         """Session-affine when possible (prefix-cache locality), else the
         lowest-score ready replica (name as the deterministic final
-        tie-break)."""
+        tie-break).  Breaker-open replicas are skipped; when only
+        half-open replicas remain, the best-scored one admits a single
+        probe.  A session pinned to a replica that LEFT membership
+        (crash — a clean drain pops the pin at dispatch) falls back to
+        least-loaded immediately AND drops the pin, so the conversation
+        re-pins to the fallback replica — its prefix pages warm THERE,
+        and the session must not bounce back to the cold original when
+        it rejoins inside the affinity TTL."""
         now = time.monotonic()
         ready = [r for r in self.replicas
                  if r.ready and r.name not in exclude]
-        if not ready:
-            return None
         if session is not None:
             with self._lock:
                 ent = self._affinity.get(session)
             if ent is not None and now - ent[1] < self.affinity_ttl:
                 rep = self._by_name.get(ent[0])
-                if rep is not None and rep.ready and rep.name not in exclude:
+                usable = (rep is not None and rep.ready
+                          and rep.breaker_state(now) == "closed")
+                if usable and rep.name not in exclude:
                     return rep
-        return min(ready, key=lambda r: (r.score(), r.name))
+                if not usable:
+                    # pinned replica crashed / tripped its breaker: unpin
+                    # so the dispatch below re-pins to where it actually
+                    # lands.  A pin that is merely EXCLUDED this round
+                    # (e.g. it answered one transient 429) is kept — the
+                    # session returns to its warm prefix pages next time
+                    with self._lock:
+                        if self._affinity.get(session) is ent:
+                            del self._affinity[session]
+        # least-loaded over closed replicas AND half-open probes: a
+        # cooled-down replica re-enters the ordering by score (it has no
+        # inflight, so it naturally reaches the front) and admits ONE
+        # probe — whose outcome closes or re-opens its breaker; open /
+        # probe-busy replicas are skipped
+        for rep in sorted(ready, key=lambda r: (r.score(), r.name)):
+            state = rep.breaker_state(now)
+            if state == "closed":
+                return rep
+            if state == "half-open" and rep.try_probe(now):
+                return rep
+        return None
 
     def _post(self, rep: Replica, payload: dict) -> Tuple[int, dict]:
         import urllib.error
@@ -282,17 +450,61 @@ class Router:
             except Exception:
                 return exc.code, {"error": f"replica returned {exc.code}"}
 
+    def _take_retry_token(self) -> bool:
+        """One retry's withdrawal from the budget bucket; False = the
+        bucket is dry and the retry must be suppressed (a fleet where
+        everything fails must not be hammered at rounds x offered
+        load by its own router)."""
+        with self._lock:
+            if self._retry_tokens >= 1.0:
+                self._retry_tokens -= 1.0
+                return True
+        self._m_budget_exhausted.inc()
+        return False
+
     def dispatch(self, payload: dict) -> Tuple[int, dict]:
         """Route one ``/generate`` payload: pick → POST → retry elsewhere
         on failure.  Returns ``(status, body)``; 200 bodies carry the
-        serving replica's name under ``"replica"``.  A request is only
-        failed (503) after ``dispatch_rounds`` picks found no replica
-        that would take it — drain-aware redistribution means a replica
-        draining mid-request hands its queued-never-admitted requests
-        back as 503s, and they land here for a second life elsewhere."""
+        serving replica's name under ``"replica"``.
+
+        Every dispatch carries an ``idempotency_key`` (the caller's, or
+        one minted here): replicas de-duplicate on it, so retries after
+        AMBIGUOUS failures — a socket death after the request may have
+        been delivered, a router-side timeout on a wedged replica —
+        cannot double-generate (they join the original in-flight
+        request).  Failure handling per status:
+
+        - ``-1`` unreachable / socket timeout: membership drop + breaker
+          count + retry elsewhere (timeouts are retry-safe now — the
+          historical 504-no-retry existed exactly because a retry could
+          double-generate);
+        - ``5xx``: breaker count + retry elsewhere (a 500-ing replica
+          whose /healthz still answers 200 trips its breaker and is
+          skipped until the half-open probe heals it);
+        - ``429`` shed: NOT a failure — membership and breaker untouched,
+          retry the others; when every ready replica is shedding, 429
+          surfaces to the client with the largest ``Retry-After``;
+        - ``504`` from the replica itself (client/service deadline):
+          authoritative, surfaced, never retried.
+
+        Retries draw from the budget bucket; an empty bucket fails the
+        request with what the last replica said instead of amplifying."""
         session = payload.get("session")
+        payload = dict(payload)
+        if not payload.get("idempotency_key"):
+            payload["idempotency_key"] = \
+                f"{self._idem_prefix}-{next(self._idem_seq)}"
+        with self._lock:
+            # first-attempt traffic refills the retry budget
+            self._retry_tokens = min(self.retry_budget_cap,
+                                     self._retry_tokens
+                                     + self.retry_budget_ratio)
         last_err: Optional[dict] = None
+        shed_backoffs: List[float] = []
+        non_shed_failures = 0
+        budget_dry = False
         tried: set = set()
+        posts = 0
         for attempt in range(self.dispatch_rounds):
             rep = self.pick(session=session, exclude=tuple(tried))
             if rep is None and tried:
@@ -304,56 +516,106 @@ class Router:
                 self.refresh()
                 time.sleep(self.retry_backoff * (attempt + 1))
                 continue
+            if posts >= 1 and not self._take_retry_token():
+                # a pick() may have reserved this replica's half-open
+                # probe: hand it back, the probe never ran
+                rep.release_probe()
+                budget_dry = True
+                break
+            posts += 1
             with self._lock:
                 rep.inflight += 1
             try:
                 try:
                     code, body = self._post(rep, payload)
                 except OSError as exc:
-                    # a TIMEOUT is not "unreachable": the replica may
-                    # still be mid-generation, and re-dispatching would
-                    # double-generate the prompt — surface it like the
-                    # replica's own 504 (no retry); genuine connection
-                    # failures fall through to retry-elsewhere
                     reason = getattr(exc, "reason", exc)
                     if isinstance(exc, TimeoutError) or isinstance(
                             reason, TimeoutError):
-                        return 504, {"error": "router-side timeout; the "
-                                              "replica may still be "
-                                              "generating (not retried)",
-                                     "replica": rep.name}
-                    code, body = -1, {"error": f"unreachable: {exc}"}
+                        # ambiguous — the replica may be wedged holding
+                        # our request; the idempotency key makes the
+                        # retry elsewhere safe, and the breaker keeps us
+                        # from feeding the wedged replica more work
+                        code, body = -1, {
+                            "error": "router-side socket timeout "
+                                     "(replica wedged?); retrying "
+                                     "idempotently"}
+                    else:
+                        code, body = -1, {"error": f"unreachable: {exc}"}
             finally:
                 with self._lock:
                     rep.inflight -= 1
+            now = time.monotonic()
             if code == 200:
+                rep.note_success()
+                self._m_breaker_open[rep.name].set(0)
                 self._m_dispatch[rep.name].inc()
                 if session is not None:
                     with self._lock:
-                        self._affinity[session] = (rep.name,
-                                                   time.monotonic())
+                        self._affinity[session] = (rep.name, now)
                     if len(self._affinity) > self.max_sessions:
                         self._expire_affinity()
                 body["replica"] = rep.name
                 return 200, body
             if code == 400:
                 # the payload itself is bad — no replica will differ
+                rep.release_probe()
                 return 400, body
+            if code == 429:
+                # overload shed: graceful degradation, not a failure —
+                # the replica stays in membership with its breaker
+                # untouched (a half-open probe reservation is released,
+                # not resolved); try the rest of the fleet
+                rep.release_probe()
+                self._m_shed_429.inc()
+                try:
+                    shed_backoffs.append(
+                        float(body.get("retry_after_s", 1.0)))
+                except (TypeError, ValueError):
+                    shed_backoffs.append(1.0)
+                tried.add(rep.name)
+                last_err = body
+                continue
             if code == 504:
-                # the replica timed out mid-generation: re-dispatching
-                # could double-generate; surface it
+                # the replica's own deadline verdict (client timeout
+                # abort or service-deadline expiry): too late everywhere
+                rep.release_probe()
                 body["replica"] = rep.name
                 return 504, body
-            # -1 (unreachable) / 503 (draining or requeued): take the
-            # replica out until the next healthz poll and retry elsewhere
-            rep.ready = False
-            rep.reason = body.get("error") or f"generate -> {code}"
+            # -1 (unreachable/timeout) / 5xx / 503 (draining, requeued,
+            # crash-requeued): count it on the breaker and retry
+            non_shed_failures += 1
+            if rep.note_failure(now, self.breaker_threshold,
+                                self.breaker_cooldown,
+                                self.breaker_cooldown_max):
+                self._m_breaker_trips.inc()
+            self._m_breaker_open[rep.name].set(
+                0 if rep.breaker_state(now) == "closed" else 1)
+            if code in (-1, 503):
+                # gone or draining: out of membership until the next
+                # healthz poll; 500-class replicas stay (healthz is the
+                # membership truth — the breaker is what skips them)
+                rep.ready = False
+                rep.reason = body.get("error") or f"generate -> {code}"
             if session is not None:
                 with self._lock:
                     self._affinity.pop(session, None)
             self._m_retries.inc()
             tried.add(rep.name)
             last_err = body
+        if shed_backoffs and non_shed_failures == 0:
+            # the whole ready fleet is load-shedding: tell the client to
+            # back off (RouterServer forwards Retry-After), don't call
+            # an overloaded fleet an outage
+            return 429, {"error": "every ready replica is shedding "
+                                  "(admission queues at their "
+                                  "watermark); back off and retry",
+                         "shed": True,
+                         "retry_after_s": max(shed_backoffs)}
+        if budget_dry:
+            return 503, {"error": "retry budget exhausted (fleet-wide "
+                                  "failures; not amplifying)",
+                         "last": last_err}
         return 503, {"error": "no replica accepted the request after "
                               f"{self.dispatch_rounds} rounds",
                      "last": last_err}
@@ -379,7 +641,8 @@ class Router:
     def snapshot(self) -> Dict[str, object]:
         return {"replicas": [r.snapshot() for r in self.replicas],
                 "ready": sum(1 for r in self.replicas if r.ready),
-                "sessions": len(self._affinity)}
+                "sessions": len(self._affinity),
+                "retry_tokens": round(self._retry_tokens, 2)}
 
 
 # ---------------------------------------------------------------------------
@@ -395,6 +658,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if code == 429 and isinstance(payload, dict) \
+                and payload.get("retry_after_s") is not None:
+            # the shed contract end to end: replicas 429 the router, the
+            # router 429s the client, both with a Retry-After
+            self.send_header("Retry-After",
+                             str(max(1, int(payload["retry_after_s"]))))
         self.end_headers()
         self.wfile.write(body)
 

@@ -41,6 +41,19 @@ RUNNING = "running"        # decoding
 FINISHED = "finished"
 
 
+class QueueFull(RuntimeError):
+    """Admission-control shed: the bounded wait queue is at its
+    watermark, so this submit is REFUSED instead of queued (graceful
+    degradation — an unbounded queue turns overload into unbounded
+    latency for everyone, docs/RESILIENCE.md "Serving fleet").  The HTTP
+    surface maps it to ``429 Too Many Requests`` with a ``Retry-After``;
+    the router backs off and tries another replica."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 @dataclass
 class Request:
     """One generation request and its lifecycle bookkeeping."""
@@ -61,6 +74,10 @@ class Request:
     t_admit: float = 0.0                # slot assignment (queue wait ends)
     t_first_token: float = 0.0
     t_finish: float = 0.0
+    # absolute service deadline (perf_counter clock; 0 = none): a request
+    # still QUEUED past it is cancelled with reason "deadline" instead of
+    # burning a slot on an answer nobody is waiting for
+    deadline: float = 0.0
     finish_reason: str = ""             # "eos" | "length" | "cache_budget"
     # which bound produced the engine's position limit (min of request
     # budget and cache budget) — recorded WHERE the limit is computed so
@@ -115,10 +132,16 @@ class IterationScheduler:
     not submit time — early-EOS rows drain first).
     """
 
-    def __init__(self, num_slots: int, registry=None):
+    def __init__(self, num_slots: int, registry=None,
+                 max_queue_depth: int = 0,
+                 shed_retry_after_s: float = 1.0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
+        # admission control (0 = unbounded, the pre-overload-protection
+        # behavior): submits past the watermark shed with QueueFull
+        self.max_queue_depth = int(max_queue_depth)
+        self.shed_retry_after_s = float(shed_retry_after_s)
         self._queue: Deque[Request] = deque()
         self._slots: List[Optional[Request]] = [None] * num_slots
         self.finished: List[Request] = []
@@ -152,10 +175,26 @@ class IterationScheduler:
                            "finished requests by reason",
                            labels={"reason": r})
             for r in ("eos", "length", "cache_budget", "cancelled",
-                      "unknown")}
+                      "deadline", "unknown")}
+        self._m_shed = reg.counter(
+            "ds_serve_shed_total",
+            "submits refused by the bounded admission queue (429)")
+        self._m_deadline = reg.counter(
+            "ds_serve_deadline_expired_total",
+            "queued requests cancelled past their service deadline")
 
     # -- admission -----------------------------------------------------
     def submit(self, req: Request) -> Request:
+        if self.max_queue_depth > 0 \
+                and len(self._queue) >= self.max_queue_depth:
+            # shed at the watermark: refusing NOW (the caller 429s and
+            # the router goes elsewhere) beats queueing work this replica
+            # cannot start before everyone's latency blows out
+            self._m_shed.inc()
+            raise QueueFull(
+                f"admission queue full ({len(self._queue)} >= "
+                f"max_queue_depth={self.max_queue_depth}); shedding",
+                retry_after_s=self.shed_retry_after_s)
         if req.request_id < 0:
             req.request_id = next(self._ids)
         req.state = QUEUED
@@ -179,9 +218,44 @@ class IterationScheduler:
     def resume_admission(self) -> None:
         self.admission_paused = False
 
+    def expire_deadlines(self, now: Optional[float] = None) -> List[Request]:
+        """Cancel every QUEUED request whose service deadline has passed
+        (reason ``deadline``) — starting work nobody is still waiting for
+        wastes the slot AND delays requests that can still make theirs.
+        Runs at the top of every :meth:`admit`; bounded by the queue
+        depth (itself bounded by ``max_queue_depth`` when shedding is
+        on).  Thread-safe against concurrent HTTP-thread ``submit``/
+        ``cancel``: the scan walks a GIL-atomic ``list()`` snapshot
+        (iterating the live deque raises on concurrent appends), and
+        each removal goes through ``deque.remove`` (raising = lost
+        race, same as cancel)."""
+        now = time.perf_counter() if now is None else now
+        expired = [r for r in list(self._queue) if 0 < r.deadline < now]
+        out = []
+        for req in expired:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                continue                 # admitted/cancelled concurrently
+            req.state = FINISHED
+            req.finish_reason = "deadline"
+            req.t_finish = now
+            self._tracer.finish(req.request_id, now, "deadline", 0)
+            if self._flight.enabled:
+                self._flight.record("serve_deadline", rid=req.request_id)
+            self._m_finished["deadline"].inc()
+            self._m_deadline.inc()
+            out.append(req)
+        if out:
+            self._m_queue_depth.set(len(self._queue))
+        return out
+
     def admit(self) -> List[Request]:
         """Assign free slots to the oldest queued requests (FIFO); returns
-        the newly-admitted requests, now in PREFILLING state."""
+        the newly-admitted requests, now in PREFILLING state.  Queued
+        requests past their deadline are expired first — they never take
+        a slot."""
+        self.expire_deadlines()
         if self.admission_paused:
             return []
         admitted = []

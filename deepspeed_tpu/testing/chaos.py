@@ -22,6 +22,28 @@ injectable, deterministic primitives:
 - :func:`fail_after_calls` — an exception out of the Nth call of any
   method ("exception mid-step").
 
+Serving-fleet faults (the chaos-harness vocabulary behind
+docs/RESILIENCE.md "Serving fleet"):
+
+- :func:`crash_on_call` — raise out of exactly the Nth call, pass
+  through before AND after: "the serving loop dies mid-trace, then the
+  supervisor restarts it" needs the method working again post-kill,
+  which :func:`fail_after_calls` (fails forever after N) cannot model.
+- :func:`wedge_method` — the Nth call BLOCKS until released: a hung
+  serving loop / wedged replica (alive, answering nothing) rather than
+  a dead one.
+- :func:`http_error_burst` — wrap a ``(payload) -> (status, body)``
+  HTTP handler to answer 500 for its next N calls (inject 500s at the
+  replica's ``/generate`` seam without touching the engine).
+- :class:`ChaosProxy` — a runtime-switchable TCP proxy for the network
+  fault vocabulary between a router and a replica: ``pass`` /
+  ``refuse`` (connection dies at accept) / ``blackhole`` (accepts and
+  never answers — the ambiguous client-side timeout) /
+  ``deliver_then_reset`` (forwards the request, lets the replica DO the
+  work, then tears the client connection down before the response — the
+  ambiguous socket death that makes non-idempotent retries
+  double-generate) / ``slow`` (drips bytes).
+
 Process-level faults (SIGKILL between incarnations, SIGTERM grace
 windows) are exercised by the supervisor tests via real subprocesses;
 this module covers the intra-process byte-level vocabulary those cannot
@@ -34,11 +56,16 @@ from __future__ import annotations
 
 import builtins
 import os
+import socket
+import threading
+import time
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, Optional
 
 __all__ = ["InjectedFault", "crash_on_write", "crash_before",
-           "fail_after_calls", "truncate_file", "flip_bit"]
+           "fail_after_calls", "truncate_file", "flip_bit",
+           "crash_on_call", "wedge_method", "http_error_burst",
+           "ChaosProxy"]
 
 
 class InjectedFault(RuntimeError):
@@ -160,6 +187,249 @@ def truncate_file(path: str, drop_bytes: int = 1) -> int:
     with open(path, "rb+") as fh:
         fh.truncate(new)
     return new
+
+
+@contextmanager
+def crash_on_call(obj: Any, method: str, n: int) -> Iterator[Dict[str, int]]:
+    """Raise :class:`InjectedFault` out of exactly the ``n``-th call of
+    ``obj.method`` (1-based); calls before AND after pass through.  The
+    kill-then-restart probe: a serving loop crashed by call ``n`` can be
+    revived inside the same context and step again — which
+    :func:`fail_after_calls` (fails forever past N) cannot model."""
+    real = getattr(obj, method)
+    state = {"calls": 0}
+
+    def wrapped(*args, **kwargs):
+        state["calls"] += 1
+        if state["calls"] == n:
+            raise InjectedFault(
+                f"injected crash on call {n} of {method}")
+        return real(*args, **kwargs)
+
+    setattr(obj, method, wrapped)
+    try:
+        yield state
+    finally:
+        setattr(obj, method, real)
+
+
+@contextmanager
+def wedge_method(obj: Any, method: str,
+                 on_call: int = 1) -> Iterator[Dict[str, Any]]:
+    """From its ``on_call``-th call (1-based), ``obj.method`` BLOCKS until
+    the yielded handle's ``release`` event is set — a hung (wedged)
+    component rather than a dead one.  The handle: ``{"release": Event,
+    "wedged": Event, "calls": int}``; exiting the context releases and
+    restores.  Earlier calls pass through."""
+    real = getattr(obj, method)
+    handle: Dict[str, Any] = {"release": threading.Event(),
+                              "wedged": threading.Event(), "calls": 0}
+
+    def wrapped(*args, **kwargs):
+        handle["calls"] += 1
+        if handle["calls"] >= on_call:
+            handle["wedged"].set()
+            handle["release"].wait()
+        return real(*args, **kwargs)
+
+    setattr(obj, method, wrapped)
+    try:
+        yield handle
+    finally:
+        handle["release"].set()
+        setattr(obj, method, real)
+
+
+def http_error_burst(handler, n: int, code: int = 500):
+    """Wrap a ``(payload) -> (status, body)`` HTTP handler (the replica's
+    ``/generate`` seam) so its next ``n`` calls answer ``code`` with an
+    injected-error body, then pass through.  Returns ``(wrapped,
+    state)``; ``state["errors"]`` counts the faults served."""
+    state = {"left": int(n), "errors": 0}
+
+    def wrapped(payload):
+        if state["left"] > 0:
+            state["left"] -= 1
+            state["errors"] += 1
+            return code, {"error": f"injected {code} "
+                                   f"({state['errors']}/{n})"}
+        return handler(payload)
+
+    return wrapped, state
+
+
+class ChaosProxy:
+    """Runtime-switchable TCP fault proxy (router <-> replica seam).
+
+    ``ChaosProxy(upstream_port).start()`` listens on an ephemeral
+    ``proxy.port``; each ACCEPTED connection obeys the mode at accept
+    time (flip ``proxy.mode`` between requests):
+
+    - ``"pass"`` — transparent byte pump both ways;
+    - ``"refuse"`` — the connection dies immediately (unambiguous
+      failure: nothing was delivered);
+    - ``"blackhole"`` — accepted and held silent, never answered (the
+      client times out; ambiguous, nothing delivered);
+    - ``"deliver_then_reset"`` — the request is forwarded and the
+      upstream DOES the work, but the client connection is torn down the
+      moment the response starts back: the ambiguous socket death after
+      delivery — the retry that double-generates unless dispatch is
+      idempotent;
+    - ``"slow"`` — both directions drip in small chunks with a delay
+      per chunk (``slow_delay``).
+
+    ``counts`` tallies connections per mode.  ``stop()`` closes the
+    listener and every held/open connection."""
+
+    def __init__(self, upstream_port: int, upstream_host: str = "127.0.0.1",
+                 mode: str = "pass", slow_delay: float = 0.05,
+                 slow_chunk: int = 256):
+        self.upstream = (upstream_host, int(upstream_port))
+        self.mode = mode
+        self.slow_delay = float(slow_delay)
+        self.slow_chunk = int(slow_chunk)
+        self.counts: Dict[str, int] = {}
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._conns = []
+        self._once = []          # one-shot modes consumed before self.mode
+        self._lock = threading.Lock()
+        self.port = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def inject(self, mode: str, n: int = 1) -> None:
+        """Queue ``n`` one-shot faults: the next ``n`` accepted
+        connections get ``mode``, later ones fall back to ``self.mode``
+        — a single ambiguous socket death in an otherwise-clean stream,
+        without racing a mode flip against the victim's connect."""
+        with self._lock:
+            self._once.extend([mode] * int(n))
+
+    def start(self) -> "ChaosProxy":
+        if self._listener is not None:
+            return self
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="chaos-proxy", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._listener = None
+
+    def _track(self, *socks) -> None:
+        with self._lock:
+            self._conns.extend(socks)
+
+    def _accept_loop(self) -> None:
+        # local ref: stop() nulls the attribute concurrently — the
+        # closed socket raises OSError below, an attribute read of None
+        # would raise AttributeError out of the daemon thread instead
+        listener = self._listener
+        while not self._stopping:
+            try:
+                client, _addr = listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                mode = self._once.pop(0) if self._once else self.mode
+                self.counts[mode] = self.counts.get(mode, 0) + 1
+            if mode == "refuse":
+                client.close()
+                continue
+            if mode == "blackhole":
+                self._track(client)      # held open, never answered
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=10)
+            except OSError:
+                client.close()
+                continue
+            # (pass-mode sockets are closed by the pump countdown below;
+            # only held blackhole sockets need stop()-time tracking)
+            # both pumps share one countdown: sockets are CLOSED exactly
+            # once, by whichever pump finishes LAST.  A pump closing both
+            # sockets on its own EOF (the obvious implementation) races
+            # its twin's blocked recv across threads — and once the freed
+            # fd is reused by a new connection, a stale recv can STEAL
+            # that connection's bytes (observed: a replica's 200 response
+            # vanished mid-proxy and the router hung to its socket
+            # deadline).  Mid-stream teardown uses shutdown(), which
+            # never frees the fd out from under the twin.
+            pair = {"left": 2, "lock": threading.Lock(),
+                    "socks": (client, up)}
+            threading.Thread(target=self._pump,
+                             args=(pair, client, up, mode, True),
+                             daemon=True).start()
+            threading.Thread(target=self._pump,
+                             args=(pair, up, client, mode, False),
+                             daemon=True).start()
+
+    @staticmethod
+    def _pair_done(pair) -> None:
+        with pair["lock"]:
+            pair["left"] -= 1
+            last = pair["left"] == 0
+        if last:
+            for s in pair["socks"]:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _pump(self, pair, src: socket.socket, dst: socket.socket, mode: str,
+              client_to_up: bool) -> None:
+        chunk = self.slow_chunk if mode == "slow" else 65536
+        try:
+            while True:
+                data = src.recv(chunk)
+                if not data:
+                    break
+                if mode == "deliver_then_reset" and not client_to_up:
+                    # the upstream answered: the work is DONE there —
+                    # kill the client connection without delivering a
+                    # byte (shutdown, not close: the fd must stay owned
+                    # until both pumps retire)
+                    try:
+                        dst.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    break
+                dst.sendall(data)
+                if mode == "slow":
+                    time.sleep(self.slow_delay)
+        except OSError:
+            pass
+        finally:
+            # propagate EOF to the write side only; the twin pump keeps
+            # the reverse direction alive (an HTTP client half-closing
+            # after its request must still receive the response)
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            self._pair_done(pair)
 
 
 def flip_bit(path: str, byte_offset: Optional[int] = None,

@@ -1,0 +1,37 @@
+"""Fleet chaos benchmark rung (slow): the bimodal trace through the
+router over two replicas, clean vs replica-kill+supervisor-restart
+mid-trace (``bench.bench_fleet_chaos``).  Marked ``slow`` — runs under
+``make chaos``, outside tier-1; the fast tier-1 chaos coverage is
+``tests/unit/test_serving_chaos.py``.  On the CPU mesh this validates
+the scenario mechanics and the exactly-once/token-identity acceptance
+bits; the goodput-retention number is a TPU row."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_fleet_chaos_bench_scenario(capsys):
+    from bench import bench_fleet_chaos
+
+    out = bench_fleet_chaos(num_requests=12, tiny=True)
+    # the acceptance bits: zero drops / zero duplicates / greedy outputs
+    # unchanged, on BOTH sides — and the chaos side really was chaotic
+    assert out["answered_exactly_once"] is True
+    assert out["outputs_token_identical"] is True
+    assert out["restarts_observed"] >= 1, \
+        "the kill+restart never happened; the chaos side measured nothing"
+    assert out["clean"]["goodput_tok_s"] > 0
+    assert out["chaos"]["goodput_tok_s"] > 0
+    assert out["clean"]["shed_429"] + out["clean"]["answered"] == 12
+    assert out["chaos"]["shed_429"] + out["chaos"]["answered"] == 12
+    assert out["goodput_retention"] > 0
+    with capsys.disabled():
+        print(f"\nfleet chaos bench (tiny/CPU): retention "
+              f"{out['goodput_retention']}x, chaos TTFT p99 "
+              f"{out['ttft_p99_chaos_s']}s vs clean "
+              f"{out['ttft_p99_clean_s']}s, "
+              f"{out['restarts_observed']} restart(s), "
+              f"{out['chaos']['shed_429']} shed")

@@ -352,7 +352,15 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
                   "host_tier_serving": {
                       "hit_ratio_on": 0.61, "hit_ratio_off": 0.42,
                       "outputs_token_identical": True, "demotes": 6,
-                      "promotes": 5, "goodput_speedup": 1.1}}}
+                      "promotes": 5, "goodput_speedup": 1.1},
+                  "fleet_chaos": {
+                      "goodput_retention": 0.83,
+                      "clean": {"goodput_tok_s": 120.0, "shed_429": 0},
+                      "chaos": {"goodput_tok_s": 99.6, "shed_429": 2},
+                      "ttft_p99_clean_s": 0.05, "ttft_p99_chaos_s": 0.4,
+                      "restarts_observed": 1,
+                      "answered_exactly_once": True,
+                      "outputs_token_identical": True}}}
     lines = bench.summary_lines(record, None)
     parsed = json.loads(lines[-1])
     st = parsed["streamed_offload"]
@@ -364,6 +372,14 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
     assert ht["hit_ratio_on"] == 0.61 and ht["hit_ratio_off"] == 0.42
     assert ht["outputs_token_identical"] is True
     assert ht["demotes"] == 6 and ht["promotes"] == 5
+    # the ISSUE 13 fleet-chaos acceptance row rides BENCH_JSON
+    fc = parsed["fleet_chaos"]
+    assert fc["goodput_retention"] == 0.83
+    assert fc["goodput_clean_tok_s"] == 120.0
+    assert fc["goodput_chaos_tok_s"] == 99.6
+    assert fc["restarts_observed"] == 1 and fc["shed_429"] == 2
+    assert fc["answered_exactly_once"] is True
+    assert fc["outputs_token_identical"] is True
     # bulky capture payloads never reach the final line
     assert "device_profile" not in json.dumps(parsed)
     assert lines[-2] == "BENCH_JSON: " + lines[-1]

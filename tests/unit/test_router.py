@@ -364,6 +364,51 @@ def test_loop_crash_flips_health_and_requeues(devices):
         serve.close()
 
 
+def test_affinity_crashed_replica_falls_back_and_unpins():
+    """A session pinned to a replica that left membership by CRASH (not
+    a clean drain, which pops the pin at dispatch) must fall back to
+    least-loaded IMMEDIATELY — and drop the pin, so when the crashed
+    replica rejoins inside the affinity TTL the conversation stays where
+    its prefix pages are now warm instead of bouncing back cold."""
+    import time as _time
+
+    router_tool = _tool("router")
+    a, b = router_tool._FakeReplica("a"), router_tool._FakeReplica("b")
+    try:
+        router = Router([f"a={a.url}", f"b={b.url}"],
+                        registry=MetricsRegistry().enable(),
+                        affinity_ttl=3600.0, retry_backoff=0.01)
+        router.refresh()
+        b.queue_depth = 5                 # a is least-loaded: pin lands on a
+        router.refresh()
+        code, body = router.dispatch({"prompt": [1], "max_new_tokens": 2,
+                                      "session": "conv"})
+        assert code == 200 and body["replica"] == "a"
+        assert router._affinity["conv"][0] == "a"
+        # a CRASHES (no drain; the pin is still in place when the poll
+        # notices) — the next pick must not wait out the hour-long TTL
+        a.ready, a.reason = False, None
+        a.stop()
+        router.refresh()
+        assert not router.replicas[0].ready
+        b.queue_depth = 0
+        picked = router.pick(session="conv")
+        assert picked is not None and picked.name == "b"
+        # the stale pin is GONE (dropped at pick), and serving the
+        # session re-pins it to b
+        assert "conv" not in router._affinity
+        code, body = router.dispatch({"prompt": [2], "max_new_tokens": 2,
+                                      "session": "conv"})
+        assert code == 200 and body["replica"] == "b"
+        assert router._affinity["conv"][0] == "b"
+        # a rejoining does NOT steal the session back (TTL never expired)
+        rep_a = router.replicas[0]
+        rep_a.ready = True
+        assert router.pick(session="conv").name == "b"
+    finally:
+        b.stop()
+
+
 def test_affinity_cap_actually_bounds_sessions():
     """The session map is LRU-capped for real: sustained fresh sessions
     inside the TTL cannot grow it past max_sessions (review finding: the

@@ -1,0 +1,104 @@
+"""Serving-fleet replica supervisor (tools/serve_supervisor.py) and the
+shared restart ladder (deepspeed_tpu/elasticity/supervisor.py): the
+tier-1-wired selftest (real subprocess replicas driven through kill /
+wedge / scale-out / scale-in / graceful shutdown), the fresh-interpreter
+no-jax contract, and units for the shared RestartPolicy the train and
+serve supervisors must not drift apart on."""
+
+import os
+import sys
+
+from deepspeed_tpu.elasticity.supervisor import (PREEMPT_EXIT_CODE,
+                                                 RestartPolicy)
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# the shared restart ladder (one source of truth for both supervisors)
+# ---------------------------------------------------------------------------
+
+def test_restart_policy_matches_train_supervisor_contract():
+    """The exact PR 8 TrainSupervisor ladder: crash backoff doubles from
+    backoff_base and caps at backoff_max, preempts restart free, the
+    budget counts CRASHES only, and exhaustion gives up."""
+    p = RestartPolicy(max_restarts=3, backoff_base=1.0, backoff_max=2.5)
+    assert p.decide(0) == ("done", 0.0, "completed")
+    a = p.decide(7)
+    b = p.decide(7)
+    c = p.decide(7)
+    assert (a.action, a.delay) == ("restart", 1.0)
+    assert (b.action, b.delay) == ("restart", 2.0)
+    assert (c.action, c.delay) == ("restart", 2.5)      # capped
+    assert p.backoffs == [1.0, 2.0, 2.5]
+    d = p.decide(PREEMPT_EXIT_CODE)
+    assert (d.action, d.delay, d.kind) == ("restart", 0.0, "preempt")
+    assert p.crash_restarts == 3 and p.preempt_restarts == 1
+    assert p.decide(7).action == "give_up"
+    assert p.restarts == 4                               # give_up not counted
+
+
+def test_restart_policy_healthy_reset_forgives_ladder():
+    """The serve-supervisor long-horizon mode: a replica that ran past
+    healthy_reset_s before crashing starts the ladder over — a
+    once-a-day crash cannot exhaust a lifetime budget.  ran_s below the
+    threshold keeps burning budget (crash loops still give up)."""
+    p = RestartPolicy(max_restarts=2, backoff_base=1.0,
+                      healthy_reset_s=60.0)
+    assert p.decide(9, ran_s=1.0).delay == 1.0
+    assert p.decide(9, ran_s=1.0).delay == 2.0
+    assert p.decide(9, ran_s=1.0).action == "give_up"
+    # a long healthy run resets the ladder: back to the first rung
+    d = p.decide(9, ran_s=120.0)
+    assert (d.action, d.delay) == ("restart", 1.0)
+    assert p.crash_restarts == 1
+
+
+def test_train_supervisor_exposes_shared_policy():
+    """tools/train_supervisor.py rides the SHARED module (no private
+    copy of the ladder left to drift): its counters are views of the
+    policy's."""
+    ts = _tool("train_supervisor")
+    sup = ts.TrainSupervisor([sys.executable, "-c", "pass"],
+                             max_restarts=2, backoff_base=0.5)
+    assert isinstance(sup.policy, RestartPolicy)
+    sup.policy.decide(7)
+    assert sup.restarts == 1 and sup.crash_restarts == 1
+    assert sup.backoffs == [0.5]
+
+
+# ---------------------------------------------------------------------------
+# the tool: selftest wired tier-1 + the no-jax contract
+# ---------------------------------------------------------------------------
+
+def test_serve_supervisor_tool_selftest():
+    """tools/serve_supervisor.py --selftest drives the REAL supervisor
+    over synthetic replica subprocesses: SIGKILL -> ladder restart,
+    wedge (alive-but-unresponsive) -> SIGKILL + restart, sustained
+    queue-depth scale-out, graceful drain scale-in, SIGTERM-fan-out
+    shutdown."""
+    tool = _tool("serve_supervisor")
+    assert tool.main(["serve_supervisor", "--selftest"]) == 0
+
+
+def test_serve_supervisor_runs_without_jax():
+    """The fresh-interpreter RUNTIME half of the no-jax contract (the
+    STATIC import-graph half is dslint DSL003, which now covers
+    serve_supervisor.py in JAXFREE_TOOLS)."""
+    import subprocess
+
+    script = os.path.join(_TOOLS, "serve_supervisor.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--selftest"], capture_output=True,
+        text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    assert "serve_supervisor selftest: OK" in proc.stdout

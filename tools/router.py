@@ -84,6 +84,8 @@ class _FakeReplica:
         self.active_slots = 0
         self.served: List[int] = []      # request ids this replica served
         self.requeue_next = 0            # N next /generate calls -> 503
+        self.error_next = 0              # N next /generate calls -> 500
+        self.shed_next = 0               # N next /generate calls -> 429
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -125,6 +127,15 @@ class _FakeReplica:
                     fake.requeue_next -= 1
                     self._send(503, {"error": "request requeued: replica "
                                               "draining", "requeued": True})
+                    return
+                if fake.error_next > 0:
+                    fake.error_next -= 1
+                    self._send(500, {"error": "injected 500"})
+                    return
+                if fake.shed_next > 0:
+                    fake.shed_next -= 1
+                    self._send(429, {"error": "admission queue full",
+                                     "shed": True, "retry_after_s": 0.2})
                     return
                 prompt = payload.get("prompt") or []
                 max_new = int(payload.get("max_new_tokens", 4))

@@ -1,0 +1,726 @@
+#!/usr/bin/env python
+"""Serving-fleet replica supervisor: spawn, watch, restart, and scale N
+replica processes (ROADMAP item 1's control-plane loop; docs/RESILIENCE.md
+"Serving fleet").
+
+    python tools/serve_supervisor.py --replicas 2 --base-port 9101 -- \\
+        python serve_replica.py --port {port}
+    python tools/serve_supervisor.py --selftest          # tier-1 wired
+
+Each replica is one process serving the ``init_serving(metrics_port=...)``
+surface on its assigned port (``{port}``/``{index}`` substituted into the
+command template; the child also sees ``DS_REPLICA_INDEX`` /
+``DS_REPLICA_PORT``).  The supervisor's loop, every ``--poll-interval``:
+
+- **liveness** — a replica whose process exited is restarted through the
+  SHARED restart ladder (``deepspeed_tpu/elasticity/supervisor.py``
+  ``RestartPolicy`` — the exact ``train_supervisor`` exit-code contract:
+  bounded crash restarts with exponential backoff, preempt exits restart
+  free, and ``--healthy-reset`` forgives the ladder after a long healthy
+  run so a once-a-day crash cannot exhaust a lifetime budget).
+- **wedge detection** — a process that is alive but whose ``/healthz``
+  has not ANSWERED (any status; 503-draining is an answer) for
+  ``--wedge-timeout`` seconds is wedged (serving loop hung, socket
+  black-holed): SIGKILL + crash restart.  Liveness is the HTTP server
+  answering at all — readiness (200 vs 503) is the router's concern,
+  not ours.
+- **scaling** — with ``--max-replicas`` above ``--replicas``, the
+  supervisor scrapes each ready replica's ``/statz`` and scales OUT when
+  the fleet's mean queue depth sits above ``--scale-up-queue`` (or KV
+  pool pressure above ``--kv-high``) for ``--scale-sustain`` seconds,
+  and scales IN (down to ``--min-replicas``) when it sits below
+  ``--scale-down-queue``.  Scale-in is a graceful SIGTERM: the replica
+  drains (zero-drop — the router re-dispatches its queued work) and
+  exits on its own; only past the grace window is it killed.
+- **graceful shutdown** — SIGTERM to the supervisor forwards SIGTERM to
+  every replica (drain → exit), waits out the grace window, SIGKILLs
+  stragglers, and exits without restarting anything.
+
+Zero dependencies beyond the stdlib — no jax import (the
+``fleet_dump``/``router`` rule; dslint DSL003 pins the import closure).
+``--selftest`` drives the real supervisor over synthetic stdlib replica
+processes through kill/restart, wedge detection, scale-out/in, and
+graceful shutdown; it is wired into tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+SIGTERM_GRACE_S = 30.0
+
+
+def _load_supervisor_core():
+    """The shared restart-ladder module (the ``tools/train_supervisor.py``
+    loader, verbatim): via the package when importable, else by file
+    path — no jax on an operator box."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.elasticity import supervisor
+
+        return supervisor
+    mod = sys.modules.get("_ds_supervisor_core")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "deepspeed_tpu", "elasticity", "supervisor.py")
+    spec = importlib.util.spec_from_file_location("_ds_supervisor_core", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_supervisor_core"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_core = _load_supervisor_core()
+RestartPolicy = _core.RestartPolicy
+PREEMPT_EXIT_CODE = _core.PREEMPT_EXIT_CODE
+
+
+def _http_json(url: str, timeout: float):
+    """GET ``url`` -> (status_code, parsed_json | {}); (None, {}) when the
+    endpoint did not answer at all (refused / timed out / reset)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            try:
+                return resp.status, json.load(resp)
+            except ValueError:
+                return resp.status, {}
+    except urllib.error.HTTPError as exc:
+        try:
+            return exc.code, json.load(exc)
+        except Exception:
+            return exc.code, {}
+    except OSError:
+        return None, {}
+
+
+class _Sustain:
+    """A condition must hold continuously for ``sustain_s`` before it
+    fires (scale decisions must not flap on one noisy scrape)."""
+
+    def __init__(self, sustain_s: float):
+        self.sustain_s = float(sustain_s)
+        self.since: Optional[float] = None
+
+    def update(self, cond: bool, now: float) -> bool:
+        if not cond:
+            self.since = None
+            return False
+        if self.since is None:
+            self.since = now
+        return now - self.since >= self.sustain_s
+
+
+class ReplicaHandle:
+    """One supervised replica slot: its process, its restart ladder, and
+    the supervisor's last view of its health/load."""
+
+    RUNNING = "running"
+    BACKOFF = "backoff"      # crashed; waiting out the ladder delay
+    DRAINING = "draining"    # scale-in SIGTERM sent; exiting on its own
+    RETIRED = "retired"      # drained out on purpose; slot removed
+    FAILED = "failed"        # ladder exhausted; left down (still counts
+    #                          toward target — the fleet runs degraded and
+    #                          visibly, instead of crash-looping a fresh
+    #                          ladder on a replacement slot forever)
+
+    def __init__(self, index: int, port: int, cmd: List[str],
+                 policy: RestartPolicy):
+        self.index = index
+        self.port = port
+        self.cmd = cmd
+        self.policy = policy
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = ReplicaHandle.BACKOFF
+        self.restart_at = 0.0            # monotonic; 0 = spawn on next tick
+        self.spawned_at = 0.0
+        self.last_answer = 0.0           # last /healthz ANSWER (any status)
+        self.ready = False               # last /healthz was 200
+        self.queue_depth = 0.0
+        self.kv_busy = 0.0
+        self.drain_deadline = 0.0
+        self.wedge_kills = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"index": self.index, "port": self.port, "state": self.state,
+                "ready": self.ready, "pid":
+                    (self.proc.pid if self.proc is not None else None),
+                "restarts": self.policy.restarts,
+                "crash_restarts": self.policy.crash_restarts,
+                "wedge_kills": self.wedge_kills,
+                "queue_depth": self.queue_depth,
+                "kv_busy": round(self.kv_busy, 4)}
+
+
+class ServeSupervisor:
+    """Spawn/watch/restart/scale a fleet of replica processes (module
+    docstring has the full contract)."""
+
+    def __init__(self, cmd_template: List[str], replicas: int = 1,
+                 base_port: int = 9101, max_restarts: int = 5,
+                 backoff_base: float = 1.0, backoff_max: float = 30.0,
+                 healthy_reset_s: Optional[float] = 300.0,
+                 poll_interval: float = 0.5, poll_timeout: float = 2.0,
+                 wedge_timeout: float = 30.0, grace_s: float = SIGTERM_GRACE_S,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 scale_up_queue: float = 0.0, scale_down_queue: float = 0.0,
+                 kv_high: float = 0.92, scale_sustain_s: float = 10.0,
+                 env: Optional[Dict[str, str]] = None,
+                 sleep=time.sleep):
+        if not cmd_template:
+            raise ValueError("no replica command template given")
+        self.cmd_template = list(cmd_template)
+        self.base_port = int(base_port)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.healthy_reset_s = healthy_reset_s
+        self.poll_interval = float(poll_interval)
+        self.poll_timeout = float(poll_timeout)
+        self.wedge_timeout = float(wedge_timeout)
+        self.grace_s = float(grace_s)
+        self.target = int(replicas)
+        self.min_replicas = int(min_replicas if min_replicas is not None
+                                else replicas)
+        self.max_replicas = int(max_replicas if max_replicas is not None
+                                else replicas)
+        self.scale_up_queue = float(scale_up_queue)
+        self.scale_down_queue = float(scale_down_queue)
+        self.kv_high = float(kv_high)
+        self._up = _Sustain(scale_sustain_s)
+        self._down = _Sustain(scale_sustain_s)
+        self.base_env = dict(env if env is not None else os.environ)
+        self.sleep = sleep
+        self.replicas: List[ReplicaHandle] = []
+        self.total_restarts = 0          # crash+wedge+preempt respawns
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self._next_index = 0
+        self._terminating = False
+        for _ in range(self.target):
+            self._new_handle()
+
+    # -- lifecycle ------------------------------------------------------
+    def _log(self, msg: str) -> None:
+        print(f"[serve_supervisor] {msg}", file=sys.stderr, flush=True)
+
+    def _new_handle(self) -> ReplicaHandle:
+        idx = self._next_index
+        self._next_index += 1
+        port = self.base_port + idx
+        cmd = [a.replace("{port}", str(port)).replace("{index}", str(idx))
+               for a in self.cmd_template]
+        policy = RestartPolicy(max_restarts=self.max_restarts,
+                               backoff_base=self.backoff_base,
+                               backoff_max=self.backoff_max,
+                               healthy_reset_s=self.healthy_reset_s)
+        h = ReplicaHandle(idx, port, cmd, policy)
+        self.replicas.append(h)
+        return h
+
+    def _spawn(self, h: ReplicaHandle, now: float) -> None:
+        env = dict(self.base_env)
+        env["DS_REPLICA_INDEX"] = str(h.index)
+        env["DS_REPLICA_PORT"] = str(h.port)
+        env["DS_SUPERVISOR_RESTART"] = str(h.policy.restarts)
+        h.proc = subprocess.Popen(h.cmd, env=env)
+        h.state = ReplicaHandle.RUNNING
+        h.spawned_at = now
+        h.last_answer = now              # the wedge clock starts at spawn
+        h.ready = False
+        self._log(f"replica {h.index} (port {h.port}): started pid "
+                  f"{h.proc.pid} (incarnation {h.policy.restarts})")
+
+    def request_stop(self) -> None:
+        """Graceful shutdown from any thread (the SIGTERM handler's body):
+        the run loop forwards SIGTERM to every replica, waits out the
+        grace window, and exits without restarting."""
+        self._terminating = True
+
+    def _forward_sigterm(self, _sig, _frame) -> None:
+        self.request_stop()
+
+    # -- one supervision tick -------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._reap(now)
+        self._poll_health(now)
+        self._detect_wedged(now)
+        self._scale(now)
+        self._reconcile(now)
+
+    def _reap(self, now: float) -> None:
+        for h in self.replicas:
+            if h.proc is None or h.proc.poll() is None:
+                continue
+            code = h.proc.returncode
+            h.proc = None
+            h.ready = False
+            if h.state == ReplicaHandle.DRAINING:
+                self._log(f"replica {h.index}: drained and exited {code} "
+                          f"(scale-in complete)")
+                h.state = ReplicaHandle.RETIRED  # slot removed below
+                continue
+            if self._terminating:
+                continue
+            if code == 0:
+                # a serving replica has no natural "done": an exit 0 with
+                # the slot still wanted is respawned immediately, outside
+                # the crash ladder (operator-initiated restarts)
+                self._log(f"replica {h.index}: exited 0; respawning")
+                self.total_restarts += 1
+                h.state = ReplicaHandle.BACKOFF
+                h.restart_at = now
+                continue
+            decision = h.policy.decide(code, ran_s=now - h.spawned_at)
+            if decision.action == "give_up":
+                self._log(f"replica {h.index}: crash ladder exhausted "
+                          f"(exit {code}); leaving it down")
+                h.state = ReplicaHandle.FAILED
+                continue
+            self.total_restarts += 1
+            h.state = ReplicaHandle.BACKOFF
+            h.restart_at = now + decision.delay
+            self._log(f"replica {h.index}: exited {code} ({decision.kind}); "
+                      f"restart #{h.policy.restarts} in {decision.delay:g}s")
+
+    def _poll_health(self, now: float) -> None:
+        for h in self.replicas:
+            if h.state != ReplicaHandle.RUNNING or not h.alive():
+                continue
+            code, _body = _http_json(h.url + "/healthz",
+                                     min(self.poll_timeout,
+                                         max(0.05, self.wedge_timeout / 4)))
+            if code is not None:         # ANY answer is liveness
+                h.last_answer = now
+                h.ready = code == 200
+            else:
+                h.ready = False
+            if not h.ready:
+                continue
+            code, body = _http_json(h.url + "/statz", self.poll_timeout)
+            if code != 200:
+                continue
+            m = body.get("metrics", {}) if isinstance(body, dict) else {}
+            h.queue_depth = float(m.get("ds_serve_queue_depth") or 0)
+            used = float(m.get("ds_serve_kv_pages_used") or 0)
+            free = float(m.get("ds_serve_kv_pages_free") or 0)
+            h.kv_busy = used / (used + free) if used + free else 0.0
+
+    def _detect_wedged(self, now: float) -> None:
+        for h in self.replicas:
+            if h.state != ReplicaHandle.RUNNING or not h.alive():
+                continue
+            if now - h.last_answer <= self.wedge_timeout:
+                continue
+            # alive but not answering: the serving/HTTP side is hung —
+            # a restart is the only way this replica serves again
+            self._log(f"replica {h.index}: wedged ({now - h.last_answer:.1f}s "
+                      f"without a /healthz answer); SIGKILL + restart")
+            h.wedge_kills += 1
+            try:
+                h.proc.kill()
+            except ProcessLookupError:
+                pass
+            h.proc.wait()
+            # feed the kill through the crash ladder (a wedge IS a crash)
+            h.proc = None
+            h.ready = False
+            decision = h.policy.decide(137, ran_s=now - h.spawned_at)
+            if decision.action == "give_up":
+                self._log(f"replica {h.index}: crash ladder exhausted "
+                          f"after wedge; leaving it down")
+                h.state = ReplicaHandle.FAILED
+                continue
+            self.total_restarts += 1
+            h.state = ReplicaHandle.BACKOFF
+            h.restart_at = now + decision.delay
+
+    def _scale(self, now: float) -> None:
+        if self.max_replicas <= self.min_replicas or self._terminating:
+            return
+        ready = [h for h in self.replicas if h.ready
+                 and h.state == ReplicaHandle.RUNNING]
+        if not ready:
+            self._up.update(False, now)
+            self._down.update(False, now)
+            return
+        mean_q = sum(h.queue_depth for h in ready) / len(ready)
+        max_kv = max(h.kv_busy for h in ready)
+        want_up = (self.scale_up_queue > 0 and mean_q >= self.scale_up_queue) \
+            or max_kv >= self.kv_high
+        # scale-in is opt-in exactly like scale-out: 0 disables (an
+        # operator scaling out on KV pressure alone must not have idle
+        # queues silently SIGTERM their warm replicas)
+        want_down = (self.scale_down_queue > 0
+                     and mean_q <= self.scale_down_queue
+                     and max_kv < self.kv_high)
+        if self._up.update(want_up, now) and self.target < self.max_replicas:
+            self.target += 1
+            self.scale_outs += 1
+            self._up.since = None        # re-sustain before the next step
+            self._log(f"scale OUT -> {self.target} (mean queue {mean_q:.1f},"
+                      f" kv {max_kv:.2f})")
+        elif self._down.update(want_down, now) \
+                and self.target > self.min_replicas:
+            self.target -= 1
+            self.scale_ins += 1
+            self._down.since = None
+            self._log(f"scale IN -> {self.target} (mean queue {mean_q:.1f})")
+
+    def _reconcile(self, now: float) -> None:
+        # drop slots that drained out on purpose (scale-in complete);
+        # FAILED slots stay — they occupy their target slot so the fleet
+        # runs visibly degraded instead of crash-looping replacements
+        self.replicas = [h for h in self.replicas
+                         if h.state != ReplicaHandle.RETIRED]
+        live = [h for h in self.replicas
+                if h.state in (ReplicaHandle.RUNNING, ReplicaHandle.BACKOFF)]
+        occupying = live + [h for h in self.replicas
+                            if h.state == ReplicaHandle.FAILED]
+        if not self._terminating:
+            while len(occupying) < self.target:
+                h = self._new_handle()
+                live.append(h)
+                occupying.append(h)
+            # scale-in: SIGTERM the youngest slot — drain is zero-drop
+            # (the router re-dispatches its queued work) and the replica
+            # exits on its own; stragglers are killed past the grace
+            surplus = len(occupying) - self.target
+            for h in sorted(live, key=lambda x: -x.index)[:max(0, surplus)]:
+                if h.state == ReplicaHandle.RUNNING and h.alive():
+                    self._log(f"replica {h.index}: scale-in SIGTERM "
+                              f"(drain -> exit)")
+                    try:
+                        h.proc.send_signal(signal.SIGTERM)
+                    except ProcessLookupError:
+                        pass
+                    h.state = ReplicaHandle.DRAINING
+                    h.drain_deadline = now + self.grace_s
+                elif h.state == ReplicaHandle.BACKOFF:
+                    self.replicas.remove(h)   # never spawned/waiting: drop
+        for h in self.replicas:
+            if h.state == ReplicaHandle.DRAINING and h.alive() \
+                    and now > h.drain_deadline:
+                self._log(f"replica {h.index}: drain grace expired; killing")
+                try:
+                    h.proc.kill()
+                except ProcessLookupError:
+                    pass
+            if h.state == ReplicaHandle.BACKOFF and now >= h.restart_at \
+                    and not self._terminating:
+                self._spawn(h, now)
+
+    # -- main loop ------------------------------------------------------
+    def run(self) -> int:
+        prev = None
+        try:
+            prev = signal.signal(signal.SIGTERM, self._forward_sigterm)
+        except ValueError:               # non-main thread (selftest)
+            prev = None
+        try:
+            while not self._terminating:
+                self.tick()
+                self.sleep(self.poll_interval)
+            return self._shutdown()
+        finally:
+            if prev is not None:
+                try:
+                    signal.signal(signal.SIGTERM, prev)
+                except ValueError:
+                    pass
+
+    def _shutdown(self) -> int:
+        """SIGTERM every replica (graceful drain → exit), wait out the
+        grace window, SIGKILL stragglers.  Never restarts."""
+        victims = [h for h in self.replicas if h.alive()]
+        self._log(f"shutting down: SIGTERM -> {len(victims)} replica(s)")
+        for h in victims:
+            try:
+                h.proc.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        deadline = time.monotonic() + self.grace_s
+        for h in victims:
+            left = deadline - time.monotonic()
+            try:
+                h.proc.wait(timeout=max(0.0, left))
+            except subprocess.TimeoutExpired:
+                self._log(f"replica {h.index}: grace expired; killing")
+                h.proc.kill()
+                h.proc.wait()
+        self._log("shutdown complete")
+        return 0
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"target": self.target,
+                "total_restarts": self.total_restarts,
+                "scale_outs": self.scale_outs, "scale_ins": self.scale_ins,
+                "replicas": [h.snapshot() for h in self.replicas]}
+
+
+# ---------------------------------------------------------------------------
+# selftest (tier-1 wired: tests/unit/test_serve_supervisor.py)
+# ---------------------------------------------------------------------------
+
+# a synthetic replica: stdlib HTTP /healthz + /statz whose load/wedge
+# behavior is driven by a JSON file the selftest mutates at runtime, and
+# whose SIGTERM handler drains (healthz 503) then exits 0 — the graceful
+# scale-in / shutdown contract a real replica implements via
+# ServingEngine.drain()
+_FAKE_REPLICA_PROG = r"""
+import json, os, signal, sys, threading, time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+port, beh_path, marker = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+index = int(os.environ.get("DS_REPLICA_INDEX", "-1"))
+state = {"draining": False}
+
+def beh():
+    try:
+        with open(beh_path) as fh:
+            return json.load(fh)
+    except Exception:
+        return {}
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        b = beh()
+        if b.get("wedge_index") == index:
+            time.sleep(3600)
+        path = self.path.partition("?")[0]
+        if path == "/healthz":
+            code = 503 if state["draining"] else 200
+            body = json.dumps({"ready": code == 200}).encode()
+        elif path == "/statz":
+            code = 200
+            body = json.dumps({"enabled": True, "metrics": {
+                "ds_serve_queue_depth": b.get("queue_depth", 0),
+                "ds_serve_active_slots": 0,
+                "ds_serve_kv_pages_used": b.get("kv_used", 0),
+                "ds_serve_kv_pages_free": b.get("kv_free", 8),
+            }}).encode()
+        else:
+            self.send_error(404)
+            return
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass
+
+def on_term(_sig, _frm):
+    state["draining"] = True
+    def die():
+        time.sleep(0.1)                      # the "drain window"
+        with open(marker, "a") as fh:
+            fh.write("drained %s\n" % os.environ.get("DS_REPLICA_INDEX", "?"))
+        os._exit(0)
+    threading.Thread(target=die, daemon=True).start()
+
+signal.signal(signal.SIGTERM, on_term)
+srv = ThreadingHTTPServer(("127.0.0.1", port), H)
+srv.serve_forever()
+"""
+
+
+def _free_port_block(n: int) -> int:
+    """A base port with ``n`` consecutive free ports (probed by binding;
+    inherently racy, retried by the caller on spawn failure)."""
+    import random
+    import socket
+
+    for _attempt in range(64):
+        base = random.randint(22000, 52000)
+        ok = True
+        for p in range(base, base + n):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", p))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port block found")
+
+
+def _wait(cond, timeout: float, what: str) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def selftest() -> int:
+    import tempfile
+
+    if os.path.basename(sys.argv[0]).startswith("serve_supervisor"):
+        # standalone contract: this tool must never drag jax in
+        assert "jax" not in sys.modules, "serve_supervisor imported jax"
+    with tempfile.TemporaryDirectory() as td:
+        beh_path = os.path.join(td, "behavior.json")
+        marker = os.path.join(td, "drained.txt")
+        with open(beh_path, "w") as fh:
+            json.dump({}, fh)
+        base = _free_port_block(4)
+        sup = ServeSupervisor(
+            [sys.executable, "-c", _FAKE_REPLICA_PROG, "{port}", beh_path,
+             marker],
+            replicas=2, base_port=base, max_restarts=4, backoff_base=0.05,
+            backoff_max=0.2, healthy_reset_s=None, poll_interval=0.05,
+            poll_timeout=0.5, wedge_timeout=1.5, grace_s=5.0,
+            min_replicas=2, max_replicas=3, scale_up_queue=4.0,
+            scale_down_queue=1.0, scale_sustain_s=0.2)
+        thread = threading.Thread(target=sup.run, daemon=True)
+        thread.start()
+        try:
+            # 1) both replicas come up ready
+            _wait(lambda: sum(h.ready for h in sup.replicas) == 2, 15,
+                  "2 replicas ready")
+            # 2) SIGKILL replica 0 -> crash restart through the ladder
+            h0 = sup.replicas[0]
+            pid0 = h0.proc.pid
+            os.kill(pid0, signal.SIGKILL)
+            _wait(lambda: h0.ready and h0.proc is not None
+                  and h0.proc.pid != pid0, 15, "replica 0 restarted")
+            assert h0.policy.crash_restarts >= 1
+            assert sup.total_restarts >= 1
+            # 3) wedge: replica 1 stops answering -> SIGKILL + restart
+            wedged = sup.replicas[1]
+            with open(beh_path, "w") as fh:
+                json.dump({"wedge_index": wedged.index}, fh)
+            _wait(lambda: wedged.wedge_kills >= 1, 20, "wedge kill")
+            with open(beh_path, "w") as fh:
+                json.dump({}, fh)
+            _wait(lambda: all(h.ready for h in sup.replicas
+                              if h.state == ReplicaHandle.RUNNING)
+                  and sum(h.ready for h in sup.replicas) >= 2, 20,
+                  "fleet healthy after wedge")
+            # 4) sustained queue depth above the watermark -> scale OUT
+            with open(beh_path, "w") as fh:
+                json.dump({"queue_depth": 9}, fh)
+            _wait(lambda: sup.target == 3
+                  and sum(h.ready for h in sup.replicas) == 3, 20,
+                  "scale-out to 3")
+            assert sup.scale_outs == 1
+            # 5) load drops -> graceful scale IN (victim drains, exits 0)
+            with open(beh_path, "w") as fh:
+                json.dump({"queue_depth": 0}, fh)
+            _wait(lambda: sup.scale_ins == 1
+                  and sum(1 for h in sup.replicas if h.alive()) == 2, 20,
+                  "scale-in to 2")
+            _wait(lambda: os.path.exists(marker)
+                  and "drained" in open(marker).read(), 10,
+                  "scale-in victim drained")
+            # 6) graceful shutdown: SIGTERM fans out, every child drains
+            pids = [h.proc.pid for h in sup.replicas if h.alive()]
+            sup.request_stop()
+            thread.join(timeout=20)
+            assert not thread.is_alive(), "supervisor did not shut down"
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    raise AssertionError(f"child {pid} survived shutdown")
+                except ProcessLookupError:
+                    pass
+            drained = open(marker).read().count("drained")
+            assert drained >= 3, f"expected >=3 drains, saw {drained}"
+        finally:
+            sup.request_stop()
+            thread.join(timeout=20)
+            for h in sup.replicas:
+                if h.alive():
+                    h.proc.kill()
+    print("serve_supervisor selftest: OK (restart-on-kill, wedge "
+          "detection, scale-out/in, graceful shutdown)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    if "--selftest" in argv[1:]:
+        return selftest()
+    parser = argparse.ArgumentParser(
+        prog="serve_supervisor",
+        description="Spawn, watch, restart, and scale N serving replica "
+                    "processes ({port}/{index} substituted into the "
+                    "command template).")
+    parser.add_argument("--replicas", type=int, default=1)
+    parser.add_argument("--base-port", type=int, default=9101)
+    parser.add_argument("--max-restarts", type=int, default=5)
+    parser.add_argument("--backoff-base", type=float, default=1.0)
+    parser.add_argument("--backoff-max", type=float, default=30.0)
+    parser.add_argument("--healthy-reset", type=float, default=300.0,
+                        help="a replica healthy this long resets its crash "
+                             "ladder (0 disables)")
+    parser.add_argument("--poll-interval", type=float, default=0.5)
+    parser.add_argument("--wedge-timeout", type=float, default=30.0,
+                        help="alive-but-unresponsive seconds before a "
+                             "SIGKILL + restart")
+    parser.add_argument("--grace", type=float, default=SIGTERM_GRACE_S)
+    parser.add_argument("--min-replicas", type=int, default=None)
+    parser.add_argument("--max-replicas", type=int, default=None)
+    parser.add_argument("--scale-up-queue", type=float, default=0.0,
+                        help="mean fleet queue depth that scales OUT when "
+                             "sustained (0 disables queue-driven scaling)")
+    parser.add_argument("--scale-down-queue", type=float, default=0.0,
+                        help="mean fleet queue depth at or below which the "
+                             "fleet scales IN when sustained (0 disables "
+                             "queue-driven scale-in)")
+    parser.add_argument("--kv-high", type=float, default=0.92,
+                        help="KV pool pressure that scales OUT when "
+                             "sustained")
+    parser.add_argument("--scale-sustain", type=float, default=10.0)
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- followed by the replica command template")
+    args = parser.parse_args(argv[1:])
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        parser.error("no replica command given (… -- python replica.py "
+                     "--port {port} …)")
+    sup = ServeSupervisor(
+        cmd, replicas=args.replicas, base_port=args.base_port,
+        max_restarts=args.max_restarts, backoff_base=args.backoff_base,
+        backoff_max=args.backoff_max,
+        healthy_reset_s=(args.healthy_reset or None),
+        poll_interval=args.poll_interval, wedge_timeout=args.wedge_timeout,
+        grace_s=args.grace, min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas, scale_up_queue=args.scale_up_queue,
+        scale_down_queue=args.scale_down_queue, kv_high=args.kv_high,
+        scale_sustain_s=args.scale_sustain)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

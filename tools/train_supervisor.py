@@ -34,6 +34,11 @@ Zero dependencies beyond the stdlib — no jax import, so the supervisor
 runs on any box (the ``fleet_dump`` / ``ckpt_verify`` rule).
 ``--selftest`` exercises the retry/backoff/preempt state machine against
 synthetic children and is wired into tier-1.
+
+The restart/backoff ladder itself lives in the SHARED
+``deepspeed_tpu/elasticity/supervisor.py`` (``RestartPolicy``) so this
+tool and ``tools/serve_supervisor.py`` cannot drift apart on the
+exit-code contract.
 """
 
 from __future__ import annotations
@@ -46,9 +51,32 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional
 
-# runtime/preemption.py carries the same default; both sides read the env
-# override so the contract cannot drift silently in a deployment
-PREEMPT_EXIT_CODE = int(os.environ.get("DS_PREEMPT_EXIT_CODE", "243"))
+
+def _load_supervisor_core():
+    """The shared restart-ladder module: via the package when it is
+    importable in this process, else exec'd by file path (operator box,
+    no jax — the ``tools/router.py`` loader idiom)."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.elasticity import supervisor
+
+        return supervisor
+    mod = sys.modules.get("_ds_supervisor_core")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "deepspeed_tpu", "elasticity", "supervisor.py")
+    spec = importlib.util.spec_from_file_location("_ds_supervisor_core", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_supervisor_core"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_core = _load_supervisor_core()
+RestartPolicy = _core.RestartPolicy
+PREEMPT_EXIT_CODE = _core.PREEMPT_EXIT_CODE
 
 SIGTERM_GRACE_S = 30.0
 
@@ -66,19 +94,39 @@ class TrainSupervisor:
         if not cmd:
             raise ValueError("no child command given")
         self.cmd = list(cmd)
-        self.max_restarts = int(max_restarts)
-        self.backoff_base = float(backoff_base)
-        self.backoff_max = float(backoff_max)
-        self.preempt_exit_code = int(preempt_exit_code)
+        # the shared restart ladder (elasticity/supervisor.py): strict
+        # PR 8 semantics — no healthy-reset, every crash burns budget
+        self.policy = RestartPolicy(max_restarts=max_restarts,
+                                    backoff_base=backoff_base,
+                                    backoff_max=backoff_max,
+                                    preempt_exit_code=preempt_exit_code)
+        self.max_restarts = self.policy.max_restarts
+        self.backoff_base = self.policy.backoff_base
+        self.backoff_max = self.policy.backoff_max
+        self.preempt_exit_code = self.policy.preempt_exit_code
         self.base_env = dict(env if env is not None else os.environ)
         self.sleep = sleep
         self.grace_s = grace_s
-        self.restarts = 0            # restarts performed (any reason)
-        self.crash_restarts = 0      # restarts that burned backoff budget
-        self.preempt_restarts = 0
-        self.backoffs: List[float] = []
         self._terminating = False
         self._child: Optional[subprocess.Popen] = None
+
+    # counters live on the shared policy (one mutation site per exit);
+    # the PR 8 attribute surface stays intact for callers/tests
+    @property
+    def restarts(self) -> int:
+        return self.policy.restarts
+
+    @property
+    def crash_restarts(self) -> int:
+        return self.policy.crash_restarts
+
+    @property
+    def preempt_restarts(self) -> int:
+        return self.policy.preempt_restarts
+
+    @property
+    def backoffs(self) -> List[float]:
+        return self.policy.backoffs
 
     # -- signal forwarding ----------------------------------------------
     def _forward_sigterm(self, _sig, _frame):
@@ -131,38 +179,32 @@ class TrainSupervisor:
             code = self._wait_child()
             self._child = None
             last_code = code
-            if code == 0:
-                self._log(f"child completed (restarts={self.restarts})")
-                return 0
-            if self._terminating:
+            if self._terminating and code != 0:
                 self._log(f"supervisor was terminated; child exited "
                           f"{code} — not restarting")
                 return code
-            if code == self.preempt_exit_code:
-                # a clean emergency save was taken: restart immediately;
-                # preemptions are routine scheduling events and do NOT
-                # burn the crash budget (a child that lies about 243
-                # without actually saving is operator error)
-                self.restarts += 1
-                self.preempt_restarts += 1
-                self._log(f"child preempted (exit {code}, emergency save "
-                          f"taken): restart #{self.restarts}, no backoff")
-                continue
-            if self.crash_restarts >= self.max_restarts:
+            decision = self.policy.decide(code)
+            if decision.action == "done":
+                self._log(f"child completed (restarts={self.restarts})")
+                return 0
+            if decision.action == "give_up":
                 self._log(f"max_restarts={self.max_restarts} crash "
                           f"restarts exhausted; giving up with exit code "
                           f"{code}")
                 return code
-            self.restarts += 1
-            self.crash_restarts += 1
-            delay = min(self.backoff_max,
-                        self.backoff_base * (2 ** (self.crash_restarts - 1)))
-            self.backoffs.append(delay)
+            if decision.kind == "preempt":
+                # a clean emergency save was taken: restart immediately;
+                # preemptions are routine scheduling events and do NOT
+                # burn the crash budget (a child that lies about 243
+                # without actually saving is operator error)
+                self._log(f"child preempted (exit {code}, emergency save "
+                          f"taken): restart #{self.restarts}, no backoff")
+                continue
             self._log(f"child crashed (exit {code}): restart "
-                      f"#{self.restarts} after {delay:g}s backoff; "
+                      f"#{self.restarts} after {decision.delay:g}s backoff; "
                       f"training should resume from the newest valid "
                       f"checkpoint")
-            self.sleep(delay)
+            self.sleep(decision.delay)
 
     def _wait_child(self) -> int:
         child = self._child
