@@ -16,8 +16,11 @@ tier1: lint
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 
-# the slow-marked serving chaos suite (outside tier-1): randomized
-# fleet chaos + the bench_fleet_chaos rung at CPU smoke scale
+# the slow-marked chaos suites (outside tier-1): the serving fleet
+# matrix + bench_fleet_chaos, and the TRAINING matrix
+# (tests/perf/test_train_chaos.py — randomized kill-sweep across an
+# elastic 4->2->8->4 cycle, multi-round gradient bombs, and the
+# bench_elastic_resume rung) at CPU smoke scale
 chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m slow -k chaos \
 		--continue-on-collection-errors -p no:cacheprovider \

@@ -925,6 +925,137 @@ def bench_host_tier_serving(num_requests: int = 32, num_slots: int = 4,
     }
 
 
+def bench_elastic_resume(steps_pre: int = 3, steps_post: int = 3,
+                         seed: int = 0, tiny: bool = True) -> dict:
+    """Elastic training resilience rung (docs/RESILIENCE.md "Elastic
+    training"): save a crash-atomic checkpoint at world W, resume at W/2
+    and 2W (clamped to the available device count), and record per resume
+    world: RESUME LATENCY (the ``load_checkpoint`` wall — manifest
+    verification, resharding reads, and the grad-accum-rescale step
+    recompile), the wall time of the ``steps_post`` post-resume steps
+    (the FIRST includes any rescale recompile; recorded as
+    ``post_steps_s``), and STEPS-TO-RECOVER (post-resume steps whose
+    eval loss deviates > 2% from the uninterrupted run's trajectory
+    before the first match — 0 means the very first resumed step already
+    tracks).  Headlines:
+    ``resume_latency_s_max``, ``steps_to_recover_max``, ``loss_parity``
+    (every compared step within rtol 1e-3)."""
+    import numpy as np
+
+    ndev = len(jax.devices())
+    w_save = min(4, ndev)
+    candidates = sorted({max(1, w_save // 2), min(ndev, w_save * 2)}
+                        - {w_save})
+    # the divisibility rule up front (docs/RESILIENCE.md): only worlds
+    # that can preserve the recorded global batch are resumable; the
+    # eval probe (8 rows) must shard over the world too
+    tbs_probe = 1 * w_save * 2           # micro * w_save * gas (below)
+    worlds = [w for w in candidates if tbs_probe % w == 0 and 8 % w == 0]
+    if not worlds:
+        return {"status": "skipped",
+                "note": f"{ndev} device(s): no different elastic-valid "
+                        "world to resume at"}
+    layers, hidden = (2, 64) if tiny else (4, 256)
+    seq = 32 if tiny else 128
+    micro, gas = 1, 2
+    tbs = micro * w_save * gas
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, size=(16 * tbs, seq)).astype(np.int32)
+    probe = data[:8]
+
+    def make(devs, gas_cfg):
+        mesh = build_mesh(devices=jax.devices()[:devs])
+        set_global_mesh(mesh)
+        model = causal_lm("llama-tiny", mesh=mesh, num_layers=layers,
+                          hidden_size=hidden, intermediate_size=2 * hidden,
+                          num_heads=2, num_kv_heads=2, vocab_size=256,
+                          max_seq_len=seq, remat=False)
+        cfg = {"train_batch_size": micro * devs * gas_cfg,
+               "train_micro_batch_size_per_gpu": micro,
+               "gradient_accumulation_steps": gas_cfg,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 2},
+               "steps_per_print": 10**9}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, mesh=mesh,
+            rng=jax.random.PRNGKey(seed))
+        return engine
+
+    def eval_loss(engine):
+        engine.eval()
+        try:
+            return float(engine.forward((probe, probe)))
+        finally:
+            engine.train()
+
+    def run_steps(engine, n, start=0):
+        out = []
+        for i in range(start, start + n):
+            g = engine.config.gradient_accumulation_steps
+            per = tbs // g
+            for k in range(g):
+                lo = (i * tbs + k * per) % (len(data) - per)
+                engine.forward((data[lo:lo + per], data[lo:lo + per]))
+            engine.step()
+            out.append(eval_loss(engine))
+        return out
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        e = make(w_save, gas)
+        run_steps(e, steps_pre)
+        t0 = time.perf_counter()
+        e.save_checkpoint(td, tag="elastic")
+        save_s = time.perf_counter() - t0
+        ref = run_steps(e, steps_post, start=steps_pre)
+
+        resumes = {}
+        parity = True
+        for devs in worlds:
+            # one bad world must not discard the others' measurements
+            try:
+                er = make(devs, gas)
+                er.forward((data[:devs], data[:devs]))   # lazy-init state
+                t0 = time.perf_counter()
+                ckpt_dir, _ = er.load_checkpoint(td)
+                load_s = time.perf_counter() - t0
+                assert ckpt_dir is not None
+                t0 = time.perf_counter()
+                got = run_steps(er, steps_post, start=steps_pre)
+                post_steps_s = time.perf_counter() - t0
+            except Exception as exc:
+                resumes[str(devs)] = {
+                    "status": f"failed: {type(exc).__name__}",
+                    "error": str(exc)[:160]}
+                parity = False
+                continue
+            recover = 0
+            for a, b in zip(ref, got):
+                if abs(a - b) <= 0.02 * abs(a):
+                    break
+                recover += 1
+            parity = parity and bool(np.allclose(ref, got, rtol=1e-3))
+            resumes[str(devs)] = {
+                "resume_latency_s": round(load_s, 4),
+                "gas": er.config.gradient_accumulation_steps,
+                "post_steps_s": round(post_steps_s, 4),
+                "steps_to_recover": recover,
+                "eval_loss_ref": [round(x, 6) for x in ref],
+                "eval_loss_resumed": [round(x, 6) for x in got]}
+        ok = [r for r in resumes.values() if "resume_latency_s" in r]
+        if not ok:
+            return {"status": "failed", "worlds": worlds,
+                    "resumes": resumes}
+        return {"status": "ok", "world_save": w_save, "worlds": worlds,
+                "global_batch": tbs, "save_s": round(save_s, 4),
+                "resume_latency_s_max": max(r["resume_latency_s"]
+                                            for r in ok),
+                "steps_to_recover_max": max(r["steps_to_recover"]
+                                            for r in ok),
+                "loss_parity": parity, "resumes": resumes}
+
+
 def bench_fleet_chaos(num_requests: int = 24, num_slots: int = 2,
                       seed: int = 0, tiny: bool = False) -> dict:
     """Fleet resilience rung (ISSUE 13): the bimodal shared-prefix trace
@@ -1635,6 +1766,17 @@ def main():
     if os.environ.get("DSTPU_BENCH_SKIP_STREAMED") != "1":
         rung_streamed = bench_streamed_rung()
 
+    # elastic resume: world-size-change restore latency + steps-to-recover
+    # (ISSUE 14); meaningful on CPU too — resharding reads + gas-rescale
+    # recompile are host-side costs
+    rung_elastic = None
+    if os.environ.get("DSTPU_BENCH_SKIP_ELASTIC") != "1":
+        try:
+            rung_elastic = bench_elastic_resume(tiny=not on_tpu)
+        except Exception as exc:
+            rung_elastic = {"status": f"failed: {type(exc).__name__}",
+                            "error": str(exc)[:200]}
+
     mesh = build_mesh(devices=jax.devices()[:1])
     set_global_mesh(mesh)
 
@@ -1836,6 +1978,8 @@ def main():
                       if rung_host_tier else {}),
                    **({"fleet_chaos": rung_fleet_chaos}
                       if rung_fleet_chaos else {}),
+                   **({"elastic_resume": rung_elastic}
+                      if rung_elastic else {}),
                    **({"streamed_offload": rung_streamed}
                       if rung_streamed else {})},
     })
@@ -1953,12 +2097,23 @@ def summary_lines(record: dict, rung_serving) -> list:
             "answered_exactly_once": fc["answered_exactly_once"],
             "outputs_token_identical": fc["outputs_token_identical"],
         }
+    er = record["detail"].get("elastic_resume")
+    if er and er.get("status") == "ok":
+        # the ISSUE 14 elastic-training acceptance row: resume latency +
+        # steps-to-recover across the world change, with loss parity
+        summary["elastic_resume"] = {
+            "resume_latency_s": er["resume_latency_s_max"],
+            "steps_to_recover": er["steps_to_recover_max"],
+            "loss_parity": er["loss_parity"],
+            "world_save": er["world_save"],
+            "worlds": er["worlds"],
+        }
     line = json.dumps(summary, separators=(",", ":"))
     # enforce the final-line cap: drop the bulkiest optional blocks first
     # (the record line keeps everything); the minimal summary always fits
     for victim in ("serving_metrics", "train_metrics", "overlap_ablation",
                    "serving_prefix", "streamed_offload",
-                   "serving_host_tier", "fleet_chaos"):
+                   "serving_host_tier", "fleet_chaos", "elastic_resume"):
         if len(line) <= BENCH_SUMMARY_MAX_CHARS:
             break
         if summary.pop(victim, None) is not None:

@@ -26,6 +26,16 @@ Preemption-aware (docs/RESILIENCE.md): ranks exiting with
 ``PREEMPTED_EXIT_CODE`` (``runtime/preemption.py`` — SIGTERM emergency
 save taken, left on purpose) trigger a relaunch at the SAME world size
 instead of a shrink; the checkpoint they just wrote is the resume point.
+
+World-set detection (docs/RESILIENCE.md "Elastic training"): before every
+relaunch the agent re-probes the AVAILABLE world via ``world_size_fn`` /
+``--world-size-file`` (a file the scheduler or operator keeps current with
+the allocatable worker count).  A probe larger than the surviving count
+GROWS the next incarnation back — preempted capacity returning is as
+routine as it leaving — and a probe smaller shrinks ahead of the failure
+the doomed relaunch would hit.  The probe is validated against the
+elastic set like any other world; training itself reshards on load (the
+engine's ``_maybe_elastic_rescale`` keeps the global batch invariant).
 """
 
 from __future__ import annotations
@@ -61,7 +71,7 @@ class DSElasticAgent:
                  user_args: Optional[List[str]] = None, num_procs: int = 1,
                  master_addr: str = "127.0.0.1", master_port: int = 29600,
                  max_restarts: int = 3, env: Optional[Dict[str, str]] = None,
-                 no_local_rank: bool = False):
+                 no_local_rank: bool = False, world_size_fn=None):
         self.ds_config = ds_config
         self.user_script = user_script
         self.user_args = list(user_args or [])
@@ -71,7 +81,42 @@ class DSElasticAgent:
         self.max_restarts = max_restarts
         self.base_env = dict(env if env is not None else os.environ)
         self.no_local_rank = no_local_rank
+        # optional availability probe: () -> int | None, consulted before
+        # every (re)launch; None/invalid readings fall back to the default
+        self.world_size_fn = world_size_fn
         self.restart_count = 0
+
+    @staticmethod
+    def world_size_file_fn(path: str):
+        """Probe reading the allocatable worker count from a file the
+        scheduler/operator keeps current (``--world-size-file``).  A
+        missing or unparseable file reads as None (keep the default)."""
+        def probe() -> Optional[int]:
+            try:
+                with open(path) as fh:
+                    return int(fh.read().strip())
+            except (OSError, ValueError):
+                return None
+        return probe
+
+    def _probe_world(self, default: int) -> int:
+        """The available world for the next incarnation: the probe's
+        answer when it gives a usable one, else ``default`` (bounded by
+        the configured ceiling — a probe cannot grow past num_procs)."""
+        if self.world_size_fn is None:
+            return default
+        try:
+            avail = self.world_size_fn()
+        except Exception as exc:  # a broken probe must not kill the agent
+            logger.warning("elastic agent: world probe failed: %s", exc)
+            return default
+        if avail is None or int(avail) < 1:
+            return default
+        world = min(int(avail), self.num_procs)
+        if world != default:
+            logger.info("elastic agent: world probe reports %d available "
+                        "(default was %d)", world, default)
+        return world
 
     # -- membership validation ------------------------------------------
     def _validate_world(self, world_size: int) -> int:
@@ -118,7 +163,7 @@ class DSElasticAgent:
                     p.kill()
 
     def run(self) -> int:
-        world = self.num_procs
+        world = self._probe_world(self.num_procs)
         port = self.master_port
         try:
             micro = self._validate_world(world)
@@ -175,22 +220,52 @@ class DSElasticAgent:
             if all(c == PREEMPTED_EXIT_CODE for _, c in failed):
                 self.restart_count += 1
                 port = _free_port(self.master_addr)
+                # the probe may report the preempted capacity already back
+                # (or more gone): relaunch at what is actually available
+                new_world = self._probe_world(world)
+                if new_world != world:
+                    try:
+                        self._validate_world(new_world)
+                        world = new_world
+                    except ElasticityError as exc:
+                        logger.warning(
+                            "elastic agent: probed world %d rejected by "
+                            "elastic config (%s); keeping world=%d",
+                            new_world, exc, world)
                 logger.info(
                     "elastic agent: rank(s) %s preempted (clean emergency "
-                    "save); restart #%d at unchanged world=%d — training "
-                    "resumes from the latest checkpoint",
+                    "save); restart #%d at world=%d — training resumes "
+                    "from the latest checkpoint",
                     [r for r, _ in failed], self.restart_count, world)
                 continue
-            new_world = world - len(failed)
+            # changed-device-set detection: the probe's availability (hosts
+            # may already be BACK — grow — or more may be gone) wins over
+            # the naive survivors count when it validates
+            new_world = self._probe_world(world - len(failed))
             if new_world < 1:
                 logger.error("elastic agent: no survivors to restart with")
                 return code
             try:
                 micro = self._validate_world(new_world)
             except ElasticityError as exc:
-                logger.error("elastic agent: surviving world %d rejected by "
-                             "elastic config: %s", new_world, exc)
-                return code
+                fallback = world - len(failed)
+                if fallback != new_world and fallback >= 1:
+                    logger.warning(
+                        "elastic agent: probed world %d rejected by elastic "
+                        "config (%s); trying the surviving count %d",
+                        new_world, exc, fallback)
+                    new_world = fallback
+                    try:
+                        micro = self._validate_world(new_world)
+                    except ElasticityError as exc2:
+                        logger.error("elastic agent: surviving world %d "
+                                     "rejected by elastic config: %s",
+                                     new_world, exc2)
+                        return code
+                else:
+                    logger.error("elastic agent: surviving world %d rejected "
+                                 "by elastic config: %s", new_world, exc)
+                    return code
             self.restart_count += 1
             world = new_world
             # fresh coordinator port: the old one may sit in TIME_WAIT, and a
@@ -213,17 +288,25 @@ def main(argv=None) -> int:
     parser.add_argument("--master_port", type=int, default=29600)
     parser.add_argument("--max_restarts", type=int, default=3)
     parser.add_argument("--no_local_rank", action="store_true")
+    parser.add_argument("--world_size_file", default=None,
+                        help="file holding the currently-allocatable worker "
+                             "count; re-read before every relaunch so the "
+                             "next incarnation grows/shrinks to the actual "
+                             "device set")
     parser.add_argument("user_script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     with open(args.ds_config) as fh:
         ds_config = json.load(fh)
+    probe = (DSElasticAgent.world_size_file_fn(args.world_size_file)
+             if args.world_size_file else None)
     agent = DSElasticAgent(ds_config, args.user_script, args.user_args,
                            num_procs=args.num_procs,
                            master_addr=args.master_addr,
                            master_port=args.master_port,
                            max_restarts=args.max_restarts,
-                           no_local_rank=args.no_local_rank)
+                           no_local_rank=args.no_local_rank,
+                           world_size_fn=probe)
     return agent.run()
 
 

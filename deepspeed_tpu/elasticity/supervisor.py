@@ -22,14 +22,37 @@ job and an N-replica serving fleet.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import List, NamedTuple, Optional
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
 
 # runtime/preemption.py carries the same default; every side reads the
 # env override so the contract cannot drift silently in a deployment
 PREEMPT_EXIT_CODE = int(os.environ.get("DS_PREEMPT_EXIT_CODE", "243"))
 
-__all__ = ["PREEMPT_EXIT_CODE", "RestartDecision", "RestartPolicy"]
+__all__ = ["PREEMPT_EXIT_CODE", "RestartDecision", "RestartPolicy",
+           "write_status"]
+
+
+def write_status(path: Optional[str], payload: Dict[str, Any]) -> None:
+    """Atomically publish supervisor truth as a JSON file (``--status-file``
+    on both supervisors): ladder counters, worker/replica states, restart
+    timestamps — so operators and ``fleet_dump`` read state instead of
+    scraping logs.  tmp + ``os.replace``: a reader never sees a torn
+    write.  A ``None`` path no-ops; write failures are swallowed (a full
+    disk must not take the supervisor down with it)."""
+    if not path:
+        return
+    try:
+        payload = dict(payload)
+        payload["updated_unix"] = time.time()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True, default=str)
+        os.replace(tmp, path)
+    except OSError:
+        pass
 
 
 class RestartDecision(NamedTuple):
@@ -76,6 +99,16 @@ class RestartPolicy:
         self.crash_restarts = 0      # restarts that burned backoff budget
         self.preempt_restarts = 0
         self.backoffs: List[float] = []
+
+    def counters(self) -> Dict[str, Any]:
+        """Ladder truth for ``--status-file`` payloads (one schema for
+        both supervisors)."""
+        return {"max_restarts": self.max_restarts,
+                "restarts": self.restarts,
+                "crash_restarts": self.crash_restarts,
+                "preempt_restarts": self.preempt_restarts,
+                "backoffs": list(self.backoffs),
+                "healthy_reset_s": self.healthy_reset_s}
 
     def decide(self, exit_code: int,
                ran_s: Optional[float] = None) -> RestartDecision:

@@ -1,0 +1,162 @@
+"""Gradient-norm anomaly detector: the bf16 answer to fp16's overflow skip.
+
+fp16 training gets loss-scale overflow detection for free — a non-finite
+grad zeroes the step via the branchless ``has_overflow`` select in
+``runtime/engine.py``.  bf16 has no loss scaler, so a run that goes
+non-finite (or takes a gradient bomb from a corrupt batch / a straggler
+host returning garbage) silently destroys the parameters and every
+checkpoint saved after it.  This detector watches the one per-step scalar
+training already computes — the global gradient norm — and classifies each
+step against a rolling-median spike bound, the ``StepWatchdog`` cached-
+bound idiom (``monitor/watchdog.py``):
+
+- the trip *bound* (``factor`` x rolling median of ACCEPTED norms) is
+  cached; healthy samples cost one deque append + one comparison, and the
+  true median is recomputed only when a sample crosses the cached bound
+  or once per ``window`` samples (the re-anchor that keeps a falling
+  median honest);
+- non-finite norms and norms above the bound are anomalies; anomalous
+  samples never enter the window (a bomb must not drag its own bar up);
+- unlike the watchdog this is MULTI-shot: every step is classified, and
+  the engine escalates — skip the step in-program first (the fp16
+  select, mirrored), then after ``patience`` CONSECUTIVE anomalies roll
+  back to the last-good checkpoint (``runtime/engine._anomaly_tick``).
+
+Host-side cost when enabled: the engine feeds realized norms with a lag-1
+deferred fetch (the serving ``_fetch_block`` idiom), so no step ever
+blocks on its own norm.  Disabled (default): the engine never constructs
+a detector and the step program is byte-identical to before.
+
+Stdlib-only; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Dict, Optional
+
+__all__ = ["GradAnomalyDetector"]
+
+
+class GradAnomalyDetector:
+    def __init__(self, factor: float = 10.0, window: int = 64,
+                 warmup: int = 8, patience: int = 3):
+        if factor <= 1.0:
+            raise ValueError(f"anomaly factor must be > 1, got {factor}")
+        self.factor = float(factor)
+        self.window = max(2, int(window))
+        # warmup > window could never arm (the deque holds `window` max)
+        self.warmup = min(max(2, int(warmup)), self.window)
+        self.patience = max(1, int(patience))
+        self._dq: deque = deque(maxlen=self.window)
+        self._bound = math.inf          # cached trip bound (inf = unarmed)
+        self._refresh = self.window
+        self.consecutive = 0            # current run of anomalous steps
+        self.trips_total = 0
+        self.rollbacks = 0              # lifetime rollback count
+        self.rollback_streak = 0        # rollbacks with no accepted step between
+        self.median_recomputes = 0
+        self.last_trip: Optional[Dict[str, Any]] = None
+
+    # -- the device-side select reads this each dispatch ----------------
+    @property
+    def bound(self) -> float:
+        """Current trip bound for the in-program skip select (``+inf``
+        until the warmup window fills: never skip on no evidence)."""
+        return self._bound
+
+    # -- classification --------------------------------------------------
+    def observe(self, gnorm: float, skipped: Optional[bool] = None) -> bool:
+        """Classify one realized grad norm; returns True when the step
+        was anomalous/SKIPPED.  ``skipped`` is the device's own select
+        decision for this step (made against the bound at dispatch) —
+        passing it keeps the host ledger truthful even when the cached
+        bound has drifted from the live median; None falls back to the
+        host rule (host-stepped paths, where decision and ledger share
+        one bound).  Healthy samples feed the window; anomalies never do.
+
+        A step the device dropped whose norm is nevertheless WITHIN
+        ``factor`` of the true median is a *drift* skip (the cached bound
+        was stale-low): it is still reported True (the step really was
+        lost — the caller must count it), the bound refreshes so the
+        next dispatch stops skipping, and the sample enters the window
+        WITHOUT escalating the rollback ladder."""
+        if not math.isfinite(gnorm):
+            return self._trip(gnorm, kind="non_finite")
+        suspect = bool(skipped) if skipped is not None else gnorm > self._bound
+        if suspect:
+            # confirm against the true median EXCLUDING any influence of
+            # the suspect (it was never appended)
+            self.median_recomputes += 1
+            med = self._median()
+            if med > 0 and gnorm > self.factor * med:
+                return self._trip(gnorm, kind="spike", median=med)
+            # the median drifted up past the cached bound: refresh it so
+            # the new normal stops tripping
+            self._bound = self.factor * max(med, gnorm / self.factor)
+            if skipped:
+                # the device already dropped this step — report the skip
+                # (kind "drift") but treat the run as healthy
+                self.trips_total += 1
+                self.last_trip = {"gnorm": gnorm, "kind": "drift",
+                                  "median": med, "bound": self._bound,
+                                  "consecutive": self.consecutive}
+                self._accept(gnorm)
+                return True
+        self._accept(gnorm)
+        return False
+
+    def _accept(self, gnorm: float) -> None:
+        self.consecutive = 0
+        self.rollback_streak = 0        # a healthy step forgives the ladder
+        self._dq.append(gnorm)
+        n = len(self._dq)
+        if self._bound is math.inf:
+            if n >= self.warmup:
+                self._bound = self.factor * self._median()
+            return
+        self._refresh -= 1
+        if self._refresh <= 0:
+            # once-per-window re-anchor: the median can FALL (early steps
+            # are noisy, then training settles) and a stale-high bound
+            # would let a real spike through
+            self._refresh = self.window
+            self._bound = self.factor * self._median()
+
+    def _trip(self, gnorm: float, kind: str, median: float = 0.0) -> bool:
+        self.consecutive += 1
+        self.trips_total += 1
+        self.last_trip = {"gnorm": gnorm, "kind": kind,
+                          "median": median or self._median(),
+                          "bound": self._bound,
+                          "consecutive": self.consecutive}
+        return True
+
+    # -- escalation ------------------------------------------------------
+    @property
+    def should_rollback(self) -> bool:
+        return self.consecutive >= self.patience
+
+    def note_rollback(self) -> None:
+        """Reset the escalation ladder after a rollback: the restored
+        state starts a fresh consecutive count, and the window is kept —
+        the healthy-median memory survives the rollback (a persisting
+        bomb trips again immediately instead of slipping through a
+        re-warmup blind spot)."""
+        self.rollbacks += 1
+        self.rollback_streak += 1
+        self.consecutive = 0
+
+    def _median(self) -> float:
+        vals = sorted(self._dq)
+        n = len(vals)
+        if not n:
+            return 0.0
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+    @property
+    def median(self) -> float:
+        """Current rolling median (reads sort; not the hot path)."""
+        return self._median()

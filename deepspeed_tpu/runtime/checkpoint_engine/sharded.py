@@ -25,6 +25,7 @@ replicated by construction).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any, Dict, List, Optional
@@ -90,10 +91,16 @@ class ShardedCheckpointEngine(CheckpointEngine):
                         data = np.asarray(shard.data)  # ONE shard on host
                         self.max_bytes_in_flight = max(self.max_bytes_in_flight,
                                                        data.nbytes)
-                        fh.write(data.tobytes())
+                        raw = data.tobytes()
+                        fh.write(raw)
+                        # per-CHUNK sha256: deep verification
+                        # (tools/ckpt_verify.py --deep) pinpoints the
+                        # corrupted shard/leaf, not just the file
                         chunks.append({"index": _norm_index(shard.index, shape),
                                        "file": bin_name, "offset": offset,
-                                       "nbytes": int(data.nbytes)})
+                                       "nbytes": int(data.nbytes),
+                                       "sha256":
+                                           hashlib.sha256(raw).hexdigest()})
                         offset += data.nbytes
                 else:
                     arr = np.asarray(leaf)
@@ -101,10 +108,13 @@ class ShardedCheckpointEngine(CheckpointEngine):
                     if proc == 0:  # replicated host value: one writer
                         self.max_bytes_in_flight = max(self.max_bytes_in_flight,
                                                        arr.nbytes)
-                        fh.write(np.ascontiguousarray(arr).tobytes())
+                        raw = np.ascontiguousarray(arr).tobytes()
+                        fh.write(raw)
                         chunks.append({"index": [[0, d] for d in shape],
                                        "file": bin_name, "offset": offset,
-                                       "nbytes": int(arr.nbytes)})
+                                       "nbytes": int(arr.nbytes),
+                                       "sha256":
+                                           hashlib.sha256(raw).hexdigest()})
                         offset += arr.nbytes
                 index[key] = {"shape": list(shape), "dtype": dtype,
                               "chunks": chunks}

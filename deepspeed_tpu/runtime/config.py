@@ -300,6 +300,31 @@ class WatchdogConfig(DeepSpeedConfigModel):
     rearm: bool = False              # reset after a trip (watch for repeats)
 
 
+class AnomalyConfig(DeepSpeedConfigModel):
+    """``anomaly_detection`` section (TPU extension; docs/RESILIENCE.md
+    "Elastic training"): bf16/fp32 step-anomaly containment — the fp16
+    overflow-skip ladder for runs with no loss scaler.  A step whose
+    global grad norm is non-finite or exceeds ``factor`` x the rolling
+    median (over the last ``window`` ACCEPTED steps, armed after
+    ``warmup``) is SKIPPED in-program (branchless select, mirroring the
+    fp16 ``has_overflow`` path); after ``patience`` consecutive skips the
+    engine dumps the flight recorder and ROLLS BACK to the newest valid
+    checkpoint in ``save_dir`` (default: ``checkpoint.save_dir``).
+    ``max_rollbacks`` consecutive-ladder rollbacks without an accepted
+    step in between raise instead of looping forever.  Metrics:
+    ``ds_train_anomaly_skipped_total`` / ``ds_train_anomaly_rollback_total``.
+    """
+
+    enabled: bool = False
+    factor: float = 10.0
+    window: int = 64
+    warmup: int = 8
+    patience: int = 3
+    rollback: bool = True
+    save_dir: Optional[str] = None   # default: checkpoint.save_dir
+    max_rollbacks: int = 3
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"
     load_universal: bool = False
@@ -313,6 +338,14 @@ class CheckpointConfig(DeepSpeedConfigModel):
     # trusts a tag's bytes; on failure the loader walks back to the
     # newest valid tag instead of crashing
     verify_on_load: bool = True
+    # additionally verify the sharded payload's per-CHUNK sha256 index
+    # records (tools/ckpt_verify.py --deep): pinpoints the offending
+    # shard/leaf instead of just the file; costs a second hash pass
+    deep_verify_on_load: bool = False
+    # on a world-size-changed resume, rescale gradient_accumulation_steps
+    # so the recorded global batch is preserved (docs/RESILIENCE.md
+    # "Elastic training"); off = warn and keep the current triad
+    elastic_resume: bool = True
     # retention GC: after a successful commit, delete the oldest VALID
     # tags beyond this count (never the tag `latest` points to); 0 = keep
     # everything
@@ -503,6 +536,7 @@ class DeepSpeedConfig:
         self.comms_logger = CommsLoggerConfig(**d.get("comms_logger", {}))
         self.flight_recorder = FlightRecorderConfig(**d.get("flight_recorder", {}))
         self.watchdog = WatchdogConfig(**d.get("watchdog", {}))
+        self.anomaly_detection = AnomalyConfig(**d.get("anomaly_detection", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
         self.elasticity = ElasticityConfig(**d.get("elasticity", {}))
         self.tensor_parallel = TensorParallelConfig(**d.get("tensor_parallel", {}))

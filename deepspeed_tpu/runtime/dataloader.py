@@ -63,6 +63,20 @@ class DeepSpeedDataLoader:
         self.collate_fn = collate_fn
         self._epoch = 0
         self.data_sampler = data_sampler
+        # deterministic stream state (docs/RESILIENCE.md "Elastic
+        # training"): sample offset within the current epoch, tracked in
+        # SAMPLES (not batches) so a resume at a different batch size —
+        # an elastic world-size change resizes the global micro-batch —
+        # replays exactly the remaining sample stream.  The shuffle
+        # permutation is a pure function of (seed, epoch), so offsets
+        # survive a process restart.  ``_samples_consumed`` mirrors the
+        # live iterator's position (what ``state_dict`` reports);
+        # ``_resume_offset`` is consumed by exactly ONE subsequent
+        # ``__iter__`` after ``load_state_dict`` — a fresh iterator
+        # without a pending resume starts the epoch at sample 0, so
+        # peek-then-iterate callers never silently lose a batch
+        self._samples_consumed = 0
+        self._resume_offset = 0
 
         if isinstance(dataset, (tuple, list)) and len(dataset) > 0 and hasattr(dataset[0], "shape"):
             self._arrays = tuple(np.asarray(a) for a in dataset)
@@ -78,15 +92,62 @@ class DeepSpeedDataLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+        self._samples_consumed = 0
+        self._resume_offset = 0
 
-    def __iter__(self) -> Iterator[Any]:
+    # -- saveable stream state (rides checkpoints as client_state) -------
+    def state_dict(self) -> dict:
+        """Everything needed to resume the exact sample stream: epoch,
+        sample offset within it, and the shuffle identity (seed + flag +
+        dataset length, validated on restore)."""
+        return {"epoch": int(self._epoch),
+                "samples_consumed": int(self._samples_consumed),
+                "seed": int(self.seed), "shuffle": bool(self.shuffle),
+                "n": int(self._n)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore a :meth:`state_dict`.  The permutation identity must
+        match — a different dataset length or shuffle seed cannot replay
+        the recorded stream, and silently resuming a DIFFERENT stream is
+        worse than failing."""
+        if int(sd.get("n", self._n)) != self._n:
+            raise ValueError(
+                f"dataloader resume: dataset length changed "
+                f"({sd.get('n')} -> {self._n}); the saved sample offset "
+                "indexes a different permutation")
+        if bool(sd.get("shuffle", self.shuffle)) != self.shuffle:
+            raise ValueError("dataloader resume: shuffle flag changed")
+        if self.shuffle and int(sd.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                f"dataloader resume: shuffle seed changed "
+                f"({sd.get('seed')} -> {self.seed})")
+        self._epoch = int(sd.get("epoch", 0))
+        self._samples_consumed = int(sd.get("samples_consumed", 0))
+        self._resume_offset = self._samples_consumed
+
+    def _perm(self) -> np.ndarray:
         idx = np.arange(self._n)
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self._epoch)
             rng.shuffle(idx)
-        nb = len(self)
+        return idx
+
+    def __iter__(self) -> Iterator[Any]:
+        idx = self._perm()
+        # resume mid-epoch at the restored SAMPLE offset (consumed by
+        # this one iterator): a new batch size (elastic restart) slices
+        # the same permutation differently but yields the identical
+        # remaining sample stream.  The offset is iterator-LOCAL from
+        # here — a second/abandoned iterator restarts its epoch at 0
+        # instead of silently eating the stream.
+        start, self._resume_offset = self._resume_offset, 0
+        self._samples_consumed = start
+        avail = self._n - start
+        nb = (avail // self.batch_size if self.drop_last
+              else (avail + self.batch_size - 1) // self.batch_size)
         for b in range(nb):
-            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            lo = start + b * self.batch_size
+            sel = idx[lo:lo + self.batch_size]
             if self._arrays is not None:
                 batch = tuple(a[sel] for a in self._arrays)
             else:
@@ -95,8 +156,11 @@ class DeepSpeedDataLoader:
                     batch = self.collate_fn(samples)
                 else:
                     batch = jax.tree.map(lambda *xs: np.stack(xs), *samples)
+            # mirrored for state_dict (checkpoints taken mid-epoch)
+            self._samples_consumed = lo + len(sel)
             yield shard_batch(batch, self.mesh)
         self._epoch += 1
+        self._samples_consumed = 0
 
 
 class RepeatingLoader:
@@ -115,3 +179,12 @@ class RepeatingLoader:
         except StopIteration:
             self._it = iter(self.loader)
             return next(self._it)
+
+    # stream-state passthrough: a repeating wrapper checkpoints/restores
+    # its inner loader's position (restore re-enters at the saved offset)
+    def state_dict(self) -> dict:
+        return self.loader.state_dict()
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.loader.load_state_dict(sd)
+        self._it = iter(self.loader)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import json
+import math
 import os
 import time
 from typing import Any, Callable, List, NamedTuple, Optional, Tuple
@@ -566,6 +567,34 @@ class DeepSpeedEngine:
                      + (f" + captures {wdc.capture_steps} steps"
                         if wdc.trace and perfetto_supported() else ""),
                      ranks=[0])
+
+        # bf16/fp32 anomaly containment (ds_config `anomaly_detection`;
+        # docs/RESILIENCE.md "Elastic training"): rolling-median grad-norm
+        # spike + non-finite detector.  Where the standard apply/fused
+        # step compiles, the trip is a BRANCHLESS in-program select (the
+        # fp16 has_overflow idiom); after `patience` consecutive trips
+        # the boundary tick rolls back to the last-good checkpoint.
+        self._anomaly = None
+        self._anomaly_pending = None   # lag-1 deferred grad-norm fetch
+        self._anomaly_select = False   # step programs compiled with the bound arg
+        anc = self.config.anomaly_detection
+        if anc.enabled:
+            if self._zeropp or self._onebit:
+                logger.warning(
+                    "anomaly_detection: the ZeRO++/1-bit step programs do "
+                    "not carry the in-program skip select; detector NOT "
+                    "armed (use the standard/offload paths)")
+            else:
+                from deepspeed_tpu.monitor.anomaly import GradAnomalyDetector
+
+                self._anomaly = GradAnomalyDetector(
+                    factor=anc.factor, window=anc.window,
+                    warmup=anc.warmup, patience=anc.patience)
+                log_dist(
+                    f"anomaly detector armed: grad norm non-finite or > "
+                    f"{anc.factor:g}x rolling median skips the step; "
+                    f"{anc.patience} consecutive trips roll back to the "
+                    f"last-good checkpoint", ranks=[0])
 
         self.flops_profiler = None
         self._profile_probes = {}
@@ -1313,6 +1342,7 @@ class DeepSpeedEngine:
     def _compile_steps(self) -> None:
         self._flight.record("compile", what="train step functions",
                             zero_stage=self.zero_stage)
+        self._anomaly_select = False   # set by the paths that compile the bound arg
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         compute_dtype = self.compute_dtype
@@ -1342,8 +1372,16 @@ class DeepSpeedEngine:
                                        state.grad_acc, grads)
             return state._replace(grad_acc=new_acc), loss
 
+        # bf16/fp32 anomaly containment: compile the step with an extra
+        # traced `anomaly_bound` scalar and fold "grad norm non-finite or
+        # above the bound" into the SAME branchless skip select fp16
+        # overflow uses — the skipped step is a no-op on params/opt state
+        # and does not advance global_steps.  Disabled (default): the
+        # programs below are exactly the pre-anomaly forms.
+        anomaly_on = self._anomaly is not None
+
         @jax.named_scope("ds_optimizer_step")
-        def apply(state: TrainState):
+        def apply(state: TrainState, anomaly_bound):
             scale = state.scaler.scale if fp16 else jnp.float32(1.0)
             overflow = has_overflow(state.grad_acc) if fp16 else jnp.zeros((), bool)
             # No-op unscale when fp16 is off: dividing a bf16 accumulator by
@@ -1356,6 +1394,9 @@ class DeepSpeedEngine:
                 grads, gnorm = clip_grad_norm(grads, clip)
             else:
                 gnorm = global_norm(grads)
+            if anomaly_on:
+                overflow = (overflow | ~jnp.isfinite(gnorm)
+                            | (gnorm > anomaly_bound))
             updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
             if getattr(self.optimizer, "updates_are_new_params", False):
                 # adam8bit-style transformations return new params directly
@@ -1365,7 +1406,7 @@ class DeepSpeedEngine:
                 import optax
 
                 new_params = optax.apply_updates(state.params, updates)
-            if fp16:
+            if fp16 or anomaly_on:
                 sel = lambda new, old: jax.tree.map(
                     lambda a, b: jnp.where(overflow, b, a), new, old)
                 new_params = sel(new_params, state.params)
@@ -1383,6 +1424,11 @@ class DeepSpeedEngine:
 
         def evaluate(params, batch, rng):
             return loss_fn(cast_params(params), batch, rng)
+
+        def apply1(state: TrainState):
+            # anomaly off: the bound arg is never read, so this compiles
+            # to exactly the historical one-arg program
+            return apply(state, None)
 
         def offload_prep(state: TrainState):
             """Device half of the offload step: unscale + clip; grads leave
@@ -1411,7 +1457,7 @@ class DeepSpeedEngine:
                     state.global_steps + (1 - overflow.astype(jnp.int32)),
                     new_scaler)
 
-        def fused(state: TrainState, batches, rng):
+        def fused(state: TrainState, batches, rng, anomaly_bound):
             """Full optimizer step in ONE XLA program: scan the gas
             micro-batches (grad accumulation), then apply the update.  One
             host dispatch instead of gas+1 — the dispatch latency matters on
@@ -1425,8 +1471,11 @@ class DeepSpeedEngine:
                 return st, loss
 
             state, losses = jax.lax.scan(micro, state, (batches, rngs))
-            state, gnorm, overflow = apply(state)
+            state, gnorm, overflow = apply(state, anomaly_bound)
             return state, losses.mean(), gnorm, overflow
+
+        def fused1(state: TrainState, batches, rng):
+            return fused(state, batches, rng, None)
 
         if self._zeropp:
             self._compile_zeropp_steps(loss_fn, gas)
@@ -1464,14 +1513,22 @@ class DeepSpeedEngine:
                     out_shardings=scalar)
             return
         if self._overlap:
-            self._compile_overlap_steps(apply, evaluate, gas)
+            self._compile_overlap_steps(apply if anomaly_on else apply1,
+                                        evaluate, gas, anomaly_on)
             return
         self._accum_fn = jax.jit(accum, donate_argnums=(0,), in_shardings=(sh, None, None),
                                  out_shardings=(sh, NamedSharding(self.mesh, P())))
+        self._anomaly_select = anomaly_on and not self._offload
         if not self._offload:
-            self._fused_fn = jax.jit(
-                fused, donate_argnums=(0,), in_shardings=(sh, None, None),
-                out_shardings=(sh, scalar, scalar, scalar))
+            if anomaly_on:
+                self._fused_fn = jax.jit(
+                    fused, donate_argnums=(0,),
+                    in_shardings=(sh, None, None, None),
+                    out_shardings=(sh, scalar, scalar, scalar))
+            else:
+                self._fused_fn = jax.jit(
+                    fused1, donate_argnums=(0,), in_shardings=(sh, None, None),
+                    out_shardings=(sh, scalar, scalar, scalar))
         if self._offload:
             self._offload_prep_fn = jax.jit(offload_prep, in_shardings=(sh,))
             self._offload_commit_fn = jax.jit(
@@ -1479,14 +1536,21 @@ class DeepSpeedEngine:
                 out_shardings=(sh.grad_acc, NamedSharding(self.mesh, P()), sh.scaler))
             self._apply_fn = None
         else:
-            self._apply_fn = jax.jit(apply, donate_argnums=(0,),
-                                     in_shardings=(sh,),
-                                     out_shardings=(sh, NamedSharding(self.mesh, P()),
-                                                    NamedSharding(self.mesh, P())))
+            if anomaly_on:
+                self._apply_fn = jax.jit(
+                    apply, donate_argnums=(0,), in_shardings=(sh, None),
+                    out_shardings=(sh, NamedSharding(self.mesh, P()),
+                                   NamedSharding(self.mesh, P())))
+            else:
+                self._apply_fn = jax.jit(
+                    apply1, donate_argnums=(0,), in_shardings=(sh,),
+                    out_shardings=(sh, NamedSharding(self.mesh, P()),
+                                   NamedSharding(self.mesh, P())))
         self._eval_fn = jax.jit(evaluate, in_shardings=(self._param_shardings, None, None),
                                 out_shardings=NamedSharding(self.mesh, P()))
 
-    def _compile_overlap_steps(self, apply, evaluate, gas) -> None:
+    def _compile_overlap_steps(self, apply, evaluate, gas,
+                               anomaly_on: bool = False) -> None:
         """Accum (and the fused step's micro scan) under full-manual
         ``shard_map`` with the layer-bucketed explicit collective schedule
         (runtime/zero/overlap.py).  The boundary ``apply`` and ``evaluate``
@@ -1529,7 +1593,12 @@ class DeepSpeedEngine:
         sh = self._state_shardings
         scalar = NamedSharding(mesh, P())
 
-        def fused(state: TrainState, batches, rng):
+        self._anomaly_select = anomaly_on
+
+        def fused(state: TrainState, batches, rng, *anomaly_bound):
+            # *anomaly_bound: one traced scalar when the anomaly select is
+            # compiled in, empty otherwise — `apply` arrives 2-arg or
+            # 1-arg to match (see _compile_steps)
             rngs = jax.random.split(rng, gas)
 
             def micro(st, xs):
@@ -1538,14 +1607,16 @@ class DeepSpeedEngine:
                 return st, loss
 
             state, losses = jax.lax.scan(micro, state, (batches, rngs))
-            state, gnorm, overflow = apply(state)
+            state, gnorm, overflow = apply(state, *anomaly_bound)
             return state, losses.mean(), gnorm, overflow
 
+        extra = (None,) if anomaly_on else ()
         self._fused_fn = jax.jit(
-            fused, donate_argnums=(0,), in_shardings=(sh, None, None),
+            fused, donate_argnums=(0,),
+            in_shardings=(sh, None, None) + extra,
             out_shardings=(sh, scalar, scalar, scalar))
         self._apply_fn = jax.jit(apply, donate_argnums=(0,),
-                                 in_shardings=(sh,),
+                                 in_shardings=(sh,) + extra,
                                  out_shardings=(sh, scalar, scalar))
         self._eval_fn = jax.jit(
             evaluate, in_shardings=(self._param_shardings, None, None),
@@ -2000,6 +2071,98 @@ class DeepSpeedEngine:
             self._aux_trace = (cap, "watchdog", None)
 
     # ------------------------------------------------------------------
+    # anomaly containment: skip -> rollback ladder for bf16/fp32 runs
+    # (docs/RESILIENCE.md "Elastic training"; the boundary-hook slot the
+    # watchdog and preemption ticks share)
+    # ------------------------------------------------------------------
+    def _anomaly_tick(self) -> None:
+        """Classify the PREVIOUS boundary's realized grad norm (lag-1
+        deferred fetch — the serving ``_fetch_block`` idiom: the value has
+        long materialized, so this never blocks the step just dispatched)
+        and escalate: count the skip, and after ``patience`` consecutive
+        trips roll back to the last-good checkpoint."""
+        a = self._anomaly
+        if a is None:
+            return
+        pending, self._anomaly_pending = (self._anomaly_pending,
+                                          (self._last_grad_norm,
+                                           self._last_overflow))
+        if pending is None:
+            return
+        gnorm = float(np.asarray(pending[0]))
+        # the device's own select decision for that step: for non-fp16
+        # engines the overflow output IS the anomaly trip, which keeps
+        # the host ledger truthful even when the cached bound drifted
+        # from the live median between dispatch and classification (a
+        # dropped step must never go uncounted); fp16 conflates it with
+        # loss-scale overflow, so fall back to the host rule there
+        skipped = (None if self.fp16_enabled or pending[1] is None
+                   else bool(np.asarray(pending[1])))
+        if not a.observe(gnorm, skipped=skipped):
+            return
+        get_registry().counter(
+            "ds_train_anomaly_skipped_total",
+            "training steps skipped by the grad-norm anomaly select "
+            "(non-finite or above factor x rolling median)").inc()
+        trip = dict(a.last_trip)
+        trip["step"] = self._host_steps
+        # the recorder's first positional is the EVENT kind; the
+        # detector's trip kind rides as "anomaly"
+        trip["anomaly"] = trip.pop("kind")
+        self._flight.record("anomaly_skip", **trip)
+        logger.warning(
+            "anomaly: grad norm %.3e flagged %s (median %.3e, consecutive "
+            "%d/%d) — step skipped", gnorm, trip["anomaly"], trip["median"],
+            a.consecutive, a.patience)
+        if a.should_rollback and self.config.anomaly_detection.rollback:
+            self._anomaly_rollback()
+
+    def _anomaly_rollback(self) -> None:
+        """``patience`` consecutive anomalous steps: the skip select alone
+        is not containing the failure (a poisoned accumulator, or params
+        already damaged before the detector armed) — dump the flight
+        recorder and restore the newest valid checkpoint."""
+        a = self._anomaly
+        anc = self.config.anomaly_detection
+        if a.rollback_streak >= anc.max_rollbacks:
+            raise RuntimeError(
+                f"anomaly: {a.rollback_streak} rollbacks without a single "
+                f"accepted step in between (max_rollbacks="
+                f"{anc.max_rollbacks}) — the anomaly persists across "
+                "restores; refusing to loop")
+        save_dir = (anc.save_dir or self.config.checkpoint_config.save_dir
+                    or (self._preempt_cfg[0] if self._preempt_cfg else None))
+        reason = (f"anomaly rollback: {a.consecutive} consecutive anomalous "
+                  f"steps at step {self._host_steps}")
+        self._flight.record("anomaly_rollback", step=self._host_steps,
+                            consecutive=a.consecutive,
+                            trip=dict(a.last_trip or {}))
+        try:
+            self._flight.dump(reason=reason)
+        except Exception as exc:     # a broken disk must not kill the run
+            logger.error("anomaly: flight dump failed: %s", exc)
+        if save_dir is None:
+            logger.error("anomaly: rollback requested but no save dir is "
+                         "configured (anomaly_detection.save_dir / "
+                         "checkpoint.save_dir); continuing with per-step "
+                         "skips only")
+            a.consecutive = 0        # re-arm the ladder, don't re-enter per step
+            return
+        ckpt_dir, _ = self.load_checkpoint(save_dir)
+        if ckpt_dir is None:
+            logger.error("anomaly: nothing loadable in %s; continuing with "
+                         "per-step skips only", save_dir)
+            a.consecutive = 0
+            return
+        get_registry().counter(
+            "ds_train_anomaly_rollback_total",
+            "anomaly-ladder rollbacks to the last-good checkpoint").inc()
+        a.note_rollback()
+        self._anomaly_pending = None   # the pending norm belongs to the dead timeline
+        logger.warning("%s — restored %s (rollback #%d)", reason, ckpt_dir,
+                       a.rollbacks)
+
+    # ------------------------------------------------------------------
     # preemption: SIGTERM -> emergency save at the next optimizer boundary
     # (docs/RESILIENCE.md; same boundary-hook slot as the watchdog)
     # ------------------------------------------------------------------
@@ -2295,7 +2458,11 @@ class DeepSpeedEngine:
                 gnorm, overflow = self._step_offload()
             else:
                 with annotate("ds_optimizer_step"):
-                    self.state, gnorm, overflow = self._apply_fn(self.state)
+                    if self._anomaly_select:
+                        self.state, gnorm, overflow = self._apply_fn(
+                            self.state, self._anomaly.bound)
+                    else:
+                        self.state, gnorm, overflow = self._apply_fn(self.state)
         except BaseException:
             # leave the timer re-startable: a caller that catches a
             # mid-step failure and resumes from a checkpoint must not hit
@@ -2325,6 +2492,7 @@ class DeepSpeedEngine:
         if self._trace is not None:
             self._trace.after_step(self._host_steps)
         self._watchdog_tick()
+        self._anomaly_tick()
         self._aux_trace_tick()
         self._preemption_tick()
 
@@ -2360,6 +2528,14 @@ class DeepSpeedEngine:
         leaves = jax.tree_util.tree_leaves(acc)
         gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
                                   for g in leaves)))
+        if self._anomaly is not None and (not math.isfinite(gnorm)
+                                          or gnorm > self._anomaly.bound):
+            # anomaly skip (fp16-overflow semantics for the host-master
+            # path): drop the accumulated grads, step nothing
+            for g in leaves:
+                g[:] = 0.0
+            self._last_grad_norm = gnorm
+            return gnorm, True
         clip = self.config.gradient_clipping
         if clip and clip > 0 and gnorm > clip:
             scale = clip / (gnorm + 1e-6)
@@ -2413,6 +2589,14 @@ class DeepSpeedEngine:
         # The host optimizer step forces a sync anyway; reading the overflow
         # flag here costs nothing extra (reference offload is host-synced too).
         skipped = self.fp16_enabled and bool(overflow)
+        if self._anomaly is not None and not skipped:
+            # anomaly skip for the host-stepped path: the same sync
+            # rationale as the overflow read above (no in-program select
+            # exists — the optimizer step is host code)
+            g = float(np.asarray(gnorm))
+            if not math.isfinite(g) or g > self._anomaly.bound:
+                skipped = True
+                overflow = np.bool_(True)   # steps/scaler record the skip
         if not skipped:
             flat, treedef = jax.tree_util.tree_flatten(grads)
             for leaf in flat:  # start every D2H now; np.asarray below collects
@@ -2548,8 +2732,12 @@ class DeepSpeedEngine:
         # the host range cannot separate them (device scope rows can)
         try:
             with annotate("ds_fwd_bwd"):
-                self.state, loss, gnorm, overflow = self._fused_fn(
-                    self.state, stacked, rng)
+                if self._anomaly_select:
+                    self.state, loss, gnorm, overflow = self._fused_fn(
+                        self.state, stacked, rng, self._anomaly.bound)
+                else:
+                    self.state, loss, gnorm, overflow = self._fused_fn(
+                        self.state, stacked, rng)
         except BaseException:
             # keep the timer re-startable across a caught mid-step failure
             self.timers(SynchronizedWallClockTimer.STEP).stop(record=False)
@@ -2587,6 +2775,7 @@ class DeepSpeedEngine:
         if self._trace is not None:
             self._trace.after_step(self._host_steps)
         self._watchdog_tick()
+        self._anomaly_tick()
         self._aux_trace_tick()
         self._preemption_tick()
         return loss
@@ -2695,6 +2884,20 @@ class DeepSpeedEngine:
         final_dir = os.path.join(save_dir, tag)
         stage_dir = atomic.stage_path(save_dir, tag)
         rank0 = comm.get_rank() == 0
+        # deterministic data resume (docs/RESILIENCE.md "Elastic
+        # training"): the attached dataloader's stream state (epoch,
+        # sample offset, shuffle seed) rides client_state so an elastic
+        # restart replays the exact remaining sample stream — an explicit
+        # caller-provided "dataloader" key wins
+        client_state = dict(client_state or {})
+        dl = self.training_dataloader
+        if (dl is not None and "dataloader" not in client_state
+                and hasattr(dl, "state_dict")):
+            try:
+                client_state["dataloader"] = dl.state_dict()
+            except Exception as exc:
+                logger.warning("checkpoint: dataloader state_dict failed: "
+                               "%s", exc)
         # every process ensures the dirs exist (a non-shared filesystem
         # would otherwise FileNotFoundError on non-zero ranks); only rank
         # 0 clears crash debris — concurrent rmtrees could delete a
@@ -2717,12 +2920,22 @@ class DeepSpeedEngine:
             # host-resident fp32 master + moments, streamed one leaf at a time
             self._offload_opt.write_state(os.path.join(stage_dir, "offload_states"))
         if rank0:
-            meta = {"client_state": client_state or {},
+            # the batch triad rides along so a resume at a DIFFERENT
+            # device set can rescale grad accumulation to preserve the
+            # recorded global batch (_maybe_elastic_rescale)
+            meta = {"client_state": client_state,
                     "micro_count": self._micro_count,
                     "lr_scheduler": (self.lr_scheduler.state_dict()
                                      if self.lr_scheduler else None),
                     "zero_stage": self.zero_stage,
-                    "world_size": comm.get_world_size()}
+                    "world_size": comm.get_world_size(),
+                    "data_parallel_size":
+                        comm.get_data_parallel_world_size(self.mesh),
+                    "gradient_accumulation_steps":
+                        self.config.gradient_accumulation_steps,
+                    "train_micro_batch_size_per_gpu":
+                        self.config.train_micro_batch_size_per_gpu,
+                    "train_batch_size": self.config.train_batch_size}
             with open(os.path.join(stage_dir, "client_state.json"), "w") as fh:
                 json.dump(meta, fh, default=str)
         comm.barrier()               # every process's shards are on disk
@@ -2813,6 +3026,7 @@ class DeepSpeedEngine:
                            "cannot load", load_dir)
             return None, {}
         verify = self.config.checkpoint_config.verify_on_load
+        deep = self.config.checkpoint_config.deep_verify_on_load
         reg = get_registry()
         for i, t in enumerate(candidates):
             ckpt_dir = os.path.join(load_dir, t)
@@ -2834,6 +3048,25 @@ class DeepSpeedEngine:
                         "checkpoint %s failed verification (%s): %s — "
                         "walking back", ckpt_dir, st.state,
                         "; ".join(st.problems[:3]) or "?")
+                    continue
+            if deep:
+                # chunk-level pass (checkpoint.deep_verify_on_load),
+                # independent of verify_on_load: names the offending
+                # shard/leaf and catches index corruption the per-file
+                # manifest hashes cannot
+                deep_problems = atomic.deep_verify(ckpt_dir)
+                if deep_problems:
+                    reg.counter(
+                        "ds_ckpt_verify_failures_total",
+                        "checkpoint tags that failed manifest "
+                        "verification at load").inc()
+                    self._flight.record("ckpt_verify_fail", tag=t,
+                                        state="corrupt_deep",
+                                        problems=deep_problems[:3])
+                    logger.warning(
+                        "checkpoint %s failed DEEP verification: %s — "
+                        "walking back", ckpt_dir,
+                        "; ".join(deep_problems[:3]))
                     continue
             result = self._load_checkpoint_dir(
                 ckpt_dir, load_optimizer_states, load_lr_scheduler_states,
@@ -2898,6 +3131,7 @@ class DeepSpeedEngine:
         self.state = new_state
         if self._param_offload and getattr(self, "_streamed", None) is not None:
             self._np_params = jax.device_get(self.state.params)
+        self._restore_client_runtime(meta)
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})
 
@@ -2947,8 +3181,91 @@ class DeepSpeedEngine:
         self.state = new_state
         if self._param_offload and getattr(self, "_streamed", None) is not None:
             self._np_params = jax.device_get(self.state.params)
+        self._restore_client_runtime(meta)
         log_dist(f"loaded legacy checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})
+
+    def _restore_client_runtime(self, meta: dict) -> None:
+        """Elastic-resume hooks shared by both load paths: rescale grad
+        accumulation against the recorded batch triad when the device set
+        changed, then restore the attached dataloader's stream state."""
+        self._maybe_elastic_rescale(meta)
+        dl_state = (meta.get("client_state") or {}).get("dataloader")
+        dl = self.training_dataloader
+        if dl_state and dl is not None and hasattr(dl, "load_state_dict"):
+            try:
+                dl.load_state_dict(dl_state)
+            except Exception as exc:
+                logger.warning("checkpoint: dataloader state restore "
+                               "failed: %s", exc)
+
+    def _maybe_elastic_rescale(self, meta: dict) -> None:
+        """World-size-change resume (docs/RESILIENCE.md "Elastic
+        training"): the checkpoint records the batch triad it was trained
+        with; when the data-parallel extent changed across the restart,
+        rescale ``gradient_accumulation_steps`` (keeping the per-device
+        micro batch) so the GLOBAL batch — and therefore the loss
+        trajectory — is preserved, and recompile the step programs with
+        the new accumulation count.  The divisibility rule: the recorded
+        global batch must be an exact multiple of ``micro x new_dp``;
+        anything else raises instead of silently training at a different
+        batch size."""
+        saved_dp = int(meta.get("data_parallel_size") or 0)
+        saved_gas = int(meta.get("gradient_accumulation_steps") or 0)
+        saved_micro = int(meta.get("train_micro_batch_size_per_gpu") or 0)
+        if not (saved_dp and saved_gas and saved_micro):
+            return          # pre-elastic checkpoint: no triad recorded
+        cfg = self.config
+        cur_dp = comm.get_data_parallel_world_size(self.mesh)
+        saved_tbs = int(meta.get("train_batch_size")
+                        or saved_micro * saved_gas * saved_dp)
+        cur_tbs = (cfg.train_micro_batch_size_per_gpu
+                   * cfg.gradient_accumulation_steps * cur_dp)
+        if cur_tbs == saved_tbs:
+            return          # triad already consistent (same world, or the
+                            # config pre-resolved gas for the new world)
+        if not cfg.checkpoint_config.elastic_resume:
+            logger.warning(
+                "checkpoint was trained at global batch %d (dp=%d, gas=%d) "
+                "but this run computes %d (dp=%d): checkpoint."
+                "elastic_resume is OFF — keeping the current triad; the "
+                "loss trajectory will NOT match the original run",
+                saved_tbs, saved_dp, saved_gas, cur_tbs, cur_dp)
+            return
+        den = cfg.train_micro_batch_size_per_gpu * cur_dp
+        if saved_tbs % den:
+            from deepspeed_tpu.elasticity import \
+                ElasticityIncompatibleWorldSize
+
+            raise ElasticityIncompatibleWorldSize(
+                f"cannot resume the recorded global batch {saved_tbs} at "
+                f"data-parallel world {cur_dp} with micro batch "
+                f"{cfg.train_micro_batch_size_per_gpu}: {saved_tbs} is not "
+                f"a multiple of micro x dp = {den} — resume at a world "
+                f"size dividing global_batch/micro "
+                f"(docs/RESILIENCE.md 'Elastic training')")
+        new_gas = saved_tbs // den
+        old_gas = cfg.gradient_accumulation_steps
+        cfg.gradient_accumulation_steps = new_gas
+        cfg.train_batch_size = saved_tbs
+        if self._micro_count:
+            logger.warning("elastic resume inside an accumulation window: "
+                           "dropping %d partial micro-batches",
+                           self._micro_count)
+            self._micro_count = 0
+        if new_gas != old_gas:
+            self._compile_steps()   # gas is baked into the step programs
+        self.tput_timer.batch_size = saved_tbs
+        get_registry().counter(
+            "ds_elastic_resumes_total",
+            "checkpoint loads that rescaled grad accumulation to preserve "
+            "the global batch across a world-size change").inc()
+        self._flight.record("elastic_resume", saved_dp=saved_dp, dp=cur_dp,
+                            saved_gas=saved_gas, gas=new_gas,
+                            global_batch=saved_tbs)
+        log_dist(f"elastic resume: dp {saved_dp} -> {cur_dp}; "
+                 f"gradient_accumulation_steps {saved_gas} -> {new_gas} "
+                 f"preserves global batch {saved_tbs}", ranks=[0])
 
     def _cast_like(self, tree, like):
         """Cast loaded leaves to the live state's dtypes (cheap jitted map;

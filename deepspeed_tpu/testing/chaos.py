@@ -65,7 +65,7 @@ from typing import Any, Dict, Iterator, Optional
 __all__ = ["InjectedFault", "crash_on_write", "crash_before",
            "fail_after_calls", "truncate_file", "flip_bit",
            "crash_on_call", "wedge_method", "http_error_burst",
-           "ChaosProxy"]
+           "gradient_bomb", "ChaosProxy"]
 
 
 class InjectedFault(RuntimeError):
@@ -238,6 +238,44 @@ def wedge_method(obj: Any, method: str,
     finally:
         handle["release"].set()
         setattr(obj, method, real)
+
+
+@contextmanager
+def gradient_bomb(engine: Any, scale: float = 1e20, on_call: int = 1,
+                  n: int = 1) -> Iterator[Dict[str, int]]:
+    """Training fault: multiply the float leaves of the batches fed to
+    calls ``[on_call, on_call + n)`` (1-based) of ``engine.forward`` by
+    ``scale`` — the corrupt-batch / garbage-host event that sends a bf16
+    run non-finite or spikes the gradient norm by orders of magnitude.
+    The anomaly ladder (``anomaly_detection``) must contain it: skip the
+    step, then roll back after ``patience`` consecutive trips.  Yields
+    ``{"calls", "bombed"}``.  Works on float batches (int token batches
+    cannot be scaled into a bomb — poison the labels/model instead)."""
+    real = engine.forward
+    state = {"calls": 0, "bombed": 0}
+
+    def _scale(b):
+        if isinstance(b, (tuple, list)):
+            return type(b)(_scale(v) for v in b)
+        if isinstance(b, dict):
+            return {k: _scale(v) for k, v in b.items()}
+        kind = getattr(getattr(b, "dtype", None), "kind", None)
+        if kind == "f" or (kind is None and isinstance(b, float)):
+            return b * scale
+        return b
+
+    def wrapped(batch):
+        state["calls"] += 1
+        if on_call <= state["calls"] < on_call + n:
+            state["bombed"] += 1
+            batch = _scale(batch)
+        return real(batch)
+
+    engine.forward = wrapped
+    try:
+        yield state
+    finally:
+        engine.forward = real
 
 
 def http_error_burst(handler, n: int, code: int = 500):

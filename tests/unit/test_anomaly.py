@@ -1,0 +1,283 @@
+"""bf16/fp32 anomaly containment (docs/RESILIENCE.md "Elastic training"):
+the ``anomaly_detection`` skip -> rollback ladder.
+
+A gradient bomb (``testing/chaos.gradient_bomb``) must be CONTAINED: the
+anomalous step is skipped in-program (the fp16 ``has_overflow`` select,
+mirrored — params/opt state untouched, global_steps not advanced), and
+after ``patience`` consecutive trips the engine dumps the flight recorder
+and rolls back to the last-good checkpoint, after which the run
+re-converges loss-identical to a run that never saw the bomb.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.anomaly import GradAnomalyDetector
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.metrics import get_registry
+from deepspeed_tpu.testing import chaos
+from tests.unit.simple_model import SimpleModel, random_dataset
+
+X, Y = random_dataset(n=32)
+
+
+# ---------------------------------------------------------------------------
+# detector units (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_detector_warmup_never_trips_on_spikes():
+    d = GradAnomalyDetector(factor=5.0, window=8, warmup=4)
+    assert d.bound == math.inf
+    for g in (1.0, 100.0, 1.2):         # wild swings during warmup: accepted
+        assert not d.observe(g)
+    assert d.bound == math.inf
+    assert not d.observe(1.1)           # 4th sample arms the bound
+    assert d.bound < math.inf
+
+
+def test_detector_nonfinite_trips_even_unarmed():
+    d = GradAnomalyDetector(factor=5.0, window=8, warmup=4)
+    assert d.observe(float("nan"))
+    assert d.observe(float("inf"))
+    assert d.last_trip["kind"] == "non_finite"
+    assert d.consecutive == 2 and d.trips_total == 2
+    assert not d.observe(1.0)           # healthy sample resets the run
+    assert d.consecutive == 0
+
+
+def test_detector_spike_vs_drift_and_cached_bound():
+    d = GradAnomalyDetector(factor=4.0, window=16, warmup=4, patience=2)
+    for _ in range(6):
+        assert not d.observe(1.0)
+    rec0 = d.median_recomputes
+    assert not d.observe(1.01)          # under the cached bound: fast path
+    assert d.median_recomputes == rec0
+    # a genuine spike trips and NEVER enters the window
+    assert d.observe(50.0)
+    assert d.last_trip["kind"] == "spike"
+    assert abs(d.median - 1.0) < 0.02
+    # slow drift above the cached bound but under factor x median is a
+    # false alarm: accepted, and the bound refreshes so the new normal
+    # stops taking the slow path
+    assert not d.observe(3.9)
+    assert d.consecutive == 0
+    # escalation: patience consecutive trips -> should_rollback
+    assert d.observe(50.0) and not d.should_rollback
+    assert d.observe(50.0) and d.should_rollback
+    d.note_rollback()
+    assert d.consecutive == 0 and d.rollbacks == 1 and d.rollback_streak == 1
+    # an accepted step forgives the rollback streak (not the lifetime count)
+    assert not d.observe(1.0)
+    assert d.rollback_streak == 0 and d.rollbacks == 1
+
+
+def test_detector_bound_reanchors_as_median_falls():
+    d = GradAnomalyDetector(factor=5.0, window=4, warmup=2)
+    for g in (100.0, 100.0):            # compile-era noise inflates warmup
+        d.observe(g)
+    high = d.bound
+    for g in (1.0, 1.0, 1.0, 1.0, 1.0):  # training settles
+        assert not d.observe(g)
+    assert d.bound < high               # once-per-window re-anchor
+    assert d.observe(30.0)              # a spike vs the NEW median trips
+
+
+# ---------------------------------------------------------------------------
+# engine e2e
+# ---------------------------------------------------------------------------
+
+
+def _make_engine(tmp_path, stage=0, masters=None, patience=2, rollback=True,
+                 max_rollbacks=3):
+    zero = {"stage": stage}
+    if masters is not None:
+        zero["offload_optimizer"] = {"device": "cpu",
+                                     "int8_masters": masters == "int8"}
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": zero, "steps_per_print": 10**9,
+           "anomaly_detection": {"enabled": True, "factor": 5.0,
+                                 "window": 8, "warmup": 3,
+                                 "patience": patience, "rollback": rollback,
+                                 "max_rollbacks": max_rollbacks,
+                                 "save_dir": str(tmp_path)},
+           "flight_recorder": {"enabled": True, "dump_dir": str(tmp_path)}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg,
+        rng=jax.random.PRNGKey(3))
+    return engine
+
+
+def _step(engine, i):
+    lo = (i % 4) * 8
+    loss = engine.forward((X[lo:lo + 8], Y[lo:lo + 8]))
+    engine.step()
+    return float(loss)
+
+
+def _params(engine):
+    return jax.tree.map(lambda a: np.array(a),
+                        jax.device_get(engine.state.params))
+
+
+@pytest.mark.parametrize("masters", [None, "fp32", "int8"])
+def test_gradient_bomb_contained_skip_then_rollback(tmp_path, masters):
+    """THE containment e2e, on the in-program select path (plain state)
+    and both host-master offload paths: 3 bombed steps -> every one
+    skipped (params frozen), 2 consecutive detections -> flight dump +
+    rollback to the last-good tag, then the run re-converges
+    loss-identical to a run that never saw the bomb."""
+    reg = get_registry()
+    reg.enable()
+    flight = get_flight_recorder()
+    flight.reset()
+    try:
+        # clean first: the process-global flight recorder keeps the LAST
+        # enable()'s dump_dir, which must be tmp_path for the dump assert
+        clean = _make_engine(tmp_path / "clean", masters=masters)
+        engine = _make_engine(tmp_path, masters=masters)
+        for i in range(5):
+            _step(engine, i)
+            _step(clean, i)
+        engine.save_checkpoint(str(tmp_path), tag="good")
+        good = _params(engine)
+        steps0 = engine.global_steps
+        sk0 = reg.counter("ds_train_anomaly_skipped_total").value
+        rb0 = reg.counter("ds_train_anomaly_rollback_total").value
+
+        with chaos.gradient_bomb(engine, scale=1e18, on_call=1, n=3) as st:
+            for i in range(3):
+                _step(engine, 5 + i)
+        assert st["bombed"] == 3
+        # every bombed step was a no-op on the params (skip select /
+        # host-side skip), and the rollback restored the good tag
+        for a, b in zip(jax.tree.leaves(good),
+                        jax.tree.leaves(_params(engine))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert engine.global_steps == steps0
+        assert reg.counter("ds_train_anomaly_skipped_total").value \
+            - sk0 >= 2
+        assert reg.counter("ds_train_anomaly_rollback_total").value \
+            - rb0 == 1
+        kinds = [e["kind"] for e in flight.events()]
+        assert "anomaly_skip" in kinds and "anomaly_rollback" in kinds
+        assert os.path.exists(str(tmp_path)) and any(
+            n.startswith("ds_flight") for n in os.listdir(tmp_path))
+
+        # post-rollback: loss-identical to the engine that never bombed
+        after = [_step(engine, 5 + i) for i in range(4)]
+        ref = [_step(clean, 5 + i) for i in range(4)]
+        assert after == ref, (after, ref)
+        assert engine._anomaly.consecutive == 0
+    finally:
+        flight.disable()
+        reg.disable()
+
+
+def test_spike_skip_without_rollback(tmp_path):
+    """A single finite spike (below patience) skips exactly one step and
+    never rolls back; the next healthy step trains normally."""
+    reg = get_registry()
+    reg.enable()
+    try:
+        engine = _make_engine(tmp_path, patience=3)
+        for i in range(5):
+            _step(engine, i)
+        engine.save_checkpoint(str(tmp_path), tag="good")
+        p0 = _params(engine)
+        rb0 = reg.counter("ds_train_anomaly_rollback_total").value
+        with chaos.gradient_bomb(engine, scale=1e3, on_call=1, n=1):
+            _step(engine, 5)
+        # the spike step froze params...
+        for a, b in zip(jax.tree.leaves(p0),
+                        jax.tree.leaves(_params(engine))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        _step(engine, 6)                 # lag-1 tick classifies the spike
+        assert engine._anomaly.trips_total >= 1
+        assert reg.counter("ds_train_anomaly_rollback_total").value == rb0
+        # ...and the healthy step after it moved them
+        assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+                   for a, b in zip(jax.tree.leaves(p0),
+                                   jax.tree.leaves(_params(engine))))
+    finally:
+        reg.disable()
+
+
+def test_persistent_anomaly_exhausts_max_rollbacks(tmp_path):
+    """A bomb that persists across restores must not loop forever: after
+    ``max_rollbacks`` ladder rollbacks with no accepted step in between,
+    the engine raises."""
+    engine = _make_engine(tmp_path, patience=1, max_rollbacks=1)
+    for i in range(5):
+        _step(engine, i)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    with pytest.raises(RuntimeError, match="max_rollbacks"):
+        with chaos.gradient_bomb(engine, scale=1e18, on_call=1, n=10):
+            for i in range(10):
+                _step(engine, 5 + i)
+
+
+def test_rollback_without_savedir_degrades_to_skips(tmp_path):
+    """No checkpoint to restore: the ladder logs, re-arms, and the run
+    keeps skipping instead of crashing."""
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 10**9,
+           "anomaly_detection": {"enabled": True, "factor": 5.0,
+                                 "window": 8, "warmup": 3, "patience": 2}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg,
+        rng=jax.random.PRNGKey(3))
+    for i in range(4):
+        _step(engine, i)
+    p0 = _params(engine)
+    with chaos.gradient_bomb(engine, scale=1e18, on_call=1, n=5):
+        for i in range(5):
+            _step(engine, 4 + i)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(_params(engine))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert engine._anomaly.rollbacks == 0
+
+
+def test_disabled_by_default_and_fused_path_skips(tmp_path):
+    """Default engines carry no detector (the step program is the
+    historical one-arg form); with the detector on, the FUSED
+    single-dispatch train_step also skips in-program."""
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 10**9}
+    plain, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg,
+        rng=jax.random.PRNGKey(3))
+    assert plain._anomaly is None and not plain._anomaly_select
+
+    cfg = dict(cfg)
+    cfg["anomaly_detection"] = {"enabled": True, "factor": 5.0,
+                                "window": 8, "warmup": 2, "patience": 99}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg,
+        rng=jax.random.PRNGKey(3))
+    batch = (X[:32].reshape(2, 16, -1), Y[:32].reshape(2, 16, -1))
+    for _ in range(4):
+        engine.train_batch(iter([(X[:16], Y[:16]), (X[16:32], Y[16:32])]))
+    assert engine._anomaly_select
+    p0 = _params(engine)
+    steps0 = engine.global_steps
+    bombed = (X[:32].reshape(2, 16, -1) * 1e18,
+              Y[:32].reshape(2, 16, -1))
+    engine.train_step(bombed)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(_params(engine))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert engine.global_steps == steps0
+    engine.train_step(batch)             # healthy fused step trains
+    assert engine.global_steps == steps0 + 1

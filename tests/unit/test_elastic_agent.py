@@ -45,6 +45,69 @@ def test_validate_world_rejects_outside_set(tmp_path):
         agent._validate_world(3)
 
 
+def test_world_probe_validates_and_falls_back(tmp_path):
+    """The ``--world-size-file`` probe: missing/garbage files keep the
+    default, readings clamp to num_procs, and an elastic-invalid reading
+    is rejected at relaunch (unit-level)."""
+    path = tmp_path / "world"
+    agent = DSElasticAgent({"elasticity": ELASTIC_SECTION}, "unused.py",
+                           num_procs=2,
+                           world_size_fn=DSElasticAgent.world_size_file_fn(
+                               str(path)))
+    assert agent._probe_world(2) == 2          # no file: default
+    path.write_text("not a number")
+    assert agent._probe_world(2) == 2
+    path.write_text("1")
+    assert agent._probe_world(2) == 1          # shrink reading
+    path.write_text("64")
+    assert agent._probe_world(1) == 2          # clamped to num_procs
+    path.write_text("0")
+    assert agent._probe_world(2) == 2          # nonsense: default
+
+
+def test_world_size_file_grows_next_incarnation(tmp_path):
+    """Changed-device-set detection ACROSS a restart: the agent starts at
+    the probed world 1 (capacity reported down), the incarnation crashes
+    after flipping the probe file to 2 (capacity back), and the agent
+    GROWS the relaunch to world 2 instead of relaunching the survivor
+    count.  Stdlib-only child: the grow path is agent logic, not jax."""
+    world_file = tmp_path / "world"
+    world_file.write_text("1")
+    marker = tmp_path / "incarnations.txt"
+    script = tmp_path / "stub.py"
+    script.write_text(textwrap.dedent("""\
+        import os, sys
+        marker, world_file = sys.argv[1], sys.argv[2]
+        restart = int(os.environ["DS_ELASTIC_RESTART"])
+        world = int(os.environ["WORLD_SIZE"])
+        rank = int(os.environ["RANK"])
+        with open(marker, "a") as fh:
+            fh.write(f"{restart}:{world}:{rank}\\n")
+        if restart == 0:
+            # "the preempted hosts came back": flip the availability file
+            # the scheduler keeps current, then die as a member loss
+            with open(world_file, "w") as fh:
+                fh.write("2")
+            sys.exit(1)
+        sys.exit(0)
+        """))
+    agent = DSElasticAgent(
+        {"elasticity": ELASTIC_SECTION}, str(script),
+        user_args=[str(marker), str(world_file)], num_procs=2,
+        max_restarts=3, no_local_rank=True,
+        world_size_fn=DSElasticAgent.world_size_file_fn(str(world_file)))
+    assert agent.run() == 0
+    lines = marker.read_text().strip().splitlines()
+    by_restart = {}
+    for line in lines:
+        r, w, rank = map(int, line.split(":"))
+        by_restart.setdefault(r, []).append((w, rank))
+    # incarnation 0 ran at the probed world 1; incarnation 1 GREW to 2
+    assert by_restart[0] == [(1, 0)], by_restart
+    assert sorted(by_restart[1]) == [(2, 0), (2, 1)], by_restart
+    assert agent.restart_count == 1
+
+
 def test_kill_one_member_restart_resumes(tmp_path):
     """The done-criterion: rank 1 dies at step 2 of 4; the agent restarts at
     world=1; the survivor resumes from the step-2 checkpoint and finishes."""

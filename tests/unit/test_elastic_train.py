@@ -1,0 +1,373 @@
+"""Elastic training resilience (docs/RESILIENCE.md "Elastic training").
+
+THE acceptance e2e: a crash-atomic checkpoint saved at world 4 resumes at
+world 2 AND world 8 — ZeRO stages 1/2/3 with plain fp32 state and with
+host-offloaded {fp32, int8} masters — with gradient accumulation rescaled
+so the global batch is preserved and the loss trajectory equal to an
+uninterrupted run.  Plus the chaos-matrix pieces that ride the same
+machinery: a bit-flipped shard detected by DEEP verification (per-chunk
+sha256, offending shard named) and recovered via walk-back, and
+deterministic dataloader stream resume across a batch-size change.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.metrics import get_registry
+from deepspeed_tpu.runtime.checkpoint_engine import atomic
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.testing import chaos
+from tests.unit.simple_model import SimpleModel, random_dataset
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+
+X, Y = random_dataset(n=64)
+TBS = 8                        # micro 1 x world 4 x gas 2
+PROBE = (X[:16], Y[:16])
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _make_engine(devs, gas, stage=1, masters=None, ckpt_cfg=None):
+    """Engine over the first ``devs`` virtual devices.  ``masters``:
+    None = plain fp32 state; "fp32"/"int8" = host-offloaded optimizer
+    masters (the PR 10 formats)."""
+    mesh = build_mesh(devices=jax.devices()[:devs])
+    set_global_mesh(mesh)
+    zero = {"stage": stage}
+    if masters is not None:
+        zero["offload_optimizer"] = {"device": "cpu",
+                                     "int8_masters": masters == "int8",
+                                     "quant_block": 64}
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": gas,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": zero,
+           "steps_per_print": 10**9}
+    if ckpt_cfg:
+        cfg["checkpoint"] = ckpt_cfg
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg, mesh=mesh,
+        rng=jax.random.PRNGKey(3))
+    return engine
+
+
+def _eval_loss(engine):
+    engine.eval()
+    try:
+        return float(engine.forward(PROBE))
+    finally:
+        engine.train()
+
+
+def _run_steps(engine, n, start=0):
+    """n optimizer steps over a FIXED global-batch schedule (step i always
+    consumes the same TBS samples regardless of the engine's gas/world),
+    returning the eval-loss trajectory — the world-size-independent
+    signal the acceptance compares."""
+    out = []
+    for i in range(start, start + n):
+        gas = engine.config.gradient_accumulation_steps
+        per = TBS // gas
+        for g in range(gas):
+            lo = ((i % 4) * TBS + g * per) % 56
+            engine.forward((X[lo:lo + per], Y[lo:lo + per]))
+        engine.step()
+        out.append(_eval_loss(engine))
+    return out
+
+
+def _init_state(engine, devs):
+    """Lazy-init the engine's state from one correctly-sized batch so
+    load_checkpoint can reshard over it."""
+    engine.forward((X[:devs], Y[:devs]))
+
+
+# ---------------------------------------------------------------------------
+# THE elastic acceptance e2e: save at world 4, resume at 2 and at 8
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+@pytest.mark.parametrize("masters", [None, "fp32", "int8"])
+def test_world_size_change_resume_loss_trajectory(tmp_path, stage, masters):
+    """Save at world 4 (gas 2), resume at world 2 (gas must become 4) and
+    world 8 (gas 1): the eval-loss trajectory equals the uninterrupted
+    world-4 run at the matched global batch.  Plain state runs the
+    in-program step; offloaded fp32/int8 masters run the host-master
+    formats PR 10 added — all resharding through the sharded-load /
+    owned-copy seams."""
+    save_dir = str(tmp_path)
+    reg = get_registry()
+    reg.enable()
+    try:
+        e4 = _make_engine(4, gas=2, stage=stage, masters=masters)
+        _run_steps(e4, 2)
+        e4.save_checkpoint(save_dir, tag="t")
+        ref = _run_steps(e4, 2, start=2)
+
+        for devs, expect_gas in ((2, 4), (8, 1)):
+            er0 = reg.counter("ds_elastic_resumes_total").value
+            e = _make_engine(devs, gas=2, stage=stage, masters=masters)
+            _init_state(e, devs)
+            ckpt_dir, _ = e.load_checkpoint(save_dir)
+            assert ckpt_dir is not None and ckpt_dir.endswith("t")
+            # the divisibility rule resolved gas to preserve global batch 8
+            assert e.config.gradient_accumulation_steps == expect_gas
+            assert e.config.train_batch_size == TBS
+            assert reg.counter("ds_elastic_resumes_total").value - er0 == 1
+            got = _run_steps(e, 2, start=2)
+            # different device counts reduce/accumulate in a different
+            # order: tolerance-equal, not bit-equal
+            assert np.allclose(ref, got, rtol=1e-4), (devs, ref, got)
+    finally:
+        reg.disable()
+
+
+def test_same_world_resume_stays_exact(tmp_path):
+    """Control: a same-topology resume does NOT rescale (no recompile,
+    no counter) and the trajectory is exactly the uninterrupted run's."""
+    save_dir = str(tmp_path)
+    reg = get_registry()
+    reg.enable()
+    try:
+        e = _make_engine(4, gas=2)
+        _run_steps(e, 2)
+        e.save_checkpoint(save_dir, tag="t")
+        ref = _run_steps(e, 2, start=2)
+        er0 = reg.counter("ds_elastic_resumes_total").value
+        e2 = _make_engine(4, gas=2)
+        _init_state(e2, 4)
+        ckpt_dir, _ = e2.load_checkpoint(save_dir)
+        assert ckpt_dir is not None
+        assert e2.config.gradient_accumulation_steps == 2
+        assert reg.counter("ds_elastic_resumes_total").value == er0
+        assert _run_steps(e2, 2, start=2) == ref
+    finally:
+        reg.disable()
+
+
+def test_indivisible_world_raises_with_rule(tmp_path):
+    """Global batch 8 at micro 1 cannot resume on a 3-device-dp world:
+    the loader raises the documented divisibility rule instead of
+    silently training at a different batch size."""
+    from deepspeed_tpu.elasticity import ElasticityIncompatibleWorldSize
+
+    save_dir = str(tmp_path)
+    e4 = _make_engine(4, gas=2)
+    _run_steps(e4, 1)
+    e4.save_checkpoint(save_dir, tag="t")
+    e3 = _make_engine(3, gas=2)
+    _init_state(e3, 3)
+    with pytest.raises(ElasticityIncompatibleWorldSize, match="not a"):
+        e3.load_checkpoint(save_dir)
+
+
+def test_elastic_resume_off_keeps_triad(tmp_path):
+    """checkpoint.elastic_resume=false: the load succeeds but keeps the
+    configured triad (loud warning instead of a silent rescale)."""
+    save_dir = str(tmp_path)
+    e4 = _make_engine(4, gas=2)
+    _run_steps(e4, 1)
+    e4.save_checkpoint(save_dir, tag="t")
+    e2 = _make_engine(2, gas=2, ckpt_cfg={"elastic_resume": False})
+    _init_state(e2, 2)
+    ckpt_dir, _ = e2.load_checkpoint(save_dir)
+    assert ckpt_dir is not None
+    assert e2.config.gradient_accumulation_steps == 2   # untouched
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: bit-flipped shard -> DEEP-detected, walk-back recovers
+# ---------------------------------------------------------------------------
+
+
+def test_bitflip_shard_deep_detected_and_walked_back(tmp_path):
+    """The silent-corruption case only chunk hashes catch: flip a bit in
+    a shard and REGENERATE the manifest (corruption arriving before the
+    manifest pass — the file-level hashes now agree with the corrupt
+    bytes).  ``--deep`` (and ``checkpoint.deep_verify_on_load``) must
+    convict the tag NAMING the offending shard, and the loader must walk
+    back to the older valid tag — across a world-size change."""
+    save_dir = str(tmp_path)
+    e4 = _make_engine(4, gas=2,
+                      ckpt_cfg={"deep_verify_on_load": True})
+    _run_steps(e4, 1)
+    e4.save_checkpoint(save_dir, tag="t1")
+    ref = _run_steps(e4, 2, start=1)
+    e4.save_checkpoint(save_dir, tag="t2")
+
+    t2 = os.path.join(save_dir, "t2")
+    shard = glob.glob(os.path.join(t2, "model_states", "shard_p*.bin"))[0]
+    chaos.flip_bit(shard)
+    atomic.write_manifest(t2, "t2", extra={"world_size": 4})
+    # file-level verification now PASSES; only the chunk hashes disagree
+    assert atomic.verify_dir(t2, level="full").ok
+    probs = atomic.deep_verify(t2)
+    assert any("chunk checksum" in p and "shard_p" in p for p in probs)
+
+    # the offline auditor's --deep verdict matches the loader's
+    ckpt_verify = _tool("ckpt_verify")
+    rep = ckpt_verify.audit(save_dir, level="deep")
+    by = {e["tag"]: e["state"] for e in rep["tags"]}
+    assert by["t2"] == "corrupt" and by["t1"] == "valid"
+    assert rep["loadable"] == "t1"
+    assert ckpt_verify.audit(save_dir, level="full")["loadable"] == "t2"
+
+    reg = get_registry()
+    reg.enable()
+    flight = get_flight_recorder()
+    flight.reset()
+    flight.enable()
+    try:
+        fails0 = reg.counter("ds_ckpt_verify_failures_total").value
+        e2 = _make_engine(2, gas=2,
+                          ckpt_cfg={"deep_verify_on_load": True})
+        _init_state(e2, 2)
+        ckpt_dir, _ = e2.load_checkpoint(save_dir)   # latest -> t2
+        assert ckpt_dir is not None and ckpt_dir.endswith("t1")
+        assert reg.counter("ds_ckpt_verify_failures_total").value \
+            - fails0 >= 1
+        ev = [e for e in flight.events() if e["kind"] == "ckpt_verify_fail"]
+        assert ev and ev[-1]["state"] == "corrupt_deep"
+        assert any("chunk checksum" in p for p in ev[-1]["problems"])
+        # ...and training continues on the walked-back state at the new
+        # world (trajectory = the run that never saw t2's corruption)
+        got = _run_steps(e2, 2, start=1)
+        assert np.allclose(ref, got, rtol=1e-4), (ref, got)
+
+        # deep_verify_on_load is independent of verify_on_load: with the
+        # manifest pass OFF, the chunk pass still convicts t2
+        e2b = _make_engine(2, gas=2,
+                           ckpt_cfg={"verify_on_load": False,
+                                     "deep_verify_on_load": True})
+        _init_state(e2b, 2)
+        ckpt_dir, _ = e2b.load_checkpoint(save_dir)
+        assert ckpt_dir is not None and ckpt_dir.endswith("t1")
+    finally:
+        flight.disable()
+        reg.disable()
+
+
+# ---------------------------------------------------------------------------
+# deterministic data resume (dataloader stream state)
+# ---------------------------------------------------------------------------
+
+
+def _loader_ids(loader, n_batches=None):
+    out = []
+    for batch in loader:
+        xs = np.asarray(jax.device_get(batch[0]))
+        out.extend(xs[:, 0].tolist())     # first feature identifies the row
+        if n_batches is not None and len(out) >= n_batches:
+            break
+    return out
+
+
+def test_dataloader_sample_offset_resume_across_batch_size():
+    """Consume part of an epoch at batch 8, checkpoint, resume at batch 4
+    (the elastic world-change case): the remaining sample stream is
+    IDENTICAL — offsets are tracked in samples, and the shuffle
+    permutation is a pure function of (seed, epoch)."""
+    mesh = build_mesh(devices=jax.devices()[:1])
+    a = DeepSpeedDataLoader((X, Y), batch_size=8, mesh=mesh, shuffle=True,
+                            seed=7)
+    it = iter(a)
+    for _ in range(3):
+        next(it)                        # 24 samples consumed
+    sd = a.state_dict()
+    assert sd["samples_consumed"] == 24 and sd["epoch"] == 0
+
+    rest_full = _loader_ids(it)         # the stream an uninterrupted run sees
+
+    b = DeepSpeedDataLoader((X, Y), batch_size=4, mesh=mesh, shuffle=True,
+                            seed=7)
+    b.load_state_dict(sd)
+    rest_resumed = _loader_ids(iter(b))
+    assert rest_resumed == rest_full
+    # the epoch boundary reset: the NEXT epoch replays from sample 0 with
+    # the epoch's own permutation, identically on both loaders
+    assert b.state_dict()["epoch"] == 1
+    assert b.state_dict()["samples_consumed"] == 0
+    a2 = _loader_ids(iter(a))
+    b2 = _loader_ids(iter(b))
+    assert a2 == b2 and len(b2) == 64
+
+
+def test_dataloader_resume_validates_identity():
+    mesh = build_mesh(devices=jax.devices()[:1])
+    a = DeepSpeedDataLoader((X, Y), batch_size=8, mesh=mesh, shuffle=True,
+                            seed=7)
+    sd = a.state_dict()
+    short = DeepSpeedDataLoader((X[:32], Y[:32]), batch_size=8, mesh=mesh,
+                                shuffle=True, seed=7)
+    with pytest.raises(ValueError, match="length changed"):
+        short.load_state_dict(sd)
+    reseeded = DeepSpeedDataLoader((X, Y), batch_size=8, mesh=mesh,
+                                   shuffle=True, seed=8)
+    with pytest.raises(ValueError, match="seed changed"):
+        reseeded.load_state_dict(sd)
+    # RepeatingLoader passes the state through to its inner loader
+    rep = RepeatingLoader(DeepSpeedDataLoader((X, Y), batch_size=8,
+                                              mesh=mesh, shuffle=True,
+                                              seed=7))
+    rep.load_state_dict(sd)
+    assert rep.state_dict() == sd
+
+
+def test_dataloader_state_rides_checkpoint(tmp_path):
+    """The engine auto-attaches the training dataloader's stream state to
+    client_state.json on save and restores it on load — the missing piece
+    that makes elastic resume replay the exact remaining stream."""
+    save_dir = str(tmp_path)
+    mesh = build_mesh(devices=jax.devices()[:4])
+    set_global_mesh(mesh)
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 10**9}
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg, mesh=mesh,
+        training_data=(X, Y), rng=jax.random.PRNGKey(3))
+    assert isinstance(loader, DeepSpeedDataLoader)
+    it = iter(loader)
+    for _ in range(3):
+        engine.forward(next(it))
+        engine.step()
+    consumed = loader.state_dict()["samples_consumed"]
+    assert consumed == 3 * loader.batch_size
+    engine.save_checkpoint(save_dir, tag="t")
+    meta = json.load(open(os.path.join(save_dir, "t",
+                                       "client_state.json")))
+    assert meta["client_state"]["dataloader"]["samples_consumed"] \
+        == consumed
+
+    engine2, _, loader2, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg, mesh=mesh,
+        training_data=(X, Y), rng=jax.random.PRNGKey(3))
+    engine2.forward(next(iter(loader2)))
+    ckpt_dir, client_state = engine2.load_checkpoint(save_dir)
+    assert ckpt_dir is not None
+    assert loader2.state_dict()["samples_consumed"] == consumed
+    # an explicit caller-provided "dataloader" key wins over the auto one
+    engine.save_checkpoint(save_dir, tag="t2",
+                           client_state={"dataloader": {"custom": 1}})
+    meta = json.load(open(os.path.join(save_dir, "t2",
+                                       "client_state.json")))
+    assert meta["client_state"]["dataloader"] == {"custom": 1}

@@ -360,7 +360,12 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
                       "ttft_p99_clean_s": 0.05, "ttft_p99_chaos_s": 0.4,
                       "restarts_observed": 1,
                       "answered_exactly_once": True,
-                      "outputs_token_identical": True}}}
+                      "outputs_token_identical": True},
+                  "elastic_resume": {
+                      "status": "ok", "world_save": 4, "worlds": [2, 8],
+                      "resume_latency_s_max": 0.68,
+                      "steps_to_recover_max": 0, "loss_parity": True,
+                      "resumes": {"2": {"resume_latency_s": 0.68}}}}}
     lines = bench.summary_lines(record, None)
     parsed = json.loads(lines[-1])
     st = parsed["streamed_offload"]
@@ -380,6 +385,11 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
     assert fc["restarts_observed"] == 1 and fc["shed_429"] == 2
     assert fc["answered_exactly_once"] is True
     assert fc["outputs_token_identical"] is True
+    # the ISSUE 14 elastic-resume acceptance row rides BENCH_JSON
+    er = parsed["elastic_resume"]
+    assert er["resume_latency_s"] == 0.68
+    assert er["steps_to_recover"] == 0 and er["loss_parity"] is True
+    assert er["world_save"] == 4 and er["worlds"] == [2, 8]
     # bulky capture payloads never reach the final line
     assert "device_profile" not in json.dumps(parsed)
     assert lines[-2] == "BENCH_JSON: " + lines[-1]

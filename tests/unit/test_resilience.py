@@ -254,6 +254,110 @@ def test_regression_latest_is_written_only_after_commit(tmp_path):
     assert atomic.read_latest(save_dir) == "t2"
 
 
+def _make_pr10_engine(int8=False, streamed=False):
+    """Engines producing the PR 10 checkpoint formats the original
+    kill-at-byte sweep predates: host-offloaded masters (``offload_states``
+    dir; ``int8_masters`` requantizes on save) and the streamed
+    offload_param path (pinned param refresh at save time)."""
+    from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+    from deepspeed_tpu.models import causal_lm
+
+    mesh = build_mesh()
+    set_global_mesh(mesh)
+    zero = {"stage": 3,
+            "offload_optimizer": {"device": "cpu", "int8_masters": int8,
+                                  "quant_block": 64}}
+    if streamed:
+        zero["offload_param"] = {"device": "cpu"}
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=32,
+                      intermediate_size=64, num_heads=2, num_kv_heads=2,
+                      vocab_size=128, max_seq_len=32, remat=False)
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1, "bf16": {"enabled": True},
+           "zero_optimization": zero,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, mesh=mesh, rng=jax.random.PRNGKey(5))
+    toks = np.asarray(jax.random.randint(jax.random.PRNGKey(7), (8, 16),
+                                         0, 128))
+    return engine, (toks, toks)
+
+
+@pytest.mark.parametrize("fmt", ["int8_masters", "streamed_int8"])
+def test_kill_at_byte_offset_pr10_formats_never_corrupt_latest(tmp_path,
+                                                               fmt):
+    """The PR 8 kill-at-arbitrary-byte acceptance, re-run against the
+    checkpoint formats PR 10 added after it was written: int8 host
+    masters (requant-on-save ``offload_states``) and the streamed
+    offload-param path.  A crash at any byte offset — including inside
+    ``offload_states`` — must leave ``latest`` naming a tag that verifies
+    AND loads with the exact pre-crash params + master state."""
+    engine, batch = _make_pr10_engine(int8=True,
+                                      streamed=fmt == "streamed_int8")
+    _train_steps(engine, batch)
+    if fmt == "streamed_int8":
+        assert engine._streamed is not None     # the format under test
+    assert engine._offload_opt.int8_masters
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    assert os.path.isdir(os.path.join(save_dir, "t1", "offload_states"))
+    p1 = _params_snapshot(engine)
+    m1 = [m.copy() for m in engine._offload_opt.masters()]
+    _train_steps(engine, batch)              # diverge from t1
+
+    total = sum(os.path.getsize(os.path.join(root, f))
+                for root, _d, files in os.walk(os.path.join(save_dir, "t1"))
+                for f in files)
+    # offsets spanning the save, plus one aimed INSIDE offload_states
+    off_dir_start = sum(
+        os.path.getsize(os.path.join(root, f))
+        for root, _d, files in os.walk(os.path.join(save_dir, "t1",
+                                                    "model_states"))
+        for f in files)
+    offsets = [0, total // 2, off_dir_start + 100, total - 50]
+    for i, off in enumerate(offsets):
+        with pytest.raises(chaos.InjectedFault):
+            with chaos.crash_on_write(off, save_dir):
+                engine.save_checkpoint(save_dir, tag=f"crash{i}")
+        assert atomic.read_latest(save_dir) == "t1"
+        assert atomic.list_tags(save_dir) == ["t1"]
+        st = atomic.verify_dir(os.path.join(save_dir, "t1"), level="full")
+        assert st.ok, (off, st.problems)
+        assert atomic.deep_verify(os.path.join(save_dir, "t1")) == []
+
+    ckpt_dir, _ = engine.load_checkpoint(save_dir)
+    assert ckpt_dir.endswith("t1")
+    _assert_params_equal(engine, p1)
+    # the int8 store requantized back to exactly the saved masters
+    for a, b in zip(m1, engine._offload_opt.masters()):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    # a later clean save publishes over the debris and keeps training
+    engine.save_checkpoint(save_dir, tag="t2")
+    assert atomic.read_latest(save_dir) == "t2"
+    loss = _train_steps(engine, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_corrupt_offload_states_falls_back(tmp_path):
+    """A bit flip inside the offload_states master file is caught by the
+    manifest (it covers EVERY file in the tag, not just shards) and the
+    loader walks back."""
+    engine, batch = _make_pr10_engine(int8=True)
+    _train_steps(engine, batch)
+    save_dir = str(tmp_path)
+    engine.save_checkpoint(save_dir, tag="t1")
+    p1 = _params_snapshot(engine)
+    _train_steps(engine, batch)
+    engine.save_checkpoint(save_dir, tag="t2")
+    leaf = glob.glob(os.path.join(save_dir, "t2", "offload_states",
+                                  "leaf*.npy"))[0]
+    chaos.flip_bit(leaf)
+    ckpt_dir, _ = engine.load_checkpoint(save_dir)     # latest -> t2
+    assert ckpt_dir is not None and ckpt_dir.endswith("t1")
+    _assert_params_equal(engine, p1)
+
+
 # ---------------------------------------------------------------------------
 # verified load: corrupt/truncated/missing tag -> walk back to newest valid
 # ---------------------------------------------------------------------------

@@ -5,6 +5,7 @@ box (no jax import, like fleet_dump).
     python tools/ckpt_verify.py /ckpts            # a save dir of tags
     python tools/ckpt_verify.py /ckpts/global_step100   # one tag
     python tools/ckpt_verify.py --fast /ckpts     # existence+size only
+    python tools/ckpt_verify.py --deep /ckpts     # + per-chunk sha256
     python tools/ckpt_verify.py --json /ckpts     # machine-readable
     python tools/ckpt_verify.py --selftest        # tier-1 wired
 
@@ -13,6 +14,13 @@ size + sha256, world_size, zero_stage, format version) against the bytes
 on disk, reports which tag the ``latest`` pointer names, and flags
 leftover ``tmp.<tag>`` staging debris from crashed saves (harmless — the
 next save clears it — but a large one is reclaimable space).
+
+``--deep`` additionally re-hashes every CHUNK the sharded payload's
+``index_p*.json`` records (the per-chunk sha256 the writer stores), so a
+flipped bit is reported with the offending shard path AND pytree leaf —
+and index-vs-file structural drift (out-of-range chunks, under-covered
+leaves from missing shard files) is caught even when every file hash
+matches its manifest entry.
 
 Exit status: 0 when the checkpoint the loader would pick (``latest``, or
 the single dir given) verifies valid — including when ``latest`` is
@@ -70,9 +78,17 @@ def _dir_bytes(path: str) -> int:
 
 def verify_tag(save_dir: str, tag: str, level: str) -> Dict[str, object]:
     path = os.path.join(save_dir, tag)
-    st = atomic.verify_dir(path, level=level)
-    entry: Dict[str, object] = {"tag": tag, "state": st.state,
-                                "problems": st.problems,
+    st = atomic.verify_dir(path, level="full" if level == "deep" else level)
+    state, problems = st.state, list(st.problems)
+    if level == "deep" and state in ("valid", "corrupt"):
+        # chunk-level pass: even for a tag the manifest already convicts,
+        # the deep report NAMES the offending shard/leaf
+        deep_problems = atomic.deep_verify(path)
+        if deep_problems:
+            state = "corrupt"
+            problems.extend(deep_problems)
+    entry: Dict[str, object] = {"tag": tag, "state": state,
+                                "problems": problems,
                                 "bytes": _dir_bytes(path)}
     if st.manifest:
         entry["files"] = len(st.manifest.get("files", {}))
@@ -206,6 +222,60 @@ def selftest() -> int:
         rep = audit(td)
         assert rep["loadable"] is None
         assert any(e["state"] == "no_manifest" for e in rep["tags"])
+
+    # --deep: per-chunk hashes name the offending shard + leaf, and
+    # structural drift (index pointing past the file) is caught
+    import hashlib
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        tag = os.path.join(td, "global_step9")
+        ms = os.path.join(tag, "model_states")
+        os.makedirs(ms)
+        raw_a, raw_b = b"\x01" * 256, b"\x02" * 128
+        with open(os.path.join(ms, "shard_p0.bin"), "wb") as fh:
+            fh.write(raw_a + raw_b)
+        index = {"['w']": {"shape": [64], "dtype": "float32",
+                           "chunks": [{"index": [[0, 64]],
+                                       "file": "shard_p0.bin", "offset": 0,
+                                       "nbytes": 256,
+                                       "sha256": hashlib.sha256(raw_a)
+                                       .hexdigest()}]},
+                 "['b']": {"shape": [32], "dtype": "float32",
+                           "chunks": [{"index": [[0, 32]],
+                                       "file": "shard_p0.bin",
+                                       "offset": 256, "nbytes": 128,
+                                       "sha256": hashlib.sha256(raw_b)
+                                       .hexdigest()}]}}
+        with open(os.path.join(ms, "index_p0.json"), "w") as fh:
+            json.dump(index, fh)
+        atomic.write_manifest(tag, "global_step9",
+                              extra={"world_size": 1, "zero_stage": 0})
+        atomic.write_latest(td, "global_step9")
+        assert atomic.deep_verify(tag) == []
+        rep = audit(td, level="deep")
+        assert rep["loadable"] == "global_step9"
+
+        # flip a bit inside leaf 'b''s chunk: --deep names shard AND leaf
+        with open(os.path.join(ms, "shard_p0.bin"), "rb+") as fh:
+            fh.seek(300)
+            fh.write(b"\xff")
+        probs = atomic.deep_verify(tag)
+        assert any("['b']" in p and "shard_p0.bin" in p
+                   and "chunk checksum" in p for p in probs), probs
+        assert not any("['w']" in p for p in probs), probs
+        rep = audit(td, level="deep")
+        assert rep["tags"][0]["state"] == "corrupt"
+        assert rep["loadable"] is None
+        # plain --fast never looks inside the chunks (size unchanged)
+        assert audit(td, level="fast")["loadable"] == "global_step9"
+
+        # structural drift: an index chunk pointing past the shard file
+        with open(os.path.join(ms, "shard_p0.bin"), "rb+") as fh:
+            fh.truncate(200)
+        probs = atomic.deep_verify(tag)
+        assert any("outside shard file" in p for p in probs), probs
+        assert any("under-covered" in p for p in probs), probs
     print("ckpt_verify selftest: OK")
     return 0
 
@@ -222,7 +292,8 @@ def main(argv: List[str]) -> int:
         print(__doc__.strip())
         return 0 if args else 2
     target = args[0]
-    level = "fast" if "--fast" in flags else "full"
+    level = ("deep" if "--deep" in flags
+             else "fast" if "--fast" in flags else "full")
     if os.path.exists(os.path.join(target, atomic.MANIFEST_NAME)):
         # a single tag dir: report it alone
         save_dir, tag = os.path.split(os.path.abspath(target.rstrip("/")))

@@ -10,7 +10,15 @@ per-replica SKEW (a hot replica reads directly off the skew column):
     python tools/fleet_dump.py r1=host:9101 r2=host:9102   # named replicas
     python tools/fleet_dump.py --json url...               # machine-readable
     python tools/fleet_dump.py snap1.json snap2.json       # saved snapshots
+    python tools/fleet_dump.py --supervisor-status=sup.json url...
+    python tools/fleet_dump.py --supervisor-status=sup.json  # status alone
     python tools/fleet_dump.py --selftest                  # parser self-check
+
+``--supervisor-status=<file>`` renders a supervisor's ``--status-file``
+JSON (either ``train_supervisor`` or ``serve_supervisor`` schema:
+ladder counters, replica/child states, restart timestamps) above the
+scrape table — and works with no ``/statz`` sources at all, because a
+down fleet has nothing to scrape but the status file survives.
 
 Merge semantics by instrument kind (fetched from ``/statz?kinds=1``; a
 saved snapshot without kinds falls back to the ``*_total`` naming
@@ -240,6 +248,38 @@ def render(fleet: Dict[str, object], replicas: List[str]) -> str:
     return "\n".join(render_table(header, fleet_rows(fleet, replicas)))
 
 
+def render_supervisor_status(st: Dict[str, object]) -> str:
+    """Render a supervisor ``--status-file`` JSON (either supervisor's
+    schema — ``tools/{train,serve}_supervisor.py --status-file``):
+    supervisor truth next to the scraped metrics, no log scraping."""
+    kind = st.get("kind", "supervisor")
+    head = (f"{kind}: state={st.get('state')} pid={st.get('pid')} "
+            f"updated_unix={st.get('updated_unix')}")
+    rows: List[List[str]] = []
+    if "replicas" in st:                 # serve_supervisor: one row each
+        for r in st["replicas"]:
+            lad = r.get("ladder") or {}
+            rows.append([str(r.get("index")), str(r.get("state")),
+                         str(r.get("port", "")),
+                         str(lad.get("crash_restarts", "")),
+                         str(lad.get("preempt_restarts", "")),
+                         f"{lad.get('restarts', '')}/"
+                         f"{lad.get('max_restarts', '')}"])
+        table = render_table(["replica", "state", "port", "crashes",
+                              "preempts", "restarts"], rows)
+    else:                                # train_supervisor: one child
+        lad = st.get("ladder") or {}
+        rows.append([str(st.get("incarnation")), str(st.get("state")),
+                     str(st.get("child_pid", "")),
+                     str(lad.get("crash_restarts", "")),
+                     str(lad.get("preempt_restarts", "")),
+                     f"{lad.get('restarts', '')}/"
+                     f"{lad.get('max_restarts', '')}"])
+        table = render_table(["incarnation", "state", "child_pid",
+                              "crashes", "preempts", "restarts"], rows)
+    return "\n".join([head] + list(table))
+
+
 # ---------------------------------------------------------------------------
 # selftest (bundled synthetic fixture; tier-1 wired)
 # ---------------------------------------------------------------------------
@@ -288,6 +328,26 @@ def selftest() -> int:
     table = render(fleet, sorted(snaps))
     assert "ds_serve_submitted_total" in table and "400" in table
     print(table)
+    # supervisor-status render: both schemas through one code path
+    train_st = {"kind": "train_supervisor", "state": "backoff", "pid": 7,
+                "incarnation": 2, "child_pid": 11,
+                "ladder": {"restarts": 2, "max_restarts": 5,
+                           "crash_restarts": 2, "preempt_restarts": 0}}
+    out = render_supervisor_status(train_st)
+    assert "train_supervisor: state=backoff" in out and "2/5" in out
+    serve_st = {"kind": "serve_supervisor", "state": "running", "pid": 8,
+                "target": 2, "replicas": [
+                    {"index": 0, "state": "RUNNING", "port": 9101,
+                     "ladder": {"restarts": 1, "max_restarts": 5,
+                                "crash_restarts": 1,
+                                "preempt_restarts": 0}},
+                    {"index": 1, "state": "FAILED", "port": 9102,
+                     "ladder": {"restarts": 5, "max_restarts": 5,
+                                "crash_restarts": 5,
+                                "preempt_restarts": 0}}]}
+    out = render_supervisor_status(serve_st)
+    assert "serve_supervisor: state=running" in out
+    assert "FAILED" in out and "5/5" in out
     print("fleet_dump selftest: OK")
     return 0
 
@@ -300,6 +360,27 @@ def main(argv: List[str]) -> int:
     flags = {a for a in argv[1:] if a.startswith("--")}
     if "--selftest" in flags:
         return selftest()
+    # --supervisor-status=<file>: supervisor truth (ladder counters,
+    # replica/child states) rendered next to the scrape — readable alone
+    # too (a down fleet has no /statz to scrape, but the file survives)
+    status_paths = [f.split("=", 1)[1] for f in flags
+                    if f.startswith("--supervisor-status=")]
+    statuses = []
+    for p in status_paths:
+        try:
+            with open(p) as fh:
+                statuses.append(json.load(fh))
+        except (OSError, ValueError) as exc:
+            print(f"unreadable status file {p}: {exc}", file=sys.stderr)
+            return 2
+    if not args and statuses:
+        if "--json" in flags:
+            print(json.dumps({"supervisors": statuses}, sort_keys=True,
+                             default=str))
+        else:
+            for st in statuses:
+                print(render_supervisor_status(st))
+        return 0
     if not args or "--help" in flags or "-h" in argv[1:]:
         print(__doc__.strip())
         return 0 if args else 2
@@ -319,9 +400,12 @@ def main(argv: List[str]) -> int:
         print("(no metrics found on any replica)")
         return 1
     if "--json" in flags:
-        print(json.dumps({"replicas": sorted(snaps), "fleet": fleet},
+        print(json.dumps({"replicas": sorted(snaps), "fleet": fleet,
+                          **({"supervisors": statuses} if statuses else {})},
                          sort_keys=True, default=str))
     else:
+        for st in statuses:
+            print(render_supervisor_status(st))
         print(render(fleet, sorted(snaps)))
     return 0
 

@@ -186,7 +186,7 @@ class ServeSupervisor:
                  scale_up_queue: float = 0.0, scale_down_queue: float = 0.0,
                  kv_high: float = 0.92, scale_sustain_s: float = 10.0,
                  env: Optional[Dict[str, str]] = None,
-                 sleep=time.sleep):
+                 sleep=time.sleep, status_file: Optional[str] = None):
         if not cmd_template:
             raise ValueError("no replica command template given")
         self.cmd_template = list(cmd_template)
@@ -211,6 +211,7 @@ class ServeSupervisor:
         self._down = _Sustain(scale_sustain_s)
         self.base_env = dict(env if env is not None else os.environ)
         self.sleep = sleep
+        self.status_file = status_file
         self.replicas: List[ReplicaHandle] = []
         self.total_restarts = 0          # crash+wedge+preempt respawns
         self.scale_outs = 0
@@ -268,6 +269,21 @@ class ServeSupervisor:
         self._detect_wedged(now)
         self._scale(now)
         self._reconcile(now)
+        self._write_status("running")
+
+    def _write_status(self, state: str) -> None:
+        """Fleet truth as JSON (--status-file, atomic tmp+replace): the
+        same ``snapshot()`` the selftest asserts on, plus per-replica
+        ladder counters — operators and ``fleet_dump`` read state instead
+        of scraping logs."""
+        if self.status_file is None:
+            return
+        snap = self.snapshot()
+        snap.update({"kind": "serve_supervisor", "state": state,
+                     "pid": os.getpid()})
+        for h, entry in zip(self.replicas, snap["replicas"]):
+            entry["ladder"] = h.policy.counters()
+        _core.write_status(self.status_file, snap)
 
     def _reap(self, now: float) -> None:
         for h in self.replicas:
@@ -470,6 +486,7 @@ class ServeSupervisor:
                 h.proc.kill()
                 h.proc.wait()
         self._log("shutdown complete")
+        self._write_status("shutdown")
         return 0
 
     def snapshot(self) -> Dict[str, object]:
@@ -591,6 +608,7 @@ def selftest() -> int:
         with open(beh_path, "w") as fh:
             json.dump({}, fh)
         base = _free_port_block(4)
+        status_path = os.path.join(td, "status.json")
         sup = ServeSupervisor(
             [sys.executable, "-c", _FAKE_REPLICA_PROG, "{port}", beh_path,
              marker],
@@ -598,13 +616,22 @@ def selftest() -> int:
             backoff_max=0.2, healthy_reset_s=None, poll_interval=0.05,
             poll_timeout=0.5, wedge_timeout=1.5, grace_s=5.0,
             min_replicas=2, max_replicas=3, scale_up_queue=4.0,
-            scale_down_queue=1.0, scale_sustain_s=0.2)
+            scale_down_queue=1.0, scale_sustain_s=0.2,
+            status_file=status_path)
         thread = threading.Thread(target=sup.run, daemon=True)
         thread.start()
         try:
             # 1) both replicas come up ready
             _wait(lambda: sum(h.ready for h in sup.replicas) == 2, 15,
                   "2 replicas ready")
+            # --status-file: fleet truth is published as readable JSON
+            # every tick (replica states + per-replica ladder counters)
+            _wait(lambda: os.path.exists(status_path), 10, "status file")
+            st = json.load(open(status_path))
+            assert st["kind"] == "serve_supervisor"
+            assert st["state"] == "running" and st["target"] == 2
+            assert len(st["replicas"]) == 2
+            assert all("ladder" in r for r in st["replicas"])
             # 2) SIGKILL replica 0 -> crash restart through the ladder
             h0 = sup.replicas[0]
             pid0 = h0.proc.pid
@@ -653,6 +680,10 @@ def selftest() -> int:
                     pass
             drained = open(marker).read().count("drained")
             assert drained >= 3, f"expected >=3 drains, saw {drained}"
+            # terminal status reflects the shutdown + the restart history
+            st = json.load(open(status_path))
+            assert st["state"] == "shutdown"
+            assert st["total_restarts"] >= 2 and st["scale_outs"] == 1
         finally:
             sup.request_stop()
             thread.join(timeout=20)
@@ -702,6 +733,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="KV pool pressure that scales OUT when "
                              "sustained")
     parser.add_argument("--scale-sustain", type=float, default=10.0)
+    parser.add_argument("--status-file", default=None,
+                        help="write fleet truth (replica states, ladder "
+                             "counters, scale events) as JSON to this path "
+                             "every tick")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the replica command template")
     args = parser.parse_args(argv[1:])
@@ -718,7 +753,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         grace_s=args.grace, min_replicas=args.min_replicas,
         max_replicas=args.max_replicas, scale_up_queue=args.scale_up_queue,
         scale_down_queue=args.scale_down_queue, kv_high=args.kv_high,
-        scale_sustain_s=args.scale_sustain)
+        scale_sustain_s=args.scale_sustain, status_file=args.status_file)
     return sup.run()
 
 

@@ -90,16 +90,23 @@ class TrainSupervisor:
                  preempt_exit_code: int = PREEMPT_EXIT_CODE,
                  env: Optional[Dict[str, str]] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 grace_s: float = SIGTERM_GRACE_S):
+                 grace_s: float = SIGTERM_GRACE_S,
+                 healthy_reset_s: Optional[float] = None,
+                 status_file: Optional[str] = None):
         if not cmd:
             raise ValueError("no child command given")
         self.cmd = list(cmd)
         # the shared restart ladder (elasticity/supervisor.py): strict
-        # PR 8 semantics — no healthy-reset, every crash burns budget
+        # PR 8 semantics by default — every crash burns budget; the
+        # OPT-IN --healthy-reset-s knob forgives the ladder after a long
+        # healthy incarnation (a job that crashes once a day must not
+        # exhaust a lifetime budget — the serve_supervisor long-horizon
+        # mode, now available train-side too)
         self.policy = RestartPolicy(max_restarts=max_restarts,
                                     backoff_base=backoff_base,
                                     backoff_max=backoff_max,
-                                    preempt_exit_code=preempt_exit_code)
+                                    preempt_exit_code=preempt_exit_code,
+                                    healthy_reset_s=healthy_reset_s)
         self.max_restarts = self.policy.max_restarts
         self.backoff_base = self.policy.backoff_base
         self.backoff_max = self.policy.backoff_max
@@ -107,8 +114,32 @@ class TrainSupervisor:
         self.base_env = dict(env if env is not None else os.environ)
         self.sleep = sleep
         self.grace_s = grace_s
+        self.status_file = status_file
         self._terminating = False
         self._child: Optional[subprocess.Popen] = None
+        self._state = "idle"
+        self._last_exit_code: Optional[int] = None
+        self._restart_times: List[float] = []
+
+    def _write_status(self, state: str) -> None:
+        """Supervisor truth as JSON (--status-file): ladder counters,
+        child state, restart timestamps — read by operators/fleet_dump
+        instead of scraped from logs."""
+        self._state = state
+        if self.status_file is None:
+            return
+        child = self._child
+        _core.write_status(self.status_file, {
+            "kind": "train_supervisor",
+            "state": state,           # running|backoff|done|given_up|terminated
+            "pid": os.getpid(),
+            "child_pid": child.pid if child is not None else None,
+            "incarnation": self.restarts,
+            "last_exit_code": self._last_exit_code,
+            "restart_times_unix": list(self._restart_times),
+            "ladder": self.policy.counters(),
+            "cmd": self.cmd,
+        })
 
     # counters live on the shared policy (one mutation site per exit);
     # the PR 8 attribute surface stays intact for callers/tests
@@ -167,6 +198,7 @@ class TrainSupervisor:
                 # no emergency save — the job is being preempted, stop
                 self._log("terminated during the restart window; not "
                           "spawning a new incarnation")
+                self._write_status("terminated")
                 return last_code or 143
             env = dict(self.base_env)
             env["DS_SUPERVISOR_RESTART"] = str(self.restarts)
@@ -176,22 +208,31 @@ class TrainSupervisor:
                 cmdline = cmdline[:157] + "..."
             self._log(f"starting (incarnation {self.restarts}): {cmdline}")
             self._child = subprocess.Popen(self.cmd, env=env)
+            self._write_status("running")
+            t_spawn = time.monotonic()
             code = self._wait_child()
             self._child = None
+            self._last_exit_code = code
             last_code = code
             if self._terminating and code != 0:
                 self._log(f"supervisor was terminated; child exited "
                           f"{code} — not restarting")
+                self._write_status("terminated")
                 return code
-            decision = self.policy.decide(code)
+            # ran_s feeds the opt-in healthy_reset_s ladder forgiveness
+            decision = self.policy.decide(
+                code, ran_s=time.monotonic() - t_spawn)
             if decision.action == "done":
                 self._log(f"child completed (restarts={self.restarts})")
+                self._write_status("done")
                 return 0
             if decision.action == "give_up":
                 self._log(f"max_restarts={self.max_restarts} crash "
                           f"restarts exhausted; giving up with exit code "
                           f"{code}")
+                self._write_status("given_up")
                 return code
+            self._restart_times.append(time.time())
             if decision.kind == "preempt":
                 # a clean emergency save was taken: restart immediately;
                 # preemptions are routine scheduling events and do NOT
@@ -204,6 +245,7 @@ class TrainSupervisor:
                       f"#{self.restarts} after {decision.delay:g}s backoff; "
                       f"training should resume from the newest valid "
                       f"checkpoint")
+            self._write_status("backoff")
             self.sleep(decision.delay)
 
     def _wait_child(self) -> int:
@@ -306,6 +348,41 @@ def selftest() -> int:
                               backoff_base=0.0, sleep=lambda _s: None)
         assert sup.run() == 0
         assert open(marker).read() == "0,1,"
+
+        # --status-file: supervisor truth lands as readable JSON (ladder
+        # counters + terminal state + restart timestamps), atomically
+        import json as _json
+
+        status = os.path.join(td, "status.json")
+        sup = TrainSupervisor(_counter_child(os.path.join(td, "f"), 2),
+                              max_restarts=3, backoff_base=0.0,
+                              sleep=lambda _s: None, status_file=status)
+        assert sup.run() == 0
+        st = _json.load(open(status))
+        assert st["kind"] == "train_supervisor" and st["state"] == "done"
+        assert st["ladder"]["crash_restarts"] == 2
+        assert len(st["restart_times_unix"]) == 2
+        assert st["updated_unix"] > 0
+        assert not [n for n in os.listdir(td)
+                    if n.startswith("status.json.tmp")]
+
+        # opt-in healthy_reset_s: a long-enough incarnation forgives the
+        # crash ladder (ran_s is wall time here, so use a tiny threshold
+        # and a child that sleeps past it before crashing)
+        slow_crash = os.path.join(td, "g")
+        prog = ("import os,sys,time\n"
+                f"p = {slow_crash!r}\n"
+                "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+                "open(p, 'w').write(str(n + 1))\n"
+                "time.sleep(0.05)\n"
+                "sys.exit(7 if n < 3 else 0)\n")
+        sup = TrainSupervisor([sys.executable, "-c", prog], max_restarts=1,
+                              backoff_base=0.0, sleep=lambda _s: None,
+                              healthy_reset_s=0.01)
+        # 3 crashes with max_restarts=1 would give up under the strict
+        # ladder; every incarnation ran "healthy" long enough to forgive
+        assert sup.run() == 0
+        assert sup.crash_restarts >= 1
     print("train_supervisor selftest: OK")
     return 0
 
@@ -331,6 +408,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=PREEMPT_EXIT_CODE,
                         help="child exit code meaning 'preempted after a "
                              "clean emergency save' (restart immediately)")
+    parser.add_argument("--healthy-reset-s", type=float, default=None,
+                        help="OPT-IN ladder forgiveness: an incarnation "
+                             "that ran at least this long resets the crash "
+                             "budget (default: strict — every crash burns "
+                             "it)")
+    parser.add_argument("--status-file", default=None,
+                        help="write supervisor truth (ladder counters, "
+                             "child state, restart timestamps) as JSON to "
+                             "this path on every state change")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the training command")
     args = parser.parse_args(argv[1:])
@@ -340,7 +426,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     sup = TrainSupervisor(cmd, max_restarts=args.max_restarts,
                           backoff_base=args.backoff_base,
                           backoff_max=args.backoff_max,
-                          preempt_exit_code=args.preempt_exit_code)
+                          preempt_exit_code=args.preempt_exit_code,
+                          healthy_reset_s=args.healthy_reset_s,
+                          status_file=args.status_file)
     return sup.run()
 
 
