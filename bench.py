@@ -1426,11 +1426,13 @@ def bench_overlap_rung(steps: int = 4, warmup: int = 2) -> dict:
                 "elapsed_s": round(time.perf_counter() - t0, 1)}
 
 
-def _run_overlap_subprocess() -> dict:
-    """Run the overlap ablation in a child process: a CPU parent gets a
-    virtual 8-device mesh via XLA_FLAGS (which must be set before jax
-    initializes — impossible in-process), and on TPU a child abort cannot
-    kill the 125M headline (same isolation story as the 1.34B ladder)."""
+def _run_child_rung(env_key: str) -> dict:
+    """Run one bench rung in a child process keyed by ``env_key`` (the
+    env var naming the child's JSON output file — ``main`` dispatches on
+    it): a CPU parent gets a virtual 8-device mesh via XLA_FLAGS (which
+    must be set before jax initializes — impossible in-process), and on
+    TPU a child abort cannot kill the 125M headline (same isolation
+    story as the 1.34B ladder)."""
     import subprocess
     import sys
     import tempfile
@@ -1438,7 +1440,7 @@ def _run_overlap_subprocess() -> dict:
     fd, out = tempfile.mkstemp(suffix=".json")
     os.close(fd)
     os.unlink(out)
-    env = dict(os.environ, DSTPU_BENCH_OVERLAP_OUT=out)
+    env = dict(os.environ, **{env_key: out})
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         env["XLA_FLAGS"] = (
             "--xla_force_host_platform_device_count=8 "
@@ -1457,6 +1459,188 @@ def _run_overlap_subprocess() -> dict:
                     "stderr_tail": proc.stderr[-400:]}
     except subprocess.TimeoutExpired:
         return {"status": "failed: child timeout (1800s)"}
+
+
+def _run_overlap_subprocess() -> dict:
+    return _run_child_rung("DSTPU_BENCH_OVERLAP_OUT")
+
+
+def bench_quant_comm(steps: int = 3, warmup: int = 1) -> dict:
+    """Dense vs int8 quantized-collective ablation (ROADMAP item 2;
+    comm/collectives_q.py — ZeRO++ arXiv:2306.10209, EQuARX
+    arXiv:2506.17615).
+
+    Two opted-in call-site families on the same tiny-LM workload over
+    every local device, each run dense then quantized:
+
+    - ``all_reduce`` — the ZeRO stage-1 boundary gradient sync on a dp
+      mesh: dense GSPMD psum vs the engine's manual ``q_all_reduce``
+      (error feedback ON — the convergence-safe configuration);
+    - ``gather_rs`` — the overlap schedule's per-bucket forward gathers
+      + AD-transpose reduce-scatters at ZeRO stage 3 on an fsdp mesh:
+      dense vs int8 transport.
+
+    Per side: tokens/s + final loss.  Per quantized op: wire bytes vs
+    dense-equivalent bytes — BOTH series recorded on the same trace
+    (``ds_comm_<op>_bytes_total`` / ``ds_comm_<op>_dense_bytes_total``)
+    — plus the busbw gauge when populated.  Headlines: per-op
+    ``compression`` (dense/wire, the ~2-4x acceptance number) and per-
+    family ``loss_parity``.  CPU-meaningful: bytes and parity are
+    backend-independent; rates are not comparable to TPU.
+    """
+    import gc
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"status": "skipped: needs >1 device for collectives",
+                "devices": len(devs)}
+    W = len(devs)
+    on_tpu = jax.default_backend() != "cpu"
+    registry = get_registry()
+
+    def fam_sum(metrics, name) -> float:
+        v = metrics.get(name, 0)
+        if isinstance(v, dict):
+            return float(sum(x for x in v.values()
+                             if isinstance(x, (int, float))))
+        return float(v or 0)
+
+    def snapshot() -> dict:
+        return json.loads(registry.statz_json())["metrics"]
+
+    if on_tpu:
+        over = {}
+        micro, accum, seq = 2, 2, 512
+    else:
+        over = dict(num_layers=4, hidden_size=128, intermediate_size=256,
+                    num_heads=4, vocab_size=512, max_seq_len=128)
+        micro, accum, seq = 1, 2, 64
+
+    def run_side(mesh_kw, stage, overlap, quant_cfg, q_active_check):
+        mesh = build_mesh(devices=devs, **mesh_kw)
+        set_global_mesh(mesh)
+        model = causal_lm("gpt2-small", mesh=mesh, **over)
+        ds_config = {
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": accum,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-4}},
+            "gradient_clipping": 1.0,
+            "bf16": {"enabled": bool(on_tpu)},
+            "zero_optimization": {
+                "stage": stage, "overlap_comm": overlap,
+                "overlap_bucket_layers": 1,
+                "stage3_param_persistence_threshold": 0},
+            "comms_logger": {"enabled": True},
+            "steps_per_print": 10**9,
+        }
+        if quant_cfg:
+            ds_config["comm_quantization"] = quant_cfg
+        registry.reset()
+        from deepspeed_tpu.comm.comm import comms_logger
+        comms_logger.reset()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=ds_config, mesh=mesh,
+            rng=jax.random.PRNGKey(11))
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (accum, micro * W, seq), 0,
+                                    model.config.vocab_size)
+        batch = (tokens, tokens)
+        for _ in range(warmup):
+            engine.train_step(batch)
+        if quant_cfg:
+            err = q_active_check(engine)
+            if err:
+                return None, {"status": f"failed: {err}"}
+        sync(engine.state.params)
+        t1 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_step(batch)
+        sync(engine.state.params)
+        dt = (time.perf_counter() - t1) / steps
+        row = {"tokens_per_sec": round(accum * micro * W * seq / dt, 1),
+               "step_ms": round(dt * 1e3, 1),
+               "loss": round(float(engine._last_loss), 6)}
+        metrics = snapshot()
+        engine = model = None
+        gc.collect()
+        return row, metrics
+
+    def check_qcomm_grads(engine):
+        if not engine._qcomm_grads:
+            return ("comm_quantization.grad_all_reduce did not activate: "
+                    f"{engine._qcomm_grads_reason}")
+        return None
+
+    def check_overlap_q(engine):
+        if not engine._overlap:
+            return f"overlap_comm did not activate: {engine._overlap_reason}"
+        plan = engine._comm_plan or {"micro": []}
+        if not any(e[0].startswith("q_") for e in plan["micro"]):
+            return "overlap comm plan carries no quantized entries"
+        return None
+
+    families = {}
+    compression = {}
+    parity = {}
+    for fam, mesh_kw, stage, overlap, qcfg, check, q_ops, dense_op in (
+            ("all_reduce", {"dp": W}, 1, False,
+             {"grad_all_reduce": True, "error_feedback": True},
+             check_qcomm_grads, ("q_all_reduce",), "all_reduce"),
+            ("gather_rs", {"fsdp": W}, 3, True,
+             {"all_gather": True, "reduce_scatter": True},
+             check_overlap_q, ("q_all_gather", "q_reduce_scatter"),
+             "all_gather")):
+        dense_row, dense_metrics = run_side(mesh_kw, stage, overlap, None,
+                                            check)
+        if dense_row is None:
+            return dense_metrics
+        q_row, q_metrics = run_side(mesh_kw, stage, overlap, qcfg, check)
+        if q_row is None:
+            return q_metrics
+        ops = {}
+        for op in q_ops:
+            wire = fam_sum(q_metrics, f"ds_comm_{op}_bytes_total")
+            dense_eq = fam_sum(q_metrics,
+                               f"ds_comm_{op}_dense_bytes_total")
+            entry = {"wire_bytes": int(wire),
+                     "dense_bytes": int(dense_eq)}
+            if wire and dense_eq:
+                entry["compression"] = round(dense_eq / wire, 3)
+                compression[op] = entry["compression"]
+            busbw = q_metrics.get(f"ds_comm_{op}_busbw_gbps")
+            if busbw:
+                entry["busbw_gbps"] = round(float(busbw), 3)
+            ops[op] = entry
+        dense_bytes_observed = fam_sum(
+            dense_metrics, f"ds_comm_{dense_op}_bytes_total")
+        lp = abs(q_row["loss"] - dense_row["loss"]) \
+            <= 0.05 * max(abs(dense_row["loss"]), 1e-9)
+        parity[fam] = bool(lp)
+        families[fam] = {
+            "dense": dict(dense_row,
+                          dense_op_bytes=int(dense_bytes_observed)),
+            "int8": q_row, "ops": ops, "loss_parity": bool(lp),
+            "speedup": round(q_row["tokens_per_sec"]
+                             / max(dense_row["tokens_per_sec"], 1e-9), 4)}
+    return {"status": "ok", "devices": W,
+            "backend": jax.default_backend(),
+            "steps": steps, "micro_batch": micro, "grad_accum": accum,
+            "seq": seq,
+            "compression": compression,
+            "loss_parity": parity,
+            "families": families,
+            "elapsed_s": round(time.perf_counter() - t0, 1)}
+
+
+def _run_quant_comm_subprocess() -> dict:
+    return _run_child_rung("DSTPU_BENCH_QUANTCOMM_OUT")
 
 
 # micro=4 exceeds what the AOT compiler will place at 48 layers (probed:
@@ -1742,6 +1926,12 @@ def main():
         with open(os.environ["DSTPU_BENCH_OVERLAP_OUT"], "w") as fh:
             json.dump(result, fh)
         return
+    if os.environ.get("DSTPU_BENCH_QUANTCOMM_OUT"):
+        # child mode: dense vs int8 quantized-collective ablation
+        result = bench_quant_comm()
+        with open(os.environ["DSTPU_BENCH_QUANTCOMM_OUT"], "w") as fh:
+            json.dump(result, fh)
+        return
 
     # The >1B rung runs in a child process BEFORE the parent initializes the
     # TPU client (two live clients on the tunnel conflict; and a child abort
@@ -1757,6 +1947,12 @@ def main():
     rung_overlap = None
     if os.environ.get("DSTPU_BENCH_SKIP_OVERLAP") != "1":
         rung_overlap = _run_overlap_subprocess()
+
+    # quantized-collective dense-vs-int8 ablation (ROADMAP item 2
+    # acceptance: per-op bytes ~2-4x down with loss parity); CPU-meaningful
+    rung_quant_comm = None
+    if os.environ.get("DSTPU_BENCH_SKIP_QUANTCOMM") != "1":
+        rung_quant_comm = _run_quant_comm_subprocess()
 
     on_tpu = jax.default_backend() != "cpu"
 
@@ -1968,6 +2164,8 @@ def main():
                    **({"llama_1b4": rung_1b4} if rung_1b4 else {}),
                    **({"overlap_1b4": rung_overlap} if rung_overlap
                       else {}),
+                   **({"quant_comm": rung_quant_comm} if rung_quant_comm
+                      else {}),
                    **({"llama3_8b": rung_8b} if rung_8b else {}),
                    **({"decode_125m": rung_decode} if rung_decode else {}),
                    **({"serving_125m": rung_serving} if rung_serving
@@ -2061,6 +2259,17 @@ def summary_lines(record: dict, rung_serving) -> list:
             "ttft_p99_on_s": pf["cache_on"]["ttft_p99_s"],
             "ttft_p99_off_s": pf["cache_off"]["ttft_p99_s"],
         }
+    qc = record["detail"].get("quant_comm")
+    if qc and qc.get("status") == "ok":
+        # the ROADMAP item 2 acceptance row: per-op compression (dense-
+        # equivalent bytes / wire bytes, both from ONE trace) + per-family
+        # loss parity + throughput ratios travel with the headline
+        summary["quant_comm"] = {
+            "compression": qc["compression"],
+            "loss_parity": qc["loss_parity"],
+            "speedup": {fam: f["speedup"]
+                        for fam, f in qc["families"].items()},
+        }
     st = record["detail"].get("streamed_offload")
     if st and st.get("status") == "ok":
         # the ISSUE 11 streamed-rung acceptance row: relay MB/s + bytes
@@ -2113,7 +2322,8 @@ def summary_lines(record: dict, rung_serving) -> list:
     # (the record line keeps everything); the minimal summary always fits
     for victim in ("serving_metrics", "train_metrics", "overlap_ablation",
                    "serving_prefix", "streamed_offload",
-                   "serving_host_tier", "fleet_chaos", "elastic_resume"):
+                   "serving_host_tier", "fleet_chaos", "elastic_resume",
+                   "quant_comm"):
         if len(line) <= BENCH_SUMMARY_MAX_CHARS:
             break
         if summary.pop(victim, None) is not None:

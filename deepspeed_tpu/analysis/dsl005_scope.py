@@ -150,6 +150,21 @@ def all_gather(x, axis, registry):
         with _scope("ds_comm_all_gather"):    # <- conditional scope
             return lax.all_gather(x, axis, axis=0, tiled=True)
     return lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+def q_all_reduce(q, s, axis):
+    # quantized wrapper shipping codes with a BARE exchange — the new
+    # collectives_q surface is held to the same contract
+    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    st = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
+    return qt, st
+
+
+def q_all_gather(q, s, axis, comm_metrics):
+    if comm_metrics.enabled:          # <- codes exchanged under a
+        with _scope("ds_comm_q_all_gather"):  # telemetry-enabled if
+            return lax.all_gather(q, axis, axis=0, tiled=False)
+    return lax.all_gather(q, axis, axis=0, tiled=False)
 '''
 
 SELFTEST_GOOD = '''\
@@ -161,4 +176,16 @@ from deepspeed_tpu.profiling.trace import scope as _scope
 def all_reduce(x, axis):
     with _scope("ds_comm_all_reduce"):
         return lax.psum(x, axis)
+
+
+def q_all_reduce(q, s, axis, comm_metrics):
+    # recording may be conditional; the exchange and its scope are not
+    if comm_metrics.enabled:
+        comm_metrics.record_q("q_all_reduce", axis, (q, s), q)
+    with _scope("ds_comm_q_all_reduce"):
+        qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+        st = lax.all_to_all(s, axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+    return qt, st
 '''

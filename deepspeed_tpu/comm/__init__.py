@@ -1,5 +1,7 @@
 """``deepspeed_tpu.comm`` — mesh-first communication layer (SURVEY.md §5.8)."""
 
+from deepspeed_tpu.comm.collectives_q import (q_all_gather, q_all_reduce,
+                                              q_all_to_all, q_reduce_scatter)
 from deepspeed_tpu.comm.comm import (ProcessGroup, ReduceOp, all_gather, all_reduce,
                                      all_to_all_single, axis_index, barrier, broadcast,
                                      broadcast_object_list, comms_logger, configure,
@@ -21,4 +23,5 @@ __all__ = [
     "build_mesh", "data_axes", "get_data_parallel_world_size", "get_expert_parallel_world_size",
     "get_global_mesh", "get_model_parallel_world_size", "get_sequence_parallel_world_size",
     "mesh_from_config", "replicated", "set_global_mesh",
+    "q_all_reduce", "q_all_gather", "q_reduce_scatter", "q_all_to_all",
 ]

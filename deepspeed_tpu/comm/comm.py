@@ -207,8 +207,19 @@ def reduce_scatter(x, axis: Union[str, Sequence[str]], scatter_dim: int = 0):
         return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
 
 
-def all_to_all_single(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
-    """all_to_all (reference: all_to_all_single) — MoE dispatch / Ulysses."""
+def all_to_all_single(x, axis: str, split_dim: int = 0, concat_dim: int = 0,
+                      quantized: bool = False, quant_block: int = 256):
+    """all_to_all (reference: all_to_all_single) — MoE dispatch / Ulysses.
+
+    ``quantized=True`` (the ``comm_quantization.all_to_all`` seam) ships
+    blockwise int8 codes + fp32 scales instead of the dense payload
+    (collectives_q.q_all_to_all — quant/dequant fused into the caller's
+    program, ~2-4x fewer wire bytes, both byte series recorded)."""
+    if quantized:
+        from deepspeed_tpu.comm.collectives_q import q_all_to_all
+
+        return q_all_to_all(x, axis, split_dim, concat_dim,
+                            block=quant_block)
     comms_logger.record("all_to_all", axis, x)
     with _scope("ds_comm_all_to_all"):
         return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)

@@ -57,6 +57,12 @@ class ModelConfig:
     # "scatter": O(N·k·D) scatter/gather dispatch (default);
     # "einsum": GShard one-hot [N,E,C] einsums (O(N²·k/E), parity reference)
     moe_dispatch: str = "scatter"
+    # quantized-collective transport (ds_config "comm_quantization" sets
+    # these at engine init; comm/collectives_q.py): int8 codes cross the
+    # ep dispatch boundary / the sp ring instead of dense activations
+    moe_q_dispatch: bool = False
+    seq_ring_q: bool = False
+    comm_quant_block: int = 256
     # training-time knobs
     sp_mode: str = "auto"                  # "auto" | "ulysses" | "ring" (sp>1)
     pp_microbatches: int = 0               # pipeline microbatches (0 -> pp size)

@@ -137,7 +137,8 @@ def alibi_bias(num_heads: int, q_pos, k_pos) -> jnp.ndarray:
 
 def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
                    impl: Optional[str] = None, sp_mode: str = "auto",
-                   alibi: bool = False):
+                   alibi: bool = False, ring_q: bool = False,
+                   ring_q_block: int = 256):
     """Multi-head attention on [B, H, S, Dh] tensors.
 
     Dispatch (SURVEY.md §5.7):
@@ -175,7 +176,11 @@ def attention_core(q, k, v, mesh: Optional[Mesh], causal: bool = True,
         from deepspeed_tpu.sequence.layer import ring_attention, ulysses_attention
         local_heads = h // ntp
         if sp_mode == "ring" or local_heads % nsp != 0:
-            return ring_attention(q, k, v, mesh, causal=causal)
+            # ring_q: comm_quantization.sequence_ring — the KV rotation
+            # carries int8 codes (quantized once) instead of dense chunks
+            return ring_attention(q, k, v, mesh, causal=causal,
+                                  quantized=ring_q,
+                                  quant_block=ring_q_block)
         inner = None
         if impl == "pallas" and s % 128 == 0:
             inner = functools.partial(flash_attention, causal=causal)

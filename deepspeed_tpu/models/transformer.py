@@ -223,7 +223,10 @@ class CausalLM:
         k = _repeat_kv(k, H // Hkv)
         v = _repeat_kv(v, H // Hkv)
         o = attention_core(q, k, v, mesh, causal=True, sp_mode=cfg.sp_mode,
-                           alibi=cfg.position == "alibi")
+                           alibi=cfg.position == "alibi",
+                           ring_q=getattr(cfg, "seq_ring_q", False),
+                           ring_q_block=getattr(cfg, "comm_quant_block",
+                                                256))
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
         o = o @ a["wo"]
         if cfg.use_bias:

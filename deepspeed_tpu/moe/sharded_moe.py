@@ -190,7 +190,31 @@ def moe_mlp(params, x, cfg, mesh=None, rng=None) -> Tuple[jnp.ndarray, jnp.ndarr
         # (sharded over ep) — GSPMD inserts the all-to-all here
         # (reference: _AllToAll).
         expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt)
-    expert_in = constrain(expert_in, mesh, "ep", None, None)
+    # comm_quantization.all_to_all (engine sets cfg.moe_q_dispatch): the
+    # DISPATCH boundary collective moves blockwise-int8 codes + fp32
+    # scales instead of dense activations (comm/collectives_q.py
+    # q_reshard — the GSPMD form; its custom VJP transports the
+    # cotangent quantized too, so training dispatch stays honest)
+    q_disp = (getattr(cfg, "moe_q_dispatch", False) and mesh is not None
+              and not getattr(mesh, "empty", False)
+              and dict(mesh.shape).get("ep", 1) > 1)
+    if q_disp:
+        from jax.sharding import PartitionSpec as _P
+
+        from deepspeed_tpu.comm.collectives_q import q_reshard
+        from deepspeed_tpu.comm.mesh import data_axes
+
+        qblock = int(getattr(cfg, "comm_quant_block", 256))
+        # src pinned to the token side (codes' block dim over the data
+        # axes), dst to ep: BOTH boundaries constrained so GSPMD cannot
+        # hoist the reshard before the quantize and move dense bytes
+        # (q_reshard's contract — the exchange happens between the two
+        # code constraints)
+        daxes = data_axes(mesh)
+        expert_in = q_reshard(expert_in, mesh, _P("ep"),
+                              src_spec=_P(None, daxes), block=qblock)
+    else:
+        expert_in = constrain(expert_in, mesh, "ep", None, None)
 
     act = activation_fn(cfg.activation)
     up = jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"].astype(x.dtype))
@@ -200,6 +224,14 @@ def moe_mlp(params, x, cfg, mesh=None, rng=None) -> Tuple[jnp.ndarray, jnp.ndarr
     else:
         hidden = act(up)
     out = jnp.einsum("ecf,efd->ecd", hidden, params["w_down"].astype(x.dtype))
+    # the combine return path stays DENSE on purpose: redistributing
+    # expert outputs to tokens via replicated int8 codes would move
+    # ~size*(1+4/block) bytes per device where the dense ep-sharded
+    # exchange moves ~size*itemsize/ep — for ep>=4 the "quantized" form
+    # is MORE wire bytes, not fewer (and materializes the full [E,C,D]
+    # tensor per device).  The dispatch direction above is where the
+    # int8 win is; its custom VJP already quantizes the combine-shaped
+    # cotangent on the honest per-destination reshard.
     out = constrain(out, mesh, "ep", None, None)
 
     # combine: expert buffers -> tokens (the return all-to-all)

@@ -61,7 +61,8 @@ from .flight_recorder import get_flight_recorder
 from .metrics import MetricsRegistry, get_registry
 from ..utils.logging import logger
 
-__all__ = ["CommMetrics", "comm_metrics", "busbw_factor", "KNOWN_OPS"]
+__all__ = ["CommMetrics", "comm_metrics", "busbw_factor", "KNOWN_OPS",
+           "QUANTIZED_OPS"]
 
 
 # Every op slug the framework records today; ensure_registered() registers
@@ -70,10 +71,19 @@ __all__ = ["CommMetrics", "comm_metrics", "busbw_factor", "KNOWN_OPS"]
 KNOWN_OPS = (
     "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
     "broadcast", "broadcast_object", "barrier",
-    "q_all_gather", "q_reduce_scatter",
+    "q_all_reduce", "q_all_gather", "q_reduce_scatter", "q_all_to_all",
+    "q_ppermute",
     "compressed_allreduce", "compressed_allgather",
     "zpp_q_all_gather", "zpp_all_gather", "zpp_reduce_scatter",
     "zpp_q_all_gather_hpz", "zpp_all_gather_hpz",
+)
+
+# Ops with a quantized transport: these additionally feed the
+# ``ds_comm_<op>_dense_bytes_total`` dense-twin series so the compression
+# ratio is measurable on ONE trace (comm/collectives_q.py).
+QUANTIZED_OPS = (
+    "q_all_reduce", "q_all_gather", "q_reduce_scatter", "q_all_to_all",
+    "q_ppermute", "zpp_q_all_gather", "zpp_q_all_gather_hpz",
 )
 
 
@@ -120,6 +130,7 @@ class CommMetrics:
         # lazily-built registry instruments, keyed by op slug (+ dtype)
         self._calls: Dict[str, Any] = {}
         self._bytes_c: Dict[Tuple[str, str], Any] = {}
+        self._dense_c: Dict[Tuple[str, str], Any] = {}
         self._hists: Dict[str, Any] = {}
         self._algbw: Dict[str, Any] = {}
         self._busbw: Dict[str, Any] = {}
@@ -157,6 +168,18 @@ class CommMetrics:
             self._bytes_c[key] = c
         return c
 
+    def _ins_dense(self, op: str, dtype: str):
+        key = (op, dtype)
+        c = self._dense_c.get(key)
+        if c is None:
+            c = self._registry.counter(
+                f"ds_comm_{op}_dense_bytes_total",
+                f"dense-equivalent payload bytes the quantized {op} "
+                f"transport REPLACED — the compression denominator on the "
+                f"same trace", labels={"dtype": dtype})
+            self._dense_c[key] = c
+        return c
+
     def _ins_hist(self, op: str):
         h = self._hists.get(op)
         if h is None:
@@ -189,6 +212,9 @@ class CommMetrics:
             self._ins_bw(op)
             for dt in dtypes:
                 self._ins_bytes(op, dt)
+        for op in QUANTIZED_OPS:
+            for dt in dtypes:
+                self._ins_dense(op, dt)
 
     # -- feed paths -----------------------------------------------------
     def record(self, op: str, axis: Any, x: Any) -> None:
@@ -210,19 +236,73 @@ class CommMetrics:
             logger.info("comm trace: %s shape=%s bytes=%d", key,
                         getattr(x, "shape", None), nbytes)
 
+    def record_q(self, op: str, axis: Any, parts: Iterable[Any],
+                 dense_like: Any) -> None:
+        """Trace-time record for a QUANTIZED in-jit collective: one call,
+        payload bytes summed over ``parts`` (the int8 codes + fp32 scales
+        that actually cross the wire, by dtype), plus the
+        ``ds_comm_<op>_dense_bytes_total`` dense-twin series sized from
+        ``dense_like`` (the tensor the dense collective would have moved) —
+        so the compression ratio reads off ONE trace."""
+        if not self.enabled:
+            return
+        parts = [p for p in parts if p is not None]
+
+        def nb(a) -> int:
+            # works for traced arrays AND bare ShapeDtypeStructs (the
+            # dense twin of an hpZ gather is never materialized — only
+            # its shape/dtype exist); stdlib-only on purpose (DSL003)
+            try:
+                size = a.size
+            except Exception:
+                size = 1
+                for d in getattr(a, "shape", ()):
+                    size *= int(d)
+            try:
+                return int(size) * int(a.dtype.itemsize)
+            except Exception:
+                return 0
+
+        nbytes = sum(nb(p) for p in parts)
+        dense = nb(dense_like)
+        key = f"{op}@{axis}"
+        self.counts[key] += 1
+        self.bytes[key] += nbytes
+        if self._registry._enabled:
+            slug = _slug(op)
+            self._ins_calls(slug).inc()
+            for p in parts:
+                self._ins_bytes(slug, _dtype_name(p)).inc(nb(p))
+            self._ins_dense(slug, _dtype_name(dense_like)).inc(dense)
+        if self.verbose:
+            logger.info("comm trace: %s bytes=%d dense=%d", key, nbytes,
+                        dense)
+
     def commit(self, entries, seconds: float) -> None:
         """Per-execution commit: ``entries`` is a list of
-        ``(op, calls, nbytes, dtype, world)`` tuples describing what one
-        dispatched program moved; ``seconds`` is the measured host window
-        that contained them (latency attribution is byte-weighted)."""
+        ``(op, calls, nbytes, dtype, world)`` tuples — optionally extended
+        with a sixth element for quantized ops feeding the dense-twin
+        series: either ``dense_nbytes`` (labeled with the entry's dtype)
+        or ``(dense_nbytes, dense_dtype)`` (so the twin carries the DENSE
+        payload's dtype, matching :meth:`record_q`'s labeling) —
+        describing what one dispatched program moved; ``seconds`` is the
+        measured host window that contained them (latency attribution is
+        byte-weighted)."""
         if not self.active or not entries:
             return
         total = sum(e[2] for e in entries)
         rec = get_flight_recorder()
-        for op, calls, nbytes, dtype, world in entries:
+        for entry in entries:
+            op, calls, nbytes, dtype, world = entry[:5]
+            dense_nbytes = entry[5] if len(entry) > 5 else None
+            dense_dtype = dtype
+            if isinstance(dense_nbytes, (tuple, list)):
+                dense_nbytes, dense_dtype = dense_nbytes
             slug = _slug(op)
             self._ins_calls(slug).inc(calls)
             self._ins_bytes(slug, dtype).inc(nbytes)
+            if dense_nbytes is not None:
+                self._ins_dense(slug, dense_dtype).inc(dense_nbytes)
             # byte-weighted window attribution; a zero-byte commit (barrier
             # spans) must still keep its measured wall time — a 5s straggler
             # barrier showing p99=0 would hide exactly the hang signal

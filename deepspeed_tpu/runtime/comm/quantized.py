@@ -1,25 +1,25 @@
-"""Quantized / compressed collectives over named mesh axes.
+"""Quantized / compressed collectives over named mesh axes — THIN layer.
 
-Reference: ``deepspeed/runtime/comm/nccl.py`` (cupy sign-compressed
-allreduce with error feedback for the 1-bit optimizers) and the ZeRO++
-quantized collectives (``quantized_gradients``/qgZ all-to-all; SURVEY.md
-§2.1 rows 26-27, PAPERS.md EQuARX).  TPU-native design: the compression
-math is jnp (VPU-friendly bit packing), the transport is XLA collectives
-(``all_to_all``/``all_gather``) over a named axis inside ``shard_map`` —
-ICI carries int8/uint8 payloads instead of bf16/fp32.
+The blockwise-int8 collectives that used to live here (the ZeRO++ qwAG /
+qgZ specials) are now thin delegations into the comm-layer transport
+``deepspeed_tpu/comm/collectives_q.py`` (ROADMAP item 2: int8 comm is a
+property of the comm layer, not a ZeRO++ special).  The public surface —
+``block_quantize`` / ``block_dequantize`` / ``quantized_all_gather`` /
+``quantized_reduce_scatter`` — is unchanged; the codec is the shared
+``comm/quant.py`` blockwise absmax form (the offload relay / int8 host
+master codec), so every int8 byte in the system round-trips through ONE
+implementation.
+
+What stays here: :func:`compressed_allreduce` — the 1-bit sign
+compression with two-level error feedback of the 1-bit optimizers
+(reference: ``deepspeed/runtime/comm/nccl.py`` NcclBackend), which is a
+different codec (1 bit + L1 scale, not blockwise int8) owned by the
+onebit path.  Its int8 sibling with single-level error feedback is
+``collectives_q.q_all_reduce``.
 
 All functions are *in-manual-region* primitives: call them inside a
-``shard_map`` body with the axis name.  Comm volume is recorded through the
-``comm`` façade so CommsLogger can assert the reduction.
-
-- ``block_quantize`` / ``block_dequantize``: per-block absmax int8.
-- ``quantized_all_gather``: int8 payload + fp32 scales, dequantize after.
-- ``quantized_reduce_scatter``: qgZ shape — quantize once, all_to_all the
-  int8 blocks, dequantize + reduce locally in fp32 (one quantization error
-  per element, not log(P)).
-- ``compressed_allreduce``: 1-bit sign compression with error feedback,
-  the exact two-phase (worker -> server -> worker) scheme of the
-  reference's NcclBackend.compressed_allreduce, signs bit-packed 8/byte.
+``shard_map`` body with the axis name.  Comm volume is recorded through
+the ``comm`` façade so CommsLogger can assert the reduction.
 """
 
 from __future__ import annotations
@@ -30,7 +30,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deepspeed_tpu.comm import collectives_q as cq
 from deepspeed_tpu.comm import comm as comm_api
+from deepspeed_tpu.comm.quant import (dequantize_blockwise,
+                                      quantize_blockwise)
 from deepspeed_tpu.profiling.trace import scope as _scope
 
 DEFAULT_BLOCK = 256
@@ -46,22 +49,18 @@ def _pad_to(x: jnp.ndarray, multiple: int) -> Tuple[jnp.ndarray, int]:
 
 
 def block_quantize(x, block: int = DEFAULT_BLOCK):
-    """Per-block symmetric absmax int8 quantization (delegates to the
-    shared quantizer in ops/pallas/quantizer.py; the XLA path is used here
-    because these run inside shard_map manual regions).
+    """Per-block symmetric absmax int8 quantization via the shared
+    ``comm/quant.py`` codec (the offload-relay / host-master convention).
 
     Returns (q int8 [nblocks, block], scale fp32 [nblocks, 1], pad).
     """
-    from deepspeed_tpu.ops.pallas.quantizer import quantize
-
-    q, scale, pad = quantize(x, bits=8, block=block, impl="xla")
-    return q, scale[:, None], pad
+    q, scale = quantize_blockwise(x.astype(jnp.float32).reshape(-1),
+                                  block=block)
+    return q, scale, q.size - x.size
 
 
 def block_dequantize(q, scale, pad: int, shape, dtype=jnp.float32):
-    from deepspeed_tpu.ops.pallas.quantizer import dequantize
-
-    return dequantize(q, scale.reshape(-1), pad, shape, dtype=dtype)
+    return dequantize_blockwise(q, scale.reshape(-1, 1), shape, dtype)
 
 
 def pack_signs(x) -> jnp.ndarray:
@@ -82,48 +81,24 @@ def unpack_signs(packed, n: int) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# in-shard_map collectives
+# in-shard_map collectives (delegations into comm/collectives_q.py)
 # ---------------------------------------------------------------------------
 
 def quantized_all_gather(x, axis: str, block: int = DEFAULT_BLOCK):
     """All-gather with int8 payload: each rank contributes its (quantized)
     local x; result is the dequantized concatenation along dim 0."""
-    q, scale, pad = block_quantize(x, block)
-    comm_api.comms_logger.record("q_all_gather", axis, q)
-    with _scope("ds_comm_q_all_gather"):
-        qg = lax.all_gather(q, axis, axis=0, tiled=False)       # [P, nb, block]
-        sg = lax.all_gather(scale, axis, axis=0, tiled=False)   # [P, nb, 1]
-    P = qg.shape[0]
-    parts = (qg.astype(jnp.float32) * sg).reshape(P, -1)
-    if pad:
-        parts = parts[:, : parts.shape[1] - pad]
-    return parts.reshape((P * x.shape[0],) + x.shape[1:]).astype(x.dtype)
+    return cq.q_all_gather(x, axis, block=block)
 
 
 def quantized_reduce_scatter(x, axis: str, block: int = DEFAULT_BLOCK):
-    """Reduce-scatter with int8 transport (qgZ shape): quantize the local
-    tensor once, all_to_all the int8 shards, dequantize and sum in fp32.
+    """Reduce-scatter with int8 transport (qgZ shape): quantize once,
+    all_to_all the int8 blocks, dequantize + reduce locally in fp32 (one
+    quantization error per element, not log(P)).
 
     ``x``: full local tensor, leading dim divisible by the axis size.
     Returns this rank's reduced shard (x.shape[0] // P leading dim).
     """
-    import functools as _ft
-    import numpy as _np
-
-    P = lax.axis_size(axis)
-    shard = x.shape[0] // P
-    shard_elems = shard * int(_np.prod(x.shape[1:])) if x.ndim > 1 else shard
-    xs = x.reshape(P, shard_elems)
-    # quantize each destination shard separately so blocks never span shard
-    # boundaries and scales travel with their blocks
-    q, scale, _ = jax.vmap(_ft.partial(block_quantize, block=block))(xs)
-    comm_api.comms_logger.record("q_reduce_scatter", axis, q)
-    with _scope("ds_comm_q_reduce_scatter"):
-        qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
-        st = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
-    parts = (qt.astype(jnp.float32) * st).sum(axis=0)       # [nb, block]
-    flat = parts.reshape(-1)[:shard_elems]
-    return flat.reshape((shard,) + x.shape[1:]).astype(x.dtype)
+    return cq.q_reduce_scatter(x, axis, block=block)
 
 
 def compressed_allreduce(x, error, server_error, axis: str):

@@ -256,6 +256,76 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class CommQuantizationConfig(DeepSpeedConfigModel):
+    """``comm_quantization`` section (TPU extension; ROADMAP item 2 /
+    ZeRO++ arXiv:2306.10209, EQuARX arXiv:2506.17615): blockwise-int8
+    transport for the collectives themselves, a property of the comm
+    layer every caller opts into (``comm/collectives_q.py``).
+
+    Per-site switches are tri-state: ``null`` follows ``enabled``; an
+    explicit ``true``/``false`` wins.  Sites:
+
+    - ``grad_all_reduce`` — the ZeRO stage 0/1/2 boundary gradient sync
+      (engine manual path; ``error_feedback`` carries the quantization
+      residual across steps so the compressed all-reduce converges —
+      turning it off is measurably worse, tested).
+    - ``all_gather`` / ``reduce_scatter`` — the overlap schedule's
+      per-bucket forward gathers and AD-transpose reduce-scatters
+      (``overlap_comm``), and — on the ZeRO++ stage-3 path — the qwAG /
+      qgRS switches (see the precedence rule below).
+    - ``all_to_all`` — MoE dispatch/combine (``moe/sharded_moe.py``) and
+      ``comm.all_to_all_single(quantized=True)``.
+    - ``sequence_ring`` — the sequence-parallel ring attention KV
+      rotation (codes rotate; one quantization error total).
+
+    Precedence vs the legacy ZeRO++ flags
+    (``zero_optimization.zero_quantized_weights`` / ``_gradients``): the
+    legacy flags are the stage-3 ZeRO++ spellings of ``all_gather`` /
+    ``reduce_scatter``.  Setting both to AGREEING values is fine, and
+    either alone activates its seam (a comm_quantization site turns the
+    ZeRO++ quantized transport on even with the legacy flags unset).
+    The one DETECTABLE contradiction — a legacy flag true while the
+    comm_quantization site is explicitly false — raises at config
+    parse, because silently picking one would make the other a lying
+    knob.  (The reverse cannot be detected: a default-false legacy flag
+    is indistinguishable from an explicit false, so legacy-false +
+    site-true simply activates the seam — silence is not an "off"
+    vote.)
+    """
+
+    enabled: bool = False
+    block: int = 256                 # blockwise code granularity (comm/quant.py)
+    error_feedback: bool = True      # residual carry for grad_all_reduce
+    grad_all_reduce: Optional[bool] = None
+    all_gather: Optional[bool] = None
+    reduce_scatter: Optional[bool] = None
+    all_to_all: Optional[bool] = None
+    sequence_ring: Optional[bool] = None
+
+    def _site(self, value: Optional[bool]) -> bool:
+        return bool(self.enabled) if value is None else bool(value)
+
+    @property
+    def q_grad_all_reduce(self) -> bool:
+        return self._site(self.grad_all_reduce)
+
+    @property
+    def q_all_gather(self) -> bool:
+        return self._site(self.all_gather)
+
+    @property
+    def q_reduce_scatter(self) -> bool:
+        return self._site(self.reduce_scatter)
+
+    @property
+    def q_all_to_all(self) -> bool:
+        return self._site(self.all_to_all)
+
+    @property
+    def q_sequence_ring(self) -> bool:
+        return self._site(self.sequence_ring)
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -534,6 +604,8 @@ class DeepSpeedConfig:
         self.wandb = WandbConfig(**d.get("wandb", {}))
         self.csv_monitor = CSVConfig(**d.get("csv_monitor", {}))
         self.comms_logger = CommsLoggerConfig(**d.get("comms_logger", {}))
+        self.comm_quantization = CommQuantizationConfig(
+            **d.get("comm_quantization", {}))
         self.flight_recorder = FlightRecorderConfig(**d.get("flight_recorder", {}))
         self.watchdog = WatchdogConfig(**d.get("watchdog", {}))
         self.anomaly_detection = AnomalyConfig(**d.get("anomaly_detection", {}))
@@ -606,6 +678,31 @@ class DeepSpeedConfig:
                              "accumulation (data_types.grad_accum_dtype)")
         if self.zero_config.stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_optimization.stage must be 0-3, got {self.zero_config.stage}")
+        # comm_quantization vs the legacy ZeRO++ flags: agreeing settings
+        # compose (the legacy flags are the stage-3 spellings of
+        # all_gather / reduce_scatter); an explicit contradiction raises —
+        # silently preferring one would make the other a lying knob.
+        cq = self.comm_quantization
+        zc = self.zero_config
+        for legacy_key, legacy_val, site_key, site_val in (
+                ("zero_optimization.zero_quantized_weights",
+                 zc.zero_quantized_weights, "all_gather", cq.all_gather),
+                ("zero_optimization.zero_quantized_gradients",
+                 zc.zero_quantized_gradients, "reduce_scatter",
+                 cq.reduce_scatter)):
+            # a contradiction needs BOTH sides explicit: the legacy flag
+            # set true while the comm_quantization site says false (a
+            # default-False legacy flag is silence, not an "off" vote)
+            if legacy_val and site_val is False:
+                raise ValueError(
+                    f"conflicting quantized-comm config: {legacy_key}="
+                    f"{legacy_val} but comm_quantization.{site_key}="
+                    f"{site_val}.  The legacy flag is the ZeRO++ spelling "
+                    f"of the comm_quantization site — set them to agree "
+                    f"or drop one (precedence rule: contradictions raise, "
+                    f"they are never silently resolved)")
+        if cq.block <= 0:
+            raise ValueError("comm_quantization.block must be positive")
         if self.train_batch_size <= 0:
             raise ValueError("train_batch_size must be positive")
         if self.gradient_clipping < 0:

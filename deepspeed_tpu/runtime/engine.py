@@ -373,8 +373,17 @@ class DeepSpeedEngine:
         # all-gathers / gradient reduce-scatters + hpZ secondary partition,
         # on the full-manual shard_map path (runtime/zero/zeropp.py).
         zc = self.config.zero_config
+        cq = self.config.comm_quantization
+        self._qcomm = cq
+        # the comm_quantization gather/scatter sites are the comm-layer
+        # spellings of the ZeRO++ flags: at stage 3 (and without
+        # overlap_comm, which owns its own quantized schedule) they
+        # activate the ZeRO++ path by themselves — either spelling alone
+        # turns the seam on (config docstring contract)
         want_zpp = (zc.zero_quantized_weights or zc.zero_quantized_gradients
-                    or zc.zero_hpz_partition_size > 1)
+                    or zc.zero_hpz_partition_size > 1
+                    or (self.zero_stage == 3 and not zc.overlap_comm
+                        and (cq.q_all_gather or cq.q_reduce_scatter)))
         self._zeropp = False
         self._zeropp_reason = None
         if want_zpp:
@@ -437,6 +446,65 @@ class DeepSpeedEngine:
                                         "through the model's layer segments")
             else:
                 self._overlap_want = True
+        # Unified quantized-collective transport (comm/collectives_q.py;
+        # ROADMAP item 2): the `comm_quantization` block opts individual
+        # call sites into int8 comm.  The grad_all_reduce site routes the
+        # ZeRO stage 0/1/2 boundary gradient sync through an explicit
+        # manual-region q_all_reduce with an error-feedback residual
+        # carried as engine state; the other sites thread through the
+        # overlap schedule, ZeRO++, MoE dispatch and the sequence ring.
+        self._qcomm_grads = False
+        self._qcomm_grads_reason = None
+        self._qcomm_residual = None
+        if cq.q_grad_all_reduce:
+            # ep counts as a bad axis here, not a data axis: expert
+            # params shard over ep, and the manual region would feed a
+            # full-E dispatch into an E/ep-local expert tree (trace
+            # crash) — and q_all_reduce over ep would average DIFFERENT
+            # experts' gradient shards together
+            bad = [a for a in ("tp", "sp", "pp", "ep")
+                   if self.mesh.shape.get(a, 1) > 1]
+            data_world = 1
+            for a in ("dp", "fsdp", "ep"):
+                data_world *= self.mesh.shape.get(a, 1)
+            if self.zero_stage > 2:
+                self._qcomm_grads_reason = (
+                    "stage 3 has no boundary grad all-reduce — its "
+                    "gathers/scatters quantize via overlap_comm or the "
+                    "ZeRO++ flags")
+            elif self._offload or self._param_offload:
+                self._qcomm_grads_reason = (
+                    "offloaded grads cross the host relay, not a "
+                    "collective (offload_optimizer.int8_masters / "
+                    "offload_param.int8_stream own that transport)")
+            elif self._onebit:
+                self._qcomm_grads_reason = ("1-bit optimizers already "
+                                            "compress their exchange")
+            elif self._overlap_want:
+                self._qcomm_grads_reason = (
+                    "overlap_comm owns the bucketed reduction schedule "
+                    "(enable comm_quantization.reduce_scatter there)")
+            elif self.fp16_enabled:
+                self._qcomm_grads_reason = ("requires bf16/fp32 (no fp16 "
+                                            "loss scaling)")
+            elif bad:
+                self._qcomm_grads_reason = (
+                    f"model/expert-parallel axes {bad} are not supported "
+                    "on the manual quantized-grad path (ep shards expert "
+                    "params; tp/sp/pp shard the program)")
+            elif data_world <= 1:
+                self._qcomm_grads_reason = ("no data-parallel axis > 1 — "
+                                            "there is no all-reduce to "
+                                            "quantize")
+            else:
+                self._qcomm_grads = True
+                log_dist(
+                    f"comm_quantization: stage {self.zero_stage} gradient "
+                    f"all-reduce -> int8 q_all_reduce (block {cq.block}, "
+                    f"error_feedback={'on' if cq.error_feedback else 'OFF'})"
+                    + ("" if cq.error_feedback else
+                       " — compressed grads without the residual "
+                       "accumulate quantization bias"), ranks=[0])
         self.gradient_accumulation_steps = lambda: self.config.gradient_accumulation_steps
         self.train_batch_size = lambda: self.config.train_batch_size
         self.train_micro_batch_size_per_gpu = lambda: self.config.train_micro_batch_size_per_gpu
@@ -457,6 +525,55 @@ class DeepSpeedEngine:
                     "offload_param: model %s does not expose a param_offload "
                     "hook; params stay host-resident but the model will not "
                     "stream them per-layer", type(model).__name__)
+        # comm_quantization sites that live inside the MODEL's program
+        # (MoE dispatch, sequence ring) are wired through the model
+        # config, the param_offload idiom above.  Assigned UNCONDITIONALLY
+        # (True or False): a model object reused across engines must not
+        # keep a previous engine's quantization flags stuck on.
+        _mcfg = getattr(model, "config", None)
+        if _mcfg is not None and hasattr(_mcfg, "moe_q_dispatch"):
+            _mcfg.comm_quant_block = cq.block
+            _moe_q = bool(cq.q_all_to_all
+                          and getattr(_mcfg, "num_experts", 0) > 0)
+            _mcfg.moe_q_dispatch = _moe_q
+            if _moe_q:
+                log_dist("comm_quantization: MoE ep dispatch -> int8 "
+                         "q_reshard (combine stays dense — replicated "
+                         "codes would move MORE bytes than the "
+                         "ep-sharded exchange)", ranks=[0])
+            elif cq.q_all_to_all:
+                logger.warning(
+                    "comm_quantization.all_to_all: model has no MoE "
+                    "layers — only explicit "
+                    "all_to_all_single(quantized=True) callers quantize")
+            # attention_core only takes the RING when sp_mode says so or
+            # the head count forces it — otherwise ulysses runs and this
+            # knob would be a lying log line
+            _nsp = self.mesh.shape.get("sp", 1)
+            _ntp = self.mesh.shape.get("tp", 1)
+            _heads = int(getattr(_mcfg, "num_heads", 0) or 0)
+            _local_heads = _heads // max(1, _ntp)
+            _ring = (getattr(_mcfg, "sp_mode", "auto") == "ring"
+                     or (_local_heads and _local_heads % _nsp))
+            _ring_q = bool(cq.q_sequence_ring and _nsp > 1 and _ring)
+            _mcfg.seq_ring_q = _ring_q
+            if _ring_q:
+                log_dist("comm_quantization: sequence-parallel ring KV "
+                         "rotation -> int8 codes", ranks=[0])
+            elif cq.q_sequence_ring and _nsp > 1:
+                logger.warning(
+                    "comm_quantization.sequence_ring is set but this "
+                    "configuration resolves to ULYSSES attention "
+                    "(sp_mode=%s, %d local heads divisible by sp=%d) — "
+                    "the knob is inert; set the model's sp_mode='ring' "
+                    "to opt the ring in",
+                    getattr(_mcfg, "sp_mode", "auto"), _local_heads,
+                    _nsp)
+        elif cq.q_all_to_all or cq.q_sequence_ring:
+            logger.warning(
+                "comm_quantization: model %s exposes no comm-quant hooks "
+                "(moe_q_dispatch/seq_ring_q); the all_to_all/"
+                "sequence_ring sites stay dense", type(model).__name__)
         self._client_loss_fn = loss_fn is not None
         self._loss_fn = loss_fn or self._make_loss_fn(model)
         if param_pspecs is None and hasattr(model, "logical_pspecs"):
@@ -677,6 +794,22 @@ class DeepSpeedEngine:
             if zc.zero_hpz_partition_size > 1:
                 inert.append(("zero_optimization.zero_hpz_partition_size",
                               self._zeropp_inactive_reason()))
+        cq = self.config.comm_quantization
+        if cq.q_grad_all_reduce and not self._qcomm_grads:
+            inert.append(("comm_quantization.grad_all_reduce",
+                          f"{self._qcomm_grads_reason}; the gradient sync "
+                          "runs dense"))
+        if ((cq.q_all_gather or cq.q_reduce_scatter)
+                and not (self._overlap_want or self._zeropp)):
+            inert.append(("comm_quantization.all_gather/reduce_scatter",
+                          "no explicit gather/scatter seam in this "
+                          "configuration (GSPMD places dense collectives) "
+                          "— enable zero_optimization.overlap_comm or the "
+                          "ZeRO++ stage-3 path"))
+        if cq.q_sequence_ring and self.mesh.shape.get("sp", 1) <= 1:
+            inert.append(("comm_quantization.sequence_ring",
+                          "no sp mesh axis > 1 — there is no ring "
+                          "exchange to quantize"))
         import logging as _logging
 
         for key, why in inert:
@@ -948,12 +1081,23 @@ class DeepSpeedEngine:
 
         mesh = self.mesh
         zc = self.config.zero_config
+        cq = self.config.comm_quantization
         Pfsdp = self.mesh.shape.get("fsdp", 1)
         z = max(1, zc.zero_hpz_partition_size)
+        # the comm_quantization sites are the comm-layer spellings of the
+        # legacy ZeRO++ flags (same seam, documented precedence): either
+        # alone turns the quantized transport on here — otherwise an
+        # hpz-only ZeRO++ config would silently ignore the block
+        q_weights = zc.zero_quantized_weights or cq.q_all_gather
+        q_grads = zc.zero_quantized_gradients or cq.q_reduce_scatter
+        if (q_weights != zc.zero_quantized_weights
+                or q_grads != zc.zero_quantized_gradients):
+            log_dist(f"ZeRO++ transport driven by comm_quantization: "
+                     f"qw={q_weights} qg={q_grads}", ranks=[0])
         self._zpp_cfg = zpp.ZeroPPConfig(
             axis="fsdp", world=Pfsdp, hpz=z,
-            q_weights=zc.zero_quantized_weights,
-            q_grads=zc.zero_quantized_gradients,
+            q_weights=q_weights,
+            q_grads=q_grads,
             compute_dtype=self.compute_dtype)
         self._zpp_shapes = jax.tree.map(lambda p: tuple(p.shape), params)
         self._zpp_lens = zpp.flatten_spec(self._zpp_shapes, Pfsdp)
@@ -1083,11 +1227,24 @@ class DeepSpeedEngine:
         # Gradient accumulator: sharded from stage 2 up (reduce-scatter), or
         # like params under stage 3 (grads of sharded params are sharded).
         acc_shard = self.zero_stage >= 2
-        if self._onebit:
-            # per-worker local grad accumulators, stacked on a leading [W] axis
+        if self._onebit or self._qcomm_grads:
+            # per-worker LOCAL grad accumulators, stacked on a leading [W]
+            # axis sharded over the data axes (each device holds exactly
+            # its own running sum).  The 1-bit path needs this because its
+            # compression is defined over local grads; the quantized
+            # grad-all-reduce path needs it because the whole point is to
+            # defer the reduction to the boundary and move int8 there —
+            # note the ZeRO-2 sharded-accumulator memory saving is traded
+            # away on this path (full-size local sums, like 1-bit).
             waxes = ("dp", "fsdp", "ep")
             self._acc_specs = jax.tree.map(
                 lambda p: P(waxes, *([None] * getattr(p, "ndim", 0))), params)
+            if self._qcomm_grads and self.zero_stage == 2:
+                log_dist("comm_quantization.grad_all_reduce at ZeRO stage "
+                         "2: gradients accumulate LOCALLY (full-size) and "
+                         "reduce once per boundary — the stage-2 sharded-"
+                         "accumulator memory saving is traded for int8 "
+                         "boundary bytes", ranks=[0])
         elif self._overlap:
             # overlap schedule: stage 3 accumulates in EXACTLY the param
             # layout (each bucket's reduce-scatter is the gather's
@@ -1214,8 +1371,9 @@ class DeepSpeedEngine:
         opt_state = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)(params)
         if self._param_offload:
             grad_acc = ()
-        elif self._onebit:
-            W = self.optimizer.world
+        elif self._onebit or self._qcomm_grads:
+            W = (self.optimizer.world if self._onebit
+                 else comm.get_data_parallel_world_size(self.mesh))
             strip = 1 if self._onebit_stacked else 0
             grad_acc = jax.jit(
                 lambda p: jax.tree.map(
@@ -1516,6 +1674,10 @@ class DeepSpeedEngine:
             self._compile_overlap_steps(apply if anomaly_on else apply1,
                                         evaluate, gas, anomaly_on)
             return
+        if self._qcomm_grads:
+            self._compile_qcomm_steps(loss_fn, cast_params, evaluate, gas,
+                                      anomaly_on)
+            return
         self._accum_fn = jax.jit(accum, donate_argnums=(0,), in_shardings=(sh, None, None),
                                  out_shardings=(sh, NamedSharding(self.mesh, P())))
         self._anomaly_select = anomaly_on and not self._offload
@@ -1560,10 +1722,23 @@ class DeepSpeedEngine:
         transparently."""
         import functools
 
-        from deepspeed_tpu.runtime.zero.overlap import OverlapSchedule
+        from deepspeed_tpu.runtime.zero.overlap import (OverlapSchedule,
+                                                        QCommOpts)
 
         mesh = self.mesh
         mcfg = getattr(self.module, "config", None)
+        cq = self.config.comm_quantization
+        qcomm = QCommOpts(all_gather=cq.q_all_gather and self.zero_stage == 3,
+                          reduce_scatter=cq.q_reduce_scatter
+                          and self.zero_stage >= 2,
+                          block=cq.block)
+        if qcomm.all_gather or qcomm.reduce_scatter:
+            log_dist(
+                f"comm_quantization on the overlap schedule: "
+                f"gathers={'int8' if qcomm.all_gather else 'dense'}, "
+                f"reduce-scatters="
+                f"{'int8' if qcomm.reduce_scatter else 'dense'} "
+                f"(block {qcomm.block})", ranks=[0])
         self._overlap_sched = OverlapSchedule(
             segments=self._overlap_segments,
             params=self._state.params,
@@ -1579,7 +1754,8 @@ class DeepSpeedEngine:
             # the ZeRO-3 memory contract); stages 1/2 follow the model's
             # activation-checkpointing choice
             remat=(self.zero_stage == 3 or bool(getattr(mcfg, "remat",
-                                                        False))))
+                                                        False))),
+            qcomm=qcomm)
         state_specs = TrainState(
             params=self._param_specs, opt_state=self._opt_specs,
             grad_acc=self._acc_specs, global_steps=P(),
@@ -1729,6 +1905,232 @@ class DeepSpeedEngine:
             sm(eval_local, in_specs=(self._zpp_state_param_specs, bspec, P()),
                out_specs=P()))
 
+    def _compile_qcomm_steps(self, loss_fn, cast_params, evaluate, gas,
+                             anomaly_on: bool) -> None:
+        """ZeRO stage 0/1/2 with the comm-layer quantized gradient sync
+        (``comm_quantization.grad_all_reduce``; comm/collectives_q.py).
+
+        Accum runs under full-manual ``shard_map`` over the data axes with
+        LOCAL gradients (the 1-bit skeleton: every worker keeps its own
+        running sum, stacked on the [W] axis) — no implicit GSPMD psum
+        ever moves dense grad bytes.  The boundary apply reduces the
+        accumulated tree ONCE through :func:`collectives_q.q_all_reduce`
+        (int8 codes + fp32 block scales, fp32 reduce after dequant) and
+        then runs the standard update under GSPMD.  Quantizing once per
+        boundary (not per micro) is both cheaper and kinder to the
+        error-feedback residual, which is carried as ENGINE state
+        (``self._qcomm_residual``) — donated into and returned from every
+        boundary program, reset to zero on (re)compile and on checkpoint
+        load (it is transient sync state, not part of the model; a resume
+        restarts it at zero, documented in docs/OBSERVABILITY.md).
+
+        The anomaly-detection in-program skip select composes here
+        exactly as on the standard path (the ZeRO++/1-bit refuse-to-arm
+        list is unchanged — this path is neither)."""
+        import functools
+
+        from deepspeed_tpu.comm import collectives_q as cqt
+
+        mesh = self.mesh
+        waxes = ("dp", "fsdp", "ep")
+        active_axes = tuple(a for a in waxes
+                            if mesh.shape.get(a, 1) > 1)
+        cq = self.config.comm_quantization
+        block = int(cq.block)
+        ef = bool(cq.error_feedback)
+        clip = self.config.gradient_clipping
+        optimizer = self.optimizer
+        new_params_opt = getattr(optimizer, "updates_are_new_params", False)
+        fp16_cfg = self.config.fp16
+
+        state_specs = TrainState(
+            params=jax.tree.map(lambda s: s.spec, self._param_shardings),
+            opt_state=self._opt_specs,
+            grad_acc=self._acc_specs,
+            global_steps=P(),
+            scaler=scaler_lib.LossScaleState(P(), P(), P(), P()))
+        bspec = P(waxes)
+
+        def accum_local(state: TrainState, batch, rng):
+            # twin of _compile_onebit_steps.accum_local (minus the [W]
+            # replica stacking): a fix to the local-grad skeleton here
+            # almost certainly applies there too
+            def f(p):
+                return loss_fn(cast_params(p), batch,
+                               rng).astype(jnp.float32) / gas
+
+            loss, grads = jax.value_and_grad(f)(state.params)
+            new_acc = jax.tree.map(lambda a, g: a + g[None].astype(a.dtype),
+                                   state.grad_acc, grads)
+            return (state._replace(grad_acc=new_acc),
+                    jax.lax.pmean(loss * gas, waxes))
+
+        def qsync_local(acc, res=None):
+            """[W]-stacked local sums -> globally-reduced MEAN grads
+            (replicated) (+ the new residual when error feedback is on),
+            via int8 q_all_reduce."""
+            leaves, treedef = jax.tree_util.tree_flatten(acc)
+            res_leaves = (jax.tree_util.tree_leaves(res) if ef
+                          else [None] * len(leaves))
+            outs, new_res = [], []
+            for a, r in zip(leaves, res_leaves):
+                o, nr = cqt.q_all_reduce(
+                    a[0], active_axes, block=block,
+                    residual=(r[0] if ef else None), mean=True)
+                outs.append(o)
+                new_res.append(nr[None] if nr is not None else None)
+            reduced = jax.tree_util.tree_unflatten(treedef, outs)
+            if not ef:
+                return reduced
+            return reduced, jax.tree_util.tree_unflatten(treedef, new_res)
+
+        sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+        acc_specs = self._acc_specs
+        reduced_specs = jax.tree.map(lambda _: P(), acc_specs)
+        if ef:
+            qsync = sm(qsync_local, in_specs=(acc_specs, acc_specs),
+                       out_specs=(reduced_specs, acc_specs))
+        else:
+            # no residual program state at all with error feedback off:
+            # a full-model fp32 tree donated through every boundary for
+            # nothing would be pure wasted HBM + dispatch traffic
+            qsync = sm(qsync_local, in_specs=(acc_specs,),
+                       out_specs=reduced_specs)
+
+        @jax.named_scope("ds_optimizer_step")
+        def apply_q(state: TrainState, residual, *anomaly_bound):
+            if ef:
+                grads, new_res = qsync(state.grad_acc, residual)
+            else:
+                grads = qsync(state.grad_acc)
+                new_res = None
+            if clip > 0:
+                grads, gnorm = clip_grad_norm(grads, clip)
+            else:
+                gnorm = global_norm(grads)
+            overflow = jnp.zeros((), bool)
+            if anomaly_on:
+                overflow = (overflow | ~jnp.isfinite(gnorm)
+                            | (gnorm > anomaly_bound[0]))
+            updates, new_opt = optimizer.update(grads, state.opt_state,
+                                                state.params)
+            if new_params_opt:
+                new_params = updates
+            else:
+                import optax
+
+                new_params = optax.apply_updates(state.params, updates)
+            if anomaly_on:
+                sel = lambda new, old: jax.tree.map(
+                    lambda a, b: jnp.where(overflow, b, a), new, old)
+                new_params = sel(new_params, state.params)
+                new_opt = sel(new_opt, state.opt_state)
+                if ef:
+                    # the residual must roll back WITH the step: it was
+                    # computed from the rejected gradients, so carrying
+                    # it would leak ~1/254 of them into the next boundary
+                    # — and a non-finite gradient would poison the carry
+                    # FOREVER (every later comp = grads + NaN),
+                    # defeating the skip
+                    new_res = sel(new_res, residual)
+            new_scaler = scaler_lib.update(
+                state.scaler, overflow, dynamic=False,
+                loss_scale_window=fp16_cfg.loss_scale_window,
+                min_loss_scale=fp16_cfg.min_loss_scale,
+                hysteresis=fp16_cfg.hysteresis)
+            zero_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
+            new_state = TrainState(
+                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
+                global_steps=state.global_steps
+                + (1 - overflow.astype(jnp.int32)),
+                scaler=new_scaler)
+            out = (new_state, gnorm, overflow)
+            return out + ((new_res,) if ef else ())
+
+        def fused(state: TrainState, residual, batches, rng,
+                  *anomaly_bound):
+            rngs = jax.random.split(rng, gas)
+
+            def micro(st, xs):
+                b, r = xs
+                st, loss = sm_accum(st, b, r)
+                return st, loss
+
+            state, losses = jax.lax.scan(micro, state, (batches, rngs))
+            out = apply_q(state, residual, *anomaly_bound)
+            return (out[0], losses.mean()) + out[1:]
+
+        sm_accum = sm(accum_local, in_specs=(state_specs, bspec, P()),
+                      out_specs=(state_specs, P()))
+        self._accum_fn = jax.jit(sm_accum, donate_argnums=(0,))
+        sh = self._state_shardings
+        scalar = NamedSharding(mesh, P())
+        res_sh = sh.grad_acc
+        extra = (None,) if anomaly_on else ()
+        res_tail = (res_sh,) if ef else ()
+        if ef:
+            apply_jit = jax.jit(
+                apply_q, donate_argnums=(0, 1),
+                in_shardings=(sh, res_sh) + extra,
+                out_shardings=(sh, scalar, scalar) + res_tail)
+            fused_jit = jax.jit(
+                fused, donate_argnums=(0, 1),
+                in_shardings=(sh, res_sh, None, None) + extra,
+                out_shardings=(sh, scalar, scalar, scalar) + res_tail)
+            acc_shapes = jax.tree.map(lambda a: tuple(a.shape),
+                                      self.state.grad_acc)
+            res_zeros = jax.jit(
+                lambda: jax.tree.map(
+                    lambda shp: jnp.zeros(shp, jnp.float32), acc_shapes,
+                    is_leaf=lambda x: isinstance(x, tuple)),
+                out_shardings=res_sh)
+        else:
+            # ef off: no residual program state at all — the jits take
+            # and return only the TrainState tuple
+            apply_jit = jax.jit(
+                lambda state, *b: apply_q(state, None, *b),
+                donate_argnums=(0,), in_shardings=(sh,) + extra,
+                out_shardings=(sh, scalar, scalar))
+            fused_jit = jax.jit(
+                lambda state, batches, rng, *b: fused(state, None,
+                                                      batches, rng, *b),
+                donate_argnums=(0,), in_shardings=(sh, None, None) + extra,
+                out_shardings=(sh, scalar, scalar, scalar))
+            res_zeros = None
+        self._qcomm_residual = None
+        self._qcomm_apply_jit = apply_jit
+
+        def _residual():
+            if self._qcomm_residual is None:
+                self._qcomm_residual = res_zeros()
+            return self._qcomm_residual
+
+        def _apply(state, *bound):
+            if ef:
+                st, gnorm, overflow, res = apply_jit(state, _residual(),
+                                                     *bound)
+                self._qcomm_residual = res
+            else:
+                st, gnorm, overflow = apply_jit(state, *bound)
+            return st, gnorm, overflow
+
+        def _fused(state, batches, rng, *bound):
+            if ef:
+                st, loss, gnorm, overflow, res = fused_jit(
+                    state, _residual(), batches, rng, *bound)
+                self._qcomm_residual = res
+            else:
+                st, loss, gnorm, overflow = fused_jit(state, batches,
+                                                      rng, *bound)
+            return st, loss, gnorm, overflow
+
+        self._apply_fn = _apply
+        self._fused_fn = _fused
+        self._anomaly_select = anomaly_on
+        self._eval_fn = jax.jit(
+            evaluate, in_shardings=(self._param_shardings, None, None),
+            out_shardings=scalar)
+
     def _compile_onebit_steps(self, loss_fn, cast_params, gas) -> None:
         """Accum/apply under full-manual shard_map over the data axes: each
         worker keeps LOCAL gradients (no implicit psum), which is what the
@@ -1819,7 +2221,11 @@ class DeepSpeedEngine:
             self._flops_per_step_fn = (
                 lambda tokens, seq, n=n_params, L=L, D=D:
                 tokens * lm_flops_per_token(n, L, D, seq))
-        if not (self._zeropp or self._onebit or self._param_offload):
+        # the qcomm grad path's explicit manual collectives record
+        # themselves (trace-time q/dense twins) — an analytic GSPMD plan
+        # on top would double-count the sync it replaced
+        if not (self._zeropp or self._onebit or self._param_offload
+                or self._qcomm_grads):
             try:
                 plan = _build_comm_plan(
                     self.state.params, self._param_specs, self._acc_specs,
@@ -1953,7 +2359,9 @@ class DeepSpeedEngine:
         out = {}
         for mult, entries in ((gas, self._comm_plan["micro"]),
                               (1, self._comm_plan["boundary"])):
-            for op, _calls, nbytes, _dtype, world in entries:
+            for entry in entries:
+                # quantized overlap entries carry a 6th (dense-twin) field
+                op, _calls, nbytes, _dtype, world = entry[:5]
                 b, w = out.get(op, (0, world))
                 out[op] = (b + nbytes * mult * steps, max(w, world))
         return out or None
@@ -2500,7 +2908,10 @@ class DeepSpeedEngine:
         if (self.flops_profiler is None
                 or self._host_steps != self.config.flops_profiler.profile_step):
             return
-        if self._apply_fn is not None and self._state is not None:
+        if (self._apply_fn is not None and self._state is not None
+                and hasattr(self._apply_fn, "lower")):
+            # the qcomm-grad path's apply is a python wrapper carrying the
+            # error-feedback residual — no AOT surface to cost-analyze
             self._profile_probes.setdefault("apply", (self._apply_fn, (self._state,)))
         if self._streamed is not None and self._streamed.probes:
             # streamed offload: fwd+bwd is L dispatches of the per-layer
@@ -2746,9 +3157,17 @@ class DeepSpeedEngine:
         if t0:
             # the fused program runs gas micro-batches + the boundary in one
             # dispatch: commit the whole step's plan against its one window
-            entries = [(op, calls * gas, nbytes * gas, dtype, world)
-                       for op, calls, nbytes, dtype, world
-                       in self._comm_plan["micro"]]
+            def scale_entry(e):
+                out = e[:1] + (e[1] * gas, e[2] * gas) + e[3:5]
+                if len(e) > 5:   # dense twin: bytes or (bytes, dtype)
+                    d = e[5]
+                    if isinstance(d, (tuple, list)):
+                        out += ((d[0] * gas, d[1]),)
+                    else:
+                        out += (d * gas,)
+                return out
+
+            entries = [scale_entry(e) for e in self._comm_plan["micro"]]
             entries += self._comm_plan["boundary"]
             comm_metrics.commit(entries, time.perf_counter() - t0)
         if self._flops_per_step_fn is not None and get_registry().enabled:
@@ -3129,6 +3548,9 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.state = new_state
+        # the error-feedback residual is transient sync state, not part of
+        # the checkpoint: a resume restarts it at zero (documented)
+        self._qcomm_residual = None
         if self._param_offload and getattr(self, "_streamed", None) is not None:
             self._np_params = jax.device_get(self.state.params)
         self._restore_client_runtime(meta)
@@ -3179,6 +3601,7 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.state = new_state
+        self._qcomm_residual = None   # transient sync state, never loaded
         if self._param_offload and getattr(self, "_streamed", None) is not None:
             self._np_params = jax.device_get(self.state.params)
         self._restore_client_runtime(meta)

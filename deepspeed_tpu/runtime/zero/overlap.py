@@ -61,11 +61,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepspeed_tpu.comm import collectives_q as cq
 from deepspeed_tpu.runtime.zero.partition import choose_pspec, params_pspecs
 from deepspeed_tpu.utils.logging import logger
 
-__all__ = ["OverlapSchedule", "plan_buckets", "layerwise_pspecs",
-           "unpack_lm_batch"]
+__all__ = ["OverlapSchedule", "QCommOpts", "plan_buckets",
+           "layerwise_pspecs", "unpack_lm_batch"]
 
 # the data-parallel axes the overlap step is manual over; param shards live
 # on SHARD_AXIS (the ZeRO convention everywhere else in runtime/zero)
@@ -219,6 +220,65 @@ def _scoped_all_gather_bwd(dims_axes, _res, ct):
 _scoped_all_gather.defvjp(_scoped_all_gather_fwd, _scoped_all_gather_bwd)
 
 
+class QCommOpts(NamedTuple):
+    """Quantized-transport switches for the bucketed schedule
+    (``comm_quantization`` config block -> engine -> here).  ``all_gather``
+    quantizes the per-bucket forward parameter gathers (int8 codes + fp32
+    block scales on the wire — the ZeRO++ qwAG shape composed with the
+    bucketed stream); ``reduce_scatter`` quantizes the AD-transpose /
+    stage-2 gradient reduce-scatters (the qgZ shape).  Byte accounting
+    stays on the analytic per-execution comm plan (``comm_plan_entries``
+    emits q ops with dense-twin bytes), so the collectives here run with
+    ``record=False`` — the trace-time and per-execution feeds never
+    double-count (monitor/comms.py contract)."""
+
+    all_gather: bool = False
+    reduce_scatter: bool = False
+    block: int = 256
+
+
+def _q_tiled_gathers(leaf, dims_axes, block):
+    # scope lives inside collectives_q (ds_comm_q_all_gather) — same
+    # custom-VJP reasoning as _tiled_gathers: the bwd must not inherit it
+    for dim, ax in dims_axes:
+        leaf = cq.q_all_gather_dim(leaf, ax, dim, block=block,
+                                   record=False)
+    return leaf
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _scoped_all_gather_q(leaf, dims_axes, block, q_fwd, q_bwd):
+    """Quantized-transport twin of :func:`_scoped_all_gather`: the
+    forward gather ships int8 codes when ``q_fwd``; the custom bwd emits
+    the per-bucket reduce-scatter as a quantized exchange when ``q_bwd``
+    (cotangents leave the producing bucket as codes) — each under its own
+    ``ds_comm_q_*`` scope so per-op device series stay honest."""
+    if q_fwd:
+        return _q_tiled_gathers(leaf, dims_axes, block)
+    return _tiled_gathers(leaf, dims_axes)
+
+
+def _scoped_all_gather_q_fwd(leaf, dims_axes, block, q_fwd, q_bwd):
+    return _scoped_all_gather_q(leaf, dims_axes, block, q_fwd, q_bwd), None
+
+
+def _scoped_all_gather_q_bwd(dims_axes, block, q_fwd, q_bwd, _res, ct):
+    if q_bwd:
+        for dim, ax in reversed(dims_axes):
+            ct = cq.q_reduce_scatter_dim(ct, ax, dim, block=block,
+                                         record=False)
+        return (ct,)
+    with jax.named_scope("ds_comm_reduce_scatter"):
+        for dim, ax in reversed(dims_axes):
+            ct = jax.lax.psum_scatter(ct, ax, scatter_dimension=dim,
+                                      tiled=True)
+    return (ct,)
+
+
+_scoped_all_gather_q.defvjp(_scoped_all_gather_q_fwd,
+                            _scoped_all_gather_q_bwd)
+
+
 class BucketInfo(NamedTuple):
     """One schedule bucket, for tests / the analytic comm plan."""
 
@@ -240,8 +300,10 @@ class OverlapSchedule:
     def __init__(self, *, segments: Dict[str, Any], params: Any,
                  param_specs: Any, acc_specs: Any, mesh: Mesh,
                  zero_stage: int, compute_dtype, bucket_layers: int,
-                 use_dropout: bool, remat: bool):
+                 use_dropout: bool, remat: bool,
+                 qcomm: QCommOpts = QCommOpts()):
         self.seg = segments
+        self.qcomm = qcomm
         self.mesh = mesh
         self.zero_stage = zero_stage
         self.compute_dtype = compute_dtype
@@ -396,16 +458,34 @@ class OverlapSchedule:
                 elif dp_world > 1:
                     ar_rows.append((nbytes, dp_world))
 
-            def add(op, rows, mult=1):
-                if rows:
-                    micro.append((op, mult * len(rows),
-                                  mult * sum(b for b, _ in rows), cname,
-                                  max(w for _, w in rows)))
+            qc = self.qcomm
+
+            def qbytes(nbytes: int) -> int:
+                # int8 codes + one fp32 scale per block, per element
+                return int(nbytes / c_item * (1 + 4.0 / qc.block))
+
+            def add(op, rows, mult=1, quantized=False):
+                if not rows:
+                    return
+                dense = mult * sum(b for b, _ in rows)
+                world = max(w for _, w in rows)
+                calls = mult * len(rows)
+                if quantized:
+                    # quantized transport: q op slug, wire bytes =
+                    # codes+scales, dense twin rides as the 6th element
+                    # as (bytes, dense dtype) so the twin series' dtype
+                    # label matches record_q's (CommMetrics.commit)
+                    micro.append((f"q_{op}", calls, qbytes(dense), "int8",
+                                  world, (dense, cname)))
+                else:
+                    micro.append((op, calls, dense, cname, world))
 
             if self.zero_stage == 3:
-                add("all_gather", g_rows, mult=info.gathers_per_micro)
+                add("all_gather", g_rows, mult=info.gathers_per_micro,
+                    quantized=qc.all_gather)
             if self.zero_stage >= 2:
-                add("reduce_scatter", r_rows)
+                add("reduce_scatter", r_rows,
+                    quantized=qc.reduce_scatter)
             else:
                 ar_rows = ar_rows + r_rows   # stage<2: everything pmeans
             add("all_reduce", ar_rows)
@@ -421,8 +501,8 @@ class OverlapSchedule:
         total = sum(e[2] for e in entries)
         if not total:
             return 0.0
-        gathers = [e for e in entries if e[0] == "all_gather"]
-        reduces = [e for e in entries if e[0] != "all_gather"]
+        gathers = [e for e in entries if e[0].endswith("all_gather")]
+        reduces = [e for e in entries if not e[0].endswith("all_gather")]
         exposed = 0
         if gathers:
             exposed += gathers[0][2]   # first bucket's gather (conservative)
@@ -441,6 +521,7 @@ class OverlapSchedule:
         the backward needs)."""
         mesh = self.mesh
         cdtype = self.compute_dtype
+        qc = self.qcomm
 
         def g(leaf, spec):
             if (jnp.issubdtype(leaf.dtype, jnp.floating)
@@ -448,7 +529,12 @@ class OverlapSchedule:
                 leaf = leaf.astype(cdtype)
             dims = tuple((d, a) for d, a in _sharded_dims(spec, mesh))
             if dims:
-                leaf = _scoped_all_gather(leaf, dims)
+                if qc.all_gather or qc.reduce_scatter:
+                    leaf = _scoped_all_gather_q(leaf, dims, qc.block,
+                                                qc.all_gather,
+                                                qc.reduce_scatter)
+                else:
+                    leaf = _scoped_all_gather(leaf, dims)
             return leaf
 
         return jax.tree.map(g, tree, spec_tree)
@@ -483,11 +569,20 @@ class OverlapSchedule:
             target = _sharded_dims(aspec, mesh)
             if target:
                 w = 1
-                with jax.named_scope("ds_comm_reduce_scatter"):
+                if self.qcomm.reduce_scatter:
+                    # stage-2 explicit reduce-scatter as a quantized
+                    # exchange (qgZ shape; scope inside collectives_q)
                     for dim, ax in target:
-                        g = jax.lax.psum_scatter(g, ax, scatter_dimension=dim,
-                                                 tiled=True)
+                        g = cq.q_reduce_scatter_dim(
+                            g, ax, dim, block=self.qcomm.block,
+                            record=False)
                         w *= mesh.shape.get(ax, 1)
+                else:
+                    with jax.named_scope("ds_comm_reduce_scatter"):
+                        for dim, ax in target:
+                            g = jax.lax.psum_scatter(
+                                g, ax, scatter_dimension=dim, tiled=True)
+                            w *= mesh.shape.get(ax, 1)
                 g = g / w
                 rest = tuple(a for a in DATA_AXES
                              if a not in {ax for _, ax in target})

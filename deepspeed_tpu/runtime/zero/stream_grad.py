@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.runtime.zero.streaming import ParamStreamer, _tree_nbytes
+from deepspeed_tpu.runtime.zero.streaming import ParamStreamer
 
 
 class StreamedFwdBwd:
@@ -105,6 +105,7 @@ class StreamedFwdBwd:
         embed_fwd = segments["embed_fwd"]
         use_drop = self.use_drop
         mat = self.streamer.materialize
+        mat_aux = self.streamer.materialize_aux
 
         def lfwd(lp, x, key, cos, sin):
             # mat() is the streamer's fused consumer stage: pinned->device
@@ -122,7 +123,18 @@ class StreamedFwdBwd:
             g_lp, ct_x = vjp((ct_y, ct_aux))
             return ct_x, g_lp
 
-        def hvag(head_tree, x, labels, mask):
+        def efwd(embed_p, tokens):
+            # embed/head ride the SAME aux transport (int8 codes + fused
+            # dequant when the relay is int8 — the PR 10 "embed/head stay
+            # bf16" gap, closed); dense mode materializes to itself
+            return embed_fwd(mat_aux("embed", embed_p), tokens)
+
+        def hvag(head_p, x, labels, mask):
+            # grads are taken w.r.t. the MATERIALIZED head tree —
+            # quantization is a transport codec, not part of the
+            # differentiated function (the lbwd contract)
+            head_tree = mat_aux("head", head_p)
+
             def f(ht, x_):
                 # grads scaled 1/gas exactly like the whole-program path
                 return head_loss(ht, x_, labels, mask).astype(jnp.float32) / gas
@@ -130,12 +142,13 @@ class StreamedFwdBwd:
             loss, (g_ht, ct_x) = jax.value_and_grad(f, argnums=(0, 1))(head_tree, x)
             return loss * gas, g_ht, ct_x
 
-        def ebwd(embed, tokens, ct_x):
+        def ebwd(embed_p, tokens, ct_x):
+            embed = mat_aux("embed", embed_p)
             _, vjp = jax.vjp(lambda e: embed_fwd(e, tokens), embed)
             (g_embed,) = vjp(ct_x)
             return g_embed
 
-        self._embed_fwd = jax.jit(embed_fwd)
+        self._embed_fwd = jax.jit(efwd)
         self._layer_fwd = jax.jit(lfwd)
         self._layer_bwd = jax.jit(lbwd)
         self._head_vag = jax.jit(hvag)
@@ -175,12 +188,11 @@ class StreamedFwdBwd:
             self.streamer.refresh(np_layers)
             self._src_id = id(np_layers)
 
-    def _put_nonlayer(self, tree, shardings):
-        """Embed/head H2D (outside the layer streamer; counted on the same
-        relay ledger)."""
-        if self.streamer.meter.registry.enabled:
-            self.streamer.meter.h2d_bytes.inc(_tree_nbytes(tree))
-        return jax.device_put(tree, shardings)
+    def _put_nonlayer(self, name: str, tree, shardings):
+        """Embed/head H2D through the streamer's aux transport (int8
+        codes when the relay is int8; counted on the same relay ledger)."""
+        return self.streamer.put_aux(name, tree, shardings,
+                                     src_key=self._src_id)
 
     @staticmethod
     def _acc(buf_tree, grad_tree):
@@ -218,7 +230,11 @@ class StreamedFwdBwd:
             keys = [jnp.zeros((2,), jnp.uint32)] * L
 
         self._bind_source(np_params["layers"])
-        embed_dev = self._put_nonlayer(np_params["embed"], self._embed_sh)
+        embed_dev = self._put_nonlayer("embed", np_params["embed"],
+                                       self._embed_sh)
+        if "embed_fwd" not in self.probes:
+            self.probes["embed_fwd"] = (
+                self._embed_fwd, self._abstract((embed_dev, tokens)))
         x = self._embed_fwd(embed_dev, tokens)
         del embed_dev
 
@@ -249,13 +265,11 @@ class StreamedFwdBwd:
         ht = {"final_norm": np_params["final_norm"], "head": head_np}
         if "lm_head_bias" in np_params:
             ht["head_bias"] = np_params["lm_head_bias"]
-        head_tree = self._put_nonlayer(ht, self._head_sh)
+        head_tree = self._put_nonlayer("head", ht, self._head_sh)
         if "head_vag" not in self.probes:
             self.probes["head_vag"] = (
                 self._head_vag,
                 self._abstract((head_tree, xs[-1], labels, loss_mask)))
-            self.probes["embed_fwd"] = (
-                self._embed_fwd, self._abstract((np_params["embed"], tokens)))
         loss, g_head, ct = self._head_vag(head_tree, xs[-1], labels, loss_mask)
         del head_tree
         self._d2h_async(g_head)
@@ -297,7 +311,8 @@ class StreamedFwdBwd:
         if prev_grads is not None:
             self._acc_indexed(acc_tree["layers"], prev_idx, prev_grads)
 
-        embed_dev = self._put_nonlayer(np_params["embed"], self._embed_sh)
+        embed_dev = self._put_nonlayer("embed", np_params["embed"],
+                                       self._embed_sh)
         if "embed_bwd" not in self.probes:
             self.probes["embed_bwd"] = (
                 self._embed_bwd, self._abstract((embed_dev, tokens, ct)))

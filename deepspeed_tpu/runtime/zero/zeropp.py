@@ -34,11 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from deepspeed_tpu.comm import collectives_q as cq
 from deepspeed_tpu.comm import comm as comm_api
 from deepspeed_tpu.profiling.trace import scope as _scope
 from deepspeed_tpu.runtime.comm.quantized import (block_dequantize,
-                                                  block_quantize,
-                                                  quantized_reduce_scatter)
+                                                  block_quantize)
 
 QUANT_BLOCK = 256
 
@@ -73,19 +73,12 @@ def hpz_groups(P: int, z: int) -> Optional[Tuple[Tuple[int, ...], ...]]:
 def q_all_gather_flat(local: jnp.ndarray, axis: str,
                       groups=None, block: int = QUANT_BLOCK) -> jnp.ndarray:
     """int8 all-gather of a flat local shard -> flat fp32 concatenation
-    (over the whole axis, or each subgroup when ``groups`` is given)."""
-    q, scale, pad = block_quantize(local, block)
-    comm_api.comms_logger.record("zpp_q_all_gather", axis, q)
-    with _scope("ds_comm_zpp_q_all_gather"):
-        qg = lax.all_gather(q, axis, axis=0, tiled=False,
-                            axis_index_groups=groups)
-        sg = lax.all_gather(scale, axis, axis=0, tiled=False,
-                            axis_index_groups=groups)
-    G = qg.shape[0]
-    parts = (qg.astype(jnp.float32) * sg).reshape(G, -1)
-    if pad:
-        parts = parts[:, : parts.shape[1] - pad]
-    return parts.reshape(-1)
+    (over the whole axis, or each subgroup when ``groups`` is given).
+    Thin caller of the comm-layer transport — the qwAG exchange itself is
+    ``collectives_q.q_all_gather_flat``; this wrapper only pins the
+    ZeRO++ record label so the zpp byte series stay distinct."""
+    return cq.q_all_gather_flat(local, axis, groups=groups, block=block,
+                                op="zpp_q_all_gather")
 
 
 def dense_all_gather_flat(local: jnp.ndarray, axis: str, groups=None) -> jnp.ndarray:
@@ -97,9 +90,11 @@ def dense_all_gather_flat(local: jnp.ndarray, axis: str, groups=None) -> jnp.nda
 
 def reduce_scatter_flat(full: jnp.ndarray, axis: str, quantized: bool,
                         block: int = QUANT_BLOCK) -> jnp.ndarray:
-    """[n_pad] local gradient -> this rank's reduced [n_pad / P] shard."""
+    """[n_pad] local gradient -> this rank's reduced [n_pad / P] shard.
+    The quantized branch is the comm-layer qgRS (quantize once, exchange
+    int8, fp32 reduce after dequant — ``collectives_q``)."""
     if quantized:
-        return quantized_reduce_scatter(full, axis, block=block)
+        return cq.q_reduce_scatter_flat(full, axis, block=block)
     comm_api.comms_logger.record("zpp_reduce_scatter", axis, full)
     with _scope("ds_comm_zpp_reduce_scatter"):
         return lax.psum_scatter(full, axis, scatter_dimension=0, tiled=True)
@@ -136,8 +131,11 @@ def gather_param_tree(zp: ZeroPPParams, cfg: ZeroPPConfig, shapes: Any):
             # secondary slice length (pre-quant): n_pad / z
             s2 = flat_local.shape[0] * cfg.world // cfg.hpz
             if cfg.q_weights:
-                comm_api.comms_logger.record("zpp_q_all_gather(hpz)",
-                                             cfg.axis, sec_q)
+                # dense twin: the bf16/compute-dtype slice this subgroup
+                # gather replaced (never materialized — shape/dtype only)
+                comm_api.comms_logger.record_q(
+                    "zpp_q_all_gather(hpz)", cfg.axis, (sec_q, sec_s),
+                    jax.ShapeDtypeStruct((s2,), cfg.compute_dtype))
                 with _scope("ds_comm_zpp_q_all_gather_hpz"):
                     qg = lax.all_gather(sec_q, cfg.axis, axis=0, tiled=False,
                                         axis_index_groups=groups)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepspeed_tpu.comm import collectives_q as cq
 from deepspeed_tpu.comm.mesh import axis_size, data_axes
 
 NEG_INF = -1e30
@@ -120,7 +121,8 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
 
 
 def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
-                   sm_scale: Optional[float] = None, axis: str = "sp"):
+                   sm_scale: Optional[float] = None, axis: str = "sp",
+                   quantized: bool = False, quant_block: int = 256):
     """Blockwise ring attention over the ``sp`` axis (ppermute KV rotation).
 
     q/k/v: [B, H, S, D] globally; sharded on S internally.  Each ring step
@@ -130,6 +132,15 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     VJP re-runs the ring instead of letting scan save every visiting KV
     chunk (which would be O(S) again — VERDICT r2 weak #8).  Comm is
     nearest-neighbor on the ICI torus in both passes.
+
+    ``quantized`` (``comm_quantization.sequence_ring``): the KV chunk is
+    quantized ONCE into blockwise int8 + fp32 scales before the ring and
+    the *codes* rotate (``collectives_q.q_ppermute``) — every hop moves
+    ~1/4 the fp32 bytes, with ONE quantization error total (not one per
+    hop; the carried codes never re-quantize).  Compute dequantizes the
+    visiting chunk per step.  The backward's dK/dV partial sums stay
+    dense: they are running accumulations, and requantizing a running sum
+    per hop WOULD compound error.
     """
     B, H, S, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
@@ -144,27 +155,56 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def _inner(ql, kl, vl):
-        return _ring_local(ql, kl, vl, axis, sp, chunk, scale, causal)
+        return _ring_local(ql, kl, vl, axis, sp, chunk, scale, causal,
+                           bool(quantized), int(quant_block))
 
     return _inner(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_local(ql, kl, vl, axis, sp, chunk, scale, causal):
-    out, _ = _ring_fwd(ql, kl, vl, axis, sp, chunk, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring_local(ql, kl, vl, axis, sp, chunk, scale, causal, quantized,
+                block):
+    out, _ = _ring_fwd(ql, kl, vl, axis, sp, chunk, scale, causal,
+                       quantized, block)
     return out
 
 
-def _ring_fwd(ql, kl, vl, axis, sp, chunk, scale, causal):
+def _kv_carry(kl, vl, quantized, block):
+    """(carry, dequant) pair: the scan-carried transport form of the
+    visiting KV chunk and the per-step stage recovering compute values."""
+    if not quantized:
+        return (kl, vl), lambda c: (c[0], c[1])
+    kc = cq.quantize_carry(kl, block)
+    vc = cq.quantize_carry(vl, block)
+
+    def deq(c):
+        return (cq.dequantize_carry(c[0], kl.shape, kl.dtype),
+                cq.dequantize_carry(c[1], vl.shape, vl.dtype))
+
+    return (kc, vc), deq
+
+
+def _rotate_kv(carry_kv, axis, perm, quantized, kl, vl):
+    if quantized:
+        kc = cq.q_ppermute(carry_kv[0], axis, perm, dense_like=kl)
+        vc = cq.q_ppermute(carry_kv[1], axis, perm, dense_like=vl)
+        return (kc, vc)
+    return (jax.lax.ppermute(carry_kv[0], axis, perm),
+            jax.lax.ppermute(carry_kv[1], axis, perm))
+
+
+def _ring_fwd(ql, kl, vl, axis, sp, chunk, scale, causal, quantized, block):
     my = jax.lax.axis_index(axis)
     q_pos = my * chunk + jnp.arange(chunk)
     m0 = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
     l0 = jnp.zeros(ql.shape[:3], jnp.float32)
     a0 = jnp.zeros(ql.shape, jnp.float32)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
+    kv0, deq = _kv_carry(kl, vl, quantized, block)
 
     def step(carry, t):
-        kc, vc, m, l, acc = carry
+        kv, m, l, acc = carry
+        kc, vc = deq(kv)
         # KV chunk visiting at step t started at rank (my - t) mod sp
         src = jnp.mod(my - t, sp)
         k_pos = src * chunk + jnp.arange(chunk)
@@ -174,26 +214,33 @@ def _ring_fwd(ql, kl, vl, axis, sp, chunk, scale, causal):
         c_new = jnp.exp(bm - mn)
         l = l * c_old + bl * c_new
         acc = acc * c_old[..., None] + bacc * c_new[..., None]
-        kc = jax.lax.ppermute(kc, axis, perm)
-        vc = jax.lax.ppermute(vc, axis, perm)
-        return (kc, vc, mn, l, acc), None
+        kv = _rotate_kv(kv, axis, perm, quantized, kl, vl)
+        return (kv, mn, l, acc), None
 
-    (_, _, m, l, acc), _ = jax.lax.scan(step, (kl, vl, m0, l0, a0),
-                                        jnp.arange(sp))
+    (_, m, l, acc), _ = jax.lax.scan(step, (kv0, m0, l0, a0),
+                                     jnp.arange(sp))
     safe_l = jnp.maximum(l, 1e-30)
     out = (acc / safe_l[..., None]).astype(ql.dtype)
     lse = m + jnp.log(safe_l)                       # [B, H, Sq]
     return out, (ql, kl, vl, out, lse)
 
 
-def _ring_local_fwd(ql, kl, vl, axis, sp, chunk, scale, causal):
-    out, res = _ring_fwd(ql, kl, vl, axis, sp, chunk, scale, causal)
+def _ring_local_fwd(ql, kl, vl, axis, sp, chunk, scale, causal, quantized,
+                    block):
+    out, res = _ring_fwd(ql, kl, vl, axis, sp, chunk, scale, causal,
+                         quantized, block)
     return out, res
 
 
-def _ring_local_bwd(axis, sp, chunk, scale, causal, res, g):
+def _ring_local_bwd(axis, sp, chunk, scale, causal, quantized, block, res,
+                    g):
     """Second ring pass: dK/dV partials travel with their KV chunk and are
-    complete when the chunk arrives back home after sp rotations."""
+    complete when the chunk arrives back home after sp rotations.  Under
+    ``quantized`` the visiting KV chunk rotates as codes (matching the
+    forward's bytes AND its numerics — the backward must see the same
+    dequantized values the forward attended to); the dK/dV running sums
+    rotate dense on purpose (requantizing an accumulation per hop would
+    compound error)."""
     ql, kl, vl, out, lse = res
     my = jax.lax.axis_index(axis)
     q_pos = my * chunk + jnp.arange(chunk)
@@ -203,9 +250,11 @@ def _ring_local_bwd(axis, sp, chunk, scale, causal, res, g):
     dq0 = jnp.zeros(ql.shape, jnp.float32)
     dk0 = jnp.zeros(kl.shape, jnp.float32)
     dv0 = jnp.zeros(vl.shape, jnp.float32)
+    kv0, deq = _kv_carry(kl, vl, quantized, block)
 
     def step(carry, t):
-        kc, vc, dkc, dvc, dq = carry
+        kv, dkc, dvc, dq = carry
+        kc, vc = deq(kv)
         src = jnp.mod(my - t, sp)
         k_pos = src * chunk + jnp.arange(chunk)
         s = jnp.einsum("bhqd,bhkd->bhqk", ql.astype(jnp.float32),
@@ -220,14 +269,13 @@ def _ring_local_bwd(axis, sp, chunk, scale, causal, res, g):
         ds = p * (dp - delta[..., None]) * scale
         dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kc.astype(jnp.float32))
         dkc = dkc + jnp.einsum("bhqk,bhqd->bhkd", ds, ql.astype(jnp.float32))
-        kc = jax.lax.ppermute(kc, axis, perm)
-        vc = jax.lax.ppermute(vc, axis, perm)
+        kv = _rotate_kv(kv, axis, perm, quantized, kl, vl)
         dkc = jax.lax.ppermute(dkc, axis, perm)
         dvc = jax.lax.ppermute(dvc, axis, perm)
-        return (kc, vc, dkc, dvc, dq), None
+        return (kv, dkc, dvc, dq), None
 
-    (_, _, dk, dv, dq), _ = jax.lax.scan(step, (kl, vl, dk0, dv0, dq0),
-                                         jnp.arange(sp))
+    (_, dk, dv, dq), _ = jax.lax.scan(step, (kv0, dk0, dv0, dq0),
+                                      jnp.arange(sp))
     return dq.astype(ql.dtype), dk.astype(kl.dtype), dv.astype(vl.dtype)
 
 

@@ -1,0 +1,28 @@
+"""Quantized-collective benchmark rung (slow): dense vs int8 per
+collective family on the 8-device CPU mesh (``bench.bench_quant_comm``).
+Marked ``slow`` — the fast tier-1 coverage is
+``tests/unit/test_collectives_q.py`` / ``test_qcomm_engine.py``.  On CPU
+the bytes + compression + loss-parity acceptance bits are exact
+(backend-independent); the throughput ratio is a TPU row."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_quant_comm_bench_scenario(capsys):
+    from bench import bench_quant_comm
+
+    out = bench_quant_comm(steps=2, warmup=1)
+    assert out["status"] == "ok", out
+    # the ROADMAP item 2 acceptance: every opted-in collective moves
+    # ~2-4x fewer bytes than its dense twin ON THE SAME TRACE
+    for op in ("q_all_reduce", "q_all_gather", "q_reduce_scatter"):
+        assert 2.0 <= out["compression"][op] <= 4.5, (op, out["compression"])
+    assert out["loss_parity"] == {"all_reduce": True, "gather_rs": True}
+    for fam, row in out["families"].items():
+        assert row["dense"]["tokens_per_sec"] > 0
+        assert row["int8"]["tokens_per_sec"] > 0
+    with capsys.disabled():
+        print(f"\nquant comm bench (CPU): compression {out['compression']}, "
+              f"parity {out['loss_parity']}")

@@ -255,3 +255,35 @@ def test_metrics_dump_comms_table(tmp_path):
     # ds_mem_* byte gauges humanize in the main table
     main_table = metrics_dump.render(metrics_dump.rows_from_snapshot(metrics))
     assert "3.00 GiB" in main_table
+
+
+def test_metrics_dump_comms_compression_column(tmp_path):
+    """The quantized transports' per-op compression column (quantized
+    wire bytes vs the dense-twin series, both from ONE trace —
+    comm/collectives_q.py): rendered as `<ratio>x`, blank for dense
+    ops."""
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    try:
+        import metrics_dump
+    finally:
+        sys.path.pop(0)
+    reg = MetricsRegistry().enable()
+    cm = CommMetrics(registry=reg)
+    cm.configure(enabled=True)
+    # a quantized op: wire = int8 codes + fp32 scales, dense twin = fp32
+    cm.commit([("q_all_reduce", 2, 1_000_000, "int8", 8, 3_500_000)],
+              seconds=0.1)
+    # a dense op on the same snapshot: no compression column
+    cm.commit([("all_reduce", 2, 4_000_000, "float32", 8)], seconds=0.1)
+    snap = tmp_path / "statz.json"
+    snap.write_text(reg.statz_json())
+    metrics = metrics_dump.load_snapshot(str(snap))
+    rows = metrics_dump.comms_rows(metrics)
+    by_op = {r[0]: r for r in rows}
+    assert by_op["q_all_reduce"][3] == "3.50x"
+    assert by_op["all_reduce"][3] == ""
+    table = metrics_dump.render_comms(rows)
+    assert "compress" in table and "3.50x" in table

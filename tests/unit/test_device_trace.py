@@ -311,8 +311,9 @@ def test_metrics_dump_device_columns(tmp_path):
     assert "dev_p50_s" in table and "dev_busbw" in table
     row = rows[0]
     assert row[0] == "all_gather"
-    assert row[6] != "" and float(row[6]) == pytest.approx(0.001, rel=0.5)
-    assert "123" in row[7]
+    assert row[3] == ""   # dense op: no compression column
+    assert row[7] != "" and float(row[7]) == pytest.approx(0.001, rel=0.5)
+    assert "123" in row[8]
 
 
 def test_interval_helpers():

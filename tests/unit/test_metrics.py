@@ -365,7 +365,21 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
                       "status": "ok", "world_save": 4, "worlds": [2, 8],
                       "resume_latency_s_max": 0.68,
                       "steps_to_recover_max": 0, "loss_parity": True,
-                      "resumes": {"2": {"resume_latency_s": 0.68}}}}}
+                      "resumes": {"2": {"resume_latency_s": 0.68}}},
+                  "quant_comm": {
+                      "status": "ok",
+                      "compression": {"q_all_reduce": 3.44,
+                                      "q_all_gather": 3.94,
+                                      "q_reduce_scatter": 3.94},
+                      "loss_parity": {"all_reduce": True,
+                                      "gather_rs": True},
+                      "families": {
+                          "all_reduce": {"speedup": 0.82,
+                                         "dense": {"loss": 6.13},
+                                         "int8": {"loss": 6.13}},
+                          "gather_rs": {"speedup": 0.9,
+                                        "dense": {"loss": 6.13},
+                                        "int8": {"loss": 6.13}}}}}}
     lines = bench.summary_lines(record, None)
     parsed = json.loads(lines[-1])
     st = parsed["streamed_offload"]
@@ -390,6 +404,12 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
     assert er["resume_latency_s"] == 0.68
     assert er["steps_to_recover"] == 0 and er["loss_parity"] is True
     assert er["world_save"] == 4 and er["worlds"] == [2, 8]
+    # the ISSUE 15 quantized-collective ablation row rides BENCH_JSON
+    qc = parsed["quant_comm"]
+    assert qc["compression"]["q_all_reduce"] == 3.44
+    assert qc["compression"]["q_all_gather"] == 3.94
+    assert qc["loss_parity"] == {"all_reduce": True, "gather_rs": True}
+    assert qc["speedup"] == {"all_reduce": 0.82, "gather_rs": 0.9}
     # bulky capture payloads never reach the final line
     assert "device_profile" not in json.dumps(parsed)
     assert lines[-2] == "BENCH_JSON: " + lines[-1]
@@ -622,8 +642,14 @@ def test_namespace_guard_all_metrics_documented(devices):
     # schema and additionally require their suffix token documented —
     # no blanket exemption for the new family.
     comm_re = re.compile(r"^ds_comm_([a-z0-9_]+?)_"
-                         r"(calls_total|bytes_total|seconds|algbw_gbps|"
+                         r"(calls_total|bytes_total|dense_bytes_total|"
+                         r"seconds|algbw_gbps|"
                          r"busbw_gbps|device_seconds|device_busbw_gbps)$")
+    # the quantized dense-twin suffix is part of the schema: its name must
+    # be documented like the device-truth suffixes (guard extended)
+    assert any(d.endswith("dense_bytes_total") for d in documented), (
+        "the ds_comm_*_dense_bytes_total schema is registered but no "
+        "*_dense_bytes_total name is documented in docs/OBSERVABILITY.md")
     for suffix in ("device_seconds", "device_busbw_gbps"):
         assert any(d.endswith(suffix) for d in documented), (
             f"the ds_comm_*_{suffix} schema is registered but no "

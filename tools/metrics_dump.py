@@ -10,8 +10,10 @@ Sources (auto-detected from the one positional argument):
   (one ``<event>.csv`` per series; the table shows each series' last value)
 
 ``--comms`` additionally prints the per-collective summary (count / bytes /
-p50 / p99 / busbw from the ``ds_comm_*`` family — the training-side comm
-ledger, docs/OBSERVABILITY.md) with the device-truth columns
+compression / p50 / p99 / busbw from the ``ds_comm_*`` family — the
+training-side comm ledger, docs/OBSERVABILITY.md; ``compress`` = the
+quantized transports' dense-equivalent-over-wire byte ratio, both series
+recorded on one trace by comm/collectives_q.py) with the device-truth columns
 (``ds_comm_<op>_device_seconds`` p50 + recomputed device busbw, when a
 ``/profilez``/watchdog capture populated them) alongside the analytic
 attribution for side-by-side error reading, plus the offload-relay line
@@ -94,12 +96,22 @@ def human_bytes(n: float) -> str:
 
 
 def comms_rows(metrics: Dict[str, object]) -> List[List[str]]:
-    """Per-collective summary rows [op, calls, bytes, p50, p99, busbw,
-    dev_p50, dev_busbw] from the ``ds_comm_*`` family (one row per op that
-    recorded traffic).  The last two columns come from the device-truth
+    """Per-collective summary rows [op, calls, bytes, compress, p50, p99,
+    busbw, dev_p50, dev_busbw] from the ``ds_comm_*`` family (one row per
+    op that recorded traffic).  ``compress`` is the per-op compression
+    ratio (dense-equivalent bytes / wire bytes) for quantized transports —
+    ``ds_comm_<op>_dense_bytes_total`` over ``ds_comm_<op>_bytes_total``,
+    both recorded on the SAME trace by comm/collectives_q.py; dense ops
+    leave it blank.  The device columns come from the device-truth
     ``ds_comm_<op>_device_*`` series (perfetto post-processor,
     docs/OBSERVABILITY.md "Device truth") and sit NEXT TO the analytic
     host-window attribution so the attribution error reads off one row."""
+
+    def fam_sum(v) -> float:
+        if isinstance(v, dict):             # {dtype=...} labeled family
+            return sum(x for x in v.values() if isinstance(x, (int, float)))
+        return float(v or 0)
+
     ops = {}
     for name in metrics:
         if name.startswith("ds_comm_") and name.endswith("_calls_total"):
@@ -113,9 +125,8 @@ def comms_rows(metrics: Dict[str, object]) -> List[List[str]]:
     rows = []
     for op in sorted(ops):
         calls = metrics.get(f"ds_comm_{op}_calls_total", 0)
-        byt = metrics.get(f"ds_comm_{op}_bytes_total", 0)
-        if isinstance(byt, dict):           # {dtype=...} labeled family
-            byt = sum(v for v in byt.values() if isinstance(v, (int, float)))
+        byt = fam_sum(metrics.get(f"ds_comm_{op}_bytes_total", 0))
+        dense = fam_sum(metrics.get(f"ds_comm_{op}_dense_bytes_total", 0))
         dev = metrics.get(f"ds_comm_{op}_device_seconds") or {}
         if not calls and not byt and not (isinstance(dev, dict)
                                           and dev.get("count")):
@@ -126,6 +137,7 @@ def comms_rows(metrics: Dict[str, object]) -> List[List[str]]:
             dev = {}
         dev_bw = metrics.get(f"ds_comm_{op}_device_busbw_gbps", 0)
         rows.append([op, str(calls), human_bytes(float(byt)),
+                     f"{dense / byt:.2f}x" if dense and byt else "",
                      f"{hist.get('p50', 0):.6g}" if hist.get("count") else "",
                      f"{hist.get('p99', 0):.6g}" if hist.get("count") else "",
                      f"{busbw:.3g} GB/s" if busbw else "",
@@ -186,8 +198,8 @@ def offload_relay_line(metrics: Dict[str, object]) -> str:
 
 
 def render_comms(rows: List[List[str]]) -> str:
-    header = ["collective", "calls", "bytes", "p50_s", "p99_s", "busbw",
-              "dev_p50_s", "dev_busbw"]
+    header = ["collective", "calls", "bytes", "compress", "p50_s", "p99_s",
+              "busbw", "dev_p50_s", "dev_busbw"]
     table = [header] + rows
     widths = [max(len(r[i]) for r in table) for i in range(len(header))]
     lines = []
