@@ -1643,6 +1643,149 @@ def _run_quant_comm_subprocess() -> dict:
     return _run_child_rung("DSTPU_BENCH_QUANTCOMM_OUT")
 
 
+def bench_pipe(steps: int = 3, warmup: int = 1) -> dict:
+    """Dense vs int8 stage-boundary ablation for the full-manual pipeline
+    (ISSUE 16; runtime/pipe/spmd.py — the 1F1B fused schedule with
+    ppermute boundary rings).
+
+    pp in {2, 4} over all local devices (fsdp absorbs the rest), each
+    depth run with a dense fp32 boundary then the int8 carry codec
+    (``comm_quantization.pipeline``).  Per side: tokens/s + final loss;
+    per rung: the ANALYTIC schedule bubble share ((pp-1)/T, T =
+    M + 2(pp-1) for 1F1B) and the engine-committed boundary byte ledger —
+    ``ds_comm_ppermute_bytes_total`` dense vs
+    ``ds_comm_q_ppermute_bytes_total`` + its dense-twin series on the
+    quantized side.  Headlines: per-rung ``compression`` (dense-
+    equivalent / wire, the >=2x acceptance number at fp32 — ~3.9x for
+    int8 codes + fp32 block scales) and ``loss_parity``.  CPU-meaningful:
+    bytes, bubble share and parity are backend-independent; rates are
+    not comparable to TPU.
+    """
+    import gc
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import get_registry
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    if len(devs) < 4:
+        return {"status": "skipped: needs >=4 devices for pp x fsdp",
+                "devices": len(devs)}
+    W = len(devs)
+    on_tpu = jax.default_backend() != "cpu"
+    registry = get_registry()
+
+    def fam_sum(metrics, name) -> float:
+        v = metrics.get(name, 0)
+        if isinstance(v, dict):
+            return float(sum(x for x in v.values()
+                             if isinstance(x, (int, float))))
+        return float(v or 0)
+
+    # fp32 end to end (no bf16): the acceptance pin is the fp32 boundary's
+    # ~3.9x int8 compression, and parity tolerances assume fp32 math
+    if on_tpu:
+        over = {}
+        micro, accum, seq, M = 2, 2, 512, 4
+    else:
+        over = dict(num_layers=4, hidden_size=128, intermediate_size=256,
+                    num_heads=4, num_kv_heads=2, vocab_size=512,
+                    max_seq_len=128)
+        micro, accum, seq, M = 1, 2, 64, 4
+
+    def run_side(pp, quant):
+        mesh = build_mesh(pp=pp, fsdp=W // pp, devices=devs)
+        set_global_mesh(mesh)
+        model = causal_lm("llama-tiny", mesh=mesh, pp_schedule="1f1b",
+                          pp_microbatches=M, **over)
+        ds_config = {
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": accum,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-4}},
+            "gradient_clipping": 1.0,
+            "zero_optimization": {"stage": 1},
+            "comms_logger": {"enabled": True},
+            "steps_per_print": 10**9,
+        }
+        if quant:
+            ds_config["comm_quantization"] = {"pipeline": True}
+        registry.reset()
+        from deepspeed_tpu.comm.comm import comms_logger
+        comms_logger.reset()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=ds_config, mesh=mesh,
+            rng=jax.random.PRNGKey(11))
+        if quant and not engine.module.config.pp_boundary_q:
+            return None, {"status": "failed: comm_quantization.pipeline "
+                                    "did not arm pp_boundary_q"}
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (accum, micro * W, seq), 0,
+                                    model.config.vocab_size)
+        batch = (tokens, tokens)
+        for _ in range(warmup):
+            engine.train_step(batch)
+        sync(engine.state.params)
+        t1 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_step(batch)
+        sync(engine.state.params)
+        dt = (time.perf_counter() - t1) / steps
+        row = {"tokens_per_sec": round(accum * micro * W * seq / dt, 1),
+               "step_ms": round(dt * 1e3, 1),
+               "loss": round(float(engine._last_loss), 6)}
+        metrics = json.loads(registry.statz_json())["metrics"]
+        engine = model = None
+        gc.collect()
+        return row, metrics
+
+    rungs = {}
+    compression = {}
+    parity = {}
+    bubble = {}
+    for pp in (2, 4):
+        if W % pp or W // pp < 1:
+            continue
+        dense_row, dense_metrics = run_side(pp, False)
+        if dense_row is None:
+            return dense_metrics
+        q_row, q_metrics = run_side(pp, True)
+        if q_row is None:
+            return q_metrics
+        wire = fam_sum(q_metrics, "ds_comm_q_ppermute_bytes_total")
+        dense_eq = fam_sum(q_metrics,
+                           "ds_comm_q_ppermute_dense_bytes_total")
+        key = f"pp{pp}"
+        if wire and dense_eq:
+            compression[key] = round(dense_eq / wire, 3)
+        # 1F1B schedule: T = M + 2(pp-1) ticks, pp-1 of them idle per stage
+        bubble[key] = round((pp - 1) / (M + 2 * (pp - 1)), 4)
+        lp = abs(q_row["loss"] - dense_row["loss"]) \
+            <= 0.05 * max(abs(dense_row["loss"]), 1e-9)
+        parity[key] = bool(lp)
+        rungs[key] = {
+            "dense": dict(dense_row, boundary_bytes=int(fam_sum(
+                dense_metrics, "ds_comm_ppermute_bytes_total"))),
+            "int8": dict(q_row, boundary_bytes=int(wire),
+                         dense_equiv_bytes=int(dense_eq)),
+            "loss_parity": bool(lp),
+            "speedup": round(q_row["tokens_per_sec"]
+                             / max(dense_row["tokens_per_sec"], 1e-9), 4)}
+    return {"status": "ok", "devices": W,
+            "backend": jax.default_backend(),
+            "steps": steps, "micro_batch": micro, "grad_accum": accum,
+            "seq": seq, "microbatches": M, "schedule": "1f1b",
+            "compression": compression,
+            "loss_parity": parity,
+            "bubble_share": bubble,
+            "rungs": rungs,
+            "elapsed_s": round(time.perf_counter() - t0, 1)}
+
+
+def _run_pipe_subprocess() -> dict:
+    return _run_child_rung("DSTPU_BENCH_PIPE_OUT")
+
+
 # micro=4 exceeds what the AOT compiler will place at 48 layers (probed:
 # fwd+grad compile-OOMs); micro=2 compiles under every policy
 LADDER_1B4 = [("mlp_dots", 2), ("dots", 2), ("full", 2), ("full", 1)]
@@ -1932,6 +2075,12 @@ def main():
         with open(os.environ["DSTPU_BENCH_QUANTCOMM_OUT"], "w") as fh:
             json.dump(result, fh)
         return
+    if os.environ.get("DSTPU_BENCH_PIPE_OUT"):
+        # child mode: pipeline dense-vs-int8 boundary ablation
+        result = bench_pipe()
+        with open(os.environ["DSTPU_BENCH_PIPE_OUT"], "w") as fh:
+            json.dump(result, fh)
+        return
 
     # The >1B rung runs in a child process BEFORE the parent initializes the
     # TPU client (two live clients on the tunnel conflict; and a child abort
@@ -1953,6 +2102,13 @@ def main():
     rung_quant_comm = None
     if os.environ.get("DSTPU_BENCH_SKIP_QUANTCOMM") != "1":
         rung_quant_comm = _run_quant_comm_subprocess()
+
+    # pipeline dense-vs-int8 boundary ablation (ISSUE 16 acceptance: >=2x
+    # fewer boundary bytes at loss parity, bubble share recorded);
+    # CPU-meaningful for bytes/parity
+    rung_pipe = None
+    if os.environ.get("DSTPU_BENCH_SKIP_PIPE") != "1":
+        rung_pipe = _run_pipe_subprocess()
 
     on_tpu = jax.default_backend() != "cpu"
 
@@ -2166,6 +2322,7 @@ def main():
                       else {}),
                    **({"quant_comm": rung_quant_comm} if rung_quant_comm
                       else {}),
+                   **({"pipe": rung_pipe} if rung_pipe else {}),
                    **({"llama3_8b": rung_8b} if rung_8b else {}),
                    **({"decode_125m": rung_decode} if rung_decode else {}),
                    **({"serving_125m": rung_serving} if rung_serving
@@ -2270,6 +2427,18 @@ def summary_lines(record: dict, rung_serving) -> list:
             "speedup": {fam: f["speedup"]
                         for fam, f in qc["families"].items()},
         }
+    pi = record["detail"].get("pipe")
+    if pi and pi.get("status") == "ok":
+        # the ISSUE 16 pipeline acceptance row: per-depth boundary
+        # compression (dense-equivalent / wire bytes off the engine's
+        # analytic ledger), loss parity, the analytic 1F1B bubble share
+        # and the dense-vs-int8 throughput ratio travel with the headline
+        summary["pipe"] = {
+            "compression": pi["compression"],
+            "loss_parity": pi["loss_parity"],
+            "bubble_share": pi["bubble_share"],
+            "speedup": {r: v["speedup"] for r, v in pi["rungs"].items()},
+        }
     st = record["detail"].get("streamed_offload")
     if st and st.get("status") == "ok":
         # the ISSUE 11 streamed-rung acceptance row: relay MB/s + bytes
@@ -2323,7 +2492,7 @@ def summary_lines(record: dict, rung_serving) -> list:
     for victim in ("serving_metrics", "train_metrics", "overlap_ablation",
                    "serving_prefix", "streamed_offload",
                    "serving_host_tier", "fleet_chaos", "elastic_resume",
-                   "quant_comm"):
+                   "quant_comm", "pipe"):
         if len(line) <= BENCH_SUMMARY_MAX_CHARS:
             break
         if summary.pop(victim, None) is not None:

@@ -9,11 +9,13 @@ cached executable on toggle, and the device-trace matcher
 profiling on).
 
 Scope of the rule: files under a ``comm/`` directory (the wrapper
-layers: ``deepspeed_tpu/comm/``, ``deepspeed_tpu/runtime/comm/``).  A
-function there that calls a ``lax`` collective must wrap it in a
-``with``-scope (``named_scope``/``scope``/``_scope``) whose literal
-starts with ``ds_comm_``, and neither the collective nor its scope may
-sit inside an ``if`` that tests a telemetry-enabled flag.
+layers: ``deepspeed_tpu/comm/``, ``deepspeed_tpu/runtime/comm/``) plus
+``deepspeed_tpu/runtime/pipe/`` — the pipeline schedules dispatch their
+stage-boundary ``ppermute`` rings directly (ISSUE 16) and are held to
+the same contract.  A function there that calls a ``lax`` collective
+must wrap it in a ``with``-scope (``named_scope``/``scope``/``_scope``)
+whose literal starts with ``ds_comm_``, and neither the collective nor
+its scope may sit inside an ``if`` that tests a telemetry-enabled flag.
 """
 
 from __future__ import annotations
@@ -28,7 +30,8 @@ COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
                "psum_scatter", "all_to_all", "ppermute"}
 SCOPE_FUNCS = {"named_scope", "scope", "_scope"}
 SCOPE_PREFIX = "ds_comm_"
-COMM_DIRS = ("deepspeed_tpu/comm/", "deepspeed_tpu/runtime/comm/")
+COMM_DIRS = ("deepspeed_tpu/comm/", "deepspeed_tpu/runtime/comm/",
+             "deepspeed_tpu/runtime/pipe/")
 
 
 def _is_collective(node: ast.Call) -> bool:

@@ -31,6 +31,13 @@ ever exists as a device transient.  Callers opt in through the
   hop), dequantize per step for compute.  Re-quantizing a dequantized
   block is lossless (comm/quant.py), so the ring pays one quantization
   error total, not one per hop.
+- :func:`q_boundary_ppermute` — the PIPELINE-boundary ring form built
+  from the same three stages: each stage-to-stage activation hop is
+  quantize -> rotate the codes -> dequantize.  The boundary value is
+  different on every hop (each stage produces a new activation), so
+  unlike the sequence ring this pays one quantization error *per hop*;
+  a custom VJP sends the cotangent through the reverse ring the same
+  quantized way.
 - :func:`q_reshard` — the GSPMD form for callers that are NOT inside a
   manual region (MoE dispatch in ``moe/sharded_moe.py``): quantize,
   sharding-constrain the codes across the boundary so the
@@ -72,6 +79,7 @@ __all__ = [
     "q_reduce_scatter", "q_reduce_scatter_flat", "q_reduce_scatter_dim",
     "q_all_to_all", "q_reshard",
     "quantize_carry", "dequantize_carry", "q_ppermute",
+    "q_boundary_ppermute",
     "axis_world",
 ]
 
@@ -394,6 +402,50 @@ def q_ppermute(carry, axis: str, perm, *, op: str = "q_ppermute",
     with _scope("ds_comm_q_ppermute"):
         rotated = [lax.ppermute(leaf, axis, perm) for leaf in leaves]
     return jax.tree_util.tree_unflatten(treedef, rotated)
+
+
+def q_boundary_ppermute(x, axis: str, perm, *, block: int = DEFAULT_BLOCK,
+                        op: str = "q_ppermute", record: bool = True):
+    """Dense-in/dense-out quantized ring hop — the PIPELINE boundary form.
+
+    The sequence ring rotates ONE tensor's codes the whole way round
+    (:func:`quantize_carry` once, :func:`q_ppermute` per hop), paying a
+    single quantization error.  A pipeline boundary carries a *different*
+    activation on every hop — stage s's output, not a rotated copy — so
+    each stage-to-stage transfer re-quantizes: quantize -> rotate the
+    codes (int8 + fp32 block scales on the wire, under the same
+    unconditional ``ds_comm_q_ppermute`` scope) -> dequantize on arrival.
+    One quantization error per hop; bubble-step hops carry exact zeros
+    (zero blocks quantize losslessly).
+
+    A custom VJP transports the cotangent through the REVERSE ring the
+    same quantized way (the :func:`q_reshard` codec discipline:
+    quantization is a transport codec, not part of the differentiated
+    function), so autodiff-driven schedules (the GPipe scan) get a
+    quantized backward boundary for free; the fused 1F1B schedule calls
+    this directly on its explicit reverse-ring sends.
+    """
+    inv_perm = [(d, s) for s, d in perm]
+    shape, dtype = x.shape, x.dtype
+
+    def _hop(v, prm):
+        carry = quantize_carry(v, block)
+        carry = q_ppermute(carry, axis, prm, op=op, record=record,
+                           dense_like=v)
+        return dequantize_carry(carry, shape, dtype)
+
+    @jax.custom_vjp
+    def _send(v):
+        return _hop(v, perm)
+
+    def _fwd(v):
+        return _send(v), None
+
+    def _bwd(_res, ct):
+        return (_hop(ct.astype(dtype), inv_perm).astype(ct.dtype),)
+
+    _send.defvjp(_fwd, _bwd)
+    return _send(x)
 
 
 # ---------------------------------------------------------------------------

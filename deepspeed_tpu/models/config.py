@@ -63,6 +63,14 @@ class ModelConfig:
     moe_q_dispatch: bool = False
     seq_ring_q: bool = False
     comm_quant_block: int = 256
+    # pipeline boundary transport (ds_config "comm_quantization.pipeline"
+    # arms pp_boundary_q at engine init): int8 codes + block scales ride
+    # the stage-boundary rings instead of the dense activation/cotangent
+    pp_boundary_q: bool = False
+    # trace-time boundary byte ledger (runtime/pipe/spmd.py).  The engine
+    # sets this False and commits its analytic per-execution comm plan
+    # instead — the two feeds must stay disjoint (double-count rule)
+    pp_comm_record: bool = True
     # training-time knobs
     sp_mode: str = "auto"                  # "auto" | "ulysses" | "ring" (sp>1)
     pp_microbatches: int = 0               # pipeline microbatches (0 -> pp size)

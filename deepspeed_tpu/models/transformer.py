@@ -458,6 +458,24 @@ class CausalLM:
                             else jnp.ones(labels.shape, jnp.int32))
                 has_mask = loss_mask is not None
 
+                # Uneven global batch: the loss-in-pipeline schedules need
+                # B % M == 0, so pad to the next multiple with rows the CE
+                # mask drops (label -1, mask 0, zero embedding) — exact
+                # loss and gradients, because pad rows contribute zero to
+                # both the nll sum and the token count.
+                M_eff = cfg.pp_microbatches or pp
+                pad_rows = (-x.shape[0]) % M_eff
+                if pad_rows:
+                    x = jnp.concatenate(
+                        [x, jnp.zeros((pad_rows,) + x.shape[1:], x.dtype)])
+                    labels = jnp.concatenate(
+                        [labels, jnp.full((pad_rows,) + labels.shape[1:],
+                                          -1, labels.dtype)])
+                    mask_arg = jnp.concatenate(
+                        [mask_arg,
+                         jnp.zeros((pad_rows,) + mask_arg.shape[1:],
+                                   mask_arg.dtype)])
+
                 def reduce_mb(y_mb, r_xs, consts):
                     # dense CE over one microbatch (small by construction);
                     # blockwise CE's checkpoint+scan trips XLA CHECKs under
@@ -513,7 +531,10 @@ class CausalLM:
                         loss_consts=(params["final_norm"], head_pp) + hb_pp
                         + (cnt,),
                         aux_coef=(cfg.moe_aux_loss_coef if cfg.is_moe
-                                  else 0.0))
+                                  else 0.0),
+                        quantize_boundary=cfg.pp_boundary_q,
+                        quant_block=cfg.comm_quant_block,
+                        comm_record=cfg.pp_comm_record)
 
                 # When the model remats per layer (cfg.remat), the scan's
                 # per-step residuals are already bounded by the tuned layer
@@ -525,7 +546,10 @@ class CausalLM:
                     broadcast_args=(cos, sin), scan_args=keys,
                     reduce_fn=reduce_mb, reduce_xs=(labels, mask_arg),
                     reduce_consts=(params["final_norm"], head_pp) + hb_pp,
-                    remat_stage=not bool(cfg.remat))
+                    remat_stage=not bool(cfg.remat),
+                    quantize_boundary=cfg.pp_boundary_q,
+                    quant_block=cfg.comm_quant_block,
+                    comm_record=cfg.pp_comm_record)
                 loss = red["nll"] / jnp.maximum(red["cnt"], 1.0)
                 return (loss + cfg.moe_aux_loss_coef * aux_loss
                         if cfg.is_moe else loss)
@@ -533,7 +557,10 @@ class CausalLM:
             x, aux_loss = spmd_pipeline(stage_fn, params["layers"], x, mesh,
                                         num_microbatches=cfg.pp_microbatches,
                                         broadcast_args=(cos, sin), scan_args=keys,
-                                        remat_stage=not bool(cfg.remat))
+                                        remat_stage=not bool(cfg.remat),
+                                        quantize_boundary=cfg.pp_boundary_q,
+                                        quant_block=cfg.comm_quant_block,
+                                        comm_record=cfg.pp_comm_record)
         elif cfg.scan_layers:
             x, auxes = jax.lax.scan(scan_body, x, (params["layers"], keys))
             aux_loss = jnp.sum(auxes)

@@ -68,6 +68,14 @@ __all__ = ["CommMetrics", "comm_metrics", "busbw_factor", "KNOWN_OPS",
 # Every op slug the framework records today; ensure_registered() registers
 # the full family so the docs namespace-guard covers series that only
 # materialize on multi-axis meshes.
+#
+# ``ppermute``/``q_ppermute`` carry BOTH ring call sites — the
+# sequence-parallel KV rotation (comm/collectives_q.py seq ring) and the
+# pipeline stage-boundary rings (runtime/pipe/spmd.py: forward activation
+# hops + reverse-ring cotangent hops).  Feed disjointness per the rules
+# above: standalone pipeline callers record trace-time; under the engine
+# the model's ledger is off (``pp_comm_record=False``) and the analytic
+# pipeline plan entries commit per executed micro-batch instead.
 KNOWN_OPS = (
     "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
     "broadcast", "broadcast_object", "barrier",

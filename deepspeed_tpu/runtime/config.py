@@ -277,6 +277,16 @@ class CommQuantizationConfig(DeepSpeedConfigModel):
       ``comm.all_to_all_single(quantized=True)``.
     - ``sequence_ring`` — the sequence-parallel ring attention KV
       rotation (codes rotate; one quantization error total).
+    - ``pipeline`` — the pipeline stage-boundary rings
+      (``runtime/pipe/spmd.py``): every fill/drain ``ppermute`` hop —
+      the forward activation ring AND the backward cotangent reverse
+      ring — moves int8 codes + block scales instead of the dense
+      boundary tensor.  Unlike the sequence ring, each hop carries a
+      FRESH activation, so the error budget is one quantization per
+      hop, not per rotation.  Refuses to arm under fp16 loss scaling:
+      the reverse ring would quantize loss-scaled cotangents, and int8
+      saturation maps inf/nan onto finite codes — silently blinding
+      the fp16 overflow detector that decides skip-vs-apply.
 
     Precedence vs the legacy ZeRO++ flags
     (``zero_optimization.zero_quantized_weights`` / ``_gradients``): the
@@ -301,6 +311,7 @@ class CommQuantizationConfig(DeepSpeedConfigModel):
     reduce_scatter: Optional[bool] = None
     all_to_all: Optional[bool] = None
     sequence_ring: Optional[bool] = None
+    pipeline: Optional[bool] = None
 
     def _site(self, value: Optional[bool]) -> bool:
         return bool(self.enabled) if value is None else bool(value)
@@ -324,6 +335,10 @@ class CommQuantizationConfig(DeepSpeedConfigModel):
     @property
     def q_sequence_ring(self) -> bool:
         return self._site(self.sequence_ring)
+
+    @property
+    def q_pipeline(self) -> bool:
+        return self._site(self.pipeline)
 
 
 class CommsLoggerConfig(DeepSpeedConfigModel):
@@ -703,6 +718,16 @@ class DeepSpeedConfig:
                     f"they are never silently resolved)")
         if cq.block <= 0:
             raise ValueError("comm_quantization.block must be positive")
+        if self.fp16.enabled and cq.q_pipeline:
+            raise ValueError(
+                "comm_quantization.pipeline cannot arm under fp16: the "
+                "backward boundary ring carries loss-SCALED cotangents, and "
+                "int8 saturation maps inf/nan onto finite codes — the fp16 "
+                "overflow detector (skip-vs-apply) would read clean "
+                "gradients through an overflowed boundary.  Use bf16 (no "
+                "loss scaling, overflow-free boundary codes), or keep the "
+                "pipeline boundary dense (comm_quantization.pipeline: "
+                "false) under fp16")
         if self.train_batch_size <= 0:
             raise ValueError("train_batch_size must be positive")
         if self.gradient_clipping < 0:

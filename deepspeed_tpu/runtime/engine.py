@@ -438,9 +438,13 @@ class DeepSpeedEngine:
                 self._overlap_reason = ("ZeRO++ runs its own quantized "
                                         "collective schedule")
             elif bad:
-                self._overlap_reason = (f"model/expert-parallel axes {bad} "
-                                        "are not supported on the overlap "
-                                        "path")
+                self._overlap_reason = (
+                    f"model/expert-parallel axes {bad} are not supported "
+                    "on the overlap path"
+                    + (" (the pipelined program already overlaps its "
+                       "boundary rings with stage compute — XLA schedules "
+                       "the ppermute hops against the scan body)"
+                       if "pp" in bad else ""))
             elif loss_fn is not None:
                 self._overlap_reason = ("a client loss_fn cannot route "
                                         "through the model's layer segments")
@@ -574,6 +578,26 @@ class DeepSpeedEngine:
                 "comm_quantization: model %s exposes no comm-quant hooks "
                 "(moe_q_dispatch/seq_ring_q); the all_to_all/"
                 "sequence_ring sites stay dense", type(model).__name__)
+        # pipeline boundary site (runtime/pipe/spmd.py): same unconditional
+        # assignment rule — and the trace-time boundary ledger is ALWAYS
+        # off under the engine, which commits its analytic per-execution
+        # comm plan instead (_merge_pp_comm_plan; the feed-disjointness
+        # rule)
+        if _mcfg is not None and hasattr(_mcfg, "pp_boundary_q"):
+            _npp = self.mesh.shape.get("pp", 1)
+            _pp_q = bool(cq.q_pipeline and _npp > 1)
+            _mcfg.pp_boundary_q = _pp_q
+            _mcfg.comm_quant_block = cq.block
+            _mcfg.pp_comm_record = False
+            if _pp_q:
+                log_dist("comm_quantization: pipeline boundary rings -> "
+                         "int8 carry codec (fwd activation + bwd cotangent "
+                         f"hops, block {cq.block})", ranks=[0])
+        elif cq.q_pipeline:
+            logger.warning(
+                "comm_quantization.pipeline: model %s exposes no "
+                "pp_boundary_q hook; the pipeline boundary stays dense",
+                type(model).__name__)
         self._client_loss_fn = loss_fn is not None
         self._loss_fn = loss_fn or self._make_loss_fn(model)
         if param_pspecs is None and hasattr(model, "logical_pspecs"):
@@ -583,6 +607,7 @@ class DeepSpeedEngine:
         self._client_param_pspecs = param_pspecs  # tensor-parallel logical specs
         self._micro_count = 0
         self._host_steps = 0
+        self._pp_plan_pending = True   # pipeline comm-plan merge, 1st batch
         self._boundary_override: Optional[bool] = None
         self._last_loss = None
         self._last_grad_norm = None
@@ -810,6 +835,10 @@ class DeepSpeedEngine:
             inert.append(("comm_quantization.sequence_ring",
                           "no sp mesh axis > 1 — there is no ring "
                           "exchange to quantize"))
+        if cq.q_pipeline and self.mesh.shape.get("pp", 1) <= 1:
+            inert.append(("comm_quantization.pipeline",
+                          "no pp mesh axis > 1 — there is no stage "
+                          "boundary ring to quantize"))
         import logging as _logging
 
         for key, why in inert:
@@ -2350,6 +2379,58 @@ class DeepSpeedEngine:
             else:
                 logger.warning("watchdog: trace start failed: %s", exc)
 
+    def _merge_pp_comm_plan(self, batch) -> None:
+        """Analytic pipeline boundary entries, merged into the comm plan's
+        MICRO list lazily at the first batch (the boundary tensor shape
+        needs the batch's sequence length).  One pipelined execution moves
+        ``2*T`` ring hops of one microbatch boundary [mb, S, D] in the
+        compute dtype — T forward-ring activation hops plus T reverse-ring
+        cotangent hops, with T the schedule length in ticks (``M + pp - 1``
+        GPipe, ``M + 2(pp-1)`` 1F1B).  The model's trace-time ledger is off
+        under the engine (``pp_comm_record=False``), so this plan is the
+        only feed — the repo-wide double-count rule."""
+        self._pp_plan_pending = False
+        try:
+            mcfg = getattr(self.module, "config", None)
+            pp = self.mesh.shape.get("pp", 1)
+            if pp <= 1 or mcfg is None \
+                    or not hasattr(mcfg, "pp_boundary_q"):
+                return
+            unpacked = self._unpack_lm_batch(batch)
+            if unpacked is None:
+                return
+            toks = unpacked[0]
+            if getattr(toks, "ndim", 0) < 2:
+                return
+            B, S = int(toks.shape[0]), int(toks.shape[1])
+            M = int(getattr(mcfg, "pp_microbatches", 0) or pp)
+            mb = -(-B // M)                 # padded-batch microbatch rows
+            D = int(getattr(mcfg, "hidden_size", 0) or 0)
+            if not D:
+                return
+            is_1f1b = getattr(mcfg, "pp_schedule", "gpipe") == "1f1b"
+            T = M + (2 * (pp - 1) if is_1f1b else pp - 1)
+            hops = 2 * T
+            numel = mb * S * D
+            c_item = jnp.dtype(self.compute_dtype).itemsize
+            cname = jnp.dtype(self.compute_dtype).name
+            dense = hops * numel * c_item
+            if getattr(mcfg, "pp_boundary_q", False):
+                blk = int(getattr(mcfg, "comm_quant_block", 256) or 256)
+                qbytes = hops * (numel + 4 * (-(-numel // blk)))
+                entry = ("q_ppermute", hops, qbytes, "int8", pp,
+                         (dense, cname))
+            else:
+                entry = ("ppermute", hops, dense, cname, pp)
+            if self._comm_plan is None:
+                self._comm_plan = {"micro": [entry], "boundary": []}
+            else:
+                self._comm_plan["micro"] = (
+                    list(self._comm_plan["micro"]) + [entry])
+        except Exception as exc:
+            logger.warning("telemetry: pipeline comm plan unavailable (%s)",
+                           exc)
+
     def _profile_bytes_per_op(self, steps: int):
         """Payload bytes the analytic comm plan says a ``steps``-step
         window moved, per op slug — feeds the recomputed device busbw."""
@@ -2706,6 +2787,8 @@ class DeepSpeedEngine:
         if not self._training:
             self._rng, rng = jax.random.split(self._rng)
             return self._eval_fn(self.state.params, batch, rng)
+        if self._pp_plan_pending:
+            self._merge_pp_comm_plan(batch)
         if self._trace is not None and self._micro_count == 0:
             self._trace.maybe_start(self._host_steps + 1)
         if self._micro_count == 0:
@@ -3124,6 +3207,9 @@ class DeepSpeedEngine:
                       for i in range(gas)]
             self.step()
             return jnp.mean(jnp.stack(losses))
+        if self._pp_plan_pending:
+            # fused path skips forward(): merge off one micro-slice here
+            self._merge_pp_comm_plan(jax.tree.map(lambda x: x[0], stacked))
         stacked = shard_batch(stacked, self.mesh, stacked=True)
         self._check_overlap_batch(stacked)
         self._rng, rng = jax.random.split(self._rng)

@@ -28,7 +28,8 @@ class PipelineEngine(DeepSpeedEngine):
                               or self.num_stages)
         if self.is_pipe_parallel:
             log_dist(f"pipeline engine: {self.num_stages} stages, "
-                     f"{self.micro_batches} microbatches, bubble "
+                     f"{self.micro_batches} microbatches, "
+                     f"{self.schedule} schedule, bubble "
                      f"{self.bubble_fraction:.1%}", ranks=[0])
 
     # -- schedule introspection -----------------------------------------
@@ -37,14 +38,28 @@ class PipelineEngine(DeepSpeedEngine):
         return axis_size(self.mesh, "pp")
 
     @property
+    def schedule(self) -> str:
+        """Active schedule name: "gpipe" (fill-drain + autodiff) or "1f1b"
+        (fused forward+backward scan)."""
+        mcfg = getattr(self.module, "config", None)
+        return getattr(mcfg, "pp_schedule", "gpipe") or "gpipe"
+
+    @property
     def schedule_steps(self) -> int:
-        """GPipe fill-drain length: M + pp - 1 pipeline ticks per batch."""
-        return self.micro_batches + self.num_stages - 1
+        """Schedule length in pipeline ticks per batch: M + pp - 1 for the
+        GPipe fill-drain, M + 2(pp-1) for the fused 1F1B scan (each tick
+        there carries one forward AND one backward microbatch slot)."""
+        M, pp = self.micro_batches, self.num_stages
+        if self.schedule == "1f1b":
+            return M + 2 * (pp - 1)
+        return M + pp - 1
 
     @property
     def bubble_fraction(self) -> float:
-        """Idle fraction of the schedule — (pp-1)/(M+pp-1), the reference
-        TrainSchedule's cost model."""
+        """Idle fraction of the schedule — (pp-1)/T with T the schedule
+        length: (pp-1)/(M+pp-1) for GPipe (the reference TrainSchedule's
+        cost model) and (pp-1)/(M+2(pp-1)) for 1F1B, where each stage
+        idles pp-1 of its 2T fwd+bwd slots on each wavefront."""
         return (self.num_stages - 1) / max(1, self.schedule_steps)
 
     def stage_id(self) -> int:

@@ -4,17 +4,45 @@ TPU-native replacement for the reference's instruction-VM pipeline
 (``deepspeed/runtime/pipe/engine.py`` + ``schedule.py`` + ``p2p.py``,
 SURVEY.md §2.1, §3.4): instead of a Python scheduler issuing
 ``SendActivation``/``RecvActivation`` P2P ops per rank, the whole schedule is
-one ``lax.scan`` under a ``shard_map`` that is *manual only over the ``pp``
-axis* — stage-to-stage transfers are ``ppermute`` (nearest-neighbor on the ICI
-torus), every other mesh axis (fsdp/tp/sp/ep/dp) stays under GSPMD inside the
-stage body, and autodiff through the scan replaces the 1F1B backward
-instructions (XLA schedules the pipelined backward).
+one ``lax.scan`` under a FULL-manual ``shard_map`` — stage-to-stage transfers
+are explicit ``ppermute`` rings (nearest-neighbor on the ICI torus), the
+backward boundary exchange is the reverse ring, and the 1F1B schedule fuses
+both wavefronts into one scan whose carries ARE the boundary buffers.
+
+**Full-manual, stage id as data.**  Earlier revisions were manual only over
+``pp`` (``axis_names={'pp'}``) and read the stage with ``lax.axis_index`` —
+which lowers to the PartitionId HLO the SPMD partitioner rejects on the CPU
+backend (the 9 tier-1 ``test_pipe`` failures pinned since PR 9, ROADMAP item
+2).  Now the region is manual over EVERY mesh axis and the stage identity is
+*data*: a [pp] iota enters with ``in_specs=P(pp)`` so each stage reads its own
+id from its slice, and all per-stage behavior is branchless selects over that
+id.  No PartitionId, no partial-manual partitioning — the failure class is
+gone, not suppressed.  The trade: in-stage GSPMD sharding (tp/fsdp inside the
+stage body) degrades to replicated compute inside the region
+(``models/layers.py:constrain`` detects manual axes and backs off), which is
+exact but redundant — re-sharding the stage interior is the remaining
+multi-host slice noted in ROADMAP.
 
 Schedule shape = GPipe fill-drain over ``T = M + pp - 1`` steps with M
 microbatches; the bubble fraction is ``(pp-1)/T``, identical to the
 reference's default ``TrainSchedule`` cost.  Stage ``s`` processes microbatch
 ``m`` at step ``t = m + s``; invalid (bubble) steps compute on zeros and are
 masked out of outputs and aux losses, contributing zero gradient.
+
+**Boundary transport.**  Every ring hop goes through :func:`_boundary_send`:
+dense hops are ``lax.ppermute`` under the unconditional ``ds_comm_ppermute``
+named_scope, quantized hops (``quantize_boundary=True`` — the
+``comm_quantization.pipeline`` site) re-use the PR 14 carry codec via
+``q_boundary_ppermute`` (int8 codes + fp32 block scales on the wire, under
+``ds_comm_q_ppermute``; one quantization error per hop since each hop carries
+a fresh activation).  ``comm_record`` gates the trace-time byte ledger only —
+standalone callers (tests, PipelineModule) default to trace-time recording,
+while the engine records through its analytic per-execution comm plan and
+passes ``comm_record=False`` so the two feeds stay disjoint (the repo-wide
+double-count rule).  The fill/drain RING hops are the recorded boundary
+traffic; the final output-replication / scalar-reduce psums are scoped but
+not byte-recorded (the engine's plan carries them analytically where it
+matters).
 """
 
 from __future__ import annotations
@@ -26,7 +54,40 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepspeed_tpu.comm.collectives_q import q_boundary_ppermute
 from deepspeed_tpu.comm.mesh import axis_size
+from deepspeed_tpu.comm.quant import DEFAULT_BLOCK
+from deepspeed_tpu.monitor.comms import comm_metrics
+from deepspeed_tpu.profiling.trace import scope as _scope
+
+
+def _stage_ids(pp: int) -> jnp.ndarray:
+    """Stage identity as DATA: a [pp] iota that enters the manual region
+    with ``in_specs=P(pp)`` so each stage reads its own id from its slice
+    (``sid[0]``).  Replaces ``lax.axis_index``, whose PartitionId lowering
+    the CPU SPMD partitioner rejects (ROADMAP item 2)."""
+    return jnp.arange(pp, dtype=jnp.int32)
+
+
+def _boundary_send(x, axis: str, perm, *, quantized: bool, block: int,
+                   record: bool):
+    """One stage-boundary ring hop (dense or int8), always under its
+    unconditional ``ds_comm_*`` scope (DSL005)."""
+    if quantized:
+        return q_boundary_ppermute(x, axis, perm, block=block, record=record)
+    if record:
+        comm_metrics.record("ppermute", axis, x)
+    with _scope("ds_comm_ppermute"):
+        return jax.lax.ppermute(x, axis, perm)
+
+
+def _uneven_msg(B: int, M: int, path: str) -> str:
+    return (
+        f"batch {B} not divisible by num_microbatches={M}: the {path} path "
+        "folds microbatches into scalars and cannot tell padding from data "
+        "— pad the batch to a multiple of M with rows your loss masks out "
+        "(models/transformer.py pads with label=-1 / mask=0 rows), or pick "
+        "a microbatch count that divides the batch")
 
 
 def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
@@ -35,7 +96,10 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
                   axis: str = "pp", reduce_fn: Optional[Callable] = None,
                   reduce_xs: Any = None, reduce_consts: Any = (),
                   remat_stage: bool = True,
-                  boundary_fp32: Optional[bool] = None):
+                  boundary_fp32: Optional[bool] = None,
+                  quantize_boundary: bool = False,
+                  quant_block: int = DEFAULT_BLOCK,
+                  comm_record: bool = True):
     """Run a stacked-layer function pipelined over the ``pp`` mesh axis.
 
     - ``stage_fn(local_layer_params, x_mb, local_scan_args, *broadcast_args)
@@ -44,6 +108,10 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
     - ``layer_params``: pytree with leading stacked layer dim [L, ...] on
       every leaf; sliced into [L/pp, ...] per stage.
     - ``x``: [B, ...] global batch; split into M microbatches along dim 0.
+      When B is not divisible by M, the **output path** zero-pads the batch
+      to the next multiple internally and slices the result back to [B]
+      (pad rows carry zero cotangent); the scalar-reduce paths cannot do
+      this blindly and raise with padding guidance instead.
     - ``scan_args``: optional pytree with leading [L] dim sliced like params
       (e.g. per-layer dropout keys).
     - ``broadcast_args``: replicated extras (e.g. RoPE cos/sin tables).
@@ -54,10 +122,11 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
     each finished microbatch through ``reduce_fn(y_mb, reduce_xs_mb,
     reduce_consts) -> pytree of scalars`` (e.g. CE loss sums) and only the
     summed scalars are returned — the O(global-batch) replicated output
-    buffer disappears (VERDICT r2 weak #5).  Non-last stages skip the reduce
-    via ``lax.cond``.  ``reduce_consts`` carries replicated weights the
-    reduce needs (final norm, lm head) — traced values must enter the
-    manual region as arguments, never as closures.
+    buffer disappears (VERDICT r2 weak #5).  The reduce runs branchless on
+    every stage and non-last contributions are masked to zero.
+    ``reduce_consts`` carries replicated weights the reduce needs (final
+    norm, lm head) — traced values must enter the manual region as
+    arguments, never as closures.
     Returns (reduced_scalars, aux_sum) in this mode.
 
     **Memory** (``remat_stage``, default on): the scan over ``T = M + pp - 1``
@@ -72,12 +141,21 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
     ``remat_stage=False`` — an outer save-nothing wrap would override the
     tuned policy and recompute the full stage anyway.
 
-    **Boundary dtype** (``boundary_fp32``, default auto): bf16 psum/ppermute
-    across the partial-manual boundary trips an XLA **CPU** check ("invalid
-    binary instruction opcode copy", jax 0.9 / 2026-07), so the CPU backend
-    crosses in fp32.  On TPU the boundary stays in the compute dtype — fp32
-    would double stage-to-stage ICI bytes for a bf16 model (VERDICT r3 weak
-    #2).
+    **Boundary dtype** (``boundary_fp32``, default auto): tensors crossing
+    the shard_map entry/exit in bf16 trip an XLA **CPU** backend check
+    ("invalid binary instruction opcode copy", jax 0.9 / 2026-07), so the
+    CPU backend crosses in fp32.  On TPU the boundary stays in the compute
+    dtype — fp32 would double stage-to-stage ICI bytes for a bf16 model
+    (VERDICT r3 weak #2).  The in-region ring hops always run the compute
+    dtype.
+
+    **Quantized boundary** (``quantize_boundary`` — the
+    ``comm_quantization.pipeline`` site): ring hops ship int8 codes + fp32
+    block scales (``quant_block``-element blocks) instead of the dense
+    activation, forward AND backward (the codec's custom VJP sends the
+    cotangent through the reverse ring the same way).  Each hop carries a
+    fresh activation so each hop pays one quantization error — loss parity
+    holds to quantization tolerance, not bit-exactly.
     """
     if boundary_fp32 is None:
         # Key off the MESH's devices, not jax.default_backend(): the crash
@@ -90,7 +168,8 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
         if reduce_fn is not None:
             B = x.shape[0]
             M = num_microbatches or 1
-            assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+            if B % M:
+                raise ValueError(_uneven_msg(B, M, "scalar-reduce"))
             mb = B // M
             red = None
             for m in range(M):
@@ -103,8 +182,14 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
         return y, aux
     B = x.shape[0]
     M = num_microbatches or pp
-    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
-    mb = B // M
+    pad = (-B) % M
+    if pad and reduce_fn is not None:
+        raise ValueError(_uneven_msg(B, M, "scalar-reduce"))
+    if pad:
+        # uneven last microbatch: zero-pad to a full grid, slice back below
+        x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+    Bp = B + pad
+    mb = Bp // M
     T = M + pp - 1
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
@@ -115,7 +200,7 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
                    if (reduce_fn is not None and remat_stage) else reduce_fn)
 
     # Replicated (P()) boundary tensors cross in fp32 on the CPU backend
-    # only (see docstring); TPU keeps the compute dtype on ICI.
+    # only (see docstring); TPU keeps the compute dtype.
     x_dtype = x.dtype
     b_dtypes = tuple(jnp.asarray(a).dtype for a in broadcast_args)
     n_b = len(broadcast_args)
@@ -131,13 +216,15 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
                 lambda a: jax.ShapeDtypeStruct(jnp.asarray(a).shape,
                                                jnp.asarray(a).dtype),
                 reduce_consts))
+    rc_dtypes = (jax.tree.map(lambda a: jnp.asarray(a).dtype, reduce_consts)
+                 if with_reduce else jnp.float32)
 
     @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(P(axis), P(), P(axis)) + (P(),) * n_b
-                       + (P(), P()),
+                       in_specs=(P(axis), P(), P(axis), P(axis))
+                       + (P(),) * n_b + (P(), P()),
                        out_specs=(P(), P()),
-                       axis_names={axis}, check_vma=False)
-    def _pipelined(wl, xg32, sl, *bc32_and_red):
+                       check_vma=False)
+    def _pipelined(wl, xg32, sl, sid, *bc32_and_red):
         bc32 = bc32_and_red[:n_b]
         red_xs = bc32_and_red[n_b]
         # replicated consts cross in fp32 (their cotangent psum in bf16
@@ -147,7 +234,9 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
             lambda a, dt: a.astype(dt), bc32_and_red[n_b + 1], rc_dtypes)
         xg = xg32.astype(x_dtype)
         broadcast_args = tuple(a.astype(dt) for a, dt in zip(bc32, b_dtypes))
-        stage = jax.lax.axis_index(axis)
+        stage = sid[0]
+        is_first = stage == 0
+        is_last = stage == pp - 1
         xmb = xg.reshape((M, mb) + xg.shape[1:])
         if with_reduce:
             red_mb = jax.tree.map(
@@ -157,61 +246,79 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
             buf, outs, red_acc, aux_acc = carry
             m_idx = t - stage
             valid = (m_idx >= 0) & (m_idx < M)
-            inp = jnp.where(stage == 0, xmb[jnp.clip(t, 0, M - 1)], buf)
+            inp = jnp.where(is_first, xmb[jnp.clip(t, 0, M - 1)], buf)
             out, aux = stage_call(wl, inp, sl, *broadcast_args)
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             o_idx = t - (pp - 1)
-            is_out = (stage == pp - 1) & (o_idx >= 0)
+            is_out = is_last & (o_idx >= 0)
             if with_reduce:
                 # last stage folds the finished microbatch into scalars; the
-                # reduce runs SPMD on every stage (lax.cond branches disagree
-                # on internal sharding under partial-manual meshes) and
-                # non-last contributions are masked to zero
+                # reduce runs branchless on every stage and non-last
+                # contributions are masked to zero
                 r_xs = jax.tree.map(lambda a: a[jnp.clip(o_idx, 0, M - 1)],
                                     red_mb)
                 r = reduce_call(out, r_xs, red_consts)
                 red_acc = jax.tree.map(
                     lambda a, v: a + jnp.where(is_out,
-                                               v.astype(jnp.float32), 0.0),
+                                               v.astype(jnp.float32),
+                                               0.0).reshape(a.shape),
                     red_acc, r)
             else:
-                outs = jax.lax.cond(
-                    is_out, lambda o: o.at[jnp.maximum(o_idx, 0)].set(out),
-                    lambda o: o, outs)
-            buf = jax.lax.ppermute(out, axis, perm)
+                # branchless slot write: read the current row, select, write
+                # back (a lax.cond here would copy the whole buffer per
+                # branch)
+                o_clip = jnp.clip(o_idx, 0, M - 1)
+                cur = jax.lax.dynamic_slice(
+                    outs, (o_clip,) + (0,) * out.ndim, (1,) + out.shape)[0]
+                outs = jax.lax.dynamic_update_slice(
+                    outs, jnp.where(is_out, out, cur)[None],
+                    (o_clip,) + (0,) * out.ndim)
+            buf = _boundary_send(out, axis, perm,
+                                 quantized=quantize_boundary,
+                                 block=quant_block, record=comm_record)
             return (buf, outs, red_acc, aux_acc), None
 
         buf0 = jnp.zeros((mb,) + xg.shape[1:], xg.dtype)
         outs0 = (jnp.zeros((0,), xg.dtype) if with_reduce
                  else jnp.zeros((M, mb) + xg.shape[1:], xg.dtype))
-        red0 = (jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
-                             red_shapes) if with_reduce else jnp.zeros((0,)))
+        # Scalar scan carries become rank-0 residuals that this jax's
+        # shard_map TRANSPOSE rule mishandles (_SpecError: names={0: ...} on a
+        # rank-0 aval) — carry every scalar as shape (1,) and squeeze outside
+        # the manual region.
+        red0 = (jax.tree.map(
+            lambda s: jnp.zeros(s.shape if s.ndim else (1,), jnp.float32),
+            red_shapes) if with_reduce else jnp.zeros((0,)))
         (b, outs, red, aux), _ = jax.lax.scan(
-            step, (buf0, outs0, red0, jnp.zeros((), jnp.float32)),
+            step, (buf0, outs0, red0, jnp.zeros((1,), jnp.float32)),
             jnp.arange(T))
         # Mean over microbatches so aux losses match the unpipelined full-batch
         # value (each stage contributes only its own layers; the psum over pp
         # is the sum over layers, not a duplication).
-        aux = jax.lax.psum(aux, axis) / M
+        with _scope("ds_comm_psum"):
+            aux = jax.lax.psum(aux, axis) / M
         if with_reduce:
             # only scalars cross stages — O(1) instead of O(global batch)
-            red = jax.tree.map(lambda v: jax.lax.psum(v, axis), red)
+            with _scope("ds_comm_psum"):
+                red = jax.tree.map(lambda v: jax.lax.psum(v, axis), red)
             return red, aux
-        # Replicate the last stage's outputs / summed aux across pp.  Exact
-        # in any dtype (one nonzero contribution per position); fp32 only
-        # where the CPU-backend bug demands it (see docstring).
+        # Replicate the last stage's outputs across pp.  Exact in any dtype
+        # (one nonzero contribution per position); fp32 only where the
+        # CPU-backend bug demands it (see docstring).
         if boundary_fp32:
+            with _scope("ds_comm_psum"):
+                outs = jax.lax.psum(
+                    jnp.where(is_last, outs.astype(jnp.float32), 0.0), axis)
+            return outs.astype(xg.dtype).reshape((Bp,) + xg.shape[1:]), aux
+        with _scope("ds_comm_psum"):
             outs = jax.lax.psum(
-                jnp.where(stage == pp - 1, outs.astype(jnp.float32), 0.0), axis)
-            return outs.astype(xg.dtype).reshape(xg.shape), aux
-        outs = jax.lax.psum(
-            jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), axis)
-        return outs.reshape(xg.shape), aux
+                jnp.where(is_last, outs, jnp.zeros_like(outs)), axis)
+        return outs.reshape((Bp,) + xg.shape[1:]), aux
 
     if scan_args is None:
         # shard_map needs a concrete argument; a [L]-length dummy slices fine
         leaves = jax.tree.leaves(layer_params)
         scan_args = jnp.zeros((leaves[0].shape[0],), jnp.uint32)
+
     def boundary_cast(a):
         a = jnp.asarray(a)
         if not boundary_fp32:
@@ -222,15 +329,20 @@ def spmd_pipeline(stage_fn: Callable, layer_params: Any, x: jnp.ndarray,
                else jnp.zeros((0,)))
     const_arg = (jax.tree.map(lambda a: boundary_cast(a), reduce_consts)
                  if with_reduce else jnp.zeros((0,)))
-    rc_dtypes = (jax.tree.map(lambda a: jnp.asarray(a).dtype, reduce_consts)
-                 if with_reduce else jnp.float32)
-    return _pipelined(layer_params, boundary_cast(x), scan_args,
-                      *(boundary_cast(a) for a in broadcast_args),
-                      red_arg, const_arg)
+    y, aux = _pipelined(layer_params, boundary_cast(x), scan_args,
+                        _stage_ids(pp),
+                        *(boundary_cast(a) for a in broadcast_args),
+                        red_arg, const_arg)
+    aux = aux[0]  # undo the (1,) scalar-carry promotion (see _pipelined)
+    if with_reduce:
+        y = jax.tree.map(lambda v, s: v.reshape(s.shape), y, red_shapes)
+    if not with_reduce and pad:
+        y = y[:B]
+    return y, aux
 
 
 # ---------------------------------------------------------------------------
-# 1F1B-equivalent fused schedule
+# 1F1B fused schedule
 # ---------------------------------------------------------------------------
 
 def spmd_pipeline_1f1b(stage_fn: Callable, loss_mb_fn: Callable,
@@ -239,11 +351,19 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_mb_fn: Callable,
                        scan_args: Any = None, axis: str = "pp",
                        loss_xs: Any = None, loss_consts: Any = (),
                        aux_coef: float = 0.0,
-                       boundary_fp32: Optional[bool] = None):
-    """1F1B-equivalent pipeline: ONE scan interleaves each step's forward
-    microbatch with the backward of the microbatch whose cotangent just
-    arrived, exactly the reference ``TrainSchedule``'s steady state
-    (``(R) runtime/pipe/schedule.py``), expressed SPMD.
+                       boundary_fp32: Optional[bool] = None,
+                       quantize_boundary: bool = False,
+                       quant_block: int = DEFAULT_BLOCK,
+                       comm_record: bool = True):
+    """1F1B pipeline: ONE scan interleaves each step's forward microbatch
+    with the backward of the microbatch whose cotangent just arrived,
+    exactly the reference ``TrainSchedule``'s steady state
+    (``(R) runtime/pipe/schedule.py``), expressed SPMD.  The scan's carries
+    ARE the two boundary buffers: the forward activation hop rides the
+    forward ring (``(i, i+1)`` ppermute) and the backward cotangent hop
+    rides the reverse ring (``(i, i-1)``), both through
+    :func:`_boundary_send` (dense scoped ppermute, or the int8 carry codec
+    when ``quantize_boundary`` — the ``comm_quantization.pipeline`` site).
 
     Contract differences from :func:`spmd_pipeline`:
 
@@ -278,12 +398,14 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_mb_fn: Callable,
     pp = axis_size(mesh, axis)
     B = x.shape[0]
     M = num_microbatches or pp
-    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    if B % M:
+        raise ValueError(_uneven_msg(B, M, "fused 1F1B loss"))
     if scan_args is None:
         leaves = jax.tree.leaves(layer_params)
         scan_args = jnp.zeros((leaves[0].shape[0],), jnp.uint32)
     static = _P1F1BStatic(stage_fn, loss_mb_fn, mesh, M, axis, float(aux_coef),
-                          bool(boundary_fp32))
+                          bool(boundary_fp32), bool(quantize_boundary),
+                          int(quant_block), bool(comm_record))
     return _p1f1b(static, layer_params, jnp.asarray(x),
                   jax.tree.map(jnp.asarray, scan_args),
                   tuple(jnp.asarray(a) for a in broadcast_args),
@@ -295,7 +417,8 @@ class _P1F1BStatic:
     """Hashable static bundle for the custom_vjp nondiff arg."""
 
     def __init__(self, stage_fn, loss_mb_fn, mesh, M, axis, aux_coef,
-                 boundary_fp32):
+                 boundary_fp32, quantize_boundary=False,
+                 quant_block=DEFAULT_BLOCK, comm_record=True):
         self.stage_fn = stage_fn
         self.loss_mb_fn = loss_mb_fn
         self.mesh = mesh
@@ -303,8 +426,12 @@ class _P1F1BStatic:
         self.axis = axis
         self.aux_coef = aux_coef
         self.boundary_fp32 = boundary_fp32
+        self.quantize_boundary = quantize_boundary
+        self.quant_block = quant_block
+        self.comm_record = comm_record
         self._key = (stage_fn, loss_mb_fn, mesh, M, axis, aux_coef,
-                     boundary_fp32)
+                     boundary_fp32, quantize_boundary, quant_block,
+                     comm_record)
 
     def __hash__(self):
         return hash(self._key)
@@ -341,6 +468,10 @@ def _p1f1b_run(static, layer_params, x, scan_args, broadcast_args, loss_xs,
     n_b = len(broadcast_args)
     lc_dtypes = jax.tree.map(lambda a: a.dtype, loss_consts)
     bf32 = static.boundary_fp32
+    send = functools.partial(_boundary_send,
+                             quantized=static.quantize_boundary,
+                             block=static.quant_block,
+                             record=static.comm_record)
 
     def boundary_cast(a):
         if not bf32:
@@ -349,18 +480,18 @@ def _p1f1b_run(static, layer_params, x, scan_args, broadcast_args, loss_xs,
                 if jnp.issubdtype(a.dtype, jnp.floating) else a)
 
     @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(P(axis), P(), P(axis)) + (P(),) * n_b
-                       + (P(), P()),
+                       in_specs=(P(axis), P(), P(axis), P(axis))
+                       + (P(),) * n_b + (P(), P()),
                        out_specs=(P(), P(axis), P(), P()),
-                       axis_names={axis}, check_vma=False)
-    def _fused(wl, xg32, sl, *bc_and_loss):
+                       check_vma=False)
+    def _fused(wl, xg32, sl, sid, *bc_and_loss):
         bc = tuple(a.astype(dt) for a, dt
                    in zip(bc_and_loss[:n_b], b_dtypes))
         l_xs = bc_and_loss[n_b]
         l_consts = jax.tree.map(lambda a, dt: a.astype(dt),
                                 bc_and_loss[n_b + 1], lc_dtypes)
         xg = xg32.astype(x_dtype)
-        stage = jax.lax.axis_index(axis)
+        stage = sid[0]
         is_last = stage == pp - 1
         is_first = stage == 0
         xmb = xg.reshape((M, mb) + xg.shape[1:])
@@ -410,9 +541,10 @@ def _p1f1b_run(static, layer_params, x, scan_args, broadcast_args, loss_xs,
             gx = jax.lax.dynamic_update_slice(
                 gx, dinp[None].astype(jnp.float32),
                 (jnp.clip(m_b, 0, M - 1),) + (0,) * dinp.ndim)
-            # ---- sends ---------------------------------------------------
-            fbuf = jax.lax.ppermute(out, axis, fwd_perm)
-            bbuf = jax.lax.ppermute(dinp, axis, bwd_perm)
+            # ---- boundary rings: forward ring for the activation, the
+            # reverse ring for the cotangent ------------------------------
+            fbuf = send(out, axis, fwd_perm)
+            bbuf = send(dinp, axis, bwd_perm)
             return (fbuf, bbuf, circ, gw, gx, gc, loss_acc), None
 
         carry0 = (
@@ -425,15 +557,17 @@ def _p1f1b_run(static, layer_params, x, scan_args, broadcast_args, loss_xs,
             jnp.zeros((), jnp.float32))
         (fb, bb, circ, gw, gx, gc, loss), _ = jax.lax.scan(
             step, carry0, jnp.arange(T2))
-        loss = jax.lax.psum(loss, axis)
-        gx = jax.lax.psum(jnp.where(is_first, gx, jnp.zeros_like(gx)), axis)
-        gc = jax.tree.map(
-            lambda a: jax.lax.psum(jnp.where(is_last, a, jnp.zeros_like(a)),
-                                   axis), gc)
+        with _scope("ds_comm_psum"):
+            loss = jax.lax.psum(loss, axis)
+            gx = jax.lax.psum(jnp.where(is_first, gx, jnp.zeros_like(gx)),
+                              axis)
+            gc = jax.tree.map(
+                lambda a: jax.lax.psum(
+                    jnp.where(is_last, a, jnp.zeros_like(a)), axis), gc)
         return loss, gw, gx.reshape((B,) + xg.shape[1:]), gc
 
     loss, gw, gx, gc = _fused(
-        layer_params, boundary_cast(x), scan_args,
+        layer_params, boundary_cast(x), scan_args, _stage_ids(pp),
         *(boundary_cast(a) for a in broadcast_args),
         jax.tree.map(jnp.asarray, loss_xs),
         jax.tree.map(boundary_cast, loss_consts))

@@ -1,0 +1,21 @@
+"""Seeded DSL005 violations for the PIPELINE boundary form (lives under
+a ``runtime/pipe/`` path on purpose — ISSUE 16 extended the rule to the
+schedules that dispatch their stage-boundary rings directly): a bare
+boundary ``ppermute`` with no ``ds_comm_`` scope, and a ring hop whose
+scope hides inside a telemetry conditional.  Parsed by the analyzer
+only — never imported or executed."""
+
+from jax import lax
+
+from deepspeed_tpu.profiling.trace import scope as _scope
+
+
+def boundary_send(x, axis, perm):
+    return lax.ppermute(x, axis, perm)           # <- DSL005 (no scope)
+
+
+def boundary_send_recorded(x, axis, perm, comm_metrics):
+    if comm_metrics.enabled:
+        with _scope("ds_comm_ppermute"):         # <- DSL005 (conditional)
+            return lax.ppermute(x, axis, perm)
+    return lax.ppermute(x, axis, perm)

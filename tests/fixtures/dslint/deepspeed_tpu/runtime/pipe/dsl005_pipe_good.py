@@ -1,0 +1,15 @@
+"""Clean twin of dsl005_pipe_bad.py: the pipeline boundary idiom the
+rule enforces — the byte RECORD may be conditional, the ring hop and
+its ``ds_comm_ppermute`` scope are not (compiled-program stability:
+toggling telemetry never changes the traced program)."""
+
+from jax import lax
+
+from deepspeed_tpu.profiling.trace import scope as _scope
+
+
+def boundary_send(x, axis, perm, comm_metrics):
+    if comm_metrics.enabled:
+        comm_metrics.record("ppermute", axis, x)
+    with _scope("ds_comm_ppermute"):
+        return lax.ppermute(x, axis, perm)

@@ -75,6 +75,8 @@ def test_selftest_wired():
     ("dsl002_bad.py", "DSL002", 3),           # disabled branch + 2 syncs
     ("dsl004_bad.py", "DSL004", 1),           # non-ds_ literal
     ("deepspeed_tpu/comm/dsl005_bad.py", "DSL005", 2),  # no scope + cond
+    # pipeline boundary form: bare ring hop + scope under a telemetry if
+    ("deepspeed_tpu/runtime/pipe/dsl005_pipe_bad.py", "DSL005", 2),
     ("dsl006_bad.py", "DSL006", 3),           # nested / torn / unlocked
 ])
 def test_rule_fires_on_seeded_fixture(fixture, rule, min_hits):
@@ -98,6 +100,15 @@ def test_dsl003_fires_on_seeded_tree():
 
 def test_clean_fixture_zero_findings():
     findings = _lint([os.path.join(_FIXTURES, "clean.py")], root=_FIXTURES)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_dsl005_pipe_good_twin_clean():
+    """The pipeline boundary idiom (conditional RECORD, unconditional
+    hop + scope) passes the extended runtime/pipe/ rule scope."""
+    findings = _lint([os.path.join(
+        _FIXTURES, "deepspeed_tpu/runtime/pipe/dsl005_pipe_good.py")],
+        root=_FIXTURES)
     assert findings == [], [f.render() for f in findings]
 
 

@@ -379,7 +379,21 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
                                          "int8": {"loss": 6.13}},
                           "gather_rs": {"speedup": 0.9,
                                         "dense": {"loss": 6.13},
-                                        "int8": {"loss": 6.13}}}}}}
+                                        "int8": {"loss": 6.13}}}},
+                  "pipe": {
+                      "status": "ok",
+                      "compression": {"pp2": 3.94, "pp4": 3.94},
+                      "loss_parity": {"pp2": True, "pp4": True},
+                      "bubble_share": {"pp2": 0.1667, "pp4": 0.3},
+                      "rungs": {
+                          "pp2": {"speedup": 1.0,
+                                  "dense": {"loss": 6.14,
+                                            "boundary_bytes": 6291456},
+                                  "int8": {"loss": 6.14,
+                                           "boundary_bytes": 1597440}},
+                          "pp4": {"speedup": 1.15,
+                                  "dense": {"loss": 6.12},
+                                  "int8": {"loss": 6.12}}}}}}
     lines = bench.summary_lines(record, None)
     parsed = json.loads(lines[-1])
     st = parsed["streamed_offload"]
@@ -410,6 +424,12 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
     assert qc["compression"]["q_all_gather"] == 3.94
     assert qc["loss_parity"] == {"all_reduce": True, "gather_rs": True}
     assert qc["speedup"] == {"all_reduce": 0.82, "gather_rs": 0.9}
+    # the ISSUE 16 pipeline boundary ablation row rides BENCH_JSON
+    pi = parsed["pipe"]
+    assert pi["compression"] == {"pp2": 3.94, "pp4": 3.94}
+    assert pi["loss_parity"] == {"pp2": True, "pp4": True}
+    assert pi["bubble_share"] == {"pp2": 0.1667, "pp4": 0.3}
+    assert pi["speedup"] == {"pp2": 1.0, "pp4": 1.15}
     # bulky capture payloads never reach the final line
     assert "device_profile" not in json.dumps(parsed)
     assert lines[-2] == "BENCH_JSON: " + lines[-1]

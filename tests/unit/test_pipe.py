@@ -212,9 +212,16 @@ def test_1f1b_bounds_inflight_boundaries(devices, rng):
         return ma.temp_size_in_bytes
 
     gpipe, f1b = temp_bytes("gpipe"), temp_bytes("1f1b")
-    # the boundary stash shrinks (M+pp-1)=19 -> (2pp-1)=7 slots; overall
-    # temp memory must drop measurably (other pools are shared)
-    assert f1b < 0.8 * gpipe, (f1b, gpipe)
+    # the live boundary stash shrinks from the GPipe scan's (M+pp-1)=35
+    # saved steps to the 1F1B circular buffer's (2pp-1)=7 slots.  Assert
+    # the temp-pool DELTA accounts for most of that slot-count shrink (the
+    # x/gx/grad pools are shared between the two programs and dominate the
+    # absolute numbers, so a ratio would mostly measure the model, not the
+    # schedule).
+    slot = 1 * S * 512 * 4  # one boundary microbatch [mb=1, S, D] fp32
+    shrink = ((M + 4 - 1) - (2 * 4 - 1)) * slot
+    assert f1b < gpipe, (f1b, gpipe)
+    assert gpipe - f1b > 0.7 * shrink, (f1b, gpipe, shrink)
 
 
 def _walk_eqns(jaxpr, acc):
@@ -260,7 +267,9 @@ def test_pp_boundary_crosses_in_bf16(devices, rng):
     for e in comm:
         for v in e.invars:
             aval = v.aval
-            if getattr(aval, "shape", ()) != ():  # scalars (aux) may be fp32
+            # scalar carries (aux/loss accumulators, promoted to (1,) to
+            # keep scan residuals rank>=1) may be fp32
+            if getattr(aval, "size", 1) > 1:
                 assert aval.dtype == jnp.bfloat16, (
                     f"{e.primitive.name} carries {aval.dtype}{aval.shape}")
 
@@ -327,3 +336,137 @@ def test_pp_loss_matches_no_pp(devices, rng):
     model1 = causal_lm("llama-tiny", mesh=mesh1, **kw)
     loss1 = jax.jit(lambda p: model1.apply(p, toks, labels=toks))(params)
     np.testing.assert_allclose(float(loss_pp), float(loss1), rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# PartitionId-class retirement (ISSUE 16): loss/grad parity matrix across
+# pp degrees x microbatch counts, INCLUDING an uneven last microbatch (the
+# transformer pads the batch to a multiple of M with label=-1 / mask=0 rows)
+# ---------------------------------------------------------------------------
+
+def _tiny_lm_kw():
+    return dict(num_layers=4, hidden_size=64, intermediate_size=128,
+                num_heads=4, num_kv_heads=2, vocab_size=256, remat=False,
+                ce_chunk=0)
+
+
+@pytest.mark.parametrize("pp,fsdp,M,B,schedule", [
+    (2, 4, 2, 8, "gpipe"),    # even split
+    (2, 4, 3, 8, "gpipe"),    # uneven: 8 % 3 -> pad to 9, mb=3
+    (4, 2, 4, 8, "gpipe"),    # even, deeper pipeline
+    (4, 2, 5, 8, "gpipe"),    # uneven: 8 % 5 -> pad to 10, mb=2
+    (2, 4, 3, 8, "1f1b"),     # uneven through the fused fwd+bwd scan
+    (4, 2, 4, 8, "1f1b"),     # even through the fused scan, pp=4
+])
+def test_pp_loss_grad_parity_matrix(devices, pp, fsdp, M, B, schedule):
+    """Full-manual pipelined loss AND parameter grads match the
+    unpipelined fsdp=8 reference on the same params — across pipeline
+    depths, microbatch counts (uneven last microbatch included) and both
+    schedules.  This is the real retirement of the 9 PartitionId tier-1
+    failures: the programs now compile AND are numerically right."""
+    from deepspeed_tpu.models import causal_lm
+
+    toks = jax.random.randint(jax.random.PRNGKey(11), (B, 32), 0, 256)
+    kw = _tiny_lm_kw()
+    mesh_pp = build_mesh(pp=pp, fsdp=fsdp, devices=devices)
+    set_global_mesh(mesh_pp)
+    model_pp = causal_lm("llama-tiny", mesh=mesh_pp, pp_microbatches=M,
+                         pp_schedule=schedule, **kw)
+    params = model_pp.init(jax.random.PRNGKey(3), toks)
+
+    def loss_pp(p):
+        return model_pp.apply(p, toks, labels=toks)
+
+    lp, gp = jax.jit(jax.value_and_grad(loss_pp))(params)
+
+    mesh1 = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh1)
+    model1 = causal_lm("llama-tiny", mesh=mesh1, **kw)
+
+    def loss1(p):
+        return model1.apply(p, toks, labels=toks)
+
+    l1, g1 = jax.jit(jax.value_and_grad(loss1))(params)
+    np.testing.assert_allclose(float(lp), float(l1), rtol=3e-5)
+    flat_p, _ = jax.tree.flatten(gp)
+    flat_1, _ = jax.tree.flatten(g1)
+    for a, b in zip(flat_p, flat_1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-6)
+
+
+# ---------------------------------------------------------------------------
+# quantized stage boundary: parity + the double byte ledger on one trace
+# ---------------------------------------------------------------------------
+
+def test_pp_quantized_boundary_parity_and_ledger(devices, rng):
+    """int8 boundary rings track the dense pipeline closely (one blockwise
+    quantization error per hop) and the trace-time double ledger pins the
+    wire reduction: q_ppermute moves >=2x fewer bytes than its dense twin
+    (int8 codes + fp32 block scales vs the fp32 activation)."""
+    from deepspeed_tpu.monitor.comms import CommMetrics
+    from deepspeed_tpu.monitor.metrics import MetricsRegistry
+    import deepspeed_tpu.comm.collectives_q as cq_mod
+
+    mesh = build_mesh(fsdp=2, pp=4, devices=devices)
+    set_global_mesh(mesh)
+    L, D, B, M = 8, 256, 8, 4
+    # 0.15 keeps the tanh stack roughly norm-preserving; at 0.3 each
+    # matmul amplifies the per-hop quantization error ~0.3*sqrt(D) ~ 4.8x
+    # and the test would measure the toy network's conditioning, not the
+    # boundary codec
+    w = jax.random.normal(rng, (L, D, D)) * 0.15
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+
+    def stage_fn(wl, xmb, _scan, *bcast):
+        def body(c, wi):
+            return jnp.tanh(c @ wi), None
+        y, _ = jax.lax.scan(body, xmb, wl)
+        return y, jnp.zeros((), jnp.float32)
+
+    def run(w, x, quant):
+        return spmd_pipeline(stage_fn, w, x, mesh, num_microbatches=M,
+                             quantize_boundary=quant)[0]
+
+    # per-element error is amplified by the downstream tanh(c @ w) layers
+    # (~0.3*sqrt(D) per matmul), so the parity contract is LOSS parity —
+    # what the bench rung pins — not elementwise activation identity
+    y_d = jax.jit(lambda w, x: run(w, x, False))(x=x, w=w)
+    y_q = jax.jit(lambda w, x: run(w, x, True))(x=x, w=w)
+    diff = np.asarray(y_q) - np.asarray(y_d)
+    assert 0 < float(np.abs(diff).max()) < 0.5   # perturbed, not broken
+    ld = float(np.mean(np.asarray(y_d) ** 2))
+    lq = float(np.mean(np.asarray(y_q) ** 2))
+    assert abs(lq - ld) < 0.02 * abs(ld), (lq, ld)
+
+    # grads flow through the quantized reverse ring and stay close in L2
+    gd = np.asarray(jax.jit(jax.grad(
+        lambda w: jnp.mean(run(w, x, False) ** 2)))(w))
+    gq = np.asarray(jax.jit(jax.grad(
+        lambda w: jnp.mean(run(w, x, True) ** 2)))(w))
+    rel = np.linalg.norm(gq - gd) / np.linalg.norm(gd)
+    assert rel < 0.05, rel
+
+    # double ledger: wire vs dense-twin bytes off ONE trace
+    reg = MetricsRegistry().enable()
+    cm = CommMetrics(registry=reg)
+    cm.configure(enabled=True)
+    orig = cq_mod.comm_metrics
+    cq_mod.comm_metrics = cm
+    try:
+        jax.eval_shape(lambda w, x: run(w, x, True), w, x)
+    finally:
+        cq_mod.comm_metrics = orig
+    import json as _json
+    metrics = _json.loads(reg.statz_json())["metrics"]
+
+    def fam(name):
+        v = metrics.get(name, 0)
+        if isinstance(v, dict):
+            return sum(x for x in v.values() if isinstance(x, (int, float)))
+        return v or 0
+
+    wire = fam("ds_comm_q_ppermute_bytes_total")
+    dense = fam("ds_comm_q_ppermute_dense_bytes_total")
+    assert dense > 0 and wire > 0
+    assert dense >= 2 * wire, (wire, dense)

@@ -586,3 +586,103 @@ def test_streamed_offload_int8_embed_head_loss_parity(devices):
     q = run(mk(True))
     np.testing.assert_allclose(q, dense, rtol=5e-2)
     assert np.isfinite(q).all()
+
+
+# ---------------------------------------------------------------------------
+# pipeline boundary site (ISSUE 16): tri-state config, fp16 refusal,
+# engine wiring + analytic comm plan + metrics_dump compression column
+# ---------------------------------------------------------------------------
+
+def test_pipeline_site_tristate_and_fp16_refusal():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    # tri-state: None follows `enabled`, explicit value wins
+    cfg = DeepSpeedConfig({"comm_quantization": {"enabled": True}},
+                          world_size=8)
+    assert cfg.comm_quantization.q_pipeline
+    cfg = DeepSpeedConfig({"comm_quantization": {"enabled": True,
+                                                 "pipeline": False}},
+                          world_size=8)
+    assert not cfg.comm_quantization.q_pipeline
+    cfg = DeepSpeedConfig({"comm_quantization": {"pipeline": True}},
+                          world_size=8)
+    assert cfg.comm_quantization.q_pipeline
+    cfg = DeepSpeedConfig({"comm_quantization": {}}, world_size=8)
+    assert not cfg.comm_quantization.q_pipeline
+
+    # fp16 loss scaling + int8 boundary: refuse to arm — saturation maps
+    # inf/nan cotangents onto finite codes, blinding the overflow detector
+    with pytest.raises(ValueError, match="pipeline cannot arm under fp16"):
+        DeepSpeedConfig({"fp16": {"enabled": True},
+                         "comm_quantization": {"pipeline": True}},
+                        world_size=8)
+    # ... including via the blanket `enabled` default
+    with pytest.raises(ValueError, match="pipeline cannot arm under fp16"):
+        DeepSpeedConfig({"fp16": {"enabled": True},
+                         "comm_quantization": {"enabled": True}},
+                        world_size=8)
+    # the documented escape hatch: pin the pipeline site dense
+    cfg = DeepSpeedConfig({"fp16": {"enabled": True},
+                           "comm_quantization": {"enabled": True,
+                                                 "pipeline": False}},
+                          world_size=8)
+    assert not cfg.comm_quantization.q_pipeline
+    assert cfg.comm_quantization.q_grad_all_reduce
+
+
+def test_pp_boundary_q_wired_and_comm_plan(devices, tmp_path):
+    """comm_quantization.pipeline=true on a pp mesh arms the model flag,
+    hands the byte ledger to the engine (pp_comm_record=False — feed
+    disjointness), lands an analytic q_ppermute plan entry with a >=2x
+    dense twin, and the committed series reach `metrics_dump --comms`
+    with the compression column populated."""
+    import os
+    import sys
+
+    mesh = build_mesh(pp=2, fsdp=4, devices=devices)
+    set_global_mesh(mesh)
+    reg = get_registry()
+    reg.reset()
+    comm_api.comms_logger.reset()
+    eng = make_engine(mesh, 1, qcomm={"pipeline": True},
+                      extra={"comms_logger": {"enabled": True}})
+    mcfg = eng.module.config
+    assert mcfg.pp_boundary_q is True
+    assert mcfg.pp_comm_record is False
+    losses = train(eng, steps=2, seed=2)
+    assert np.isfinite(losses).all()
+
+    q_entries = [e for e in eng._comm_plan["micro"]
+                 if e[0] == "q_ppermute"]
+    assert q_entries, eng._comm_plan
+    (_, hops, wire, dtype, world, dense_twin) = q_entries[0]
+    dense_bytes, dense_dtype = dense_twin
+    assert dtype == "int8" and world == 2 and hops > 0
+    assert dense_bytes / wire >= 2.0, (wire, dense_bytes)
+
+    # committed ledger -> statz snapshot -> the comms table
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    try:
+        import metrics_dump
+    finally:
+        sys.path.pop(0)
+    snap = tmp_path / "statz.json"
+    snap.write_text(reg.statz_json())
+    rows = metrics_dump.comms_rows(metrics_dump.load_snapshot(str(snap)))
+    by_op = {r[0]: r for r in rows}
+    assert "q_ppermute" in by_op, sorted(by_op)
+    compress = by_op["q_ppermute"][3]
+    assert compress.endswith("x") and float(compress[:-1]) >= 2.0, compress
+    comm_api.comms_logger.configure(enabled=False)
+
+
+def test_pipeline_site_inert_without_pp(devices):
+    """pipeline=true with no pp mesh axis: loudly inert (audit key), and
+    the model flag stays dense — nothing quantizes."""
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    eng = make_engine(mesh, 1, qcomm={"pipeline": True})
+    assert any("comm_quantization.pipeline" in k
+               for k in eng._inert_config_keys)
+    assert eng.module.config.pp_boundary_q is False
