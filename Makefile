@@ -1,7 +1,7 @@
 # Single-command entries the builder's verify recipe runs before the
 # suite (see ROADMAP.md for the canonical tier-1 line).
 
-.PHONY: lint lint-json tier1 chaos
+.PHONY: lint lint-json tier1 chaos perf-diff
 
 # dslint: AST-level invariant checker (docs/LINT.md) — no jax needed
 lint:
@@ -9,6 +9,12 @@ lint:
 
 lint-json:
 	python tools/dslint.py --json deepspeed_tpu tools bench.py
+
+# perf regression gate over the committed BENCH_*/MULTICHIP_* ledgers
+# (tools/perf_ledger.py --check exits 1 when the trajectory tip regresses
+# beyond tolerance; no jax needed)
+perf-diff:
+	python tools/perf_ledger.py --check --all
 
 # lint first (seconds), then the tier-1 suite (minutes)
 tier1: lint

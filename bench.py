@@ -2360,6 +2360,36 @@ def _strip_bulky(obj):
     return obj
 
 
+def run_metadata() -> dict:
+    """THE run-environment stamp every BENCH_JSON block carries (one
+    shared helper, so no block can drift): git sha, jax/jaxlib versions,
+    platform, and the summary ``schema_version`` — ``tools/perf_ledger.py``
+    uses it to label a cross-rung perf move that coincides with an
+    ENVIRONMENT change (toolchain bump, different backend) instead of
+    blaming the code.  Bump ``schema_version`` when the summary's block
+    shapes change incompatibly."""
+    meta = {"schema_version": 1}
+    try:
+        import subprocess
+
+        meta["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:
+        meta["git_sha"] = None
+    try:
+        import jaxlib
+
+        meta["jax"] = jax.__version__
+        meta["jaxlib"] = jaxlib.version.__version__
+        meta["platform"] = jax.default_backend()
+    except Exception:
+        pass
+    return meta
+
+
 def summary_lines(record: dict, rung_serving) -> list:
     """The machine-readable tail of the bench stdout: a human-greppable
     ``BENCH_JSON:``-prefixed line followed by the SAME summary as a bare
@@ -2374,6 +2404,9 @@ def summary_lines(record: dict, rung_serving) -> list:
                "unit": record["unit"], "vs_baseline": record["vs_baseline"],
                "mfu": record["detail"]["mfu"],
                "backend": record["detail"]["backend"]}
+    # environment stamp (schema_version, git sha, jax/jaxlib, platform):
+    # perf_ledger separates toolchain moves from code regressions
+    summary["run_meta"] = run_metadata()
     if record["detail"].get("metrics"):
         summary["train_metrics"] = _strip_bulky(record["detail"]["metrics"])
     ov = record["detail"].get("overlap_1b4")
@@ -2492,7 +2525,7 @@ def summary_lines(record: dict, rung_serving) -> list:
     for victim in ("serving_metrics", "train_metrics", "overlap_ablation",
                    "serving_prefix", "streamed_offload",
                    "serving_host_tier", "fleet_chaos", "elastic_resume",
-                   "quant_comm", "pipe"):
+                   "quant_comm", "pipe", "run_meta"):
         if len(line) <= BENCH_SUMMARY_MAX_CHARS:
             break
         if summary.pop(victim, None) is not None:

@@ -38,7 +38,8 @@ from .engine import FileContext, Finding, Project, Rule, register_rule
 # contract (each states it in its docstring; dslint itself is one)
 JAXFREE_TOOLS = ("router.py", "fleet_dump.py", "ckpt_verify.py",
                  "train_supervisor.py", "serve_supervisor.py",
-                 "trace_report.py", "metrics_dump.py", "dslint.py")
+                 "trace_report.py", "metrics_dump.py", "perf_ledger.py",
+                 "dslint.py")
 BANNED_ROOTS = {"jax", "jaxlib", "flax", "optax"}
 PACKAGE = "deepspeed_tpu"
 

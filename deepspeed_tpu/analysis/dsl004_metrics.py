@@ -16,6 +16,15 @@ summary block that is not in that list silently re-opens the BENCH_r05
 ``"parsed": null`` bug the first time it pushes the line over budget.
 This rule cross-checks every ``summary["<key>"] = <dict-ish>`` in
 ``summary_lines`` against the victim tuple of the cap loop.
+
+Third half (PR 17's perf ledger): ``tools/perf_ledger.py`` builds
+per-metric trajectories over the committed BENCH_*.json blocks and
+attributes regressions to environment drift — which only works when
+every block stamps its provenance.  When ``summary_lines`` emits blocks
+at all, it must also stamp a ``summary["run_meta"]`` block built by a
+``run_metadata()`` helper whose dict carries a ``schema_version`` key;
+a bench block without the stamp is a trajectory point that can never be
+attributed, so this rule requires it statically.
 """
 
 from __future__ import annotations
@@ -194,7 +203,8 @@ class MetricNamespaceRule(Rule):
                                          (ast.Dict, ast.DictComp)) or (
                         isinstance(node.value, ast.Call)
                         and isinstance(node.value.func, ast.Name)
-                        and node.value.func.id in ("dict", "_strip_bulky"))
+                        and node.value.func.id in ("dict", "_strip_bulky",
+                                                   "run_metadata"))
                     if key is not None and dictish:
                         block_assigns.append((key, node))
             elif isinstance(node, ast.For):
@@ -223,7 +233,40 @@ class MetricNamespaceRule(Rule):
                     f"truncates to non-JSON and the whole record is lost "
                     f"(the BENCH_r05 'parsed: null' bug)",
                     end_line=node.end_lineno or node.lineno))
+        if block_assigns:
+            findings.extend(self._check_run_meta_stamp(ctx, fn,
+                                                       block_assigns))
         return findings
+
+    def _check_run_meta_stamp(self, ctx: FileContext, fn: ast.FunctionDef,
+                              block_assigns: List[Tuple[str, ast.Assign]],
+                              ) -> List[Finding]:
+        """Blocks exist → a ``run_meta`` stamp with schema_version must too."""
+        has_run_meta = any(k == "run_meta" for k, _ in block_assigns)
+        schema_ok = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name == "run_metadata":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Dict) and any(
+                            const_str(k) == "schema_version"
+                            for k in sub.keys if k is not None):
+                        schema_ok = True
+                    elif isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.targets[0], ast.Subscript) \
+                            and const_str(sub.targets[0].slice) \
+                            == "schema_version":
+                        schema_ok = True
+        if has_run_meta and schema_ok:
+            return []
+        return [Finding(
+            self.id, ctx.rel, fn.lineno, fn.col_offset,
+            "BENCH_JSON blocks carry no run-metadata stamp — add "
+            "summary['run_meta'] = run_metadata() with a "
+            "'schema_version' key so tools/perf_ledger.py can attribute "
+            "a regression to environment drift (git sha / jax version) "
+            "instead of the code under test")]
 
 
 register_rule(MetricNamespaceRule())

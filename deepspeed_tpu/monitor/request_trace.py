@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from deepspeed_tpu.monitor.metrics import get_registry
 
 __all__ = ["RequestTracer", "get_request_tracer", "PHASES",
+           "StepTimeline", "get_step_timeline",
            "set_trace_clock_anchor", "get_trace_clock_anchor"]
 
 # the edge-partition phases; each gets a ds_serve_phase_<phase>_seconds
@@ -109,6 +110,22 @@ def set_trace_clock_anchor() -> Dict[str, Any]:
 def get_trace_clock_anchor() -> Dict[str, Any]:
     """The most recent capture's clock anchor (process-start fallback)."""
     return dict(_ANCHOR)
+
+
+def _perfetto_doc(events: List[Dict[str, Any]],
+                  anchor: Dict[str, Any]) -> Dict[str, Any]:
+    """The ONE trace-event envelope every exporter in this process emits
+    (request spans and the training step timeline both go through it):
+    ``ts`` values are microseconds since ``anchor["perf"]``, and
+    ``otherData.clock_anchor_unix`` is that same instant on the WALL
+    clock — the per-endpoint translation key ``tools/fleet_dump.py
+    --trace`` uses to merge N processes' exports onto one shared clock
+    (ts' = ts + (anchor_unix_source - anchor_unix_reference) * 1e6)."""
+    return {"displayTimeUnit": "ns", "traceEvents": events,
+            "otherData": {"clock_anchor_unix": anchor["unix"],
+                          "clock_source": anchor["source"],
+                          "domain": "microseconds since the last "
+                                    "profiler-session start"}}
 
 
 # ---------------------------------------------------------------------------
@@ -196,11 +213,16 @@ class RequestTracer:
     # are plain scalars so a disabled call allocates nothing.
 
     def submit(self, rid: int, t: float, prompt_len: int,
-               max_new: int) -> None:
+               max_new: int, trace: str = "") -> None:
+        """``trace`` is the propagated distributed-trace id (the 32-hex
+        trace-id from the router's ``traceparent`` header, empty for
+        direct submits): it keys this replica's timeline to the router's
+        hop spans so a fleet merge can join them."""
         if not self.enabled:
             return
         self._open[rid] = {"id": rid, "prompt_len": prompt_len,
                            "max_new": max_new, "t_submit": t, "slot": -1,
+                           "trace": trace,
                            "preemptions": 0, "spans_dropped": 0,
                            "edges": [(t, "queue")], "spans": []}
 
@@ -378,6 +400,7 @@ class RequestTracer:
              "args": {"name": "ds_requests"}}]
         for rec in self.completed():
             rid = rec["id"]
+            trace = rec.get("trace") or ""
             t_ph, t_sp = 2 * rid, 2 * rid + 1
             events.append({"ph": "M", "pid": 1, "tid": t_ph,
                            "name": "thread_name",
@@ -386,25 +409,26 @@ class RequestTracer:
             for (t0, phase), (t1, _) in zip(edges, edges[1:]):
                 if t1 <= t0:
                     continue
+                args = {"request_id": rid, "reason": rec["reason"]}
+                if trace:
+                    args["trace"] = trace
                 events.append({"ph": "X", "pid": 1, "tid": t_ph,
                                "name": phase, "ts": us(t0),
                                "dur": round((t1 - t0) * 1e6, 3),
-                               "args": {"request_id": rid,
-                                        "reason": rec["reason"]}})
+                               "args": args})
             if rec["spans"]:
                 events.append({"ph": "M", "pid": 1, "tid": t_sp,
                                "name": "thread_name",
                                "args": {"name": f"req {rid} spans"}})
             for kind, t0, t1, n in rec["spans"]:
+                args = {"request_id": rid, "tokens": n}
+                if trace:
+                    args["trace"] = trace
                 events.append({"ph": "X", "pid": 1, "tid": t_sp,
                                "name": kind, "ts": us(t0),
                                "dur": round(max(0.0, t1 - t0) * 1e6, 3),
-                               "args": {"request_id": rid, "tokens": n}})
-        return {"displayTimeUnit": "ns", "traceEvents": events,
-                "otherData": {"clock_anchor_unix": anchor["unix"],
-                              "clock_source": anchor["source"],
-                              "domain": "microseconds since the last "
-                                        "profiler-session start"}}
+                               "args": args})
+        return _perfetto_doc(events, anchor)
 
 
 _TRACER = RequestTracer()
@@ -414,3 +438,208 @@ def get_request_tracer() -> RequestTracer:
     """The process-global tracer the serving scheduler and engine record
     into (one per process, like the metrics registry)."""
     return _TRACER
+
+
+# ---------------------------------------------------------------------------
+# training step timeline
+# ---------------------------------------------------------------------------
+
+
+class StepTimeline:
+    """Training-side per-boundary timeline — the serve tracer's twin for
+    the DeepSpeedEngine (docs/OBSERVABILITY.md "Distributed tracing").
+
+    The engine marks every micro-batch dispatch and every optimizer
+    boundary; anomaly skips and elastic resumes land as instant events.
+    Each closed step retains its micro spans, the analytic comm plan
+    (rendered as byte-weighted OVERLAY slices in the perfetto export —
+    attribution, not device truth), and the pipeline ``bubble_share``
+    when pipeline parallelism is on.  Exports go through the SAME
+    envelope as :meth:`RequestTracer.perfetto_trace`
+    (:func:`_perfetto_doc`), so ``tools/trace_report.py --timeline`` and
+    ``tools/fleet_dump.py --trace`` render train and serve with one code
+    path.
+
+    Disabled (the default) every hook is one attribute-load + branch —
+    the monitor/metrics.py hot-path contract.  Single-writer: all hooks
+    run on the training (engine) thread; scrapes copy the ring
+    GIL-atomically."""
+
+    # dslint DSL006: the completed-step ring is appended by the engine
+    # thread and list()-copied by scrape threads — one atomic op per
+    # mutation, published records immutable
+    _dslint_shared = {"_ring": "atomic"}
+
+    def __init__(self, ring: int = DEFAULT_RING):
+        self.enabled = False
+        self._ring: deque = deque(maxlen=max(1, int(ring)))
+        self._cur: Optional[Dict[str, Any]] = None
+        self._t_open: Optional[float] = None   # previous boundary time
+        self.steps_total = 0
+        reg = get_registry()
+        self._m_steps = reg.counter(
+            "ds_trace_train_steps_total",
+            "optimizer boundaries recorded by the training step timeline")
+        self._m_events = reg.counter(
+            "ds_trace_train_events_total",
+            "instant events (anomaly skips, elastic resumes) recorded on "
+            "the training step timeline")
+
+    # -- switches -------------------------------------------------------
+    def enable(self) -> "StepTimeline":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "StepTimeline":
+        """Stop recording and drop the open step (its boundary will never
+        arrive while disabled); closed steps are kept."""
+        self.enabled = False
+        self._cur = None
+        self._t_open = None
+        return self
+
+    def reset(self) -> None:
+        self._cur = None
+        self._t_open = None
+        self._ring.clear()
+        self.steps_total = 0
+
+    # -- hot path (engine thread) --------------------------------------
+    def _open_step(self, step: Optional[int], t: float) -> Dict[str, Any]:
+        t0 = self._t_open if self._t_open is not None else t
+        cur = {"step": step, "t0": t0, "micros": [], "events": []}
+        self._cur = cur
+        return cur
+
+    def micro(self, step: int, idx: int, t: float) -> None:
+        """One micro-batch dispatched (called at micro end); the span
+        runs from the previous mark (step open / prior micro) to ``t``."""
+        if not self.enabled:
+            return
+        cur = self._cur
+        if cur is None:
+            cur = self._open_step(step, t)
+        last = cur["micros"][-1][2] if cur["micros"] else cur["t0"]
+        cur["micros"].append((idx, last, t))
+
+    def event(self, kind: str, t: float, **args: Any) -> None:
+        """Instant event (``anomaly_skip`` / ``elastic_resume``), parked
+        on the open step (one opens if needed — elastic resumes can land
+        between boundaries)."""
+        if not self.enabled:
+            return
+        cur = self._cur
+        if cur is None:
+            cur = self._open_step(None, t)
+        cur["events"].append((kind, t, args))
+        self._m_events.inc()
+
+    def boundary(self, step: int, t: float, comm_plan=None,
+                 bubble_share=None) -> None:
+        """Optimizer boundary: close the open step as ``[t_open, t]``,
+        attach the analytic comm plan and the pipeline bubble share, and
+        retain it.  ``t`` becomes the next step's open time."""
+        if not self.enabled:
+            return
+        cur = self._cur if self._cur is not None \
+            else self._open_step(step, t)
+        self._cur = None
+        self._t_open = t
+        cur["step"] = step
+        cur["t1"] = t
+        if bubble_share is not None:
+            cur["bubble_share"] = bubble_share
+        if comm_plan:
+            entries = list(comm_plan.get("micro") or []) \
+                + list(comm_plan.get("boundary") or [])
+            cur["comm_plan"] = [list(e[:5]) for e in entries]
+        self._ring.append(cur)
+        self.steps_total += 1
+        self._m_steps.inc()
+
+    # -- exports --------------------------------------------------------
+    def steps(self) -> List[Dict[str, Any]]:
+        return list(self._ring)
+
+    def snapshot(self, limit: int = 32) -> Dict[str, Any]:
+        limit = max(0, int(limit))
+        recent = list(self._ring)[-limit:] if limit else []
+        return {"enabled": self.enabled,
+                "steps_total": self.steps_total,
+                "retained": len(self._ring),
+                "clock": get_trace_clock_anchor(),
+                "steps": [
+                    {**{k: v for k, v in r.items()
+                        if k not in ("micros", "events")},
+                     "micros": [[i, a, b] for i, a, b in r["micros"]],
+                     "events": [[k, t, a] for k, t, a in r["events"]]}
+                    for r in recent]}
+
+    def perfetto_trace(self,
+                       anchor: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        """Trace-event JSON of every retained step, in the shared clock
+        domain (same envelope + anchor contract as the request tracer):
+        tid 1 = step slices, tid 2 = micro spans, tid 3 = the analytic
+        comm-plan OVERLAY (each step's window split across the plan's
+        entries proportional to their payload bytes — attribution, not a
+        device measurement), tid 4 = instant events."""
+        if anchor is None:
+            anchor = get_trace_clock_anchor()
+        a = anchor["perf"]
+
+        def us(t):
+            return round((t - a) * 1e6, 3)
+
+        events: List[Dict[str, Any]] = [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "ds_train_steps"}}]
+        for tid, name in ((1, "steps"), (2, "micros"),
+                          (3, "comm plan (analytic)"), (4, "events")):
+            events.append({"ph": "M", "pid": 1, "tid": tid,
+                           "name": "thread_name", "args": {"name": name}})
+        for rec in self.steps():
+            t0, t1 = rec["t0"], rec.get("t1", rec["t0"])
+            args: Dict[str, Any] = {"step": rec["step"]}
+            if "bubble_share" in rec:
+                args["bubble_share"] = rec["bubble_share"]
+            if t1 > t0:
+                events.append({"ph": "X", "pid": 1, "tid": 1,
+                               "name": f"step {rec['step']}", "ts": us(t0),
+                               "dur": round((t1 - t0) * 1e6, 3),
+                               "args": args})
+            for idx, m0, m1 in rec["micros"]:
+                if m1 <= m0:
+                    continue
+                events.append({"ph": "X", "pid": 1, "tid": 2,
+                               "name": f"micro {idx}", "ts": us(m0),
+                               "dur": round((m1 - m0) * 1e6, 3),
+                               "args": {"step": rec["step"]}})
+            plan = rec.get("comm_plan")
+            if plan and t1 > t0:
+                total = sum(e[2] for e in plan) or 1
+                tc = t0
+                for op, calls, nbytes, dtype, world in plan:
+                    dur = (t1 - t0) * (nbytes / total)
+                    events.append({"ph": "X", "pid": 1, "tid": 3,
+                                   "name": op, "ts": us(tc),
+                                   "dur": round(dur * 1e6, 3),
+                                   "args": {"bytes": nbytes, "calls": calls,
+                                            "dtype": str(dtype),
+                                            "world": world,
+                                            "analytic": True}})
+                    tc += dur
+            for kind, t, eargs in rec["events"]:
+                events.append({"ph": "i", "pid": 1, "tid": 4, "s": "t",
+                               "name": kind, "ts": us(t),
+                               "args": dict(eargs)})
+        return _perfetto_doc(events, anchor)
+
+
+_TIMELINE = StepTimeline()
+
+
+def get_step_timeline() -> StepTimeline:
+    """The process-global training step timeline the DeepSpeedEngine
+    records into (one per process, like the request tracer)."""
+    return _TIMELINE

@@ -102,11 +102,16 @@ class _Handler(BaseHTTPRequestHandler):
                 body = self.registry.statz_json().encode()
             ctype = "application/json"
         elif path in ("/requestz", "/requestz/"):
-            from deepspeed_tpu.monitor.request_trace import \
-                get_request_tracer
+            from deepspeed_tpu.monitor.request_trace import (
+                get_request_tracer, get_step_timeline)
 
             qs = parse_qs(query)
-            tracer = get_request_tracer()
+            # ?kind=train serves the training step timeline through the
+            # same endpoint/format contract (one scrape surface for
+            # fleet_dump --trace, whether the process serves or trains)
+            tracer = (get_step_timeline()
+                      if qs.get("kind", [""])[0] == "train"
+                      else get_request_tracer())
             if qs.get("format", [""])[0] == "perfetto":
                 body = json.dumps(tracer.perfetto_trace()).encode()
             else:
@@ -178,6 +183,13 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, json.JSONDecodeError) as exc:
                 code, payload = 400, {"error": f"bad JSON body: {exc}"}
             else:
+                # distributed-trace propagation: the router stamps a
+                # traceparent HEADER on its re-POST; surface it to the
+                # engine handler as a payload field (an explicit payload
+                # traceparent wins — it is the more deliberate signal)
+                tp = self.headers.get("traceparent")
+                if tp and "traceparent" not in payload:
+                    payload["traceparent"] = tp
                 # blocks this worker thread until the request completes
                 # (ThreadingHTTPServer: scrapes stay responsive)
                 code, payload = handler(payload)

@@ -46,6 +46,7 @@ from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
 from deepspeed_tpu.monitor.memory import MemoryTelemetry, device_resident_bytes
 from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.monitor.monitor import MonitorMaster
+from deepspeed_tpu.monitor.request_trace import get_step_timeline
 from deepspeed_tpu.profiling.flops import TrainFlopsMeter, lm_flops_per_token
 from deepspeed_tpu.profiling.trace import annotate, perfetto_supported
 from deepspeed_tpu.runtime import optimizer as opt_builder
@@ -652,6 +653,13 @@ class DeepSpeedEngine:
         self._flops_since_boundary = 0.0
         self._flops_meter = TrainFlopsMeter()
         self._mem_telemetry = MemoryTelemetry()
+        # training step timeline (docs/OBSERVABILITY.md "Distributed
+        # tracing"): shares the telemetry master switch — a process that
+        # records ds_* series also retains its step/micro spans for
+        # /requestz?kind=train scrapes and trace_report --timeline
+        self._timeline = get_step_timeline()
+        if self.config.comms_logger.enabled:
+            self._timeline.enable()
         self._flight = get_flight_recorder()
         self._flight_dumped = False
         frc = self.config.flight_recorder
@@ -2311,6 +2319,10 @@ class DeepSpeedEngine:
     def _micro_telemetry(self, batch) -> None:
         """Per-micro-batch accounting: FLOPs accrual for the MFU gauge and
         a flight-recorder breadcrumb.  One branch each while disabled."""
+        if self._timeline.enabled:
+            self._timeline.micro(self._host_steps + 1,
+                                 self._micro_count + 1,
+                                 time.perf_counter())
         if self._flight.enabled:
             self._flight.record("micro_end", step=self._host_steps + 1,
                                 micro=self._micro_count + 1)
@@ -2330,6 +2342,13 @@ class DeepSpeedEngine:
         ``wall_clock_breakdown`` trade), and an HBM sample."""
         flops = self._flops_since_boundary
         self._flops_since_boundary = 0.0
+        if self._timeline.enabled:
+            # close the step span BEFORE the registry gate: the timeline
+            # has its own switch (enable() keys off the same config, but
+            # a bench-hygiene registry.reset() must not truncate it)
+            self._timeline.boundary(self._host_steps, time.perf_counter(),
+                                    comm_plan=self._comm_plan,
+                                    bubble_share=self._pp_bubble_share())
         if not get_registry().enabled:
             return
         self._flops_meter.observe_boundary(flops or None,
@@ -2356,6 +2375,20 @@ class DeepSpeedEngine:
             # between passes cannot make a live scrape read "overlap: off"
             get_registry().gauge("ds_overlap_buckets").set(
                 len(self._overlap_sched.bucket_infos()))
+
+    def _pp_bubble_share(self) -> Optional[float]:
+        """Analytic pipeline bubble fraction of the step's schedule (the
+        bench.py pp-rung formula): ``(pp-1)/(M+2(pp-1))`` under 1F1B,
+        ``(pp-1)/(M+pp-1)`` under GPipe; ``None`` when the mesh has no
+        pp extent (no bubble to attribute)."""
+        pp = self.mesh.shape.get("pp", 1)
+        if pp <= 1:
+            return None
+        mcfg = getattr(self.module, "config", None)
+        M = int(getattr(mcfg, "pp_microbatches", 0) or pp)
+        if getattr(mcfg, "pp_schedule", "gpipe") == "1f1b":
+            return (pp - 1) / (M + 2 * (pp - 1))
+        return (pp - 1) / (M + pp - 1)
 
     # ------------------------------------------------------------------
     # device-true profiling: /profilez capture + step-time watchdog
@@ -2599,6 +2632,9 @@ class DeepSpeedEngine:
         # detector's trip kind rides as "anomaly"
         trip["anomaly"] = trip.pop("kind")
         self._flight.record("anomaly_skip", **trip)
+        if self._timeline.enabled:
+            self._timeline.event("anomaly_skip", time.perf_counter(),
+                                 **trip)
         logger.warning(
             "anomaly: grad norm %.3e flagged %s (median %.3e, consecutive "
             "%d/%d) — step skipped", gnorm, trip["anomaly"], trip["median"],
@@ -3772,6 +3808,11 @@ class DeepSpeedEngine:
         self._flight.record("elastic_resume", saved_dp=saved_dp, dp=cur_dp,
                             saved_gas=saved_gas, gas=new_gas,
                             global_batch=saved_tbs)
+        if self._timeline.enabled:
+            self._timeline.event("elastic_resume", time.perf_counter(),
+                                 saved_dp=saved_dp, dp=cur_dp,
+                                 saved_gas=saved_gas, gas=new_gas,
+                                 global_batch=saved_tbs)
         log_dist(f"elastic resume: dp {saved_dp} -> {cur_dp}; "
                  f"gradient_accumulation_steps {saved_gas} -> {new_gas} "
                  f"preserves global batch {saved_tbs}", ranks=[0])

@@ -372,7 +372,8 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 128,
                eos_token_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               traceparent: Optional[str] = None) -> Request:
         """Enqueue one request; returns the live Request handle (its
         ``output_tokens`` fill in as the scheduler serves it).
 
@@ -403,6 +404,13 @@ class ServingEngine:
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       eos_token_id=(-1 if eos_token_id is None
                                     else int(eos_token_id)))
+        if traceparent:
+            # W3C shape "00-<32hex trace>-<16hex span>-01": the 32-hex
+            # trace-id is the cross-process join key; a non-conforming
+            # header is kept verbatim (still a usable correlation key)
+            parts = str(traceparent).split("-")
+            req.trace_id = parts[1] if len(parts) == 4 and parts[1] \
+                else str(traceparent)
         if deadline_s is not None:
             req.deadline = time.perf_counter() + float(deadline_s)
         return self.scheduler.submit(req)
@@ -693,6 +701,12 @@ class ServingEngine:
             idem = payload.get("idempotency_key")
             if idem is not None and not isinstance(idem, str):
                 raise ValueError("idempotency_key must be a string")
+            # trace context: the router's traceparent header (injected
+            # into the payload by monitor/server.py do_POST) or a
+            # caller-supplied payload field
+            traceparent = payload.get("traceparent")
+            if traceparent is not None and not isinstance(traceparent, str):
+                raise ValueError("traceparent must be a string")
         except (KeyError, TypeError, ValueError) as exc:
             return 400, {"error": f"bad /generate payload: {exc!r}"}
         deadline = time.monotonic() + timeout
@@ -736,7 +750,8 @@ class ServingEngine:
                                            idem=idem, entry=entry)
             try:
                 req = self.submit(prompt, max_new_tokens=max_new,
-                                  eos_token_id=eos, deadline_s=deadline_s)
+                                  eos_token_id=eos, deadline_s=deadline_s,
+                                  traceparent=traceparent)
             except QueueFull as exc:       # overload shed -> 429 + backoff
                 self._idem_drop(idem, entry)
                 return 429, {"error": str(exc), "shed": True,
@@ -841,10 +856,13 @@ class ServingEngine:
             self._idem_drop(idem, entry)
             return 503, {"error": "request cancelled before completion",
                          "requeued": True, "request_id": req.request_id}
-        return 200, {"tokens": [int(t) for t in req.output_tokens],
-                     "request_id": req.request_id,
-                     "finish_reason": req.finish_reason,
-                     "prefix_hit_tokens": req.prefix_hit_tokens}
+        body = {"tokens": [int(t) for t in req.output_tokens],
+                "request_id": req.request_id,
+                "finish_reason": req.finish_reason,
+                "prefix_hit_tokens": req.prefix_hit_tokens}
+        if req.trace_id:
+            body["trace"] = req.trace_id
+        return 200, body
 
     # ------------------------------------------------------------------
     # /profilez: on-demand device-true capture over scheduler iterations
@@ -1130,7 +1148,8 @@ class ServingEngine:
         if self._flight.enabled:
             self._flight.record("serve_preempt", rid=victim.request_id,
                                 pages_freed=freed,
-                                tokens_reclaimed=freed * self.pool.page)
+                                tokens_reclaimed=freed * self.pool.page,
+                                trace=victim.trace_id)
         self._m_preempted.inc()
         self._m_pages_used.set(self.pool.pages_used)
         self._m_pages_free.set(self.pool.pages_free)

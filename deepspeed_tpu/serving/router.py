@@ -62,6 +62,7 @@ standalone on an operator box with no jax installed.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import os
@@ -99,6 +100,129 @@ def _load_metrics():
 _metrics = _load_metrics()
 
 __all__ = ["Replica", "Router", "RouterServer"]
+
+# trace-context propagation (docs/OBSERVABILITY.md, "Distributed
+# tracing"): every dispatch carries a W3C-traceparent-shaped id —
+# ``00-<32 hex trace-id>-<16 hex span-id>-01`` — minted here when the
+# client didn't send one, forwarded to the replica as the
+# ``traceparent`` HTTP header, and echoed in 200 bodies as ``trace``
+# (the bare 32-hex trace-id) so a client can find its spans later.
+_TRACEPARENT_VERSION = "00"
+
+
+def _mint_traceparent() -> str:
+    return (f"{_TRACEPARENT_VERSION}-{os.urandom(16).hex()}"
+            f"-{os.urandom(8).hex()}-01")
+
+
+def _trace_id(traceparent: str) -> str:
+    """The 32-hex trace-id half of a traceparent; a malformed value is
+    used whole (better an ugly join key than a dropped correlation)."""
+    parts = str(traceparent).split("-")
+    return parts[1] if len(parts) == 4 and parts[1] else str(traceparent)
+
+
+class _HopLog:
+    """Ring of completed dispatch records — the ROUTER side of the
+    distributed trace: one record per ``dispatch()`` carrying the trace
+    id and its hop spans (pick, attempt N, retry, breaker-skip, shed,
+    idempotency-join).
+
+    Owns this process's clock anchor (the ``set_trace_clock_anchor``
+    contract from monitor/request_trace.py, restated here because the
+    router must stay jax-free and cannot import the package): exported
+    timestamps are microseconds since ``anchor["perf"]``, and
+    ``anchor["unix"]`` is the wall time that instant corresponds to —
+    ``fleet_dump --trace`` shifts one process's export onto another's
+    clock by the difference of their unix halves."""
+
+    DEFAULT_RING = 256
+
+    # dispatch threads append finished records (the record dict is never
+    # mutated after append) and /requestz snapshots read; deque append
+    # is GIL-atomic (dslint DSL006, docs/LINT.md)
+    _dslint_shared = {"_ring": "atomic",
+                      "dispatches_total": "lock:_lock"}
+
+    def __init__(self, ring: int = DEFAULT_RING):
+        self._ring: collections.deque = collections.deque(maxlen=int(ring))
+        self._lock = threading.Lock()
+        self.dispatches_total = 0
+        self.anchor = {"perf": time.perf_counter(), "unix": time.time(),
+                       "source": "router_process"}
+
+    def record(self, trace: str, t0: float, t1: float, status: int,
+               hops: List[dict]) -> None:
+        self._ring.append({"trace": trace, "t0": t0, "t1": t1,
+                           "status": int(status), "hops": list(hops)})
+        with self._lock:
+            self.dispatches_total += 1
+
+    def _rel_us(self, t: float) -> float:
+        return (t - self.anchor["perf"]) * 1e6
+
+    def snapshot(self, limit: int = 32) -> Dict[str, object]:
+        recs = list(self._ring)
+        if limit >= 0:
+            recs = recs[-limit:] if limit else []
+        out = []
+        for rec in recs:
+            hops = []
+            for h in rec["hops"]:
+                ho = {"kind": h["kind"],
+                      "t0_us": round(self._rel_us(h["t0"]), 1)}
+                if "t1" in h:
+                    ho["dur_us"] = round((h["t1"] - h["t0"]) * 1e6, 1)
+                if h.get("args"):
+                    ho["args"] = h["args"]
+                hops.append(ho)
+            out.append({"trace": rec["trace"], "status": rec["status"],
+                        "t0_us": round(self._rel_us(rec["t0"]), 1),
+                        "dur_us": round((rec["t1"] - rec["t0"]) * 1e6, 1),
+                        "hops": hops})
+        with self._lock:
+            total = self.dispatches_total
+        return {"kind": "router_hops", "dispatches_total": total,
+                "retained": len(self._ring), "clock": dict(self.anchor),
+                "dispatches": out}
+
+    def perfetto_trace(self) -> Dict[str, object]:
+        """Chrome trace-event JSON of the retained dispatches: one
+        synthetic thread per dispatch (a dispatch's hops overlap other
+        dispatches but never each other), the dispatch itself as the
+        enclosing slice, span hops as ``X`` slices, point hops as
+        instants — every event's args carry the trace id, which is the
+        join key against the replicas' ``/requestz`` exports."""
+        events: List[dict] = [{"ph": "M", "pid": 1, "name": "process_name",
+                               "args": {"name": "ds_router"}}]
+        for tid, rec in enumerate(list(self._ring), start=1):
+            events.append({"ph": "M", "pid": 1, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name":
+                                    f"dispatch {rec['trace'][:8]}"}})
+            events.append({"ph": "X", "pid": 1, "tid": tid,
+                           "ts": self._rel_us(rec["t0"]),
+                           "dur": (rec["t1"] - rec["t0"]) * 1e6,
+                           "name": f"dispatch ({rec['status']})",
+                           "args": {"trace": rec["trace"],
+                                    "status": rec["status"]}})
+            for h in rec["hops"]:
+                args = dict(h.get("args") or {})
+                args["trace"] = rec["trace"]
+                if "t1" in h:
+                    events.append({"ph": "X", "pid": 1, "tid": tid,
+                                   "ts": self._rel_us(h["t0"]),
+                                   "dur": (h["t1"] - h["t0"]) * 1e6,
+                                   "name": h["kind"], "args": args})
+                else:
+                    events.append({"ph": "i", "pid": 1, "tid": tid,
+                                   "ts": self._rel_us(h["t0"]), "s": "t",
+                                   "name": h["kind"], "args": args})
+        return {"displayTimeUnit": "ns", "traceEvents": events,
+                "otherData": {"clock_anchor_unix": self.anchor["unix"],
+                              "clock_source": self.anchor["source"],
+                              "domain": "microseconds since the last "
+                                        "profiler-session start"}}
 
 
 class Replica:
@@ -305,6 +429,22 @@ class Router:
             "dispatches answered 429 by an overloaded replica's "
             "admission shed (not a failure: membership/breaker "
             "untouched, backoff honored)")
+        # distributed tracing: ring of per-dispatch hop records served
+        # by the router's own /requestz, with hop-kind counters and the
+        # attempt-latency histogram alongside
+        self.hops = _HopLog()
+        self._m_hops = {
+            kind: self.registry.counter(
+                "ds_router_hops_total",
+                "trace hop events recorded on the dispatch path, "
+                "by kind",
+                labels={"kind": kind})
+            for kind in ("pick", "attempt", "retry", "breaker_skip",
+                         "shed", "idem_join")}
+        self._m_hop_seconds = self.registry.histogram(
+            "ds_router_hop_seconds",
+            "wall seconds per dispatch attempt (the POST to a replica, "
+            "connect through the replica's full generation)")
 
     # -- membership + load polling -------------------------------------
     def poll_one(self, rep: Replica) -> None:
@@ -427,10 +567,17 @@ class Router:
         import urllib.error
         import urllib.request
 
+        # trace context rides the traceparent HEADER (the W3C channel;
+        # monitor/server.py extracts it back into the payload for the
+        # engine), not the forwarded body
+        tp = payload.get("traceparent")
+        payload = {k: v for k, v in payload.items() if k != "traceparent"}
+        headers = {"Content-Type": "application/json"}
+        if isinstance(tp, str) and tp:
+            headers["traceparent"] = tp
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
-            rep.base + "/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            rep.base + "/generate", data=body, headers=headers)
         # the socket deadline must OUTLAST the replica's own generation
         # deadline (the payload's "timeout", which the engine honors with
         # its 504-and-abort path) — a router that times out first would
@@ -463,8 +610,37 @@ class Router:
         return False
 
     def dispatch(self, payload: dict) -> Tuple[int, dict]:
-        """Route one ``/generate`` payload: pick → POST → retry elsewhere
-        on failure.  Returns ``(status, body)``; 200 bodies carry the
+        """Route one ``/generate`` payload: ensure a trace context (the
+        caller's ``traceparent`` or one minted here), run the retry loop
+        in :meth:`_dispatch` recording a hop span per decision point,
+        then file the finished record in :attr:`hops` (the router's
+        ``/requestz`` ring).  200 bodies additionally carry the 32-hex
+        trace id under ``"trace"``."""
+        payload = dict(payload)
+        tp = payload.get("traceparent")
+        if not (isinstance(tp, str) and tp):
+            tp = _mint_traceparent()
+            payload["traceparent"] = tp
+        trace = _trace_id(tp)
+        hops: List[dict] = []
+        t0 = time.perf_counter()
+        code, body = self._dispatch(payload, hops)
+        for h in hops:
+            m = self._m_hops.get(h["kind"])
+            if m is not None:
+                m.inc()
+            if h["kind"] == "attempt" and "t1" in h:
+                self._m_hop_seconds.record(h["t1"] - h["t0"])
+        self.hops.record(trace, t0, time.perf_counter(), code, hops)
+        if code == 200 and isinstance(body, dict):
+            body.setdefault("trace", trace)
+        return code, body
+
+    def _dispatch(self, payload: dict,
+                  hops: List[dict]) -> Tuple[int, dict]:
+        """The retry loop behind :meth:`dispatch`: pick → POST → retry
+        elsewhere on failure, appending one hop dict per decision point
+        to ``hops``.  Returns ``(status, body)``; 200 bodies carry the
         serving replica's name under ``"replica"``.
 
         Every dispatch carries an ``idempotency_key`` (the caller's, or
@@ -491,6 +667,18 @@ class Router:
         request with what the last replica said instead of amplifying."""
         session = payload.get("session")
         payload = dict(payload)
+
+        def hop(kind: str, t0: Optional[float] = None,
+                t1: Optional[float] = None, **args) -> None:
+            h: Dict[str, object] = {
+                "kind": kind,
+                "t0": t0 if t0 is not None else time.perf_counter()}
+            if t1 is not None:
+                h["t1"] = t1
+            if args:
+                h["args"] = args
+            hops.append(h)
+
         if not payload.get("idempotency_key"):
             payload["idempotency_key"] = \
                 f"{self._idem_prefix}-{next(self._idem_seq)}"
@@ -506,12 +694,22 @@ class Router:
         tried: set = set()
         posts = 0
         for attempt in range(self.dispatch_rounds):
+            t_pick = time.perf_counter()
             rep = self.pick(session=session, exclude=tuple(tried))
             if rep is None and tried:
                 # every ready replica already refused this request this
                 # round; start a fresh round over re-polled membership
                 tried.clear()
                 rep = self.pick(session=session)
+            hop("pick", t0=t_pick, t1=time.perf_counter(),
+                attempt=attempt + 1,
+                replica=rep.name if rep is not None else None)
+            now_skip = time.monotonic()
+            skipped = [r.name for r in self.replicas
+                       if r.ready and r is not rep
+                       and r.breaker_state(now_skip) != "closed"]
+            if skipped:
+                hop("breaker_skip", replicas=skipped)
             if rep is None:
                 self.refresh()
                 time.sleep(self.retry_backoff * (attempt + 1))
@@ -523,8 +721,15 @@ class Router:
                 budget_dry = True
                 break
             posts += 1
+            if posts >= 2:
+                # this POST re-presents the idempotency key minted
+                # above: a replica holding the original in-flight
+                # generation JOINS it instead of generating twice
+                hop("idem_join", replica=rep.name,
+                    key=payload["idempotency_key"])
             with self._lock:
                 rep.inflight += 1
+            t_att = time.perf_counter()
             try:
                 try:
                     code, body = self._post(rep, payload)
@@ -545,6 +750,8 @@ class Router:
             finally:
                 with self._lock:
                     rep.inflight -= 1
+            hop("attempt", t0=t_att, t1=time.perf_counter(),
+                replica=rep.name, n=posts, status=code)
             now = time.monotonic()
             if code == 200:
                 rep.note_success()
@@ -573,6 +780,8 @@ class Router:
                         float(body.get("retry_after_s", 1.0)))
                 except (TypeError, ValueError):
                     shed_backoffs.append(1.0)
+                hop("shed", replica=rep.name,
+                    retry_after_s=shed_backoffs[-1])
                 tried.add(rep.name)
                 last_err = body
                 continue
@@ -601,6 +810,7 @@ class Router:
                 with self._lock:
                     self._affinity.pop(session, None)
             self._m_retries.inc()
+            hop("retry", replica=rep.name, status=code)
             tried.add(rep.name)
             last_err = body
         if shed_backoffs and non_shed_failures == 0:
@@ -702,9 +912,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 payload["kinds"] = {name: kind for (name, _), (kind, _) in
                                     reg.typed_snapshot().items()}
             self._send(200, payload)
+        elif path in ("/requestz", "/requestz/"):
+            # the router's half of the distributed trace, same endpoint
+            # shape as a replica's /requestz so fleet_dump --trace can
+            # scrape router and replicas with one code path
+            qs = parse_qs(query)
+            if qs.get("format", [""])[0] == "perfetto":
+                self._send(200, self.router.hops.perfetto_trace())
+                return
+            try:
+                limit = int(qs.get("n", ["32"])[0])
+            except ValueError:
+                self.send_error(400, "n must be an integer")
+                return
+            self._send(200, self.router.hops.snapshot(limit))
         elif path == "/":
             self._send(200, {"endpoints": ["/generate", "/healthz",
-                                           "/replicaz", "/statz"]})
+                                           "/replicaz", "/requestz",
+                                           "/statz"]})
         else:
             self.send_error(404)
 

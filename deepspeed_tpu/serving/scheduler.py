@@ -91,6 +91,11 @@ class Request:
     # cache — accumulated across admissions (a preempt-resume that
     # re-prefills through the cache adds its resume hit here too)
     prefix_hit_tokens: int = 0
+    # propagated distributed-trace id (the 32-hex trace-id parsed from
+    # the router's traceparent header; "" for direct submits): keys this
+    # replica's tracer timeline and flight-recorder serve events to the
+    # router's hop spans across the process boundary
+    trace_id: str = ""
 
     @property
     def prompt_len(self) -> int:
@@ -201,7 +206,7 @@ class IterationScheduler:
         req.t_submit = time.perf_counter()
         self._queue.append(req)
         self._tracer.submit(req.request_id, req.t_submit, req.prompt_len,
-                            req.max_new_tokens)
+                            req.max_new_tokens, trace=req.trace_id)
         self._m_submitted.inc()
         self._m_queue_depth.set(len(self._queue))
         return req
@@ -242,7 +247,8 @@ class IterationScheduler:
             req.t_finish = now
             self._tracer.finish(req.request_id, now, "deadline", 0)
             if self._flight.enabled:
-                self._flight.record("serve_deadline", rid=req.request_id)
+                self._flight.record("serve_deadline", rid=req.request_id,
+                                    trace=req.trace_id)
             self._m_finished["deadline"].inc()
             self._m_deadline.inc()
             out.append(req)
@@ -276,7 +282,7 @@ class IterationScheduler:
             self._tracer.admit(req.request_id, slot, req.t_admit)
             if self._flight.enabled:
                 self._flight.record("serve_admit", rid=req.request_id,
-                                    slot=slot)
+                                    slot=slot, trace=req.trace_id)
             self._m_admitted.inc()
             # queue wait is submit -> FIRST admission only: a re-admission
             # after a paged-KV preempt would otherwise record the whole
@@ -322,7 +328,8 @@ class IterationScheduler:
                             len(req.output_tokens))
         if self._flight.enabled:
             self._flight.record("serve_finish", rid=req.request_id,
-                                reason=req.finish_reason or "unknown")
+                                reason=req.finish_reason or "unknown",
+                                trace=req.trace_id)
         self._m_latency.record(req.t_finish - req.t_submit)
         # an unset/novel reason lands in the explicit "unknown" series —
         # a nonzero count there means a release path forgot to attribute,
@@ -351,7 +358,8 @@ class IterationScheduler:
         req.t_finish = time.perf_counter()
         self._tracer.finish(req.request_id, req.t_finish, "cancelled", 0)
         if self._flight.enabled:
-            self._flight.record("serve_cancel", rid=req.request_id)
+            self._flight.record("serve_cancel", rid=req.request_id,
+                                trace=req.trace_id)
         self._m_finished["cancelled"].inc()
         self._m_queue_depth.set(len(self._queue))
         return True

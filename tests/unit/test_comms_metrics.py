@@ -194,6 +194,15 @@ def test_zero3_training_smoke_exposes_comm_and_mfu_via_statz(mesh8):
     server = MetricsServer(reg, port=0).start()
     try:
         losses_on = _run_steps(engine)
+        # the training step timeline rides the same master switch: each
+        # boundary retained its micro spans + the analytic comm plan
+        from deepspeed_tpu.monitor.request_trace import get_step_timeline
+
+        tl = get_step_timeline()
+        assert tl.enabled and tl.steps_total >= 3
+        last = tl.steps()[-1]
+        assert last["micros"] and last.get("comm_plan")
+        assert any(e[0] == "all_gather" for e in last["comm_plan"])
         with urllib.request.urlopen(f"{server.url}/statz", timeout=5) as r:
             snap = json.load(r)["metrics"]
         # nonzero all_gather bytes + latency (ZeRO-3 gathers 2x/micro)
@@ -219,6 +228,10 @@ def test_zero3_training_smoke_exposes_comm_and_mfu_via_statz(mesh8):
         server.stop()
         comm_metrics.configure(enabled=False)
         comm_metrics.reset()
+        from deepspeed_tpu.monitor.request_trace import get_step_timeline
+
+        get_step_timeline().disable()
+        get_step_timeline().reset()
         reg.reset()
         if not was:
             reg.disable()

@@ -1,0 +1,110 @@
+"""Perf regression ledger (tools/perf_ledger.py): the jax-free tool
+selftest wired tier-1 (the same pattern as the other operator tools),
+the committed-trajectory gate, and a seeded 20% tokens/s regression
+fixture that MUST fail ``--check`` loudly."""
+
+import json
+import os
+import subprocess
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+_REPO = os.path.abspath(os.path.join(_TOOLS, ".."))
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_perf_ledger_selftest():
+    """--selftest: clean trajectory passes, the seeded regression
+    fixture fails with the offending metric named, loose tolerances
+    wave it through, a truncated block is reported as a gap."""
+    ledger = _tool("perf_ledger")
+    assert ledger.main(["perf_ledger", "--selftest"]) == 0
+
+
+def test_perf_ledger_runs_without_jax():
+    """Runtime half of the no-jax contract (the static half is dslint
+    DSL003's import-graph closure, which now covers perf_ledger.py):
+    the selftest runs in a fresh interpreter with no jax import."""
+    script = os.path.join(_TOOLS, "perf_ledger.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--selftest"], capture_output=True,
+        text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    assert "perf_ledger selftest: OK" in proc.stdout
+
+
+def test_committed_trajectory_passes_check():
+    """make perf-diff's exact invocation over the repo's committed
+    BENCH_*/MULTICHIP_* ledgers exits 0 — the gate a regression rung
+    would trip."""
+    ledger = _tool("perf_ledger")
+    assert ledger.main(["perf_ledger", "--check",
+                        f"--dir={_REPO}"]) == 0
+    traj = ledger.load_trajectory(_REPO)
+    assert traj["runs"], "committed ledgers went missing"
+    # the BENCH_r05 truncated tail is a visible gap, never silent
+    assert any("BENCH_r05" in g for g in traj["gaps"])
+
+
+def test_seeded_regression_fails_check(tmp_path, capsys):
+    """A 20% tokens/s drop at the trajectory tip exits nonzero and
+    names the block + metric; direction-aware: the same relative move
+    on a latency metric is flagged as a rise, and an improvement on
+    either axis never fires."""
+    ledger = _tool("perf_ledger")
+
+    def rec(run, tok_s, p99):
+        return {"metric": "demo_train_tokens_per_sec_per_chip",
+                "value": tok_s, "unit": "tokens/s",
+                "detail": {"serving_metrics": {"p99_latency_s": p99}}}
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": rec("r01", 100.0, 0.20)}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": rec("r02", 80.0, 0.20)}))      # -20% tokens/s
+    rc = ledger.main(["perf_ledger", "--check", f"--dir={tmp_path}"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION" in out
+    assert "demo_train_tokens_per_sec_per_chip" in out
+    # improvements never fire: faster tip, lower latency
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": rec("r03", 120.0, 0.10)}))
+    assert ledger.main(["perf_ledger", "--check",
+                        f"--dir={tmp_path}"]) == 0
+    # latency rising 20% beyond tolerance fires on the LOWER direction
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"parsed": rec("r04", 120.0, 0.15)}))
+    rc = ledger.main(["perf_ledger", "--check", f"--dir={tmp_path}"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "p99_latency_s" in out
+    # a per-metric tolerance override waves exactly that metric through
+    assert ledger.main(["perf_ledger", "--check", f"--dir={tmp_path}",
+                        "--tolerance=p99=1.0"]) == 0
+
+
+def test_run_meta_env_drift_attribution(tmp_path):
+    """A regression whose two trajectory points disagree on run_meta
+    (jax version bump) carries env_changed naming the drifted key —
+    and git_sha churn alone is never 'drift'."""
+    ledger = _tool("perf_ledger")
+    base = {"metric": "m_tokens_per_sec", "value": 100.0,
+            "run_meta": {"schema_version": 1, "jax": "0.4.1",
+                         "git_sha": "aaa111"}}
+    tip = {"metric": "m_tokens_per_sec", "value": 70.0,
+           "run_meta": {"schema_version": 1, "jax": "0.4.2",
+                        "git_sha": "bbb222"}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({"parsed": base}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({"parsed": tip}))
+    traj = ledger.load_trajectory(str(tmp_path))
+    findings = ledger.find_regressions(traj)
+    assert len(findings) == 1
+    assert findings[0]["env_changed"] == ["jax"]

@@ -386,3 +386,101 @@ def test_fleet_dump_merges_two_live_endpoints():
     finally:
         for srv in servers:
             srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# training step timeline (the serve tracer's twin, same exporter)
+# ---------------------------------------------------------------------------
+
+def test_step_timeline_records_steps_micros_comm_and_events():
+    """micro/boundary/event recording, the analytic comm-plan overlay
+    split byte-proportionally across the step window, bubble_share on
+    the step slice, and the shared _perfetto_doc envelope."""
+    from deepspeed_tpu.monitor.request_trace import StepTimeline
+
+    tl = StepTimeline()
+    # disabled default: hooks are no-ops (hot-path contract)
+    tl.micro(1, 1, 1.0)
+    tl.boundary(1, 2.0)
+    assert tl.steps() == [] and tl.steps_total == 0
+
+    tl.enable()
+    tl.boundary(0, 0.005)                      # seeds the open time
+    tl.micro(1, 1, 0.010)
+    tl.micro(1, 2, 0.020)
+    tl.event("anomaly_skip", 0.025, anomaly="nonfinite_grad", step=1)
+    plan = {"micro": [("all_reduce", 2, 3 * (1 << 20), "bf16", 8)],
+            "boundary": [("all_gather", 1, 1 << 20, "bf16", 8)]}
+    tl.boundary(1, 0.030, comm_plan=plan, bubble_share=0.25)
+    assert tl.steps_total == 2
+
+    snap = tl.snapshot()
+    rec = snap["steps"][-1]
+    assert rec["step"] == 1 and rec["bubble_share"] == 0.25
+    assert [m[0] for m in rec["micros"]] == [1, 2]
+    assert len(rec["comm_plan"]) == 2
+    assert rec["events"][0][0] == "anomaly_skip"
+
+    anchor = {"perf": 0.0, "unix": 1000.0, "source": "test"}
+    doc = tl.perfetto_trace(anchor=anchor)
+    assert doc["otherData"]["clock_anchor_unix"] == 1000.0
+    ev = doc["traceEvents"]
+    step = [e for e in ev if e.get("name") == "step 1"][0]
+    assert step["ts"] == 5000.0 and step["dur"] == 25000.0
+    assert step["args"]["bubble_share"] == 0.25
+    micros = [e for e in ev if e.get("name", "").startswith("micro ")]
+    assert [m["name"] for m in micros] == ["micro 1", "micro 2"]
+    assert micros[0]["ts"] == 5000.0 and micros[0]["dur"] == 5000.0
+    # byte-weighted overlay: 3MiB/4MiB of the 25ms window, then 1MiB
+    comm = [e for e in ev if e["args"].get("analytic")]
+    assert [c["name"] for c in comm] == ["all_reduce", "all_gather"]
+    assert comm[0]["dur"] == pytest.approx(18750.0)
+    assert comm[1]["ts"] == pytest.approx(5000.0 + 18750.0)
+    inst = [e for e in ev if e.get("ph") == "i"][0]
+    assert inst["name"] == "anomaly_skip" and inst["ts"] == 25000.0
+
+    tl.disable()
+    tl.micro(9, 1, 9.0)
+    assert tl.snapshot()["steps_total"] == 2
+
+
+def test_requestz_kind_train_serves_the_step_timeline():
+    """/requestz?kind=train exposes the process-global StepTimeline
+    through the SAME endpoint + format contract as the request tracer
+    (snapshot JSON and ?format=perfetto)."""
+    import time
+    import urllib.request
+
+    from deepspeed_tpu.monitor.request_trace import get_step_timeline
+    from deepspeed_tpu.monitor.server import MetricsServer
+
+    tl = get_step_timeline()
+    tl.reset()
+    tl.enable()
+    srv = None
+    try:
+        base = __import__("time").perf_counter()
+        tl.boundary(0, base)
+        tl.micro(1, 1, base + 0.01)
+        tl.boundary(1, base + 0.02, bubble_share=0.5)
+        srv = MetricsServer(MetricsRegistry().enable(), port=0).start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/requestz?kind=train",
+                timeout=5) as resp:
+            snap = json.load(resp)
+        assert snap["steps_total"] == 2
+        assert snap["steps"][-1]["bubble_share"] == 0.5
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/requestz?kind=train"
+                "&format=perfetto", timeout=5) as resp:
+            doc = json.load(resp)
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert "step 1" in names
+        procs = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert procs == ["ds_train_steps"]
+    finally:
+        if srv is not None:
+            srv.stop()
+        tl.disable()
+        tl.reset()

@@ -429,3 +429,95 @@ def test_affinity_cap_actually_bounds_sessions():
         assert f"sess-0" not in router._affinity
     finally:
         fake.stop()
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing: the router's hop log (trace ids minted/honored,
+# hops recorded, /requestz exported) against synthetic replicas
+# ---------------------------------------------------------------------------
+
+def test_dispatch_mints_trace_and_logs_hops():
+    """Every dispatch gets a W3C-shaped trace id (returned in the body
+    and honored when the client sends its own traceparent), and the hop
+    log records pick/attempt spans — plus retry when the first attempt
+    503s — all under that one id."""
+    router_tool = _tool("router")
+    a, b = router_tool._FakeReplica("a"), router_tool._FakeReplica("b")
+    try:
+        router = Router([f"a={a.url}", f"b={b.url}"],
+                        registry=MetricsRegistry().enable(),
+                        dispatch_rounds=3, retry_backoff=0.01)
+        router.refresh()
+        code, body = router.dispatch({"prompt": [1], "max_new_tokens": 2})
+        assert code == 200
+        trace = body["trace"]
+        assert len(trace) == 32 and int(trace, 16) >= 0
+        rec = router.hops.snapshot()["dispatches"][-1]
+        assert rec["trace"] == trace and rec["status"] == 200
+        kinds = [h["kind"] for h in rec["hops"]]
+        assert kinds[0] == "pick" and "attempt" in kinds
+        att = [h for h in rec["hops"] if h["kind"] == "attempt"][0]
+        assert att["dur_us"] > 0 and att["args"]["status"] == 200
+
+        # an inbound traceparent is honored, not re-minted
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        code, body = router.dispatch({"prompt": [1], "max_new_tokens": 2,
+                                      "traceparent": tp})
+        assert code == 200 and body["trace"] == "ab" * 16
+
+        # a 503 first attempt -> two attempts + a retry, ONE id
+        # (b is loaded so the pick deterministically lands on a first)
+        b.queue_depth = 3
+        router.refresh()
+        a.requeue_next = 1
+        code, body = router.dispatch({"prompt": [2], "max_new_tokens": 2})
+        assert code == 200
+        rec = router.hops.snapshot()["dispatches"][-1]
+        assert rec["trace"] == body["trace"]
+        kinds = [h["kind"] for h in rec["hops"]]
+        assert kinds.count("attempt") == 2 and "retry" in kinds
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_router_requestz_endpoint_snapshot_and_perfetto():
+    """The router front-end's /requestz: JSON snapshot (with the clock
+    anchor the fleet merge translates by) and the perfetto export whose
+    envelope matches the replica tracer's contract."""
+    router_tool = _tool("router")
+    fake = router_tool._FakeReplica("a")
+    front = None
+    try:
+        router = Router([f"a={fake.url}"],
+                        registry=MetricsRegistry().enable(),
+                        dispatch_rounds=2, retry_backoff=0.01)
+        router.refresh()
+        code, body = router.dispatch({"prompt": [3], "max_new_tokens": 2})
+        assert code == 200
+        front = RouterServer(router).start()
+        with urllib.request.urlopen(front.url + "/requestz",
+                                    timeout=5) as resp:
+            snap = json.load(resp)
+        assert snap["kind"] == "router_hops"
+        assert snap["dispatches_total"] >= 1
+        assert set(snap["clock"]) >= {"perf", "unix", "source"}
+        assert snap["dispatches"][-1]["trace"] == body["trace"]
+        with urllib.request.urlopen(
+                front.url + "/requestz?format=perfetto", timeout=5) as resp:
+            doc = json.load(resp)
+        assert doc["otherData"]["clock_anchor_unix"] == \
+            router.hops.anchor["unix"]
+        slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert any(e["name"] == "dispatch (200)" for e in slices)
+        assert any(e["args"].get("trace") == body["trace"] for e in slices)
+        # bad n -> 400, not a stack trace
+        try:
+            urllib.request.urlopen(front.url + "/requestz?n=zap", timeout=5)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 400
+    finally:
+        if front is not None:
+            front.stop()
+        fake.stop()

@@ -1,0 +1,250 @@
+"""Cross-process distributed tracing e2e (satellite of the tracing PR):
+a REAL router process-boundary — the router (this process) in front of
+TWO live replica subprocesses, each with its own interpreter, clock
+anchor, request tracer, and ``/generate``+``/requestz`` endpoints.
+
+Asserts the two contracts no single-process test can:
+
+- **clock-anchor agreement**: ``fleet_dump --trace`` merges the router's
+  ``/requestz``, both replicas' ``/requestz``, and a device capture into
+  ONE Perfetto session on the first source's clock, and after the
+  per-source unix-anchor shift the winning router ``attempt`` span
+  CONTAINS the serving replica's queue/prefill/decode phases;
+- **retry-elsewhere under one trace id**: the pinned replica drains
+  out-of-band (no router refresh), the next same-session dispatch eats
+  its 503 and retries to the survivor — two ``attempt`` spans and a
+  ``retry`` instant joined under a single trace id, with the retried
+  request's tokens identical to the pre-drain answer (both children
+  init from ``PRNGKey(0)``, so the replicas are weight-identical).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.monitor.metrics import MetricsRegistry
+from deepspeed_tpu.serving import Router, RouterServer
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# the replica child: one tiny weight-deterministic ServingEngine on a
+# single CPU device, request tracing on, URL handshake on stdout, and a
+# file-flag drain trigger (the out-of-band "operator drained it" event)
+_CHILD = '''\
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+import jax.numpy as jnp
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+
+mesh = build_mesh(fsdp=1)
+set_global_mesh(mesh)
+from deepspeed_tpu.models import causal_lm
+model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                  intermediate_size=128, num_heads=4, num_kv_heads=2,
+                  vocab_size=256, remat=False)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+serve = deepspeed_tpu.init_serving(
+    model, config={"dtype": "float32", "max_out_tokens": 64,
+                   "kv_page_tokens": 16},
+    num_slots=2, prefill_chunk=8, decode_block_tokens=3,
+    metrics_port=0, serve_loop=True, request_trace=True)
+serve.set_params(params)
+print("URL", serve.metrics_server.url, flush=True)
+drain_flag = sys.argv[1]
+while not os.path.exists(drain_flag):
+    time.sleep(0.05)
+serve.drain()
+print("DRAINED", flush=True)
+while True:
+    time.sleep(1.0)
+'''
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+def _post(url, payload, timeout=180):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+def _wait_unready(url, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            code, _ = _get(url + "/healthz", timeout=5)
+        except urllib.error.HTTPError as exc:
+            if exc.code == 503:
+                return
+            raise
+        time.sleep(0.1)
+    raise AssertionError(f"{url} never flipped unready")
+
+
+@pytest.fixture(scope="module")
+def fleet_procs(tmp_path_factory):
+    td = tmp_path_factory.mktemp("trace_e2e")
+    script = td / "replica_child.py"
+    script.write_text(_CHILD)
+    procs, flags = {}, {}
+    for name in ("ra", "rb"):
+        flags[name] = str(td / f"drain_{name}")
+        repo = os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", ".."))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+               "PYTHONPATH": repo + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        procs[name] = subprocess.Popen(
+            [sys.executable, str(script), flags[name]],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+    urls = {}
+    try:
+        for name, p in procs.items():
+            url, head = None, []
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                line = p.stdout.readline()
+                if not line:
+                    break
+                head.append(line)
+                if line.startswith("URL "):
+                    url = line.split()[1].strip()
+                    break
+            assert url, f"replica {name} failed to start:\n" + "".join(head)
+            urls[name] = url
+            # keep the pipe drained so the child never blocks on stdout
+            threading.Thread(target=p.stdout.read, daemon=True).start()
+        yield urls, flags
+    finally:
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_retry_elsewhere_one_trace_merged_across_processes(
+        fleet_procs, tmp_path):
+    urls, flags = fleet_procs
+    router = Router([f"ra={urls['ra']}", f"rb={urls['rb']}"],
+                    registry=MetricsRegistry().enable(),
+                    dispatch_rounds=4, retry_backoff=0.05)
+    router.refresh()
+    assert sum(r.ready for r in router.replicas) == 2
+    front = RouterServer(router).start()
+    try:
+        payload = {"prompt": list(range(1, 10)), "max_new_tokens": 5,
+                   "session": "pin-1"}
+        code, body1 = _post(front.url, payload)
+        assert code == 200 and body1.get("trace"), body1
+        first = body1["replica"]
+        other = "rb" if first == "ra" else "ra"
+
+        # drain the session-pinned replica OUT-OF-BAND: the router's
+        # membership is stale on purpose (no refresh), so the next
+        # dispatch attempts it live and retries off the 503
+        open(flags[first], "w").close()
+        _wait_unready(urls[first])
+        code, body2 = _post(front.url, payload)
+        assert code == 200 and body2["replica"] == other, body2
+        trace = body2["trace"]
+        assert trace and trace != body1["trace"]
+        # weight-identical replicas -> token-identical across the retry
+        assert body2["tokens"] == body1["tokens"]
+
+        # router-side hop log has the whole story under that one id
+        _, snap = _get(front.url + "/requestz")
+        rec = [d for d in snap["dispatches"] if d["trace"] == trace]
+        assert len(rec) == 1
+        kinds = [h["kind"] for h in rec[0]["hops"]]
+        assert kinds.count("attempt") == 2
+        assert "retry" in kinds and "pick" in kinds
+
+        # ONE merged Perfetto session: router + both replicas + a device
+        # capture in ra's clock domain, shifted onto the router's clock
+        cap = tmp_path / "devcap.json"
+        cap.write_text(json.dumps({"traceEvents": [
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "ds_device"}},
+            {"ph": "X", "pid": 9, "tid": 1, "name": "fusion.matmul",
+             "ts": 10.0, "dur": 40.0}]}))
+        out = tmp_path / "merged.json"
+        fleet_dump = _tool("fleet_dump")
+        rc = fleet_dump.main(["fleet_dump", "--trace",
+                              f"router={front.url}",
+                              f"ra={urls['ra']}", f"rb={urls['rb']}",
+                              f"--capture=ra={cap}", f"--out={out}"])
+        assert rc == 0
+        merged = json.loads(out.read_text())
+        srcs = merged["otherData"]["sources"]
+        assert merged["otherData"]["reference"] == "router"
+        assert set(srcs) == {"router", "ra", "rb"}
+        ev = merged["traceEvents"]
+        pnames = {e["args"]["name"] for e in ev
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert {"router:ds_router", "ra:ds_requests",
+                "rb:ds_requests"} <= pnames
+        # the device capture rode ra's anchor shift into the session
+        fus = [e for e in ev if e.get("name") == "fusion.matmul"]
+        assert len(fus) == 1
+        assert fus[0]["ts"] == pytest.approx(
+            10.0 + srcs["ra"]["shift_us"], abs=1.0)
+
+        # trace-id join across processes: both attempts in the router's
+        # rows, the serving replica's phases in its rows, one id
+        def mine(e):
+            return (e.get("args") or {}).get("trace") == trace
+
+        attempts = [e for e in ev if e.get("name") == "attempt" and mine(e)]
+        assert len(attempts) == 2
+        won = [e for e in attempts if e["args"].get("status") == 200]
+        assert len(won) == 1
+        phases = [e for e in ev if e.get("ph") == "X" and mine(e)
+                  and e["name"] in ("queue", "prefill", "decode")]
+        assert {e["name"] for e in phases} >= {"queue", "prefill",
+                                               "decode"}
+        # clock-anchor agreement: on the shared clock the winning
+        # attempt CONTAINS the replica's request phases (the 503 attempt
+        # contains none — the drained replica admitted nothing).  The
+        # tolerance bounds same-host anchor-translation error, far below
+        # the attempt's own duration.
+        tol = 50_000.0  # us
+        lo, hi = won[0]["ts"], won[0]["ts"] + won[0]["dur"]
+        for e in phases:
+            assert e["ts"] >= lo - tol, (e, lo)
+            assert e["ts"] + e["dur"] <= hi + tol, (e, hi)
+        # both replicas contributed spans to the one session (the
+        # pre-drain request traced on `first`, the retried on `other`)
+        assert any((e.get("args") or {}).get("trace") == body1["trace"]
+                   for e in ev if e.get("ph") == "X")
+    finally:
+        front.stop()
+        router.stop()

@@ -12,7 +12,26 @@ per-replica SKEW (a hot replica reads directly off the skew column):
     python tools/fleet_dump.py snap1.json snap2.json       # saved snapshots
     python tools/fleet_dump.py --supervisor-status=sup.json url...
     python tools/fleet_dump.py --supervisor-status=sup.json  # status alone
+    python tools/fleet_dump.py --trace router=u0 ra=u1 rb=u2 --out=m.json
     python tools/fleet_dump.py --selftest                  # parser self-check
+
+``--trace`` switches to DISTRIBUTED-TRACE merge (docs/OBSERVABILITY.md
+"Distributed tracing"): every source is scraped at
+``/requestz?format=perfetto`` (append ``#train`` to a URL for a training
+process's step timeline; a non-URL source is read as a saved export
+file), and the per-process Perfetto documents are merged into ONE
+session on the FIRST source's clock.  Each export self-describes its
+clock via ``otherData.clock_anchor_unix`` (the wall time its timestamp
+origin corresponds to — the ``set_trace_clock_anchor()`` contract), so
+translation is a pure shift: ``ts += (anchor_unix_src -
+anchor_unix_ref) * 1e6``.  Pids are remapped per source and process
+names prefixed ``<source>:`` so N processes cannot collide.
+``--capture=<source>=<file>`` merges a ``/profilez`` device capture
+(plain or ``.gz`` trace-event JSON) on the named source's clock — its
+timestamps share that process's trace-session domain.  Every scrape and
+status output also carries a ``scraped_at`` ``{wall, mono}`` pair so a
+metrics view, a supervisor status, and a trace can be correlated in
+time; the rendered views show the resulting skew.
 
 ``--supervisor-status=<file>`` renders a supervisor's ``--status-file``
 JSON (either ``train_supervisor`` or ``serve_supervisor`` schema:
@@ -48,9 +67,11 @@ repo's stdlib-only metrics module — no jax import.
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -86,6 +107,14 @@ _quantile_from_counts = _metrics._quantile_from_counts
 _RATIO_BUCKETS = tuple(i / 16 for i in range(1, 17))
 _BOUNDS_BY_LEN = {len(DEFAULT_BUCKETS) + 1: DEFAULT_BUCKETS,
                   len(_RATIO_BUCKETS) + 1: _RATIO_BUCKETS}
+
+
+def _stamp_now() -> Dict[str, float]:
+    """The correlation stamp every output carries: wall time (cross-
+    process comparable) paired with this process's monotonic clock
+    (interval-true locally) — the pair lets a scrape, a supervisor
+    status, and a trace session be lined up in time."""
+    return {"wall": time.time(), "mono": time.monotonic()}
 
 
 def fetch_statz(url: str, timeout: float = 5.0) -> Dict[str, object]:
@@ -203,6 +232,117 @@ def merge_snapshots(snaps: Dict[str, Dict[str, object]],
 
 
 # ---------------------------------------------------------------------------
+# distributed-trace merge (--trace): N /requestz perfetto exports + device
+# captures onto the first source's clock
+# ---------------------------------------------------------------------------
+
+
+def fetch_trace(url: str, kind: str = "",
+                timeout: float = 5.0) -> Dict[str, object]:
+    """GET one process's ``/requestz?format=perfetto`` export (router
+    hops, a replica's request spans, or — with ``kind='train'`` — the
+    training step timeline)."""
+    import urllib.request
+
+    q = "/requestz?format=perfetto" + (f"&kind={kind}" if kind else "")
+    with urllib.request.urlopen(base_url(url) + q, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def load_capture(path: str) -> Dict[str, object]:
+    """A ``/profilez`` device capture: trace-event JSON, plain or
+    gzipped, either the full document or a bare event list."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as fh:
+        data = json.load(fh)
+    if isinstance(data, list):
+        data = {"traceEvents": data}
+    return data
+
+
+def _shift_events(events: List[dict], shift_us: float, pid_base: int,
+                  src: str) -> List[dict]:
+    """One source's events onto the merged session: timestamps shifted
+    into the reference clock, pids offset into the source's own block,
+    process names prefixed with the source name."""
+    out = []
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        ev = dict(ev)
+        if "pid" in ev:
+            try:
+                ev["pid"] = pid_base + int(ev["pid"])
+            except (TypeError, ValueError):
+                ev["pid"] = pid_base
+        if isinstance(ev.get("ts"), (int, float)):
+            ev["ts"] = ev["ts"] + shift_us
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            args = dict(ev.get("args") or {})
+            args["name"] = f"{src}:{args.get('name', '')}"
+            ev["args"] = args
+        out.append(ev)
+    return out
+
+
+def merge_traces(docs: Dict[str, Dict[str, object]],
+                 captures: Optional[Dict[str, List[dict]]] = None
+                 ) -> Dict[str, object]:
+    """Merge per-process Perfetto exports into ONE session on the FIRST
+    source's clock.
+
+    Anchor-translation contract (docs/OBSERVABILITY.md): each export's
+    timestamps are microseconds since its process's clock anchor, and
+    ``otherData.clock_anchor_unix`` is the wall time of that origin —
+    so source i's events land on the reference clock via ``ts +=
+    (unix_i - unix_ref) * 1e6``.  A device capture under ``captures``
+    shares its named source's trace-session clock and gets the same
+    shift.  ``otherData.sources`` records every anchor and its applied
+    shift (the cross-process skew, made visible instead of absorbed)."""
+    if not docs:
+        raise ValueError("--trace needs at least one source")
+    captures = captures or {}
+    names = list(docs)
+    ref = names[0]
+    ref_unix = float(
+        (docs[ref].get("otherData") or {}).get("clock_anchor_unix") or 0.0)
+    events: List[dict] = []
+    sources: Dict[str, dict] = {}
+    pid_base = 0
+    shifts: Dict[str, float] = {}
+    for name in names:
+        doc = docs[name]
+        other = doc.get("otherData") or {}
+        unix = float(other.get("clock_anchor_unix") or ref_unix)
+        shift = (unix - ref_unix) * 1e6
+        shifts[name] = shift
+        pid_base += 1000
+        sources[name] = {"clock_anchor_unix": unix,
+                         "clock_source": other.get("clock_source"),
+                         "shift_us": round(shift, 3),
+                         "pid_base": pid_base}
+        events.extend(_shift_events(
+            list(doc.get("traceEvents") or []), shift, pid_base, name))
+    for name, caps in captures.items():
+        if name not in shifts:
+            raise ValueError(
+                f"--capture={name}=... names no --trace source "
+                f"(have: {', '.join(names)})")
+        for j, cap in enumerate(caps):
+            pid_base += 1000
+            events.extend(_shift_events(
+                list(cap.get("traceEvents") or []), shifts[name],
+                pid_base, f"{name}:device{j if len(caps) > 1 else ''}"))
+    return {"displayTimeUnit": "ns", "traceEvents": events,
+            "otherData": {"reference": ref,
+                          "clock_anchor_unix": ref_unix,
+                          "scraped_at": _stamp_now(),
+                          "sources": sources,
+                          "domain": "microseconds since the reference "
+                                    "source's clock anchor"}}
+
+
+# ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
 
@@ -255,6 +395,15 @@ def render_supervisor_status(st: Dict[str, object]) -> str:
     kind = st.get("kind", "supervisor")
     head = (f"{kind}: state={st.get('state')} pid={st.get('pid')} "
             f"updated_unix={st.get('updated_unix')}")
+    sc = st.get("scraped_at")
+    if isinstance(sc, dict) and "wall" in sc:
+        head += f" scraped_at={sc['wall']:.3f}"
+        # the status-vs-scrape skew made visible: how stale the
+        # supervisor's truth was at the moment this view was taken
+        try:
+            head += f" (age {sc['wall'] - float(st['updated_unix']):.1f}s)"
+        except (KeyError, TypeError, ValueError):
+            pass
     rows: List[List[str]] = []
     if "replicas" in st:                 # serve_supervisor: one row each
         for r in st["replicas"]:
@@ -328,13 +477,16 @@ def selftest() -> int:
     table = render(fleet, sorted(snaps))
     assert "ds_serve_submitted_total" in table and "400" in table
     print(table)
-    # supervisor-status render: both schemas through one code path
+    # supervisor-status render: both schemas through one code path, with
+    # the scraped_at pair rendered as status-vs-scrape age
     train_st = {"kind": "train_supervisor", "state": "backoff", "pid": 7,
-                "incarnation": 2, "child_pid": 11,
+                "incarnation": 2, "child_pid": 11, "updated_unix": 100.0,
+                "scraped_at": {"wall": 103.5, "mono": 5.0},
                 "ladder": {"restarts": 2, "max_restarts": 5,
                            "crash_restarts": 2, "preempt_restarts": 0}}
     out = render_supervisor_status(train_st)
     assert "train_supervisor: state=backoff" in out and "2/5" in out
+    assert "scraped_at=103.500" in out and "age 3.5s" in out, out
     serve_st = {"kind": "serve_supervisor", "state": "running", "pid": 8,
                 "target": 2, "replicas": [
                     {"index": 0, "state": "RUNNING", "port": 9101,
@@ -348,6 +500,45 @@ def selftest() -> int:
     out = render_supervisor_status(serve_st)
     assert "serve_supervisor: state=running" in out
     assert "FAILED" in out and "5/5" in out
+    # trace merge: two exports whose anchors disagree by exactly 2s —
+    # after translation the same wall instant must land on the same ts
+    docs = {
+        "router": {"traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "ds_router"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 1000.0, "dur": 500.0,
+             "name": "dispatch (200)", "args": {"trace": "t" * 32}}],
+            "otherData": {"clock_anchor_unix": 1000.0,
+                          "clock_source": "router_process"}},
+        "ra": {"traceEvents": [
+            {"ph": "X", "pid": 1, "tid": 7, "ts": 0.0, "dur": 200.0,
+             "name": "decode", "args": {"trace": "t" * 32}}],
+            "otherData": {"clock_anchor_unix": 1002.0,
+                          "clock_source": "process"}},
+    }
+    cap = {"traceEvents": [{"ph": "X", "pid": 3, "tid": 1, "ts": 50.0,
+                            "dur": 10.0, "name": "fusion"}]}
+    merged = merge_traces(docs, {"ra": [cap]})
+    other = merged["otherData"]
+    assert other["reference"] == "router"
+    assert other["sources"]["ra"]["shift_us"] == 2e6
+    by_name = {e["name"]: e for e in merged["traceEvents"]
+               if e.get("ph") == "X"}
+    # ra's ts=0 is wall 1002.0 = router ts 2_000_000; the capture rides
+    # ra's shift; pids are disjoint per source
+    assert by_name["decode"]["ts"] == 2e6
+    assert by_name["fusion"]["ts"] == 50.0 + 2e6
+    assert by_name["dispatch (200)"]["ts"] == 1000.0
+    assert len({e["pid"] for e in merged["traceEvents"]}) == 3
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert names == ["router:ds_router"], names
+    try:
+        merge_traces(docs, {"nosuch": [cap]})
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("unknown --capture source must be rejected")
     print("fleet_dump selftest: OK")
     return 0
 
@@ -355,11 +546,64 @@ def selftest() -> int:
 # ---------------------------------------------------------------------------
 
 
+def trace_main(args: List[str], flags: set) -> int:
+    """``--trace``: scrape every source's perfetto export and merge them
+    (plus any ``--capture=<source>=<file>`` device captures) into one
+    session, written to ``--out=<file>`` or stdout."""
+    docs: Dict[str, Dict[str, object]] = {}
+    for i, src in enumerate(args):
+        name, sep, rest = src.partition("=")
+        if sep and not name.startswith("http") and "/" not in name:
+            src = rest
+        else:
+            name = f"r{i}"
+        kind = ""
+        if src.endswith("#train"):
+            src, kind = src[: -len("#train")], "train"
+        if is_url(src):
+            docs[name] = fetch_trace(src, kind=kind)
+        else:
+            with open(src) as fh:
+                docs[name] = json.load(fh)
+    captures: Dict[str, List[dict]] = {}
+    for f in sorted(flags):
+        if not f.startswith("--capture="):
+            continue
+        cname, sep, cpath = f.split("=", 1)[1].partition("=")
+        if not sep:
+            print("--capture needs <source>=<file>", file=sys.stderr)
+            return 2
+        captures.setdefault(cname, []).append(load_capture(cpath))
+    try:
+        merged = merge_traces(docs, captures)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    out_paths = [f.split("=", 1)[1] for f in flags
+                 if f.startswith("--out=")]
+    body = json.dumps(merged, sort_keys=True)
+    if out_paths:
+        with open(out_paths[0], "w") as fh:
+            fh.write(body)
+        srcs = merged["otherData"]["sources"]
+        print(f"merged {len(docs)} trace source(s) "
+              f"+ {sum(len(v) for v in captures.values())} capture(s) "
+              f"-> {out_paths[0]} (reference "
+              f"{merged['otherData']['reference']}; shifts_us "
+              + ", ".join(f"{n}={s['shift_us']}"
+                          for n, s in srcs.items()) + ")")
+    else:
+        print(body)
+    return 0
+
+
 def main(argv: List[str]) -> int:
     args = [a for a in argv[1:] if not a.startswith("--")]
     flags = {a for a in argv[1:] if a.startswith("--")}
     if "--selftest" in flags:
         return selftest()
+    if "--trace" in flags:
+        return trace_main(args, flags)
     # --supervisor-status=<file>: supervisor truth (ladder counters,
     # replica/child states) rendered next to the scrape — readable alone
     # too (a down fleet has no /statz to scrape, but the file survives)
@@ -369,10 +613,13 @@ def main(argv: List[str]) -> int:
     for p in status_paths:
         try:
             with open(p) as fh:
-                statuses.append(json.load(fh))
+                st = json.load(fh)
         except (OSError, ValueError) as exc:
             print(f"unreadable status file {p}: {exc}", file=sys.stderr)
             return 2
+        if isinstance(st, dict):
+            st.setdefault("scraped_at", _stamp_now())
+        statuses.append(st)
     if not args and statuses:
         if "--json" in flags:
             print(json.dumps({"supervisors": statuses}, sort_keys=True,
@@ -386,6 +633,7 @@ def main(argv: List[str]) -> int:
         return 0 if args else 2
     snaps: Dict[str, Dict[str, object]] = {}
     kinds: Dict[str, str] = {}
+    stamps: Dict[str, Dict[str, float]] = {}
     for i, src in enumerate(args):
         name, sep, rest = src.partition("=")
         if sep and not name.startswith("http"):
@@ -395,17 +643,24 @@ def main(argv: List[str]) -> int:
         data = load_source(src)
         snaps[name] = data.get("metrics", {})
         kinds.update(data.get("kinds") or {})
+        stamps[name] = _stamp_now()
     fleet = merge_snapshots(snaps, kinds)
     if not fleet:
         print("(no metrics found on any replica)")
         return 1
     if "--json" in flags:
         print(json.dumps({"replicas": sorted(snaps), "fleet": fleet,
+                          "scraped_at": stamps,
                           **({"supervisors": statuses} if statuses else {})},
                          sort_keys=True, default=str))
     else:
         for st in statuses:
             print(render_supervisor_status(st))
+        walls = [s["wall"] for s in stamps.values()]
+        if walls:
+            print(f"scraped_at={min(walls):.3f} "
+                  f"(scrape skew {(max(walls) - min(walls)) * 1e3:.1f}ms "
+                  f"over {len(walls)} source(s))")
         print(render(fleet, sorted(snaps)))
     return 0
 

@@ -1,0 +1,400 @@
+#!/usr/bin/env python
+"""Perf-regression ledger over the committed bench trajectory.
+
+The repo accumulates ``BENCH_rNN.json`` / ``MULTICHIP_rNN.json`` blocks
+(one per growth rung — the runner's record of ``python bench.py`` and the
+multichip dryrun sweep), but until this tool nothing READ the
+trajectory: a perf regression only got caught if a human re-read old
+JSON.  This turns the archive into a gate:
+
+    python tools/perf_ledger.py                    # regressions, if any
+    python tools/perf_ledger.py --all              # every trajectory row
+    python tools/perf_ledger.py --check            # exit 1 on regression
+    python tools/perf_ledger.py --json             # machine-readable
+    python tools/perf_ledger.py --dir=/path        # ledgers elsewhere
+    python tools/perf_ledger.py --tolerance=0.15   # global tolerance
+    python tools/perf_ledger.py --tolerance=tokens_per_sec=0.05
+    python tools/perf_ledger.py --selftest         # fixture must fail
+
+What is parsed (keyed by the bench summary's block names — the same
+tuple DSL004 pins as the ``summary_lines`` victim order):
+
+- every BENCH block's ``parsed`` summary and/or the ``BENCH_JSON:`` line
+  recovered from its ``tail``: the headline ``metric``/``value`` pair,
+  ``vs_baseline``/``mfu``, and the named sub-blocks (``serving_metrics``,
+  ``train_metrics``, ``overlap_ablation``, ``serving_prefix``,
+  ``streamed_offload``, ``serving_host_tier``, ``fleet_chaos``,
+  ``elastic_resume``, ``quant_comm``, ``pipe``) flattened to dotted
+  numeric metrics;
+- every MULTICHIP block's ``ok`` bit, ``n_devices``, and the per-recipe
+  ``dryrun[name]: ... loss=X`` lines;
+- each block's ``run_meta`` (git sha, jax/jaxlib, platform,
+  ``schema_version`` — the bench.py ``run_metadata()`` stamp), kept so a
+  regression across an ENVIRONMENT change is labeled as such instead of
+  blamed on code.
+
+Regression rule: per metric, compare the NEWEST point against the
+previous one (the gate protects the tip of the trajectory; history is
+context, not a verdict).  Direction comes from the metric name
+(tokens/sec, speedup, mfu, goodput, ... are higher-better; latency, p99,
+step_ms, bubble_share, loss, ... are lower-better; identity/shape fields
+are neutral and never flagged).  A move beyond the tolerance (default
+10%, configurable globally or per name-substring) is a named finding;
+``--check`` exits nonzero when any exist.  Blocks that cannot be parsed
+(e.g. a truncated tail) are REPORTED as gaps, never silently dropped.
+
+Zero dependencies beyond the stdlib — no jax, no repo imports (dslint
+DSL003 pins the closure); wired as ``make perf-diff`` and the
+``--selftest`` runs in tier-1 next to the other jax-free tools.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the bench summary's droppable blocks — mirrors the summary_lines victim
+# tuple that DSL004 pins in bench.py (plus run_meta, the metadata stamp)
+SUMMARY_BLOCKS = ("serving_metrics", "train_metrics", "overlap_ablation",
+                  "serving_prefix", "streamed_offload", "serving_host_tier",
+                  "fleet_chaos", "elastic_resume", "quant_comm", "pipe")
+
+# direction heuristics by name substring; NEUTRAL wins, then HIGHER,
+# then LOWER; a name matching none is informational only
+NEUTRAL = ("loss_parity", "token_identical", "exactly_once", "worlds",
+           "world_save", "n_devices", "schema_version", "batch", "params",
+           "seq", "new_tokens", "grad_accum", "steps", "demotes",
+           "promotes", "restarts", "shed")
+HIGHER = ("tokens_per_sec", "tok_s", "speedup", "mfu", "goodput",
+          "retention", "hit_ratio", "compression", "savings",
+          "vs_baseline", "bandwidth", "mbps", "ok")
+LOWER = ("latency", "p99", "p50", "ttft", "step_ms", "ms_per_token",
+         "bubble_share", "gap_share", "loss", "overhead_ms", "skew",
+         "steps_to_recover", "resume_latency")
+
+
+def direction(name: str) -> Optional[str]:
+    low = name.lower()
+    for toks, d in ((NEUTRAL, None), (HIGHER, "higher"), (LOWER, "lower")):
+        if any(t in low for t in toks):
+            return d
+    return None
+
+
+def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
+    """Numeric leaves of a summary block, dotted; non-numeric leaves and
+    lists are attribution detail, not trajectory metrics."""
+    if isinstance(obj, bool):
+        out[prefix] = float(obj)
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+def _summary_metrics(doc: dict) -> Tuple[Dict[str, float], Optional[dict]]:
+    """One bench summary (or legacy record) document -> dotted metrics +
+    its run_meta block (None for pre-schema blocks)."""
+    out: Dict[str, float] = {}
+    metric = doc.get("metric")
+    if isinstance(metric, str) and isinstance(doc.get("value"),
+                                              (int, float)):
+        out[metric] = float(doc["value"])
+    for k in ("vs_baseline", "mfu"):
+        if isinstance(doc.get(k), (int, float)):
+            out[k] = float(doc[k])
+    for scope in (doc, doc.get("detail")
+                  if isinstance(doc.get("detail"), dict) else {}):
+        for blk in SUMMARY_BLOCKS:
+            sub = scope.get(blk)
+            if isinstance(sub, dict):
+                _flatten(blk, sub, out)
+    meta = doc.get("run_meta")
+    return out, meta if isinstance(meta, dict) else None
+
+
+_BENCH_JSON_RE = re.compile(r"BENCH_JSON: (\{.*\})")
+_DRYRUN_RE = re.compile(r"dryrun\[([^\]]+)\][^\n]*?loss=([0-9.eE+-]+)")
+
+
+def parse_bench_block(data: dict) -> Tuple[Dict[str, float],
+                                           Optional[dict], bool]:
+    """One ``BENCH_rNN.json``: metrics from the runner's ``parsed`` field
+    and/or the ``BENCH_JSON:`` line recovered from the tail (the line
+    wins where both name a metric — it is the bench's own summary).
+    Returns ``(metrics, run_meta, parsed_ok)``."""
+    metrics: Dict[str, float] = {}
+    meta: Optional[dict] = None
+    found = False
+    docs = []
+    if isinstance(data.get("parsed"), dict):
+        docs.append(data["parsed"])
+    m = _BENCH_JSON_RE.search(data.get("tail") or "")
+    if m:
+        try:
+            docs.append(json.loads(m.group(1)))
+        except ValueError:
+            pass
+    for doc in docs:
+        got, dmeta = _summary_metrics(doc)
+        if got:
+            found = True
+        metrics.update(got)
+        meta = dmeta or meta
+    return metrics, meta, found
+
+
+def parse_multichip_block(data: dict) -> Tuple[Dict[str, float], bool]:
+    """One ``MULTICHIP_rNN.json``: the sweep verdict plus per-recipe
+    dryrun losses; a skipped sweep contributes nothing (and is not a
+    parse gap)."""
+    if data.get("skipped"):
+        return {}, True
+    out: Dict[str, float] = {"multichip.ok": float(bool(data.get("ok")))}
+    if isinstance(data.get("n_devices"), (int, float)):
+        out["multichip.n_devices"] = float(data["n_devices"])
+    for name, loss in _DRYRUN_RE.findall(data.get("tail") or ""):
+        try:
+            out[f"multichip.dryrun.{name}.loss"] = float(loss)
+        except ValueError:
+            pass
+    return out, True
+
+
+def load_trajectory(ledger_dir: str) -> dict:
+    """Every ledger block in ``ledger_dir`` -> per-metric trajectories.
+
+    Returns ``{"points": {metric: [(run_key, value)...]},
+    "meta": {run_key: run_meta}, "gaps": [run_key...], "runs": [...]}``
+    with run keys like ``BENCH_r05`` ordered by family then rung."""
+    points: Dict[str, List[Tuple[str, float]]] = {}
+    meta: Dict[str, dict] = {}
+    gaps: List[str] = []
+    runs: List[str] = []
+    paths = sorted(glob.glob(os.path.join(ledger_dir, "BENCH_*.json"))) \
+        + sorted(glob.glob(os.path.join(ledger_dir, "MULTICHIP_*.json")))
+    for path in paths:
+        key = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            gaps.append(key)
+            continue
+        if key.startswith("MULTICHIP"):
+            metrics, ok = parse_multichip_block(data)
+        else:
+            metrics, rmeta, ok = parse_bench_block(data)
+            if rmeta is not None:
+                meta[key] = rmeta
+        runs.append(key)
+        if not ok and not metrics:
+            gaps.append(key)
+        for name, value in metrics.items():
+            points.setdefault(name, []).append((key, value))
+    return {"points": points, "meta": meta, "gaps": gaps, "runs": runs}
+
+
+def _tolerance_for(name: str, tolerances: List[Tuple[str, float]],
+                   default: float) -> float:
+    for sub, tol in tolerances:
+        if sub in name:
+            return tol
+    return default
+
+
+def find_regressions(traj: dict, default_tol: float = 0.10,
+                     tolerances: Optional[List[Tuple[str, float]]] = None
+                     ) -> List[dict]:
+    """Tip-of-trajectory check: for every directional metric with >= 2
+    points, flag a move beyond tolerance between the two NEWEST points.
+    Findings name the block/metric, both runs, the relative move, and —
+    when the two runs' ``run_meta`` stamps differ — the environment
+    fields that changed (an env move is still reported, but attributable
+    to the toolchain rather than the code)."""
+    tolerances = tolerances or []
+    findings = []
+    for name, pts in sorted(traj["points"].items()):
+        d = direction(name)
+        if d is None or len(pts) < 2:
+            continue
+        (prev_run, prev), (last_run, last) = pts[-2], pts[-1]
+        if prev == 0:
+            continue
+        rel = (last - prev) / abs(prev)
+        tol = _tolerance_for(name, tolerances, default_tol)
+        if (d == "higher" and rel < -tol) or (d == "lower" and rel > tol):
+            f = {"metric": name, "direction": d,
+                 "prev_run": prev_run, "prev": prev,
+                 "last_run": last_run, "last": last,
+                 "rel_change": round(rel, 4), "tolerance": tol}
+            m0 = traj["meta"].get(prev_run) or {}
+            m1 = traj["meta"].get(last_run) or {}
+            env = sorted(k for k in set(m0) | set(m1)
+                         if k != "git_sha" and m0.get(k) != m1.get(k))
+            if env and (m0 or m1):
+                f["env_changed"] = env
+            findings.append(f)
+    return findings
+
+
+def render(traj: dict, findings: List[dict], show_all: bool) -> str:
+    out = [f"perf ledger: {len(traj['runs'])} block(s), "
+           f"{len(traj['points'])} metric trajectorie(s), "
+           f"{len(findings)} regression(s)"]
+    if traj["gaps"]:
+        # no silent caps: a block the parser could not read is a HOLE in
+        # the trajectory, and the gate must say so
+        out.append("unparsed blocks (no metrics recovered): "
+                   + ", ".join(traj["gaps"]))
+    if show_all:
+        for name, pts in sorted(traj["points"].items()):
+            d = direction(name) or "-"
+            vals = " ".join(f"{run.split('_')[-1]}={v:g}"
+                            for run, v in pts)
+            out.append(f"  [{d:>6}] {name}: {vals}")
+    for f in findings:
+        env = (f" [environment changed: {', '.join(f['env_changed'])}]"
+               if f.get("env_changed") else "")
+        out.append(
+            f"REGRESSION {f['metric']}: {f['prev']:g} ({f['prev_run']}) "
+            f"-> {f['last']:g} ({f['last_run']}), "
+            f"{100 * f['rel_change']:+.1f}% vs {f['direction']}-is-better "
+            f"tolerance {100 * f['tolerance']:.0f}%{env}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# selftest (tier-1 wired): clean trajectory passes, a seeded 20% tokens/s
+# regression fails loudly
+# ---------------------------------------------------------------------------
+
+
+def _write_fixture(d: str, *, seeded: bool) -> None:
+    def bench(n, value, p99, sha, jaxv):
+        summary = {"metric": "demo_train_tokens_per_sec_per_chip",
+                   "value": value, "unit": "tokens/sec",
+                   "vs_baseline": value / 100.0, "mfu": 0.4,
+                   "serving_metrics": {"tokens_per_sec": value / 2,
+                                       "p99_latency_s": p99},
+                   "run_meta": {"schema_version": 1, "git_sha": sha,
+                                "jax": jaxv, "platform": "cpu"}}
+        line = json.dumps(summary, separators=(",", ":"))
+        block = {"n": n, "cmd": "python bench.py", "rc": 0,
+                 "tail": f"noise\nBENCH_JSON: {line}\n{line}",
+                 "parsed": summary}
+        with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as fh:
+            json.dump(block, fh)
+
+    bench(1, 100.0, 0.20, "aaa", "0.4.1")
+    bench(2, 110.0, 0.21, "bbb", "0.4.1")
+    if seeded:
+        # 20% tokens/s drop + a p99 blowup, across a jax version change
+        bench(3, 88.0, 0.50, "ccc", "0.4.2")
+    with open(os.path.join(d, "MULTICHIP_r01.json"), "w") as fh:
+        json.dump({"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+                   "tail": "dryrun[zero3]: mesh={} loss=6.7719 step=1 OK"},
+                  fh)
+    with open(os.path.join(d, "MULTICHIP_r02.json"), "w") as fh:
+        json.dump({"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+                   "tail": "dryrun[zero3]: mesh={} loss=6.7720 step=1 OK"},
+                  fh)
+    # a truncated block (the BENCH_r05 shape): reported as a gap
+    with open(os.path.join(d, "BENCH_r04.json"), "w") as fh:
+        json.dump({"n": 4, "cmd": "python bench.py", "rc": 0,
+                   "tail": 'per_sec": 1190.4, "truncated...', "parsed": None},
+                  fh)
+
+
+def selftest() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="ds_perf_ledger_") as d:
+        _write_fixture(d, seeded=False)
+        traj = load_trajectory(d)
+        clean = find_regressions(traj)
+        assert clean == [], clean
+        assert "BENCH_r04" in traj["gaps"], traj["gaps"]
+        pts = traj["points"]["demo_train_tokens_per_sec_per_chip"]
+        assert [v for _, v in pts] == [100.0, 110.0], pts
+        assert traj["points"]["multichip.dryrun.zero3.loss"][0][1] == 6.7719
+        text = render(traj, clean, show_all=True)
+        assert "0 regression(s)" in text and "BENCH_r04" in text, text
+    with tempfile.TemporaryDirectory(prefix="ds_perf_ledger_") as d:
+        _write_fixture(d, seeded=True)
+        traj = load_trajectory(d)
+        bad = find_regressions(traj)
+        names = {f["metric"] for f in bad}
+        assert "demo_train_tokens_per_sec_per_chip" in names, bad
+        assert "serving_metrics.p99_latency_s" in names, bad
+        lead = [f for f in bad
+                if f["metric"] == "demo_train_tokens_per_sec_per_chip"][0]
+        assert lead["rel_change"] == -0.2 and lead["direction"] == "higher"
+        # the jax bump between r02 and r03 is named, git_sha churn is not
+        assert lead.get("env_changed") == ["jax"], lead
+        text = render(traj, bad, show_all=False)
+        assert "REGRESSION demo_train_tokens_per_sec_per_chip" in text
+        assert "environment changed: jax" in text
+        # a loose per-name tolerance can wave the same move through
+        assert find_regressions(
+            traj, tolerances=[("tokens_per_sec", 0.5),
+                              ("vs_baseline", 0.5), ("p99", 2.0)]) == []
+    print("perf_ledger selftest: OK")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: List[str]) -> int:
+    flags = [a for a in argv[1:] if a.startswith("--")]
+    if any(a for a in argv[1:] if not a.startswith("--")) \
+            or "--help" in flags or "-h" in argv[1:]:
+        print(__doc__.strip())
+        return 0 if "--help" in flags or "-h" in argv[1:] else 2
+    if "--selftest" in flags:
+        return selftest()
+    ledger_dir = _REPO
+    default_tol = 0.10
+    tolerances: List[Tuple[str, float]] = []
+    for f in flags:
+        if f.startswith("--dir="):
+            ledger_dir = f.split("=", 1)[1]
+        elif f.startswith("--tolerance="):
+            spec = f.split("=", 1)[1]
+            name, sep, val = spec.rpartition("=")
+            try:
+                if sep:
+                    tolerances.append((name, float(val)))
+                else:
+                    default_tol = float(val)
+            except ValueError:
+                print(f"bad tolerance: {spec}", file=sys.stderr)
+                return 2
+    traj = load_trajectory(ledger_dir)
+    if not traj["runs"]:
+        print(f"no BENCH_*/MULTICHIP_* ledgers under {ledger_dir}",
+              file=sys.stderr)
+        return 2
+    findings = find_regressions(traj, default_tol, tolerances)
+    if "--json" in flags:
+        print(json.dumps({"runs": traj["runs"], "gaps": traj["gaps"],
+                          "points": {k: [[r, v] for r, v in pts]
+                                     for k, pts in traj["points"].items()},
+                          "regressions": findings}, sort_keys=True))
+    else:
+        print(render(traj, findings, show_all="--all" in flags))
+    if "--check" in flags and findings:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -7,6 +7,15 @@ The same analysis ``/profilez`` runs on a live engine
     python tools/trace_report.py /tmp/ds_trace            # terminal tables
     python tools/trace_report.py /tmp/ds_trace --steps 2  # per-step columns
     python tools/trace_report.py /tmp/ds_trace --json     # machine-readable
+    python tools/trace_report.py --timeline export.json   # span-lane render
+
+``--timeline`` renders a TRACE-EVENT EXPORT instead of a device trace:
+anything emitted through the repo's shared perfetto envelope — a
+replica's ``/requestz?format=perfetto`` request spans, a training
+process's ``/requestz?kind=train&format=perfetto`` step timeline, the
+router's hop export, or a ``fleet_dump --trace`` merged session — goes
+through ONE render path (lane summary + recent slices + instants), so
+train and serve timelines read identically.
 
 Accepts any directory containing a ``perfetto_trace.json.gz`` (captures
 made with ``profile_trace`` + this repo's perfetto flag, ``/profilez``, or
@@ -148,6 +157,93 @@ def render(summary: dict) -> str:
     return "\n".join(out)
 
 
+# ---------------------------------------------------------------------------
+# --timeline: render any shared-envelope trace-event export (serve request
+# spans, train step timeline, router hops, fleet_dump --trace merges)
+# ---------------------------------------------------------------------------
+
+
+def load_timeline(path: str) -> dict:
+    """A trace-event export file (plain or gzipped JSON; a bare event
+    list is wrapped)."""
+    import gzip
+
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc}
+    return doc
+
+
+def render_timeline(doc: dict, recent: int = 24) -> str:
+    """ONE code path over the repo's shared perfetto envelope: lanes
+    (process:thread) summarized by span count/total duration/window,
+    the most recent ``recent`` slices named with their trace ids, and
+    instant events listed — whether the export came from a serving
+    request tracer, a training step timeline, a router hop log, or a
+    merged fleet session."""
+    evs = [e for e in (doc.get("traceEvents") or [])
+           if isinstance(e, dict)]
+    pname = {}
+    tname = {}
+    for e in evs:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pname[e.get("pid")] = (e.get("args") or {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tname[(e.get("pid"), e.get("tid"))] = \
+                (e.get("args") or {}).get("name", "")
+
+    def lane(e):
+        p = pname.get(e.get("pid"), f"pid {e.get('pid')}")
+        t = tname.get((e.get("pid"), e.get("tid")), f"tid {e.get('tid')}")
+        return f"{p}:{t}"
+
+    spans = [e for e in evs if e.get("ph") == "X"]
+    instants = [e for e in evs if e.get("ph") == "i"]
+    other = doc.get("otherData") or {}
+    out = [f"timeline: {len(spans)} span(s), {len(instants)} instant(s) "
+           f"across {len(pname) or 1} process(es)"]
+    if other.get("clock_anchor_unix") is not None:
+        out.append(f"clock: anchor_unix={other['clock_anchor_unix']}"
+                   + (f" source={other['clock_source']}"
+                      if other.get("clock_source") else "")
+                   + (f" reference={other['reference']}"
+                      if other.get("reference") else ""))
+    lanes = {}
+    for e in spans:
+        rec = lanes.setdefault(lane(e), [0, 0.0, float("inf"), 0.0])
+        rec[0] += 1
+        rec[1] += float(e.get("dur") or 0.0)
+        ts = float(e.get("ts") or 0.0)
+        rec[2] = min(rec[2], ts)
+        rec[3] = max(rec[3], ts + float(e.get("dur") or 0.0))
+    rows = [[name, str(c), _fmt_s(tot * 1e-6),
+             _fmt_s(max(0.0, hi - lo) * 1e-6)]
+            for name, (c, tot, lo, hi) in sorted(lanes.items())]
+    if rows:
+        out.append("")
+        out.append(_table(["lane", "spans", "busy", "window"], rows))
+    if spans:
+        srows = []
+        for e in sorted(spans, key=lambda e: float(e.get("ts") or 0.0)
+                        )[-max(0, recent):]:
+            args = e.get("args") or {}
+            srows.append([str(e.get("name")), lane(e),
+                          f"{float(e.get('ts') or 0.0):.1f}",
+                          _fmt_s(float(e.get("dur") or 0.0) * 1e-6),
+                          str(args.get("trace", ""))[:8]])
+        out.append("")
+        out.append(_table(["span", "lane", "ts_us", "dur", "trace"],
+                          srows))
+    for e in sorted(instants, key=lambda e: float(e.get("ts") or 0.0)):
+        out.append(f"@{float(e.get('ts') or 0.0):.1f}us {e.get('name')} "
+                   f"{json.dumps(e.get('args') or {}, sort_keys=True)}")
+    return "\n".join(out)
+
+
 def _selftest_trace(path: str) -> str:
     """Bundled synthetic fixture: one device process with two 100us steps
     (fwd_bwd ops with a nested all_gather, an optimizer fusion on the
@@ -216,6 +312,35 @@ def _selftest_in(d: str) -> int:
     text = render(summary)
     assert "fwd_bwd" in text and "all_gather" in text
     print(text)
+    # --timeline: the SAME renderer over a serve-shaped and a
+    # train-shaped export (the shared-envelope contract)
+    serve_doc = {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "ds_requests"}},
+        {"ph": "M", "pid": 1, "tid": 3, "name": "thread_name",
+         "args": {"name": "req 3"}},
+        {"ph": "X", "pid": 1, "tid": 3, "ts": 10.0, "dur": 40.0,
+         "name": "decode", "args": {"trace": "ab" * 16}}],
+        "otherData": {"clock_anchor_unix": 10.0,
+                      "clock_source": "process"}}
+    train_doc = {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "ds_train_steps"}},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "steps"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 100.0,
+         "name": "step 1", "args": {"step": 1, "bubble_share": 0.25}},
+        {"ph": "i", "pid": 1, "tid": 4, "ts": 50.0, "s": "t",
+         "name": "anomaly_skip", "args": {"step": 1}}],
+        "otherData": {"clock_anchor_unix": 10.0,
+                      "clock_source": "process"}}
+    st = render_timeline(serve_doc)
+    assert "ds_requests:req 3" in st and "decode" in st \
+        and "abababab" in st, st
+    tt = render_timeline(train_doc)
+    assert "ds_train_steps:steps" in tt and "step 1" in tt \
+        and "anomaly_skip" in tt, tt
+    print(tt)
     print("trace_report selftest: OK")
     return 0
 
@@ -231,9 +356,25 @@ def main(argv: List[str]) -> int:
     ap.add_argument("--steps", type=int, default=None,
                     help="steps in the captured window (per-step column; "
                          "inferred from ds_optimizer_step spans when absent)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="render the argument as a trace-event EXPORT "
+                         "(/requestz perfetto, step timeline, or a "
+                         "fleet_dump --trace merge) instead of a device "
+                         "trace dir")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of tables")
     ns = ap.parse_args(argv[1:])
+    if ns.timeline:
+        try:
+            doc = load_timeline(ns.trace)
+        except (OSError, ValueError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        if ns.json:
+            print(json.dumps(doc, sort_keys=True))
+        else:
+            print(render_timeline(doc))
+        return 0
     try:
         summary = device_trace.summarize_trace(ns.trace, steps=ns.steps)
     except FileNotFoundError as exc:
