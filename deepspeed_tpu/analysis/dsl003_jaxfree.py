@@ -39,7 +39,7 @@ from .engine import FileContext, Finding, Project, Rule, register_rule
 JAXFREE_TOOLS = ("router.py", "fleet_dump.py", "ckpt_verify.py",
                  "train_supervisor.py", "serve_supervisor.py",
                  "trace_report.py", "metrics_dump.py", "perf_ledger.py",
-                 "dslint.py")
+                 "goodput_report.py", "dslint.py")
 BANNED_ROOTS = {"jax", "jaxlib", "flax", "optax"}
 PACKAGE = "deepspeed_tpu"
 

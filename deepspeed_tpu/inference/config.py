@@ -108,6 +108,15 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     max_queue_depth: int = 0
     shed_retry_after_s: float = 1.0
     request_deadline_s: float = 0.0
+    # Goodput ledger + SLO burn rules (monitor/goodput.py,
+    # docs/OBSERVABILITY.md "Goodput ledger").  ``goodput`` mirrors the
+    # training GoodputConfig as a plain dict ({enabled, path,
+    # min_tick_interval_s}); ``slo`` maps rule name -> threshold
+    # (goodput_ratio MIN, ttft_p99_s / shed_ratio MAX).  Setting either
+    # enables the ledger for the serving engine; DSTPU_RUNLEDGER enables
+    # it regardless (the supervisor channel).
+    goodput: Optional[Dict[str, Any]] = None
+    slo: Optional[Dict[str, float]] = None
 
     def __init__(self, **kwargs):
         # legacy alias: mp_size -> tensor_parallel.tp_size

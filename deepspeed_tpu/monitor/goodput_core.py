@@ -1,0 +1,401 @@
+"""Run-level goodput ledger core: telescoping wall-clock attribution.
+
+The PR 7 request-trace idiom (every microsecond of a request belongs to
+exactly one phase, phases sum to latency by construction) lifted to RUN
+scope: every second of a run's wall clock is attributed to exactly one
+category of the closed set :data:`CATEGORIES`, and the category sum
+telescopes to ``now - run_start`` — the ZeRO-Infinity (arXiv:2104.07857)
+/ T3 (arXiv:2401.16677) exposed-time framing as an always-on ledger
+instead of a one-off analysis.
+
+Attribution model
+-----------------
+A region STACK plus a cursor.  Time between two transitions belongs to
+the innermost open region (the stack top); with no region open it is
+``idle``.  ``idle`` is never accumulated directly — it is the RESIDUAL
+``wall - sum(measured categories)`` computed at snapshot time, which
+makes the telescoping identity exact by construction instead of "exact
+up to N float additions" (the residual absorbs fp drift; it can read a
+few ulps negative on a run with zero true idle, documented).  ``shift``
+moves already-attributed seconds between categories (exposed comm out
+of compute, a skipped step's compute into ``anomaly_skip``) and
+preserves the sum.
+
+Persistence / stitching
+-----------------------
+One process appends rows to ``runledger.jsonl`` (``append_row``): a
+``start`` row at enable, ``tick`` rows carrying cumulative totals, and
+``event``/``slo_burn``/``supervisor`` rows.  Rows survive process death
+by being flushed per append.  :func:`stitch` folds any number of
+incarnations (same ``run_id``, increasing ``DS_SUPERVISOR_RESTART``)
+into one run timeline: per-incarnation uptime is the last tick's
+``uptime_s``, the gap between an incarnation's last-known-alive unix
+time and the next incarnation's start is ``restart_downtime``, and the
+stitched wall is ``sum(uptimes) + sum(gaps)`` — so the stitched ledger
+telescopes by construction too.
+
+Pure stdlib ON PURPOSE: ``tools/goodput_report.py`` loads this file by
+path (the ``elasticity/supervisor.py`` idiom) inside DSL003's jax-free
+import closure.  Do not import jax, numpy, or any ``deepspeed_tpu``
+module here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+# the closed category set (docs/OBSERVABILITY.md "Goodput ledger");
+# order is the render order: productive first, overheads, then residual
+CATEGORIES = (
+    "compute",           # device dispatch windows that advanced training/serving
+    "exposed_comm",      # analytic/device-measured comm NOT hidden under compute
+    "host_stall",        # dataloader waits + offload host relay
+    "checkpoint_save",
+    "checkpoint_load",
+    "recompile",         # step-program (re)builds
+    "anomaly_skip",      # compute spent on steps the anomaly select dropped
+    "rollback",          # anomaly rollback windows (minus the nested load)
+    "restart_downtime",  # process-death -> next incarnation healthy (stitch)
+    "drain",             # serving drain windows (minus nested compute)
+    "idle",              # the residual: wall - everything above
+)
+
+# categories that count toward the goodput ratio (produced tokens)
+GOOD_CATEGORIES = ("compute",)
+
+# the telescoping contract: |sum(categories) - wall| <= REL_TOL * wall
+REL_TOL = 1e-9
+
+_MEASURED = tuple(c for c in CATEGORIES if c != "idle")
+
+
+def _utcnow_iso(t_unix: float) -> str:
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        t_unix, datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+class LedgerCore:
+    """The in-process attribution state machine (one incarnation).
+
+    All times are in ONE caller-chosen monotonic clock domain
+    (``time.perf_counter`` in the engines); unix time only appears in
+    the jsonl rows, never in attribution arithmetic.
+    """
+
+    def __init__(self, start: float):
+        self.start = float(start)
+        self._cursor = float(start)
+        # measured categories only; idle is the snapshot residual
+        self.totals: Dict[str, float] = {c: 0.0 for c in _MEASURED}
+        self._stack: List[List[Any]] = []   # frames: [category, direct_s]
+        self.tokens = 0
+        self.steps = 0
+
+    # -- attribution ----------------------------------------------------
+    def _advance(self, t: float) -> None:
+        dt = t - self._cursor
+        if dt <= 0.0:       # clock retreat / duplicate edge: nothing to do
+            return
+        if self._stack:
+            frame = self._stack[-1]
+            self.totals[frame[0]] += dt
+            frame[1] += dt
+        self._cursor = t    # stack empty: the span is idle (residual)
+
+    def push(self, category: str, t: float) -> None:
+        if category not in self.totals:
+            raise ValueError(f"unknown ledger category {category!r} "
+                             f"(closed set: {CATEGORIES})")
+        self._advance(t)
+        self._stack.append([category, 0.0])
+
+    def pop(self, t: float) -> Tuple[Optional[str], float]:
+        """Close the innermost region; returns ``(category, direct_s)``
+        where ``direct_s`` excludes time attributed to nested regions.
+        Popping with no region open is a no-op (crash tolerance)."""
+        self._advance(t)
+        if not self._stack:
+            return None, 0.0
+        cat, direct = self._stack.pop()
+        return cat, direct
+
+    def shift(self, src: str, dst: str, seconds: float) -> float:
+        """Reattribute up to ``seconds`` from ``src`` to ``dst`` (clamped
+        at what ``src`` holds); sum-preserving.  Returns the moved amount."""
+        if src not in self.totals or dst not in self.totals:
+            raise ValueError(f"unknown ledger category in shift "
+                             f"({src!r} -> {dst!r})")
+        moved = min(float(seconds), self.totals[src])
+        if moved <= 0.0:
+            return 0.0
+        self.totals[src] -= moved
+        self.totals[dst] += moved
+        return moved
+
+    # -- reading --------------------------------------------------------
+    def snapshot(self, now: float) -> Dict[str, Any]:
+        """Point-in-time totals including the open region's accrual and
+        the idle residual; does not mutate attribution state."""
+        cats = dict(self.totals)
+        dt = now - self._cursor
+        if dt > 0.0 and self._stack:
+            cats[self._stack[-1][0]] += dt
+        wall = max(0.0, now - self.start)
+        measured = sum(cats.values())
+        cats["idle"] = wall - measured
+        good = sum(cats[c] for c in GOOD_CATEGORIES)
+        return {"wall_s": wall,
+                "categories": {c: cats[c] for c in CATEGORIES},
+                "goodput_ratio": (good / wall) if wall > 0.0 else 0.0,
+                "tokens": self.tokens,
+                "steps": self.steps,
+                "open_regions": [f[0] for f in self._stack]}
+
+
+# ---------------------------------------------------------------------------
+# analytic comm time (the bench-honesty satellite): a comm-plan entry list
+# -> seconds at an assumed flat link bandwidth.  Entries are the
+# OverlapSchedule tuples ``(op, calls, nbytes, dtype, world[, dense])``
+# with nbytes the TOTAL payload of the entry's calls (CommMetrics.commit
+# semantics).
+# ---------------------------------------------------------------------------
+def analytic_comm_seconds(entries: Iterable[Sequence[Any]],
+                          gbps: float) -> float:
+    if gbps <= 0.0:
+        return 0.0
+    total_bytes = 0
+    for e in entries or ():
+        try:
+            total_bytes += int(e[2])
+        except (IndexError, TypeError, ValueError):
+            continue
+    return total_bytes / (gbps * 1e9)
+
+
+# ---------------------------------------------------------------------------
+# jsonl persistence (append-only; one row per line; flushed per append)
+# ---------------------------------------------------------------------------
+def append_row(path: str, row: Dict[str, Any]) -> None:
+    """Append one ledger row; crash-durable (flush + per-line).  Write
+    failures are swallowed — a full disk must not take the run down."""
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as fh:
+            fh.write(json.dumps(row, sort_keys=True, default=str) + "\n")
+            fh.flush()
+    except OSError:
+        pass
+
+
+def read_rows(path: str) -> List[Dict[str, Any]]:
+    """All parseable rows; a torn final line (crash mid-append) is
+    skipped, not fatal."""
+    rows: List[Dict[str, Any]] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def start_row(run_id: str, incarnation: int, role: str,
+              t_unix: float) -> Dict[str, Any]:
+    return {"v": SCHEMA_VERSION, "kind": "start", "run_id": run_id,
+            "incarnation": int(incarnation), "role": role,
+            "pid": os.getpid(), "t_unix": float(t_unix)}
+
+
+def tick_row(run_id: str, incarnation: int, t_unix: float,
+             uptime_s: float, snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    return {"v": SCHEMA_VERSION, "kind": "tick", "run_id": run_id,
+            "incarnation": int(incarnation), "t_unix": float(t_unix),
+            "uptime_s": float(uptime_s),
+            "categories": dict(snapshot["categories"]),
+            "goodput_ratio": snapshot["goodput_ratio"],
+            "tokens": snapshot["tokens"], "steps": snapshot["steps"]}
+
+
+def event_row(run_id: str, incarnation: int, event: str, event_id: str,
+              t_unix: float, dur_s: Optional[float] = None,
+              **extra: Any) -> Dict[str, Any]:
+    row = {"v": SCHEMA_VERSION, "kind": "event", "run_id": run_id,
+           "incarnation": int(incarnation), "event": event,
+           "event_id": event_id, "t_unix": float(t_unix)}
+    if dur_s is not None:
+        row["dur_s"] = float(dur_s)
+    row.update(extra)
+    return row
+
+
+def slo_burn_row(run_id: str, incarnation: int, rule: str, observed: float,
+                 target: float, t_unix: float) -> Dict[str, Any]:
+    return {"v": SCHEMA_VERSION, "kind": "slo_burn", "run_id": run_id,
+            "incarnation": int(incarnation), "rule": rule,
+            "observed": float(observed), "target": float(target),
+            "t_unix": float(t_unix)}
+
+
+def supervisor_row(run_id: str, event: str, t_unix: float,
+                   **extra: Any) -> Dict[str, Any]:
+    row = {"v": SCHEMA_VERSION, "kind": "supervisor", "run_id": run_id,
+           "event": event, "t_unix": float(t_unix)}
+    row.update(extra)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# cross-incarnation stitching
+# ---------------------------------------------------------------------------
+def stitch(rows: Iterable[Dict[str, Any]],
+           run_id: Optional[str] = None) -> Dict[str, Any]:
+    """Fold ledger rows into ONE run report.
+
+    Incarnation boundaries come from ``start`` rows (in file order; the
+    jsonl is append-only so file order IS time order).  Per-incarnation
+    truth is its LAST tick; the window between an incarnation's
+    last-known-alive unix time (``start.t_unix + uptime_s``) and the
+    next incarnation's start is ``restart_downtime`` (clamped >= 0 —
+    clock skew must not create negative downtime).  Stitched wall =
+    ``sum(uptimes) + sum(gaps)``, so the stitched category sum
+    telescopes by construction.
+    """
+    incs: List[Dict[str, Any]] = []
+    burns: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    supervisor: List[Dict[str, Any]] = []
+    rid = run_id
+    for row in rows:
+        kind = row.get("kind")
+        if run_id is not None and row.get("run_id") not in (None, run_id):
+            continue
+        if rid is None and row.get("run_id"):
+            rid = row["run_id"]
+        if kind == "start":
+            incs.append({"incarnation": row.get("incarnation", len(incs)),
+                         "role": row.get("role", "?"),
+                         "start_unix": float(row.get("t_unix", 0.0)),
+                         "uptime_s": 0.0,
+                         "categories": {c: 0.0 for c in CATEGORIES},
+                         "goodput_ratio": 0.0, "tokens": 0, "steps": 0,
+                         "ticks": 0})
+        elif kind == "tick" and incs:
+            cur = incs[-1]
+            cur["uptime_s"] = float(row.get("uptime_s", cur["uptime_s"]))
+            cats = row.get("categories") or {}
+            cur["categories"] = {c: float(cats.get(c, 0.0))
+                                 for c in CATEGORIES}
+            cur["goodput_ratio"] = row.get("goodput_ratio", 0.0)
+            cur["tokens"] = row.get("tokens", cur["tokens"])
+            cur["steps"] = row.get("steps", cur["steps"])
+            cur["ticks"] += 1
+        elif kind == "slo_burn":
+            burns.append(row)
+        elif kind == "event":
+            events.append(row)
+        elif kind == "supervisor":
+            supervisor.append(row)
+
+    gaps: List[float] = []
+    for prev, cur in zip(incs, incs[1:]):
+        dead_at = prev["start_unix"] + prev["uptime_s"]
+        gaps.append(max(0.0, cur["start_unix"] - dead_at))
+    totals = {c: 0.0 for c in CATEGORIES}
+    for inc in incs:
+        for c in CATEGORIES:
+            totals[c] += inc["categories"][c]
+    totals["restart_downtime"] += sum(gaps)
+    wall = sum(inc["uptime_s"] for inc in incs) + sum(gaps)
+    good = sum(totals[c] for c in GOOD_CATEGORIES)
+    burn_counts: Dict[str, int] = {}
+    for b in burns:
+        burn_counts[b.get("rule", "?")] = burn_counts.get(
+            b.get("rule", "?"), 0) + 1
+    return {"schema_version": SCHEMA_VERSION,
+            "run_id": rid or "?",
+            "incarnations": incs,
+            "restart_gaps_s": gaps,
+            "wall_s": wall,
+            "categories": totals,
+            "goodput_ratio": (good / wall) if wall > 0.0 else 0.0,
+            "tokens": sum(inc["tokens"] for inc in incs),
+            "steps": max([inc["steps"] for inc in incs] or [0]),
+            "slo_burns": burn_counts,
+            "events": events,
+            "supervisor": supervisor}
+
+
+def telescopes(report_or_snapshot: Dict[str, Any],
+               rel_tol: float = REL_TOL) -> bool:
+    """The acceptance predicate: category sum == wall at ``rel_tol``."""
+    wall = float(report_or_snapshot["wall_s"])
+    total = sum(report_or_snapshot["categories"].values())
+    return abs(total - wall) <= max(rel_tol * max(abs(wall), 1.0), 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# rendering (tools/goodput_report.py + /goodputz?format=text)
+# ---------------------------------------------------------------------------
+def render_lines(report: Dict[str, Any]) -> List[str]:
+    wall = report["wall_s"]
+    cats = report["categories"]
+    lines = [f"run {report['run_id']}: wall {wall:.3f}s over "
+             f"{len(report.get('incarnations', []))} incarnation(s), "
+             f"goodput {report['goodput_ratio']:.4f}"]
+    for c in CATEGORIES:
+        v = cats.get(c, 0.0)
+        share = (v / wall) if wall > 0 else 0.0
+        bar = "#" * int(round(share * 40))
+        lines.append(f"  {c:<17} {v:>10.3f}s  {share:>7.2%}  {bar}")
+    total = sum(cats.values())
+    lines.append(f"  {'sum':<17} {total:>10.3f}s  "
+                 f"(telescopes: {telescopes(report)})")
+    if report.get("tokens"):
+        lines.append(f"  tokens {report['tokens']}  steps "
+                     f"{report.get('steps', 0)}  "
+                     f"tok/s(wall) {report['tokens'] / wall:.1f}" if wall > 0
+                     else f"  tokens {report['tokens']}")
+    for inc in report.get("incarnations", []):
+        lines.append(f"  incarnation {inc['incarnation']} ({inc['role']}): "
+                     f"up {inc['uptime_s']:.3f}s from "
+                     f"{_utcnow_iso(inc['start_unix'])}, "
+                     f"{inc['ticks']} tick(s)")
+    for i, g in enumerate(report.get("restart_gaps_s", [])):
+        lines.append(f"  restart gap {i}: {g:.3f}s")
+    if report.get("slo_burns"):
+        for rule, n in sorted(report["slo_burns"].items()):
+            lines.append(f"  slo_burn {rule}: {n} breach(es)")
+    return lines
+
+
+def diff_lines(a: Dict[str, Any], b: Dict[str, Any]) -> List[str]:
+    """Category-share diff between two runs (B relative to A)."""
+    wa, wb = a["wall_s"], b["wall_s"]
+    lines = [f"goodput {a['goodput_ratio']:.4f} -> {b['goodput_ratio']:.4f} "
+             f"({b['goodput_ratio'] - a['goodput_ratio']:+.4f}) | wall "
+             f"{wa:.3f}s -> {wb:.3f}s"]
+    for c in CATEGORIES:
+        sa = (a["categories"].get(c, 0.0) / wa) if wa > 0 else 0.0
+        sb = (b["categories"].get(c, 0.0) / wb) if wb > 0 else 0.0
+        if sa == 0.0 and sb == 0.0:
+            continue
+        lines.append(f"  {c:<17} {sa:>7.2%} -> {sb:>7.2%}  "
+                     f"({sb - sa:+.2%})")
+    return lines

@@ -35,6 +35,10 @@ daemon thread:
   "timeout"?}`` blocks this worker thread until the request finishes and
   returns its tokens; 503 while the engine drains (the router re-sends
   elsewhere — no request is dropped on a drain).
+- ``GET /goodputz`` — run-level goodput ledger snapshot
+  (monitor/goodput.py): telescoping wall-clock attribution over the
+  closed category set plus the goodput ratio; ``{"enabled": false}``
+  when no ledger is enabled in this process.
 - ``GET /requestz`` — per-request span timelines from the request tracer
   (monitor/request_trace.py): recent completions, slowest exemplars, and
   the tail-attribution summary.  ``?n=`` bounds the lists;
@@ -132,6 +136,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        elif path in ("/goodputz", "/goodputz/"):
+            # run-level goodput ledger snapshot (monitor/goodput.py):
+            # telescoping wall-clock attribution for the live process —
+            # {"enabled": false} when no ledger is enabled, else the
+            # category breakdown + goodput_ratio (docs/OBSERVABILITY.md
+            # "Goodput ledger").
+            from deepspeed_tpu.monitor.goodput import get_goodput_ledger
+
+            body = json.dumps(get_goodput_ledger().snapshot(),
+                              sort_keys=True).encode()
+            ctype = "application/json"
         elif path in ("/healthz", "/healthz/"):
             # READINESS, not liveness: 503 while draining (or any other
             # not-ready reason) is the router's stop-sending signal —
@@ -151,9 +166,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         elif path == "/":
-            body = json.dumps({"endpoints": ["/healthz", "/metrics",
-                                             "/statz", "/profilez",
-                                             "/requestz", "/generate"]}
+            body = json.dumps({"endpoints": ["/goodputz", "/healthz",
+                                             "/metrics", "/statz",
+                                             "/profilez", "/requestz",
+                                             "/generate"]}
                               ).encode()
             ctype = "application/json"
         else:

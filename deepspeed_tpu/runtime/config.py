@@ -385,6 +385,46 @@ class WatchdogConfig(DeepSpeedConfigModel):
     rearm: bool = False              # reset after a trip (watch for repeats)
 
 
+class GoodputConfig(DeepSpeedConfigModel):
+    """``goodput`` section (TPU extension; docs/OBSERVABILITY.md "Goodput
+    ledger"): run-level wall-clock attribution to the closed category set
+    (compute / exposed_comm / host_stall / checkpoint_* / recompile /
+    anomaly_skip / rollback / restart_downtime / drain / idle), persisted
+    as an append-only ``runledger.jsonl`` and exported as
+    ``ds_run_goodput_ratio`` + ``ds_run_time_seconds{category=}``.
+    ``DSTPU_RUNLEDGER=<path>`` in the environment enables the ledger even
+    when this section is absent (the supervisors' channel).
+    ``assumed_comm_gbps`` prices the analytic comm plan into
+    ``exposed_comm`` seconds on hosts with no device capture (the
+    ZeRO-Infinity bandwidth-model style; stamped into bench output as
+    ``source=analytic`` for honesty)."""
+
+    enabled: bool = False
+    path: Optional[str] = None            # default: ./runledger.jsonl
+    min_tick_interval_s: float = 0.0      # 0 = persist every boundary tick
+    assumed_comm_gbps: float = 100.0      # analytic comm pricing (per host)
+
+
+class SloConfig(DeepSpeedConfigModel):
+    """``slo`` section (TPU extension; docs/OBSERVABILITY.md "Goodput
+    ledger"): declarative burn-rate rules evaluated at the ledger's
+    boundary ticks.  A breached rule emits one flight-recorder
+    ``slo_burn`` event, increments ``ds_slo_burn_total{rule=}``, and
+    appends an ``slo_burn`` ledger row per evaluation.  ``goodput_ratio``
+    is a MIN threshold; ``ttft_p99_s`` and ``shed_ratio`` are MAX
+    thresholds read from the serving registry series."""
+
+    goodput_ratio: Optional[float] = None
+    ttft_p99_s: Optional[float] = None
+    shed_ratio: Optional[float] = None
+
+    def rules(self) -> Dict[str, float]:
+        return {k: float(v) for k, v in
+                (("goodput_ratio", self.goodput_ratio),
+                 ("ttft_p99_s", self.ttft_p99_s),
+                 ("shed_ratio", self.shed_ratio)) if v is not None}
+
+
 class AnomalyConfig(DeepSpeedConfigModel):
     """``anomaly_detection`` section (TPU extension; docs/RESILIENCE.md
     "Elastic training"): bf16/fp32 step-anomaly containment — the fp16
@@ -622,6 +662,8 @@ class DeepSpeedConfig:
         self.comm_quantization = CommQuantizationConfig(
             **d.get("comm_quantization", {}))
         self.flight_recorder = FlightRecorderConfig(**d.get("flight_recorder", {}))
+        self.goodput = GoodputConfig(**d.get("goodput", {}))
+        self.slo = SloConfig(**d.get("slo", {}))
         self.watchdog = WatchdogConfig(**d.get("watchdog", {}))
         self.anomaly_detection = AnomalyConfig(**d.get("anomaly_detection", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))

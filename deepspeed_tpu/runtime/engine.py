@@ -43,6 +43,8 @@ from deepspeed_tpu import comm
 from deepspeed_tpu.comm.mesh import batch_sharding, get_global_mesh, mesh_from_config
 from deepspeed_tpu.monitor.comms import comm_metrics
 from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.goodput import get_goodput_ledger
+from deepspeed_tpu.monitor.goodput_core import analytic_comm_seconds
 from deepspeed_tpu.monitor.memory import MemoryTelemetry, device_resident_bytes
 from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.monitor.monitor import MonitorMaster
@@ -667,6 +669,22 @@ class DeepSpeedEngine:
             self._flight.enable(capacity=frc.capacity, dump_dir=frc.dump_dir)
             if frc.on_signal:
                 self._flight.install_signal_handler()
+        # -- run-level goodput ledger (docs/OBSERVABILITY.md "Goodput
+        # ledger"): every second of run wall clock attributed to one
+        # category, telescoping to now - run_start.  Config block or the
+        # DSTPU_RUNLEDGER env (the supervisors' per-incarnation channel).
+        self._goodput = get_goodput_ledger()
+        gpc = self.config.goodput
+        if gpc.enabled or os.environ.get("DSTPU_RUNLEDGER"):
+            self._goodput.enable(
+                path=gpc.path, role="train",
+                min_tick_interval_s=gpc.min_tick_interval_s,
+                slo_rules=self.config.slo.rules() or None)
+        self._gp_comm_gbps = gpc.assumed_comm_gbps
+        # per-boundary compute seconds (lag ring for the anomaly-skip
+        # reattribution: the trip classifies the PREVIOUS boundary)
+        self._gp_compute_since_boundary = 0.0
+        self._gp_step_compute = [0.0, 0.0]   # [prev boundary, last boundary]
 
         # -- preemption grace-window handling (docs/RESILIENCE.md): the
         # SIGTERM handler only latches a flag; the next optimizer boundary
@@ -1537,6 +1555,15 @@ class DeepSpeedEngine:
     def _compile_steps(self) -> None:
         self._flight.record("compile", what="train step functions",
                             zero_stage=self.zero_stage)
+        # ledger: step-program (re)builds are `recompile`, not compute —
+        # nested pushes (an elastic rescale recompiling mid-run) stack
+        self._goodput.push("recompile")
+        try:
+            self._compile_steps_inner()
+        finally:
+            self._goodput.pop()
+
+    def _compile_steps_inner(self) -> None:
         self._anomaly_select = False   # set by the paths that compile the bound arg
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
@@ -2333,6 +2360,12 @@ class DeepSpeedEngine:
                     self._flops_since_boundary += self._flops_per_step_fn(
                         int(shape[0]) * int(shape[1]), int(shape[1]))
                     break
+        if self._goodput.enabled:
+            for leaf in jax.tree_util.tree_leaves(batch):
+                shape = getattr(leaf, "shape", ())
+                if len(shape) >= 2:    # [micro, seq, ...] -> tokens
+                    self._goodput.add_tokens(int(shape[0]) * int(shape[1]))
+                    break
 
     def _boundary_telemetry(self) -> None:
         """Optimizer-boundary accounting: MFU/TFLOPS gauges off the
@@ -2349,6 +2382,23 @@ class DeepSpeedEngine:
             self._timeline.boundary(self._host_steps, time.perf_counter(),
                                     comm_plan=self._comm_plan,
                                     bubble_share=self._pp_bubble_share())
+        if self._goodput.enabled:
+            # goodput ledger boundary tick (own switch, before the
+            # registry gate): price the step's analytic comm plan into
+            # `exposed_comm` (ZeRO-Infinity bandwidth-model style — the
+            # honest CPU-host estimate; device captures refine the bench
+            # series, not this attribution), roll the per-step compute
+            # window for the lag-1 anomaly reattribution, and persist.
+            step_compute = self._gp_compute_since_boundary
+            self._gp_compute_since_boundary = 0.0
+            exposed = self._gp_analytic_exposed_comm_s()
+            if exposed > 0.0:
+                exposed = self._goodput.shift(
+                    "compute", "exposed_comm", min(exposed, step_compute))
+                step_compute -= exposed
+            self._gp_step_compute = [self._gp_step_compute[1], step_compute]
+            self._goodput.set_steps(self._host_steps)
+            self._goodput.tick()
         if not get_registry().enabled:
             return
         self._flops_meter.observe_boundary(flops or None,
@@ -2375,6 +2425,25 @@ class DeepSpeedEngine:
             # between passes cannot make a live scrape read "overlap: off"
             get_registry().gauge("ds_overlap_buckets").set(
                 len(self._overlap_sched.bucket_infos()))
+
+    def _gp_analytic_exposed_comm_s(self) -> float:
+        """Analytic EXPOSED comm seconds for one optimizer boundary: the
+        step's comm-plan bytes (gas micro executions + the boundary
+        entries) priced at ``goodput.assumed_comm_gbps``, scaled by the
+        overlap schedule's non-hideable fraction when bucketed overlap is
+        active (T3-style exposed-time accounting; arXiv:2401.16677).
+        Zero when no plan exists — nothing is invented."""
+        if self._comm_plan is None:
+            return 0.0
+        gas = self.config.gradient_accumulation_steps
+        total = (analytic_comm_seconds(self._comm_plan["micro"],
+                                       self._gp_comm_gbps) * gas
+                 + analytic_comm_seconds(self._comm_plan["boundary"],
+                                         self._gp_comm_gbps))
+        if self._overlap_sched is not None:
+            total *= max(0.0, 1.0
+                         - self._overlap_sched.hideable_comm_fraction())
+        return total
 
     def _pp_bubble_share(self) -> Optional[float]:
         """Analytic pipeline bubble fraction of the step's schedule (the
@@ -2632,6 +2701,11 @@ class DeepSpeedEngine:
         # detector's trip kind rides as "anomaly"
         trip["anomaly"] = trip.pop("kind")
         self._flight.record("anomaly_skip", **trip)
+        # ledger: the skipped step's compute produced nothing — move the
+        # classified (lag-1) boundary's compute window to `anomaly_skip`
+        self._goodput.shift("compute", "anomaly_skip",
+                            self._gp_step_compute[0])
+        self._gp_step_compute[0] = 0.0
         if self._timeline.enabled:
             self._timeline.event("anomaly_skip", time.perf_counter(),
                                  **trip)
@@ -2673,7 +2747,14 @@ class DeepSpeedEngine:
                          "skips only")
             a.consecutive = 0        # re-arm the ladder, don't re-enter per step
             return
-        ckpt_dir, _ = self.load_checkpoint(save_dir)
+        # ledger: the rollback window (flight dump + restore) is its own
+        # category; the nested load_checkpoint region attributes its own
+        # time to checkpoint_load, the remainder stays `rollback`
+        self._goodput.push("rollback")
+        try:
+            ckpt_dir, _ = self.load_checkpoint(save_dir)
+        finally:
+            self._goodput.pop()
         if ckpt_dir is None:
             logger.error("anomaly: nothing loadable in %s; continuing with "
                          "per-step skips only", save_dir)
@@ -2831,39 +2912,43 @@ class DeepSpeedEngine:
             self._maybe_start_aux_trace()
         self.timers(SynchronizedWallClockTimer.FORWARD).start()
         self._rng, rng = jax.random.split(self._rng)
-        if self._param_offload:
-            unpacked = (self._unpack_lm_batch(batch)
-                        if self._streamed is not None else None)
-            if unpacked is not None:
-                toks, labels, mask = unpacked
-                if self._host_grad_acc is None:
-                    self._host_grad_acc = jax.tree.map(
-                        lambda a: np.zeros(a.shape, np.float32),
-                        self._np_params)
-                loss = self._streamed.run(self._np_params, toks, labels,
-                                          mask, rng, self._host_grad_acc)
+        self._goodput.push("compute")
+        try:
+            if self._param_offload:
+                unpacked = (self._unpack_lm_batch(batch)
+                            if self._streamed is not None else None)
+                if unpacked is not None:
+                    toks, labels, mask = unpacked
+                    if self._host_grad_acc is None:
+                        self._host_grad_acc = jax.tree.map(
+                            lambda a: np.zeros(a.shape, np.float32),
+                            self._np_params)
+                    loss = self._streamed.run(self._np_params, toks, labels,
+                                              mask, rng, self._host_grad_acc)
+                else:
+                    loss, grads = self._pofwdbwd_fn(self.state.params, batch, rng)
+                    self._accum_host_grads(grads)
+                    if self.flops_profiler is not None:
+                        self._profile_probes["fwdbwd"] = (
+                            self._pofwdbwd_fn, (self.state.params, batch, rng))
             else:
-                loss, grads = self._pofwdbwd_fn(self.state.params, batch, rng)
-                self._accum_host_grads(grads)
+                self._check_overlap_batch(batch)
                 if self.flops_profiler is not None:
-                    self._profile_probes["fwdbwd"] = (
-                        self._pofwdbwd_fn, (self.state.params, batch, rng))
-        else:
-            self._check_overlap_batch(batch)
-            if self.flops_profiler is not None:
-                self._profile_probes["accum"] = (self._accum_fn,
-                                                 (self.state, batch, rng))
-            t0 = (time.perf_counter()
-                  if self._comm_plan is not None and comm_metrics.active
-                  else 0.0)
-            # host-timeline twin of the in-jit ds_fwd_bwd named scope: on
-            # backends whose trace export drops compiled-op scope names
-            # (CPU), the post-processor's degraded mode reads this range
-            with annotate("ds_fwd_bwd"):
-                self.state, loss = self._accum_fn(self.state, batch, rng)
-            if t0:
-                comm_metrics.commit(self._comm_plan["micro"],
-                                    time.perf_counter() - t0)
+                    self._profile_probes["accum"] = (self._accum_fn,
+                                                     (self.state, batch, rng))
+                t0 = (time.perf_counter()
+                      if self._comm_plan is not None and comm_metrics.active
+                      else 0.0)
+                # host-timeline twin of the in-jit ds_fwd_bwd named scope: on
+                # backends whose trace export drops compiled-op scope names
+                # (CPU), the post-processor's degraded mode reads this range
+                with annotate("ds_fwd_bwd"):
+                    self.state, loss = self._accum_fn(self.state, batch, rng)
+                if t0:
+                    comm_metrics.commit(self._comm_plan["micro"],
+                                        time.perf_counter() - t0)
+        finally:
+            self._gp_compute_since_boundary += self._goodput.pop()
         self.timers(SynchronizedWallClockTimer.FORWARD).stop()
         self._micro_telemetry(batch)
         self._micro_count += 1
@@ -2978,6 +3063,7 @@ class DeepSpeedEngine:
         t0 = (time.perf_counter()
               if self._comm_plan is not None and comm_metrics.active
               else 0.0)
+        self._goodput.push("compute")
         try:
             if self._param_offload:
                 gnorm, overflow = self._step_param_offload()
@@ -2994,8 +3080,10 @@ class DeepSpeedEngine:
             # leave the timer re-startable: a caller that catches a
             # mid-step failure and resumes from a checkpoint must not hit
             # "timer already started" on the next boundary
+            self._goodput.pop()
             self.timers(SynchronizedWallClockTimer.STEP).stop(record=False)
             raise
+        self._gp_compute_since_boundary += self._goodput.pop()
         self.timers(SynchronizedWallClockTimer.STEP).stop()
         if t0 and self._comm_plan["boundary"]:
             comm_metrics.commit(self._comm_plan["boundary"],
@@ -3128,6 +3216,10 @@ class DeepSpeedEngine:
                 skipped = True
                 overflow = np.bool_(True)   # steps/scaler record the skip
         if not skipped:
+            # ledger: the host relay (D2H grads -> host optimizer -> H2D
+            # params) is `host_stall`, nested inside step()'s compute
+            # region — the stack attributes this window out of compute
+            self._goodput.push("host_stall")
             flat, treedef = jax.tree_util.tree_flatten(grads)
             for leaf in flat:  # start every D2H now; np.asarray below collects
                 try:
@@ -3186,6 +3278,7 @@ class DeepSpeedEngine:
                 new_leaves.append(_owned_device_put(
                     out.reshape(opt._shapes[i]), shardings[i]))
             opt.end_step()
+            self._goodput.pop()
             if metered:
                 meter.h2d_bytes.inc(h2d)
                 meter.d2h_bytes.inc(d2h)
@@ -3263,6 +3356,7 @@ class DeepSpeedEngine:
               else 0.0)
         # the fused program runs fwd/bwd AND the update in one dispatch:
         # the host range cannot separate them (device scope rows can)
+        self._goodput.push("compute")
         try:
             with annotate("ds_fwd_bwd"):
                 if self._anomaly_select:
@@ -3273,8 +3367,10 @@ class DeepSpeedEngine:
                         self.state, stacked, rng)
         except BaseException:
             # keep the timer re-startable across a caught mid-step failure
+            self._goodput.pop()
             self.timers(SynchronizedWallClockTimer.STEP).stop(record=False)
             raise
+        self._gp_compute_since_boundary += self._goodput.pop()
         self.timers(SynchronizedWallClockTimer.STEP).stop()
         if t0:
             # the fused program runs gas micro-batches + the boundary in one
@@ -3299,6 +3395,13 @@ class DeepSpeedEngine:
                     self._flops_since_boundary += self._flops_per_step_fn(
                         int(shape[0]) * int(shape[1]) * int(shape[2]),
                         int(shape[2]))
+                    break
+        if self._goodput.enabled:
+            for leaf in jax.tree_util.tree_leaves(stacked):
+                shape = getattr(leaf, "shape", ())
+                if len(shape) >= 3:    # [gas, micro, seq, ...] -> tokens
+                    self._goodput.add_tokens(
+                        int(shape[0]) * int(shape[1]) * int(shape[2]))
                     break
         self._last_loss = loss
         self._last_grad_norm = gnorm
@@ -3332,7 +3435,13 @@ class DeepSpeedEngine:
             data_iter = iter(self.training_dataloader)
         self.tput_timer.start()
         gas = self.config.gradient_accumulation_steps
-        micros = [next(data_iter) for _ in range(gas)]
+        # ledger: dataloader wait is `host_stall` — the eager pull below
+        # is exactly the window training blocks on host-side input
+        self._goodput.push("host_stall")
+        try:
+            micros = [next(data_iter) for _ in range(gas)]
+        finally:
+            self._goodput.pop()
 
         def stack(*xs):
             # keep device-resident batches on device (shard_batch reshards
@@ -3419,9 +3528,30 @@ class DeepSpeedEngine:
         ``latest`` naming a tag that still loads."""
         if self.state is None:
             raise RuntimeError("nothing to checkpoint: engine state not initialized")
+        tag = str(tag or f"global_step{self.global_steps}")
+        gp_t0 = time.perf_counter()
+        self._goodput.push("checkpoint_save")
+        try:
+            final_dir = self._save_checkpoint_inner(save_dir, tag,
+                                                    client_state, save_latest)
+        finally:
+            self._goodput.pop()
+        # flight `checkpoint` events carry the save wall time + a ledger
+        # event id, so the ledger's checkpoint_save seconds and the
+        # flight dump reconcile row-by-row (docs/OBSERVABILITY.md)
+        dur_s = round(time.perf_counter() - gp_t0, 6)
+        event_id = self._goodput.note_event("checkpoint_save", dur_s,
+                                            tag=tag)
+        self._flight.record("checkpoint", op="save", tag=tag, dir=final_dir,
+                            dur_s=dur_s, event_id=event_id)
+        log_dist(f"saved checkpoint {final_dir}", ranks=[0])
+        return final_dir
+
+    def _save_checkpoint_inner(self, save_dir: str, tag: str,
+                               client_state: Optional[dict],
+                               save_latest: bool) -> str:
         from deepspeed_tpu.runtime.checkpoint_engine import atomic
 
-        tag = str(tag or f"global_step{self.global_steps}")
         final_dir = os.path.join(save_dir, tag)
         stage_dir = atomic.stage_path(save_dir, tag)
         rank0 = comm.get_rank() == 0
@@ -3500,8 +3630,6 @@ class DeepSpeedEngine:
         comm.barrier()
         get_registry().counter("ds_ckpt_saves_total",
                                "committed checkpoint saves").inc()
-        self._flight.record("checkpoint", tag=tag, dir=final_dir)
-        log_dist(f"saved checkpoint {final_dir}", ranks=[0])
         return final_dir
 
     def _ckpt_gc(self, save_dir: str) -> None:
@@ -3554,6 +3682,28 @@ class DeepSpeedEngine:
         if self.state is None:
             raise RuntimeError("load_checkpoint requires initialized state "
                                "(pass model_parameters or run one batch first)")
+        gp_t0 = time.perf_counter()
+        self._goodput.push("checkpoint_load")
+        try:
+            result = self._load_checkpoint_verified(
+                load_dir, tag, load_optimizer_states,
+                load_lr_scheduler_states, load_module_only)
+        finally:
+            self._goodput.pop()
+        if result[0] is not None:
+            # duration-carrying flight event + ledger event id, the same
+            # reconciliation contract as the save path
+            dur_s = round(time.perf_counter() - gp_t0, 6)
+            event_id = self._goodput.note_event("checkpoint_load", dur_s,
+                                                dir=result[0])
+            self._flight.record("checkpoint", op="load", dir=result[0],
+                                dur_s=dur_s, event_id=event_id)
+        return result
+
+    def _load_checkpoint_verified(self, load_dir: str, tag: Optional[str],
+                                  load_optimizer_states: bool,
+                                  load_lr_scheduler_states: bool,
+                                  load_module_only: bool):
         from deepspeed_tpu.runtime.checkpoint_engine import atomic
 
         requested = (str(tag) if tag is not None

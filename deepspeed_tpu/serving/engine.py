@@ -54,6 +54,7 @@ page 0 in the paged layout (a released slot's page table points there).
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 from collections import deque
@@ -68,6 +69,7 @@ from deepspeed_tpu.inference.engine import InferenceEngine, pow2_bucket
 from deepspeed_tpu.models.decoding import (forward_with_cache, init_kv_cache,
                                            sample_token)
 from deepspeed_tpu.monitor.flight_recorder import get_flight_recorder
+from deepspeed_tpu.monitor.goodput import get_goodput_ledger
 from deepspeed_tpu.monitor.health import get_health
 from deepspeed_tpu.monitor.metrics import get_registry
 from deepspeed_tpu.monitor.request_trace import get_request_tracer
@@ -276,6 +278,19 @@ class ServingEngine:
         # events — both disabled-by-default one-branch no-ops
         self._tracer = get_request_tracer()
         self._flight = get_flight_recorder()
+        # run-level goodput ledger (docs/OBSERVABILITY.md "Goodput
+        # ledger"): serving shares the same process-global run clock.
+        # Enabled by the DSTPU_RUNLEDGER env (serve_supervisor's channel)
+        # or an ``slo``/``goodput`` block in the serving config.
+        self._goodput = get_goodput_ledger()
+        slo_rules = dict(getattr(self._config, "slo", None) or {})
+        gp_cfg = dict(getattr(self._config, "goodput", None) or {})
+        if (os.environ.get("DSTPU_RUNLEDGER") or slo_rules
+                or gp_cfg.get("enabled")):
+            self._goodput.enable(
+                path=gp_cfg.get("path"), role="serve",
+                min_tick_interval_s=gp_cfg.get("min_tick_interval_s"),
+                slo_rules=slo_rules or None)
         # compute-side lifecycle metrics (queue-side spans live in the
         # scheduler; all are one-branch no-ops while the registry is
         # disabled — see docs/OBSERVABILITY.md for the schema)
@@ -422,6 +437,17 @@ class ServingEngine:
         finished during this iteration."""
         if self.engine._params is None:
             raise RuntimeError("no weights: set_params() first")
+        # ledger: one scheduler iteration is a `compute` region (admit +
+        # prefill + decode dispatches); time between step() calls is idle
+        # (or `drain` during a drain window).  Ticks ride the same seam.
+        self._goodput.push("compute")
+        try:
+            return self._step_inner()
+        finally:
+            self._goodput.pop()
+            self._goodput.tick()
+
+    def _step_inner(self) -> List[Request]:
         self._profilez_begin()
         # 0. cross-thread aborts (504'd /generate handlers): tear down on
         #    THIS thread so slot parking / page release / deferred-block
@@ -528,6 +554,10 @@ class ServingEngine:
         t0 = time.perf_counter()
         timed_out = False
         loop_is_stepping = self._loop_alive()
+        # ledger: the drain window is its own category; step()'s nested
+        # `compute` regions carve their time out, so `drain` accumulates
+        # only the non-compute remainder (waiting on occupancy).
+        self._goodput.push("drain")
         try:
             while self.scheduler.num_occupied > 0:
                 if timeout is not None and time.perf_counter() - t0 > timeout:
@@ -554,6 +584,7 @@ class ServingEngine:
                 else:
                     self.step()
         finally:
+            self._goodput.pop()
             self._m_draining.set(0)
             self._draining = False
             finished = self.scheduler.finished[done_before:]
@@ -1379,6 +1410,7 @@ class ServingEngine:
             # window with this request's scheduled token count
             self._tracer.span(req.request_id, "decode_block", t0, t1, n)
             self._m_decode_toks.inc(n)
+            self._goodput.add_tokens(n)
             refs += 1
             if req.eos_token_id < 0:
                 req.pending_blocks.append((idx, n))

@@ -90,6 +90,29 @@ def _restore_metrics_registry_enabled():
     tracer.enabled = prev_tracer
 
 
+@pytest.fixture(autouse=True)
+def _goodput_ledger_guard():
+    """A test that leaves the process-global goodput ledger enabled must
+    leave it TELESCOPING (category sum == wall at rel 1e-9, the ISSUE 18
+    run-attribution contract) — checked after EVERY test, then the
+    ledger is reset so run clocks and jsonl paths don't leak across
+    tests (the engine enables it from config/env; a leaked enable would
+    time unrelated tests into one run)."""
+    yield
+    from deepspeed_tpu.monitor import goodput_core
+    from deepspeed_tpu.monitor.goodput import get_goodput_ledger
+
+    gp = get_goodput_ledger()
+    if gp.enabled:
+        snap = gp.snapshot()
+        gp._path = None          # teardown must not append to a test's jsonl
+        gp.disable()
+        assert goodput_core.telescopes(snap), (
+            "goodput ledger left non-telescoping: wall "
+            f"{snap['wall_s']} vs sum {sum(snap['categories'].values())} "
+            f"(open regions: {snap['open_regions']})")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -342,6 +342,21 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
                   "mfu": 0.4, "backend": "cpu",
                   "metrics": {"tflops": 1.0,
                               "device_profile": {"huge": "x" * 500}},
+                  "goodput": {
+                      "wall_s": 1.0, "loop_s": 0.9, "goodput_ratio": 0.91,
+                      "telescopes": True,
+                      "categories": {"compute": 0.91, "recompile": 0.02,
+                                     "idle": 0.07},
+                      "tokens": 1536, "tokens_expected": 1536,
+                      "tokens_reconcile": True, "tokens_per_sec": 1536.0},
+                  "overlap_1b4": {
+                      "overlap_speedup": 1.2, "loss_parity": True,
+                      "off": {"tokens_per_sec": 100.0, "mfu": 0.5,
+                              "comm_s": 0.004, "comm_s_source": "analytic",
+                              "loss": 1.0},
+                      "on": {"tokens_per_sec": 120.0, "mfu": 0.6,
+                             "comm_s": 0.003, "comm_s_source": "device",
+                             "loss": 1.0}},
                   "streamed_offload": {
                       "status": "ok", "streamed_speedup": 1.6,
                       "relay_bytes_ratio": 1.9, "loss_parity": True,
@@ -396,6 +411,19 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
                                   "int8": {"loss": 6.12}}}}}}
     lines = bench.summary_lines(record, None)
     parsed = json.loads(lines[-1])
+    # the ISSUE 18 goodput row rides BENCH_JSON: ratio + categories +
+    # the telescoping / exact-token-reconciliation bits
+    gpb = parsed["goodput"]
+    assert gpb["goodput_ratio"] == 0.91 and gpb["telescopes"] is True
+    assert gpb["tokens_reconcile"] is True
+    assert gpb["tokens_per_sec"] == 1536.0
+    assert gpb["categories"]["compute"] == 0.91
+    # the overlap ablation's comm_s carries its source label (bench
+    # honesty: analytic comm-plan pricing on CPU, device truth otherwise)
+    ova = parsed["overlap_ablation"]
+    assert ova["off"]["comm_s"] == 0.004
+    assert ova["off"]["comm_s_source"] == "analytic"
+    assert ova["on"]["comm_s_source"] == "device"
     st = parsed["streamed_offload"]
     assert st["streamed_speedup"] == 1.6
     assert st["relay_bytes_ratio"] == 1.9 and st["loss_parity"] is True

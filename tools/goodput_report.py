@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Render (or diff) a run-level goodput ledger from its jsonl file.
+
+    python tools/goodput_report.py /runs/runledger.jsonl
+    python tools/goodput_report.py /runs/runledger.jsonl --run-id run-42
+    python tools/goodput_report.py A.jsonl --diff B.jsonl   # B relative to A
+    python tools/goodput_report.py ledger.jsonl --json      # stitched report
+    python tools/goodput_report.py --selftest               # tier-1 wired
+
+The ledger (``deepspeed_tpu/monitor/goodput_core.py``, written by
+training/serving engines and the supervisors) attributes every second of
+run wall clock to one category of a closed set and telescopes to the
+run's wall time; ``stitch`` folds all incarnations of one ``run_id``
+(supervisor restarts) into a single timeline whose death→healthy-again
+gaps become ``restart_downtime``.  This tool is the offline reader: one
+run renders as the category table + per-incarnation/gap detail; ``--diff``
+compares the category SHARES of two runs (a perf-regression lens over
+two bench ledgers).  A jsonl holding several run_ids (a serve fleet's
+shared ledger) renders each run in sequence unless ``--run-id`` picks one.
+
+Zero dependencies beyond the stdlib — **no jax import** (``goodput_core``
+is stdlib-only on purpose and loads by file path, the fleet_dump idiom;
+dslint rule DSL003 pins the whole closure), so a ledger scraped off a
+training pod is readable on any operator box.
+
+``--selftest`` synthesizes a two-incarnation ledger, stitches it, and
+asserts the telescoping contract + render/diff output (wired into
+tier-1 so this offline tool cannot silently rot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_goodput_core():
+    """goodput_core WITHOUT jax: reuse the package module when already
+    imported, else load the file by path (stdlib-only by contract)."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.monitor import goodput_core
+
+        return goodput_core
+    mod = sys.modules.get("_ds_goodput_core")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(_REPO, "deepspeed_tpu", "monitor", "goodput_core.py")
+    spec = importlib.util.spec_from_file_location("_ds_goodput_core", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_goodput_core"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+core = _load_goodput_core()
+
+
+def _run_ids(rows) -> List[str]:
+    """Distinct run ids in file order (a fleet ledger holds several)."""
+    seen: List[str] = []
+    for row in rows:
+        rid = row.get("run_id")
+        if rid and rid not in seen:
+            seen.append(rid)
+    return seen
+
+
+def report_for(path: str, run_id: Optional[str] = None) -> dict:
+    rows = core.read_rows(path)
+    if not rows:
+        raise SystemExit(f"no ledger rows in {path}")
+    if run_id is None:
+        ids = _run_ids(rows)
+        run_id = ids[0] if ids else None
+    return core.stitch(rows, run_id=run_id)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    if "--selftest" in argv[1:]:
+        return selftest()
+    parser = argparse.ArgumentParser(
+        prog="goodput_report",
+        description="Render or diff a run-level goodput ledger "
+                    "(runledger.jsonl).")
+    parser.add_argument("ledger", help="path to the runledger.jsonl")
+    parser.add_argument("--run-id", default=None,
+                        help="stitch only this run id (default: every run "
+                             "in the file, in order)")
+    parser.add_argument("--diff", metavar="LEDGER_B", default=None,
+                        help="second ledger: print B's category shares "
+                             "relative to the first ledger's")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the stitched report(s) as JSON")
+    args = parser.parse_args(argv[1:])
+
+    if args.diff is not None:
+        a = report_for(args.ledger, args.run_id)
+        b = report_for(args.diff, args.run_id)
+        if args.json:
+            print(json.dumps({"a": a, "b": b}, sort_keys=True))
+        else:
+            print("\n".join(core.diff_lines(a, b)))
+        return 0
+
+    rows = core.read_rows(args.ledger)
+    if not rows:
+        print(f"no ledger rows in {args.ledger}", file=sys.stderr)
+        return 1
+    ids = [args.run_id] if args.run_id else (_run_ids(rows) or [None])
+    reports = [core.stitch(rows, run_id=rid) for rid in ids]
+    if args.json:
+        print(json.dumps(reports if len(reports) > 1 else reports[0],
+                         sort_keys=True))
+        return 0
+    for i, rep in enumerate(reports):
+        if i:
+            print()
+        print("\n".join(core.render_lines(rep)))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# selftest (tier-1 wired: tests/unit/test_goodput.py)
+# ---------------------------------------------------------------------------
+
+
+def selftest() -> int:
+    import tempfile
+
+    if os.path.basename(sys.argv[0]).startswith("goodput_report"):
+        assert "jax" not in sys.modules, "goodput_report imported jax"
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "runledger.jsonl")
+        # two incarnations of one run with a 5s restart gap, plus a
+        # supervisor decision row explaining it
+        # real tick rows carry the snapshot categories INCLUDING the idle
+        # residual, so each incarnation's categories sum to its uptime
+        snap1 = {"categories": {"compute": 8.0, "checkpoint_save": 1.0,
+                                "idle": 1.0},
+                 "goodput_ratio": 0.8, "tokens": 800, "steps": 8}
+        snap2 = {"categories": {"compute": 4.0, "checkpoint_load": 0.5,
+                                "idle": 0.5},
+                 "goodput_ratio": 0.8, "tokens": 400, "steps": 12}
+        for row in (
+                core.start_row("r1", 0, "train", 1000.0),
+                core.tick_row("r1", 0, 1010.0, 10.0, snap1),
+                core.supervisor_row("r1", "restart", 1015.0,
+                                    decision="crash", exit_code=7),
+                core.start_row("r1", 1, "train", 1015.0),
+                core.tick_row("r1", 1, 1020.0, 5.0, snap2)):
+            core.append_row(path, row)
+        rep = report_for(path)
+        assert rep["run_id"] == "r1"
+        assert len(rep["incarnations"]) == 2
+        assert rep["restart_gaps_s"] == [5.0], rep["restart_gaps_s"]
+        assert abs(rep["wall_s"] - 20.0) < 1e-12
+        assert abs(rep["categories"]["restart_downtime"] - 5.0) < 1e-12
+        assert core.telescopes(rep), rep
+        assert rep["tokens"] == 1200 and rep["steps"] == 12
+        assert rep["supervisor"] and \
+            rep["supervisor"][0]["event"] == "restart"
+        text = "\n".join(core.render_lines(rep))
+        assert "restart gap 0: 5.000s" in text
+        assert "telescopes: True" in text
+
+        # diff: a second ledger with worse goodput shows a negative delta
+        path_b = os.path.join(td, "b.jsonl")
+        snap_b = {"categories": {"compute": 5.0, "host_stall": 5.0},
+                  "goodput_ratio": 0.5, "tokens": 500, "steps": 5}
+        core.append_row(path_b, core.start_row("r2", 0, "train", 2000.0))
+        core.append_row(path_b, core.tick_row("r2", 0, 2010.0, 10.0, snap_b))
+        rep_b = report_for(path_b)
+        dtext = "\n".join(core.diff_lines(rep, rep_b))
+        assert "->" in dtext and "host_stall" in dtext
+
+        # CLI surface: render + json + diff all go through main()
+        assert main(["goodput_report", path]) == 0
+        assert main(["goodput_report", path, "--json"]) == 0
+        assert main(["goodput_report", path, "--diff", path_b]) == 0
+
+        # torn final line (process died mid-append): reader skips it
+        with open(path, "a") as fh:
+            fh.write('{"v": 1, "kind": "tick", "run_id": "r1", "trunc')
+        assert report_for(path)["wall_s"] == rep["wall_s"]
+    print("goodput_report selftest: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,7 +63,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # tuple that DSL004 pins in bench.py (plus run_meta, the metadata stamp)
 SUMMARY_BLOCKS = ("serving_metrics", "train_metrics", "overlap_ablation",
                   "serving_prefix", "streamed_offload", "serving_host_tier",
-                  "fleet_chaos", "elastic_resume", "quant_comm", "pipe")
+                  "fleet_chaos", "elastic_resume", "quant_comm", "pipe",
+                  "goodput")
 
 # direction heuristics by name substring; NEUTRAL wins, then HIGHER,
 # then LOWER; a name matching none is informational only
@@ -73,10 +74,10 @@ NEUTRAL = ("loss_parity", "token_identical", "exactly_once", "worlds",
            "promotes", "restarts", "shed")
 HIGHER = ("tokens_per_sec", "tok_s", "speedup", "mfu", "goodput",
           "retention", "hit_ratio", "compression", "savings",
-          "vs_baseline", "bandwidth", "mbps", "ok")
+          "vs_baseline", "bandwidth", "mbps", "ok", "_ratio")
 LOWER = ("latency", "p99", "p50", "ttft", "step_ms", "ms_per_token",
          "bubble_share", "gap_share", "loss", "overhead_ms", "skew",
-         "steps_to_recover", "resume_latency")
+         "steps_to_recover", "resume_latency", "downtime")
 
 
 def direction(name: str) -> Optional[str]:

@@ -85,6 +85,28 @@ RestartPolicy = _core.RestartPolicy
 PREEMPT_EXIT_CODE = _core.PREEMPT_EXIT_CODE
 
 
+def _load_goodput_core():
+    """The goodput-ledger row schema (monitor/goodput_core.py), loaded
+    the same jax-free way as the supervisor core (see
+    ``tools/train_supervisor.py``)."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.monitor import goodput_core
+
+        return goodput_core
+    mod = sys.modules.get("_ds_goodput_core")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "deepspeed_tpu", "monitor", "goodput_core.py")
+    spec = importlib.util.spec_from_file_location("_ds_goodput_core", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_goodput_core"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _http_json(url: str, timeout: float):
     """GET ``url`` -> (status_code, parsed_json | {}); (None, {}) when the
     endpoint did not answer at all (refused / timed out / reset)."""
@@ -186,7 +208,9 @@ class ServeSupervisor:
                  scale_up_queue: float = 0.0, scale_down_queue: float = 0.0,
                  kv_high: float = 0.92, scale_sustain_s: float = 10.0,
                  env: Optional[Dict[str, str]] = None,
-                 sleep=time.sleep, status_file: Optional[str] = None):
+                 sleep=time.sleep, status_file: Optional[str] = None,
+                 runledger: Optional[str] = None,
+                 run_id: Optional[str] = None):
         if not cmd_template:
             raise ValueError("no replica command template given")
         self.cmd_template = list(cmd_template)
@@ -212,6 +236,14 @@ class ServeSupervisor:
         self.base_env = dict(env if env is not None else os.environ)
         self.sleep = sleep
         self.status_file = status_file
+        # goodput-ledger channel (see tools/train_supervisor.py): one
+        # shared jsonl for the fleet, run identity per REPLICA
+        # (`<run_id>-r<index>`) so goodput_report stitches each replica's
+        # incarnations independently (stitch() filters by run_id)
+        self.runledger = runledger or self.base_env.get("DSTPU_RUNLEDGER")
+        self.run_id = (run_id or self.base_env.get("DSTPU_RUN_ID")
+                       or (f"serve-{os.getpid()}-{int(time.time())}"
+                           if self.runledger else None))
         self.replicas: List[ReplicaHandle] = []
         self.total_restarts = 0          # crash+wedge+preempt respawns
         self.scale_outs = 0
@@ -239,11 +271,28 @@ class ServeSupervisor:
         self.replicas.append(h)
         return h
 
+    def _replica_run_id(self, h: ReplicaHandle) -> str:
+        return f"{self.run_id}-r{h.index}"
+
+    def _ledger_append(self, h: ReplicaHandle, event: str, **extra) -> None:
+        """Restart-decision row into the fleet's run ledger jsonl (no-op
+        without --runledger / DSTPU_RUNLEDGER)."""
+        if not self.runledger:
+            return
+        gp = _load_goodput_core()
+        gp.append_row(self.runledger, gp.supervisor_row(
+            self._replica_run_id(h), event, time.time(),
+            supervisor="serve_supervisor", replica=h.index,
+            incarnation=h.policy.restarts, **extra))
+
     def _spawn(self, h: ReplicaHandle, now: float) -> None:
         env = dict(self.base_env)
         env["DS_REPLICA_INDEX"] = str(h.index)
         env["DS_REPLICA_PORT"] = str(h.port)
         env["DS_SUPERVISOR_RESTART"] = str(h.policy.restarts)
+        if self.runledger:
+            env["DSTPU_RUNLEDGER"] = self.runledger
+            env["DSTPU_RUN_ID"] = self._replica_run_id(h)
         h.proc = subprocess.Popen(h.cmd, env=env)
         h.state = ReplicaHandle.RUNNING
         h.spawned_at = now
@@ -307,16 +356,21 @@ class ServeSupervisor:
                 self.total_restarts += 1
                 h.state = ReplicaHandle.BACKOFF
                 h.restart_at = now
+                self._ledger_append(h, "restart", decision="respawn",
+                                    exit_code=0, backoff_s=0.0)
                 continue
             decision = h.policy.decide(code, ran_s=now - h.spawned_at)
             if decision.action == "give_up":
                 self._log(f"replica {h.index}: crash ladder exhausted "
                           f"(exit {code}); leaving it down")
                 h.state = ReplicaHandle.FAILED
+                self._ledger_append(h, "give_up", exit_code=code)
                 continue
             self.total_restarts += 1
             h.state = ReplicaHandle.BACKOFF
             h.restart_at = now + decision.delay
+            self._ledger_append(h, "restart", decision=decision.kind,
+                                exit_code=code, backoff_s=decision.delay)
             self._log(f"replica {h.index}: exited {code} ({decision.kind}); "
                       f"restart #{h.policy.restarts} in {decision.delay:g}s")
 
@@ -367,10 +421,14 @@ class ServeSupervisor:
                 self._log(f"replica {h.index}: crash ladder exhausted "
                           f"after wedge; leaving it down")
                 h.state = ReplicaHandle.FAILED
+                self._ledger_append(h, "give_up", exit_code=137,
+                                    wedge=True)
                 continue
             self.total_restarts += 1
             h.state = ReplicaHandle.BACKOFF
             h.restart_at = now + decision.delay
+            self._ledger_append(h, "restart", decision="wedge",
+                                exit_code=137, backoff_s=decision.delay)
 
     def _scale(self, now: float) -> None:
         if self.max_replicas <= self.min_replicas or self._terminating:
@@ -737,6 +795,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="write fleet truth (replica states, ladder "
                              "counters, scale events) as JSON to this path "
                              "every tick")
+    parser.add_argument("--runledger", default=None,
+                        help="goodput-ledger jsonl path shared by the whole "
+                             "fleet: each replica incarnation gets "
+                             "DSTPU_RUNLEDGER + a per-replica DSTPU_RUN_ID "
+                             "(<run-id>-r<index>), and restart decisions "
+                             "are appended so tools/goodput_report.py "
+                             "stitches each replica across restarts "
+                             "(defaults to the DSTPU_RUNLEDGER env var)")
+    parser.add_argument("--run-id", default=None,
+                        help="base run identity for --runledger rows "
+                             "(default: DSTPU_RUN_ID env or a generated id)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the replica command template")
     args = parser.parse_args(argv[1:])
@@ -753,7 +822,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         grace_s=args.grace, min_replicas=args.min_replicas,
         max_replicas=args.max_replicas, scale_up_queue=args.scale_up_queue,
         scale_down_queue=args.scale_down_queue, kv_high=args.kv_high,
-        scale_sustain_s=args.scale_sustain, status_file=args.status_file)
+        scale_sustain_s=args.scale_sustain, status_file=args.status_file,
+        runledger=args.runledger, run_id=args.run_id)
     return sup.run()
 
 

@@ -81,6 +81,29 @@ PREEMPT_EXIT_CODE = _core.PREEMPT_EXIT_CODE
 SIGTERM_GRACE_S = 30.0
 
 
+def _load_goodput_core():
+    """The goodput-ledger row schema (monitor/goodput_core.py), loaded
+    the same jax-free way as the supervisor core: supervisors append
+    their restart decisions to the run ledger so ``stitch`` can show WHY
+    each ``restart_downtime`` gap exists."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.monitor import goodput_core
+
+        return goodput_core
+    mod = sys.modules.get("_ds_goodput_core")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "deepspeed_tpu", "monitor", "goodput_core.py")
+    spec = importlib.util.spec_from_file_location("_ds_goodput_core", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_goodput_core"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 class TrainSupervisor:
     """Restart-on-crash loop around one training process (module
     docstring has the exit-code contract)."""
@@ -92,7 +115,9 @@ class TrainSupervisor:
                  sleep: Callable[[float], None] = time.sleep,
                  grace_s: float = SIGTERM_GRACE_S,
                  healthy_reset_s: Optional[float] = None,
-                 status_file: Optional[str] = None):
+                 status_file: Optional[str] = None,
+                 runledger: Optional[str] = None,
+                 run_id: Optional[str] = None):
         if not cmd:
             raise ValueError("no child command given")
         self.cmd = list(cmd)
@@ -115,6 +140,15 @@ class TrainSupervisor:
         self.sleep = sleep
         self.grace_s = grace_s
         self.status_file = status_file
+        # goodput-ledger channel: every incarnation appends to ONE jsonl
+        # (DSTPU_RUNLEDGER) under ONE run identity (DSTPU_RUN_ID), and the
+        # supervisor writes its restart decisions there too — stitch()
+        # folds them back into one run timeline (restart gaps become
+        # `restart_downtime`)
+        self.runledger = runledger or self.base_env.get("DSTPU_RUNLEDGER")
+        self.run_id = (run_id or self.base_env.get("DSTPU_RUN_ID")
+                       or (f"run-{os.getpid()}-{int(time.time())}"
+                           if self.runledger else None))
         self._terminating = False
         self._child: Optional[subprocess.Popen] = None
         self._state = "idle"
@@ -140,6 +174,17 @@ class TrainSupervisor:
             "ladder": self.policy.counters(),
             "cmd": self.cmd,
         })
+
+    def _ledger_append(self, event: str, **extra) -> None:
+        """Restart-decision row into the run ledger jsonl (no-op without
+        --runledger / DSTPU_RUNLEDGER)."""
+        if not self.runledger:
+            return
+        gp = _load_goodput_core()
+        gp.append_row(self.runledger, gp.supervisor_row(
+            self.run_id, event, time.time(),
+            supervisor="train_supervisor", incarnation=self.restarts,
+            **extra))
 
     # counters live on the shared policy (one mutation site per exit);
     # the PR 8 attribute surface stays intact for callers/tests
@@ -203,6 +248,9 @@ class TrainSupervisor:
             env = dict(self.base_env)
             env["DS_SUPERVISOR_RESTART"] = str(self.restarts)
             env["DS_PREEMPT_EXIT_CODE"] = str(self.preempt_exit_code)
+            if self.runledger:
+                env["DSTPU_RUNLEDGER"] = self.runledger
+                env["DSTPU_RUN_ID"] = self.run_id
             cmdline = " ".join(self.cmd).replace("\n", "\\n")
             if len(cmdline) > 160:
                 cmdline = cmdline[:157] + "..."
@@ -225,14 +273,20 @@ class TrainSupervisor:
             if decision.action == "done":
                 self._log(f"child completed (restarts={self.restarts})")
                 self._write_status("done")
+                self._ledger_append("done", exit_code=code)
                 return 0
             if decision.action == "give_up":
                 self._log(f"max_restarts={self.max_restarts} crash "
                           f"restarts exhausted; giving up with exit code "
                           f"{code}")
                 self._write_status("given_up")
+                self._ledger_append("give_up", exit_code=code)
                 return code
             self._restart_times.append(time.time())
+            self._ledger_append("restart", decision=decision.kind,
+                                exit_code=code,
+                                backoff_s=(0.0 if decision.kind == "preempt"
+                                           else decision.delay))
             if decision.kind == "preempt":
                 # a clean emergency save was taken: restart immediately;
                 # preemptions are routine scheduling events and do NOT
@@ -383,6 +437,28 @@ def selftest() -> int:
         # ladder; every incarnation ran "healthy" long enough to forgive
         assert sup.run() == 0
         assert sup.crash_restarts >= 1
+
+        # --runledger: the run identity reaches every incarnation and the
+        # supervisor's restart decisions land as `supervisor` jsonl rows
+        ledger = os.path.join(td, "runledger.jsonl")
+        marker = os.path.join(td, "h_env")
+        prog = ("import os,sys\n"
+                f"open({marker!r}, 'a').write("
+                "os.environ['DSTPU_RUN_ID'] + ',')\n"
+                "assert os.environ['DSTPU_RUNLEDGER']\n"
+                "sys.exit(0 if os.environ['DS_SUPERVISOR_RESTART'] == '1' "
+                "else 3)\n")
+        sup = TrainSupervisor([sys.executable, "-c", prog], max_restarts=2,
+                              backoff_base=0.0, sleep=lambda _s: None,
+                              runledger=ledger, run_id="selftest-run")
+        assert sup.run() == 0
+        assert open(marker).read() == "selftest-run,selftest-run,"
+        gp = _load_goodput_core()
+        rows = gp.read_rows(ledger)
+        kinds = [(r["kind"], r.get("event")) for r in rows]
+        assert ("supervisor", "restart") in kinds, kinds
+        assert ("supervisor", "done") in kinds, kinds
+        assert all(r["run_id"] == "selftest-run" for r in rows)
     print("train_supervisor selftest: OK")
     return 0
 
@@ -417,6 +493,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="write supervisor truth (ladder counters, "
                              "child state, restart timestamps) as JSON to "
                              "this path on every state change")
+    parser.add_argument("--runledger", default=None,
+                        help="goodput-ledger jsonl path: exported to every "
+                             "incarnation as DSTPU_RUNLEDGER (+ a shared "
+                             "DSTPU_RUN_ID) and appended with the "
+                             "supervisor's restart decisions, so "
+                             "tools/goodput_report.py stitches the whole "
+                             "run across restarts (defaults to the "
+                             "DSTPU_RUNLEDGER env var)")
+    parser.add_argument("--run-id", default=None,
+                        help="run identity for --runledger rows (default: "
+                             "DSTPU_RUN_ID env or a generated id)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the training command")
     args = parser.parse_args(argv[1:])
@@ -428,7 +515,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                           backoff_max=args.backoff_max,
                           preempt_exit_code=args.preempt_exit_code,
                           healthy_reset_s=args.healthy_reset_s,
-                          status_file=args.status_file)
+                          status_file=args.status_file,
+                          runledger=args.runledger, run_id=args.run_id)
     return sup.run()
 
 
