@@ -1321,6 +1321,301 @@ def bench_fleet_chaos(num_requests: int = 24, num_slots: int = 2,
     }
 
 
+def bench_disagg_serving(num_requests: int = 16, num_slots: int = 4,
+                         seed: int = 0, tiny: bool = False) -> dict:
+    """Disaggregated prefill/decode serving rung (ISSUE 19): the bimodal
+    shared-prefix trace through the router over a MONOLITHIC fleet (2
+    ``both`` replicas) and a ROLE-SPLIT fleet (2 prefill + 2 decode,
+    int8 KV-page handoff over /kv_offer + /kv_adopt), each driven both
+    with plain and with STREAMING ``/generate`` — the role-split ×
+    streaming grid.  Recorded per cell: goodput, TTFT p50/p99 (engine
+    histogram on the plain sides; client-observed first-chunk latency on
+    the streaming sides — the user-visible number streaming exists for),
+    token identity vs single-engine ``generate()``.  The role-split
+    fleet additionally records the KV handoff ledger: wire bytes (int8 +
+    scale planes) vs the dense twin, pages shipped/adopted.  Headlines:
+    ``handoff_compression`` (dense/wire, ~2x at bf16), ``ttft_stream_
+    over_total`` (first chunk lands well before the full answer), and
+    the grid's ``outputs_token_identical`` conjunction."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm
+    from deepspeed_tpu.monitor.metrics import MetricsRegistry
+    from deepspeed_tpu.serving import Router, RouterServer
+
+    mesh = build_mesh(devices=jax.devices()[:1])
+    set_global_mesh(mesh)
+    rng = np.random.default_rng(seed + 23)
+    if tiny:  # CPU smoke scale (tests/perf/test_disagg_serving_bench.py)
+        model = causal_lm("gpt2-small", mesh=mesh, num_layers=2,
+                          hidden_size=128, intermediate_size=256,
+                          num_heads=4, vocab_size=512)
+        max_out, page_tokens = 96, 16
+        sys_len, tail = 32, (3, 8)
+        n_short, n_long = (8, 16), (24, 32)
+    else:
+        model = causal_lm("gpt2-small", mesh=mesh, vocab_size=50304)
+        max_out, page_tokens = 1024, 16
+        sys_len, tail = 256, (16, 96)
+        n_short, n_long = (16, 64), (128, 192)
+    params = jax.jit(model.init)(jax.random.PRNGKey(seed))
+    V = model.config.vocab_size
+
+    shared = rng.integers(0, V, size=sys_len).astype(np.int32)
+    long_mask = rng.random(num_requests) < 0.25
+    prompts, news = [], []
+    for i in range(num_requests):
+        t = rng.integers(0, V, size=int(rng.integers(tail[0], tail[1] + 1))
+                         ).astype(np.int32)
+        if rng.random() < 0.7:
+            prompts.append(np.concatenate([shared, t]))
+        else:
+            prompts.append(rng.integers(
+                0, V, size=sys_len // 2 + len(t)).astype(np.int32))
+        news.append(int(rng.integers(n_long[0], n_long[1] + 1)
+                        if long_mask[i]
+                        else rng.integers(n_short[0], n_short[1] + 1)))
+    # quantize_kv_cache=True everywhere: the cache planes are int8 +
+    # scale already, so the int8 wire handoff is LOSSLESS and the
+    # role-split outputs must match this reference bit for bit
+    cfg_common = {"dtype": "bfloat16", "max_out_tokens": max_out,
+                  "kv_page_tokens": page_tokens,
+                  "quantize_kv_cache": True}
+    ref = deepspeed_tpu.init_inference(model, config=dict(cfg_common))
+    ref.set_params(params)
+    want = [[int(t) for t in np.asarray(ref.generate(
+                p[None], max_new_tokens=n, do_sample=False))[0, len(p):]]
+            for p, n in zip(prompts, news)]
+
+    def run_fleet(role_split: bool) -> dict:
+        replicas = []
+        router = front = None
+        roles = (["prefill", "prefill", "decode", "decode"] if role_split
+                 else ["both", "both"])
+        try:
+            for role in roles:
+                s = deepspeed_tpu.init_serving(
+                    model, config=dict(cfg_common,
+                                       max_queue_depth=num_requests + 4),
+                    num_slots=num_slots, decode_block_tokens=4,
+                    role=role, metrics_port=0,
+                    registry=MetricsRegistry().enable(),
+                    private_health=True, serve_loop=True)
+                s.set_params(params)
+                warms = [s.submit(prompts[0], max_new_tokens=2),
+                         s.submit(prompts[0][:20], max_new_tokens=2)]
+                deadline = time.perf_counter() + 240
+                while not all(w.done for w in warms) \
+                        and time.perf_counter() < deadline:
+                    time.sleep(0.005)
+                s._registry.reset()
+                replicas.append(s)
+            router = Router(
+                [f"{r}{i}@{r}={s.metrics_server.url}"
+                 for i, (r, s) in enumerate(zip(roles, replicas))],
+                registry=MetricsRegistry().enable(), dispatch_rounds=8,
+                retry_backoff=0.02, poll_interval=0.05,
+                request_timeout=120.0)
+            router.refresh()
+            router.start()
+            front = RouterServer(router).start()
+            # warm the FULL dispatch paths through the front (every
+            # prefill shape bucket, the handoff path, decode, the
+            # stream relay) so the measured variants see steady-state
+            # shapes, not XLA compiles
+            _drive_trace(front, prompts, [4] * len(prompts), want,
+                         False, replicas)
+            _drive_trace(front, prompts[:2], news[:2], want[:2],
+                         True, replicas)
+            out = {}
+            for stream in (False, True):
+                for s in replicas:
+                    s._registry.reset()
+                    # the front warm-up filled the decode tries, and
+                    # /kv_offer dedupes pages the receiver already
+                    # holds — drop the decode-side tries so each
+                    # measured variant re-exercises the handoff wire
+                    # (XLA shapes stay warm; that was the warm-up's job)
+                    if role_split and s.role == "decode":
+                        s.prefix_cache.clear()
+                router.registry.reset()
+                out["stream" if stream else "plain"] = _drive_trace(
+                    front, prompts, news, want, stream, replicas)
+            # the role-split handoff ledger accumulates across BOTH
+            # variants (each reset clears it, so scrape per variant)
+            return out
+        finally:
+            if front is not None:
+                front.stop()
+            if router is not None:
+                router.stop()
+            for s in replicas:
+                s.close()
+
+    def _drive_trace(front, prompts, news, want, stream, replicas):
+        results = [None] * len(prompts)
+        client_lat = [None] * len(prompts)
+        first_tok = [None] * len(prompts)
+
+        def client(i):
+            t0 = time.perf_counter()
+            payload = {"prompt": prompts[i].tolist(),
+                       "max_new_tokens": news[i],
+                       "session": f"sess-{i % 4}", "timeout": 90}
+            if stream:
+                payload["stream"] = True
+            req = urllib.request.Request(
+                front.url + "/generate",
+                data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            for _attempt in range(8):
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        if stream:
+                            toks = []
+                            for line in resp:
+                                ev = _json.loads(line)
+                                if ev.get("tokens"):
+                                    if first_tok[i] is None:
+                                        first_tok[i] = (time.perf_counter()
+                                                        - t0)
+                                    toks.extend(ev["tokens"])
+                                elif ev.get("error"):
+                                    results[i] = (int(ev.get("status")
+                                                      or 503), ev)
+                                    break
+                                elif ev.get("done"):
+                                    results[i] = (200, {"tokens": toks})
+                                    break
+                        else:
+                            results[i] = (resp.status, _json.load(resp))
+                    if results[i] is not None and results[i][0] != 503:
+                        break
+                except urllib.error.HTTPError as exc:
+                    try:
+                        body = _json.load(exc)
+                    except Exception:
+                        body = {}
+                    results[i] = (exc.code, body)
+                    if exc.code in (429, 503):
+                        time.sleep(0.2)
+                        continue
+                    break
+                except OSError:
+                    break
+            client_lat[i] = time.perf_counter() - t0
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+            time.sleep(0.03)
+        for t in threads:
+            t.join(timeout=240)
+        span = time.perf_counter() - t0
+        answered, identical, toks = 0, True, 0
+        for i, r in enumerate(results):
+            if r is None:
+                continue
+            code, body = r
+            if code == 200:
+                answered += 1
+                toks += len(body.get("tokens", []))
+                identical = identical and body.get("tokens") == want[i]
+        ratios = []
+        if stream:
+            ft = sorted(x for x in first_tok if x is not None)
+            ttft_p50 = ft[len(ft) // 2] if ft else 0.0
+            ttft_p99 = ft[(len(ft) * 99) // 100] if ft else 0.0
+            ratios = sorted(f / max(t, 1e-9)
+                            for f, t in zip(first_tok, client_lat)
+                            if f is not None and t is not None)
+        else:
+            ttft_p50 = ttft_p99 = 0.0
+            for s in replicas:
+                snap = s._registry.snapshot()
+                h = snap.get("ds_serve_ttft_seconds") or {}
+                ttft_p50 = max(ttft_p50, float(h.get("p50", 0.0)))
+                ttft_p99 = max(ttft_p99, float(h.get("p99", 0.0)))
+        rec = {"goodput_tok_s": round(toks / max(span, 1e-9), 1),
+               "makespan_s": round(span, 3),
+               "answered": answered,
+               "token_identical": identical,
+               "ttft_p50_s": round(ttft_p50, 4),
+               "ttft_p99_s": round(ttft_p99, 4),
+               "client_p50_s": round(sorted(
+                   x for x in client_lat if x is not None)
+                   [answered // 2], 4) if answered else 0.0}
+        if ratios:
+            # per-request TTFT / total-latency: the user-visible claim
+            # streaming makes — the first chunk lands well before the
+            # full answer (median of per-request ratios, not a ratio of
+            # mismatched percentiles)
+            rec["ttft_over_total_p50"] = round(
+                ratios[len(ratios) // 2], 4)
+        # KV handoff ledger (role-split fleets only; zero elsewhere)
+        wire = dense = shipped = adopted = resumes = 0.0
+        for s in replicas:
+            snap = s._registry.snapshot()
+            fam = snap.get("ds_serve_kv_handoff_bytes_total") or {}
+            if isinstance(fam, dict):
+                dense += float(fam.get('{dtype="dense"}', 0) or 0)
+                wire += sum(float(v or 0) for k, v in fam.items()
+                            if k != '{dtype="dense"}')
+            shipped += float(snap.get(
+                "ds_serve_kv_handoff_pages_total", 0) or 0)
+            adopted += float(snap.get(
+                "ds_serve_kv_adopted_pages_total", 0) or 0)
+            resumes += float(snap.get(
+                "ds_serve_stream_resumes_total", 0) or 0)
+        if shipped:
+            rec.update({"handoff_wire_bytes": int(wire),
+                        "handoff_dense_bytes": int(dense),
+                        "handoff_pages_shipped": int(shipped),
+                        "handoff_pages_adopted": int(adopted)})
+        if resumes:
+            rec["stream_resumes"] = int(resumes)
+        return rec
+
+    mono = run_fleet(role_split=False)
+    disagg = run_fleet(role_split=True)
+    wire = disagg["stream"].get("handoff_wire_bytes", 0) \
+        + disagg["plain"].get("handoff_wire_bytes", 0)
+    dense = disagg["stream"].get("handoff_dense_bytes", 0) \
+        + disagg["plain"].get("handoff_dense_bytes", 0)
+    identical = all(side[v]["token_identical"]
+                    for side in (mono, disagg) for v in ("plain", "stream"))
+    ttft_over_total = disagg["stream"].get("ttft_over_total_p50", 0.0)
+    return {
+        "workload": {"num_requests": num_requests, "num_slots": num_slots,
+                     "mono_replicas": 2, "prefill_replicas": 2,
+                     "decode_replicas": 2, "shared_prefix_frac": 0.7,
+                     "system_prompt_tokens": sys_len,
+                     "kv_page_tokens": page_tokens, "seed": seed},
+        "mono": mono,
+        "disagg": disagg,
+        "handoff_compression": round(dense / wire, 3) if wire else 0.0,
+        "handoff_wire_bytes": int(wire),
+        "handoff_dense_bytes": int(dense),
+        # like-for-like: role-split vs monolithic, both streaming (the
+        # plain sides ride in the record for the off-axis of the grid)
+        "disagg_goodput_ratio": round(
+            disagg["stream"]["goodput_tok_s"]
+            / max(mono["stream"]["goodput_tok_s"], 1e-9), 3),
+        # streaming's reason to exist: the first chunk lands well before
+        # the full answer (TTFT < total latency, client-observed)
+        "ttft_stream_over_total": ttft_over_total,
+        "outputs_token_identical": identical,
+    }
+
+
 def bench_overlap_rung(steps: int = 4, warmup: int = 2) -> dict:
     """ZeRO-3 compute/collective overlap on/off ablation on the 1.34B
     training scenario (ROADMAP open item 1; runtime/zero/overlap.py).
@@ -2330,11 +2625,19 @@ def main():
         except Exception as exc:
             rung_fleet_chaos = {"status": f"failed: {type(exc).__name__}",
                                 "error": str(exc)[:200]}
+        # disaggregated prefill/decode: role-split × streaming grid,
+        # int8 KV-page handoff wire bytes vs the dense twin
+        try:
+            rung_disagg = bench_disagg_serving()
+        except Exception as exc:
+            rung_disagg = {"status": f"failed: {type(exc).__name__}",
+                           "error": str(exc)[:200]}
     else:
         rung_serving = None
         rung_prefix = None
         rung_host_tier = None
         rung_fleet_chaos = None
+        rung_disagg = None
 
     tokens_per_step = batch * seq
     tps = steps * tokens_per_step / dt
@@ -2393,6 +2696,8 @@ def main():
                       if rung_host_tier else {}),
                    **({"fleet_chaos": rung_fleet_chaos}
                       if rung_fleet_chaos else {}),
+                   **({"disagg_serving": rung_disagg}
+                      if rung_disagg else {}),
                    **({"elastic_resume": rung_elastic}
                       if rung_elastic else {}),
                    **({"streamed_offload": rung_streamed}
@@ -2581,6 +2886,19 @@ def summary_lines(record: dict, rung_serving) -> list:
             "answered_exactly_once": fc["answered_exactly_once"],
             "outputs_token_identical": fc["outputs_token_identical"],
         }
+    dg = record["detail"].get("disagg_serving")
+    if dg and "handoff_compression" in dg:
+        # the ISSUE 19 disaggregation row: role-split goodput vs the
+        # monolithic fleet, user-visible TTFT from streaming, int8 KV
+        # handoff wire bytes vs the dense twin, and token identity
+        # across the whole role-split × streaming grid
+        summary["disagg_serving"] = {
+            "disagg_goodput_ratio": dg["disagg_goodput_ratio"],
+            "ttft_stream_p50_s": dg["disagg"]["stream"]["ttft_p50_s"],
+            "ttft_stream_over_total": dg["ttft_stream_over_total"],
+            "handoff_compression": dg["handoff_compression"],
+            "outputs_token_identical": dg["outputs_token_identical"],
+        }
     er = record["detail"].get("elastic_resume")
     if er and er.get("status") == "ok":
         # the ISSUE 14 elastic-training acceptance row: resume latency +
@@ -2597,8 +2915,8 @@ def summary_lines(record: dict, rung_serving) -> list:
     # (the record line keeps everything); the minimal summary always fits
     for victim in ("serving_metrics", "train_metrics", "overlap_ablation",
                    "goodput", "serving_prefix", "streamed_offload",
-                   "serving_host_tier", "fleet_chaos", "elastic_resume",
-                   "quant_comm", "pipe", "run_meta"):
+                   "serving_host_tier", "fleet_chaos", "disagg_serving",
+                   "elastic_resume", "quant_comm", "pipe", "run_meta"):
         if len(line) <= BENCH_SUMMARY_MAX_CHARS:
             break
         if summary.pop(victim, None) is not None:

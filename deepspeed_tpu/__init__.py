@@ -105,6 +105,12 @@ def init_serving(model=None, config=None, **kwargs):
     and the ``/healthz`` readiness flag to THIS engine instead of the
     process globals — how N replica engines in one process keep
     per-replica truths for the router (docs/OBSERVABILITY.md "Router").
+    ``role=`` ("both" | "prefill" | "decode") enables disaggregated
+    serving: a ``prefill`` replica answers ``{"phase": "prefill"}``
+    requests and ships matched/computed KV pages to the ``handoff_to``
+    decode replica over ``/kv_offer`` + ``/kv_adopt`` (int8 on the wire
+    by default; ``handoff_wire="raw"`` for engine-dtype bytes) — see
+    docs/RESILIENCE.md "Disaggregated serving".
     See docs/OBSERVABILITY.md.
     """
     from deepspeed_tpu.serving.engine import ServingEngine
@@ -125,7 +131,7 @@ def init_serving(model=None, config=None, **kwargs):
     engine_kw = {k: kwargs.pop(k) for k in
                  ("engine", "num_slots", "prefill_chunk",
                   "decode_block_tokens", "do_sample", "temperature",
-                  "top_k", "top_p") if k in kwargs}
+                  "top_k", "top_p", "role", "handoff_wire") if k in kwargs}
     if config is not None or kwargs:
         # only materialize a config when one was actually given —
         # ServingEngine rejects engine= combined with config/model args
@@ -152,6 +158,8 @@ def init_serving(model=None, config=None, **kwargs):
         server = MetricsServer(reg, port=int(metrics_port),
                                health=serve.health)
         server.set_generate_handler(serve._http_generate)
+        server.set_kv_handoff_handlers(serve._http_kv_offer,
+                                       serve._http_kv_adopt)
         server.start()
         serve.metrics_server = server
         # "for the engine's lifetime": a discarded engine must not leak its

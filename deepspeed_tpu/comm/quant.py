@@ -81,6 +81,29 @@ def dequantize_blockwise_np(q: np.ndarray, scale: np.ndarray, n: int,
     return flat
 
 
+def encode_blockwise_np(arr: np.ndarray, block: int = DEFAULT_BLOCK) -> dict:
+    """Wire-ready blockwise-int8 encoding of one host array: the int8
+    code bytes + fp32 scale bytes plus the reassembly metadata.  This is
+    the transport form the KV-page handoff (disaggregated serving) and
+    any future bytes-on-a-socket caller share — the in-memory twins
+    above never leave the process."""
+    a = np.asarray(arr)
+    q, scale = quantize_blockwise_np(a, block)
+    return {"codec": "q8", "q": q.tobytes(), "scale": scale.tobytes(),
+            "shape": tuple(int(s) for s in a.shape), "block": int(block)}
+
+
+def decode_blockwise_np(enc: dict) -> np.ndarray:
+    """Inverse of :func:`encode_blockwise_np` -> fp32 array of the
+    original shape (the caller casts to its storage dtype)."""
+    block = int(enc["block"])
+    q = np.frombuffer(enc["q"], np.int8).reshape(-1, block)
+    scale = np.frombuffer(enc["scale"], np.float32).reshape(-1, 1)
+    shape = tuple(enc["shape"])
+    n = int(np.prod(shape)) if shape else 1
+    return dequantize_blockwise_np(q, scale, n).reshape(shape)
+
+
 # ---------------------------------------------------------------------------
 # jax twins (fused on-device dequant / future quantized collectives)
 # ---------------------------------------------------------------------------

@@ -61,6 +61,7 @@ CATEGORIES = (
     "rollback",          # anomaly rollback windows (minus the nested load)
     "restart_downtime",  # process-death -> next incarnation healthy (stitch)
     "drain",             # serving drain windows (minus nested compute)
+    "handoff",           # disaggregated-serving KV page capture/adopt IO
     "idle",              # the residual: wall - everything above
 )
 

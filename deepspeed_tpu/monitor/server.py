@@ -34,7 +34,13 @@ daemon thread:
   JSON body ``{"prompt": [ids], "max_new_tokens", "eos_token_id"?,
   "timeout"?}`` blocks this worker thread until the request finishes and
   returns its tokens; 503 while the engine drains (the router re-sends
-  elsewhere — no request is dropped on a drain).
+  elsewhere — no request is dropped on a drain).  With ``"stream":
+  true`` the response is chunked ndjson — one JSON event per line as
+  token blocks drain, then a terminal ``done``/``error`` event.
+- ``POST /kv_offer`` / ``POST /kv_adopt`` — the disaggregated-serving
+  KV-page handoff pair (decode-capable replicas): offer answers which
+  page chunks this replica lacks; adopt writes the shipped pages and
+  pins them into the local prefix cache (serving/handoff.py).
 - ``GET /goodputz`` — run-level goodput ledger snapshot
   (monitor/goodput.py): telescoping wall-clock attribution over the
   closed category set plus the goodput ratio; ``{"enabled": false}``
@@ -169,7 +175,8 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps({"endpoints": ["/goodputz", "/healthz",
                                              "/metrics", "/statz",
                                              "/profilez", "/requestz",
-                                             "/generate"]}
+                                             "/generate", "/kv_offer",
+                                             "/kv_adopt"]}
                               ).encode()
             ctype = "application/json"
         else:
@@ -181,12 +188,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # POST endpoints and the server attribute holding each one's handler
+    # (/kv_offer and /kv_adopt are the disaggregated-serving page-handoff
+    # pair — wired only on decode-capable replicas by init_serving)
+    POST_ROUTES = {"/generate": "generate_handler",
+                   "/kv_offer": "kv_offer_handler",
+                   "/kv_adopt": "kv_adopt_handler"}
+
     def do_POST(self):  # noqa: N802 - http.server API
         path, _, _ = self.path.partition("?")
-        if path not in ("/generate", "/generate/"):
+        attr = self.POST_ROUTES.get(path.rstrip("/") or path)
+        if attr is None:
             self.send_error(404)
             return
-        handler = getattr(self.server, "generate_handler", None)
+        handler = getattr(self.server, attr, None)
         if handler is None:
             code, payload = 503, {"error": "no serving engine attached "
                                            "to this metrics server"}
@@ -209,6 +224,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # blocks this worker thread until the request completes
                 # (ThreadingHTTPServer: scrapes stay responsive)
                 code, payload = handler(payload)
+        if not isinstance(payload, dict):
+            # streaming /generate: the handler returned an EVENT ITERATOR
+            # instead of a body — relay it as chunked ndjson, one JSON
+            # object per line, flushed per event so TTFT is wire-visible
+            self._stream_events(code, payload)
+            return
         body = json.dumps(payload, sort_keys=True).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -222,6 +243,30 @@ class _Handler(BaseHTTPRequestHandler):
                              str(max(1, int(payload["retry_after_s"]))))
         self.end_headers()
         self.wfile.write(body)
+
+    def _stream_events(self, code: int, events) -> None:
+        """Chunked-transfer ndjson relay for streaming /generate: each
+        event is one JSON line in one HTTP chunk.  A client that hangs
+        up mid-stream closes the generator (its engine-side request
+        keeps running — an idempotent retry can resume and replay the
+        unsent suffix); the generator itself signals failures in-band
+        with a terminal ``error`` event."""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for event in events:
+                data = json.dumps(event, sort_keys=True).encode() + b"\n"
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass                 # client went away: stop relaying
+        finally:
+            close = getattr(events, "close", None)
+            if close is not None:
+                close()
 
     MAX_WINDOW_KEYS = 64
 
@@ -295,6 +340,8 @@ class MetricsServer:
         # replica-scoped readiness (None = the process-global HealthState)
         self.health = health
         self._generate_handler = None
+        self._kv_offer_handler = None
+        self._kv_adopt_handler = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -320,6 +367,8 @@ class MetricsServer:
         self._httpd.window_lock = threading.Lock()
         self._httpd.health = self.health
         self._httpd.generate_handler = self._generate_handler
+        self._httpd.kv_offer_handler = self._kv_offer_handler
+        self._httpd.kv_adopt_handler = self._kv_adopt_handler
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="ds-metrics-http", daemon=True)
         self._thread.start()
@@ -329,11 +378,22 @@ class MetricsServer:
 
     def set_generate_handler(self, fn) -> None:
         """Attach the serving engine's ``POST /generate`` handler
-        (``fn(payload: dict) -> (status_code, json_payload)``); None
-        detaches (subsequent POSTs get 503)."""
+        (``fn(payload: dict) -> (status_code, json_payload)``, where the
+        payload may be an ndjson event ITERATOR for streaming
+        dispatches); None detaches (subsequent POSTs get 503)."""
         self._generate_handler = fn
         if self._httpd is not None:
             self._httpd.generate_handler = fn
+
+    def set_kv_handoff_handlers(self, offer_fn, adopt_fn) -> None:
+        """Attach the decode-side KV-page handoff pair (``POST
+        /kv_offer`` + ``POST /kv_adopt`` — disaggregated serving); None
+        detaches either."""
+        self._kv_offer_handler = offer_fn
+        self._kv_adopt_handler = adopt_fn
+        if self._httpd is not None:
+            self._httpd.kv_offer_handler = offer_fn
+            self._httpd.kv_adopt_handler = adopt_fn
 
     def stop(self) -> None:
         if self._httpd is None:

@@ -111,16 +111,20 @@ class ServingEngine:
 
     # HTTP /generate worker threads share the idempotent-dispatch map
     # with each other (reserve-then-fill): every _idem write holds the
-    # lock (dslint DSL006, docs/LINT.md)
+    # lock; the KV-handoff work queue is single-producer-append /
+    # engine-thread-popleft, GIL-atomic deque ops only (dslint DSL006,
+    # docs/LINT.md)
     _dslint_shared = {"_idem": "lock:_idem_lock",
-                      "_idem_order": "lock:_idem_lock"}
+                      "_idem_order": "lock:_idem_lock",
+                      "_handoffs": "atomic"}
 
     def __init__(self, model=None, config=None, *, engine: Optional[InferenceEngine] = None,
                  num_slots: int = 0, prefill_chunk: int = 0,
                  decode_block_tokens: int = 0, params: Any = None, mesh=None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, registry=None,
-                 health=None):
+                 health=None, role: str = "both",
+                 handoff_wire: str = "int8"):
         if engine is None:
             if config is None:
                 config = {}
@@ -143,6 +147,19 @@ class ServingEngine:
         self.max_prefill_chunks = max(1, int(self._config.max_prefill_chunks))
         self._sample = (bool(do_sample), float(temperature), int(top_k),
                         float(top_p))
+        # disaggregated serving role (docs/RESILIENCE.md "Disaggregated
+        # serving"): "prefill" replicas answer phase-prefill requests and
+        # ship KV pages, "decode" replicas adopt them; "both" (the
+        # default) serves monolithically.  The role is ADVISORY — every
+        # engine can serve every request shape, so a role-split fleet
+        # degrades to monolithic service instead of failing.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, got {role!r}")
+        self.role = role
+        if handoff_wire not in ("int8", "raw"):
+            raise ValueError(
+                f"handoff_wire must be int8|raw, got {handoff_wire!r}")
+        self._handoff_wire = handoff_wire
         # replica-scoped observability: by default both land on the
         # process-global registry / health flag (single-replica processes,
         # the existing contract); a multi-replica host passes one
@@ -252,6 +269,12 @@ class ServingEngine:
         # cross-thread abort requests (abort()): consumed at the top of
         # step() so slot/page teardown always runs on the engine thread
         self._aborts = deque()
+        # cross-thread KV-handoff work (/kv_offer, /kv_adopt HTTP
+        # handlers): prefix-trie mutation and page writes must run on the
+        # engine thread, so handlers enqueue {kind, payload, result,
+        # done-Event} items consumed right after aborts at the top of
+        # step(); the handler blocks on the Event for its answer
+        self._handoffs = deque()
         # deferred token blocks: device [K, B] arrays kept un-fetched until
         # scheduling needs their values.  No-EOS requests hold refcounted
         # (idx, n) refs resolved at finish; EOS requests are drain
@@ -287,8 +310,12 @@ class ServingEngine:
         gp_cfg = dict(getattr(self._config, "goodput", None) or {})
         if (os.environ.get("DSTPU_RUNLEDGER") or slo_rules
                 or gp_cfg.get("enabled")):
+            # role-split fleets attribute prefill-side and decode-side
+            # wall clock to distinct ledger roles so the run ledger's
+            # per-role aggregation keeps the two pools' goodput apart
             self._goodput.enable(
-                path=gp_cfg.get("path"), role="serve",
+                path=gp_cfg.get("path"),
+                role="serve" if self.role == "both" else f"serve-{self.role}",
                 min_tick_interval_s=gp_cfg.get("min_tick_interval_s"),
                 slo_rules=slo_rules or None)
         # compute-side lifecycle metrics (queue-side spans live in the
@@ -363,6 +390,35 @@ class ServingEngine:
             "ds_serve_crash_requeued_total",
             "in-flight requests handed back (503) because the serving "
             "loop crashed under them")
+        # disaggregated prefill/decode serving (docs/RESILIENCE.md):
+        # handoff byte/page accounting on the SENDER (wire = what crossed
+        # the socket, dense = the same pages at the engine compute
+        # dtype), adoption counts on the RECEIVER, and the streaming
+        # front's resume counter.  Registered unconditionally for the
+        # metric-namespace guard; only a role-split fleet moves them.
+        self._m_handoff_bytes = {
+            dt: reg.counter(
+                "ds_serve_kv_handoff_bytes_total",
+                "KV handoff bytes by encoding: wire encodings (int8/raw) "
+                "vs the dense twin the same pages would cost at the "
+                "compute dtype", labels={"dtype": dt})
+            for dt in ("int8", "raw", "dense")}
+        self._m_handoff_pages = reg.counter(
+            "ds_serve_kv_handoff_pages_total",
+            "KV pages shipped to a decode replica (sender side)")
+        self._m_adopted_pages = reg.counter(
+            "ds_serve_kv_adopted_pages_total",
+            "handed-off KV pages adopted into the local prefix cache "
+            "(receiver side; offered-but-already-held pages not counted)")
+        self._m_stream_resumes = reg.counter(
+            "ds_serve_stream_resumes_total",
+            "streaming /generate dispatches that entered with "
+            "resume_from > 0 (router resumed a broken stream here)")
+        self._m_role = reg.gauge(
+            "ds_serve_role_info",
+            "1 for this replica's serving role (prefill|decode|both)",
+            labels={"role": self.role})
+        self._m_role.set(1)
         from deepspeed_tpu.models.fused_decode import supports_fused_decode
         fused_ok = (self._config.use_fused_decode is not False
                     and supports_fused_decode(
@@ -388,7 +444,9 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int = 128,
                eos_token_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               traceparent: Optional[str] = None) -> Request:
+               traceparent: Optional[str] = None,
+               stream: bool = False,
+               prefill_only: bool = False) -> Request:
         """Enqueue one request; returns the live Request handle (its
         ``output_tokens`` fill in as the scheduler serves it).
 
@@ -418,7 +476,8 @@ class ServingEngine:
             deadline_s = cfg_dl if cfg_dl > 0 else None
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       eos_token_id=(-1 if eos_token_id is None
-                                    else int(eos_token_id)))
+                                    else int(eos_token_id)),
+                      stream=bool(stream), prefill_only=bool(prefill_only))
         if traceparent:
             # W3C shape "00-<32hex trace>-<16hex span>-01": the 32-hex
             # trace-id is the cross-process join key; a non-conforming
@@ -454,6 +513,11 @@ class ServingEngine:
         #    unref never race a dispatch
         while self._aborts:
             self._process_abort(self._aborts.popleft())
+        # 0b. KV-handoff work (/kv_offer, /kv_adopt): trie walks + page
+        #     writes on THIS thread — the prefix cache is engine-thread-
+        #     only by contract
+        while self._handoffs:
+            self._process_handoff(self._handoffs.popleft())
         done_before = len(self.scheduler.finished)
         # 1. admission: freed slots pick up the oldest queued requests;
         #    a prefix-cache hit pre-populates the slot's page table with
@@ -637,6 +701,14 @@ class ServingEngine:
             try:
                 while not stop.is_set():
                     idle = True
+                    # KV handoffs must progress on an IDLE replica too —
+                    # a decode replica with no live requests still
+                    # answers /kv_offer + /kv_adopt (the handler blocks
+                    # on this drain; without it every handoff to a quiet
+                    # replica stalls to the enqueue timeout)
+                    while self._handoffs:
+                        self._process_handoff(self._handoffs.popleft())
+                        idle = False
                     if self.scheduler.has_work and not (
                             self.scheduler.admission_paused
                             and self.scheduler.num_occupied == 0
@@ -738,8 +810,26 @@ class ServingEngine:
             traceparent = payload.get("traceparent")
             if traceparent is not None and not isinstance(traceparent, str):
                 raise ValueError("traceparent must be a string")
+            # disaggregated serving: "phase": "prefill" runs admission +
+            # chunked prefill only and ships the KV pages to handoff_to;
+            # "stream": true returns a chunked ndjson event stream;
+            # "resume_from": N streams/returns only tokens[N:] (the
+            # router already delivered the first N to the client)
+            phase = payload.get("phase")
+            if phase not in (None, "prefill"):
+                raise ValueError(f"unknown phase {phase!r}")
+            prefill_only = phase == "prefill"
+            stream = bool(payload.get("stream")) and not prefill_only
+            resume_from = int(payload.get("resume_from") or 0)
+            if resume_from < 0:
+                raise ValueError("resume_from must be >= 0")
+            handoff_to = payload.get("handoff_to")
+            if handoff_to is not None and not isinstance(handoff_to, str):
+                raise ValueError("handoff_to must be a string URL")
         except (KeyError, TypeError, ValueError) as exc:
             return 400, {"error": f"bad /generate payload: {exc!r}"}
+        if stream and resume_from:
+            self._m_stream_resumes.inc()
         deadline = time.monotonic() + timeout
         # the reservation loop converges: each pass either owns the key
         # (submits exactly once) or joins an existing in-flight entry; a
@@ -777,12 +867,18 @@ class ServingEngine:
                 req = entry["req"]
                 if req is None:
                     continue       # the original submit failed: take over
+                if stream:
+                    return 200, self._stream_request(
+                        req, deadline, owns=False, idem=idem, entry=entry,
+                        start=resume_from)
                 return self._await_request(req, deadline, owns=False,
-                                           idem=idem, entry=entry)
+                                           idem=idem, entry=entry,
+                                           resume_from=resume_from)
             try:
                 req = self.submit(prompt, max_new_tokens=max_new,
                                   eos_token_id=eos, deadline_s=deadline_s,
-                                  traceparent=traceparent)
+                                  traceparent=traceparent, stream=stream,
+                                  prefill_only=prefill_only)
             except QueueFull as exc:       # overload shed -> 429 + backoff
                 self._idem_drop(idem, entry)
                 return 429, {"error": str(exc), "shed": True,
@@ -796,8 +892,14 @@ class ServingEngine:
             if entry is not None:
                 entry["req"] = req         # published by the event below
                 entry["ready"].set()
+            if stream:
+                return 200, self._stream_request(
+                    req, deadline, owns=True, idem=idem, entry=entry,
+                    start=resume_from)
             return self._await_request(req, deadline, owns=True,
-                                       idem=idem, entry=entry)
+                                       idem=idem, entry=entry,
+                                       resume_from=resume_from,
+                                       handoff_to=handoff_to)
         return 503, {"error": "idempotency reservation kept churning "
                               "(original submits failing); try again",
                      "requeued": True}
@@ -813,11 +915,13 @@ class ServingEngine:
         entry["ready"].set()
 
     def _await_request(self, req: Request, deadline: float, *, owns: bool,
-                       idem=None, entry=None):
+                       idem=None, entry=None, resume_from: int = 0,
+                       handoff_to=None):
         """Block one HTTP worker until ``req`` finishes; maps every
         terminal state to the router-facing status contract.  ``owns``
         is False for a joined idempotent duplicate — it must not abort a
-        request another handler owns when ITS deadline passes."""
+        request another handler owns when ITS deadline passes (and it
+        never re-ships a handoff the owner already performed)."""
         now = time.monotonic()
         last_steps, last_progress = self.steps, now
         while not req.done:
@@ -887,13 +991,291 @@ class ServingEngine:
             self._idem_drop(idem, entry)
             return 503, {"error": "request cancelled before completion",
                          "requeued": True, "request_id": req.request_id}
-        body = {"tokens": [int(t) for t in req.output_tokens],
+        if req.finish_reason == "prefill_done":
+            # prefill-role completion: no output tokens by design — the
+            # OWNER ships the captured KV pages to the decode replica
+            # named by the dispatch (a joined duplicate reports success
+            # without re-shipping; the transfer is idempotent anyway,
+            # the decode side re-offers and takes nothing twice)
+            body = {"prefill_done": True, "tokens": [],
+                    "request_id": req.request_id,
+                    "finish_reason": "prefill_done",
+                    "prefix_hit_tokens": req.prefix_hit_tokens}
+            if owns and handoff_to:
+                body["handoff"] = self._ship_handoff(req, handoff_to)
+            if req.trace_id:
+                body["trace"] = req.trace_id
+            return 200, body
+        toks = [int(t) for t in req.output_tokens]
+        body = {"tokens": toks[resume_from:] if resume_from else toks,
                 "request_id": req.request_id,
                 "finish_reason": req.finish_reason,
                 "prefix_hit_tokens": req.prefix_hit_tokens}
+        if resume_from:
+            body["resume_from"] = int(resume_from)
+            body["tokens_total"] = len(toks)
         if req.trace_id:
             body["trace"] = req.trace_id
         return 200, body
+
+    def _stream_request(self, req: Request, deadline: float, *, owns: bool,
+                        idem=None, entry=None, start: int = 0):
+        """Streaming twin of :meth:`_await_request`: a generator of ndjson
+        events the HTTP front relays as chunked transfer encoding.  Token
+        chunks arrive as ``{"tokens": [...], "n": <cumulative>}`` the
+        moment the lag-1 drain lands them in ``output_tokens`` (reading
+        the list from this thread is safe: the engine thread only ever
+        appends, and list reads are GIL-atomic); the terminal event is
+        ``{"done": true, ...}`` with the buffered path's body fields, or
+        an ``{"error": ..., "status": ...}`` event mirroring the status
+        the buffered path would have returned (the transport already
+        committed to 200 + chunked, so the code rides in the event — the
+        router's relay turns ``requeued`` errors into a resume on another
+        replica).  ``start`` is resume-from-token-N: the client already
+        holds the first N tokens, so only the suffix is sent."""
+        sent = max(0, int(start))
+        last_steps, last_progress = self.steps, time.monotonic()
+        while True:
+            n = len(req.output_tokens)
+            if n > sent:
+                chunk = [int(t) for t in req.output_tokens[sent:n]]
+                sent = n
+                yield {"tokens": chunk, "n": sent}
+                continue
+            if req.done:
+                break
+            now = time.monotonic()
+            if self.steps != last_steps:
+                last_steps, last_progress = self.steps, now
+            if self._loop_crashed:
+                # same hand-back contract as _await_request: the stream
+                # ends with a resumable error and the router re-dispatches
+                # with resume_from = tokens already relayed
+                if req.state == QUEUED and self.scheduler.cancel(req):
+                    self._m_crash_requeues.inc()
+                    self._idem_drop(idem, entry)
+                    yield {"error": "request requeued: serving loop "
+                                    "crashed before admission",
+                           "requeued": True, "status": 503, "n": sent}
+                    return
+                if req.state in (PREFILLING, RUNNING):
+                    self.abort(req)
+                    self._m_crash_requeues.inc()
+                    self._idem_drop(idem, entry)
+                    yield {"error": "request requeued: serving loop "
+                                    "crashed mid-request (aborted locally)",
+                           "requeued": True, "status": 503, "n": sent}
+                    return
+            if req.state == QUEUED and (
+                    self.scheduler.admission_paused
+                    or (not self._loop_alive()
+                        and now - last_progress > 1.0)):
+                if self.scheduler.cancel(req):
+                    self._idem_drop(idem, entry)
+                    yield {"error": "request requeued: replica draining/"
+                                    "stopped before admission",
+                           "requeued": True, "status": 503, "n": sent}
+                    return
+            if now > deadline:
+                if owns:
+                    self.abort(req)
+                yield {"error": "generation timed out"
+                                + (" (request aborted; slot reclaimed)"
+                                   if owns else ""),
+                       "status": 504, "request_id": req.request_id,
+                       "n": sent}
+                return
+            time.sleep(0.001)
+        # the finish raced the last length check: flush the tail so the
+        # stream is complete before the terminal event
+        n = len(req.output_tokens)
+        if n > sent:
+            yield {"tokens": [int(t) for t in req.output_tokens[sent:n]],
+                   "n": n}
+            sent = n
+        if req.finish_reason == "deadline":
+            yield {"error": "service deadline expired before admission; "
+                            "request cancelled",
+                   "deadline_expired": True, "status": 504,
+                   "request_id": req.request_id, "n": sent}
+            return
+        if req.finish_reason == "cancelled":
+            self._idem_drop(idem, entry)
+            yield {"error": "request cancelled before completion",
+                   "requeued": True, "status": 503,
+                   "request_id": req.request_id, "n": sent}
+            return
+        final = {"done": True, "request_id": req.request_id,
+                 "finish_reason": req.finish_reason, "n": sent,
+                 "prefix_hit_tokens": req.prefix_hit_tokens}
+        if req.trace_id:
+            final["trace"] = req.trace_id
+        yield final
+
+    # ------------------------------------------------------------------
+    # KV-page handoff (disaggregated prefill/decode serving —
+    # docs/RESILIENCE.md "Disaggregated serving")
+    # ------------------------------------------------------------------
+    def _capture_handoff(self, req: Request) -> None:
+        """Engine-thread half of the prefill->decode handoff: read the
+        request's FULL prompt pages device->host and stash (chunk tokens,
+        page payload) pairs on the request — BEFORE release returns the
+        pages to the pool (the payloads are host copies, so the release
+        is safe).  Fixed-slot engines have no pages to ship; the decode
+        side simply re-prefills (degraded mode)."""
+        req.handoff = []
+        if not self.paged:
+            return
+        page = self.pool.page
+        resident = min(req.prefill_pos, req.prompt_len)
+        full = resident // page
+        if not full:
+            return
+        # ledger: handoff IO is its own category so prefill-role wall
+        # clock splits into compute vs handoff in the run ledger
+        self._goodput.push("handoff")
+        try:
+            pages = self.pool.owned(req.slot)[:full]
+            for i, pid in enumerate(pages):
+                toks = [int(t) for t in req.prompt[i * page:(i + 1) * page]]
+                req.handoff.append((toks, self._fetch_page_host(int(pid))))
+        finally:
+            self._goodput.pop()
+
+    def _ship_handoff(self, req: Request, target: str) -> dict:
+        """HTTP-handler half (network IO off the engine thread): offer
+        the captured chunk manifest to the decode replica at ``target``,
+        ship ONLY the pages it reports missing (shared prefixes transfer
+        once, fleet-wide), and account wire vs dense-twin bytes.
+        Best-effort by contract: any failure returns an ``error`` field
+        and the decode replica re-prefills the prompt itself (monolithic
+        fallback) — a handoff can make a request faster, never wrong."""
+        import json as _json
+        import urllib.request
+
+        from deepspeed_tpu.serving import handoff as hoff
+
+        pages = req.handoff or []
+        out = {"pages_offered": len(pages), "pages_shipped": 0,
+               "wire_bytes": 0, "dense_bytes": 0}
+        if not pages:
+            return out
+
+        def post(path, obj):
+            data = _json.dumps(obj).encode()
+            r = urllib.request.Request(
+                target.rstrip("/") + path, data=data,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(r, timeout=30.0) as resp:
+                return _json.loads(resp.read().decode())
+
+        try:
+            offer = post("/kv_offer", {"chunks": [c for c, _ in pages]})
+            need = sorted(int(i) for i in offer.get("need", []))
+            dense_item = np.dtype(self.engine.dtype).itemsize
+            enc_pages = {}
+            for i in need:
+                if not 0 <= i < len(pages):
+                    continue
+                enc = hoff.encode_page(pages[i][1], wire=self._handoff_wire)
+                enc_pages[str(i)] = enc
+                out["wire_bytes"] += hoff.wire_nbytes(enc)
+                out["dense_bytes"] += hoff.dense_twin_nbytes(
+                    pages[i][1], dense_item)
+            if enc_pages:
+                adopt = post("/kv_adopt", {"chunks": [c for c, _ in pages],
+                                           "pages": enc_pages})
+                out["pages_shipped"] = len(enc_pages)
+                out["pages_adopted"] = int(adopt.get("adopted", 0))
+                self._m_handoff_pages.inc(len(enc_pages))
+                self._m_handoff_bytes[self._handoff_wire].inc(
+                    out["wire_bytes"])
+                self._m_handoff_bytes["dense"].inc(out["dense_bytes"])
+        except Exception as exc:  # noqa: BLE001 - degraded mode by contract
+            out["error"] = repr(exc)
+        return out
+
+    def _http_kv_offer(self, payload: dict):
+        """``POST /kv_offer`` (decode-role side): which of these chunks
+        do I lack?  Engine-thread work — the trie walk touches LRU."""
+        return self._enqueue_handoff("offer", payload)
+
+    def _http_kv_adopt(self, payload: dict):
+        """``POST /kv_adopt`` (decode-role side): decode + write the
+        shipped pages and pin them into the local prefix trie."""
+        return self._enqueue_handoff("adopt", payload)
+
+    def _enqueue_handoff(self, kind: str, payload: dict,
+                         timeout: float = 30.0):
+        work = {"kind": kind, "payload": payload, "result": None,
+                "done": threading.Event()}
+        self._handoffs.append(work)
+        if not work["done"].wait(timeout):
+            return 503, {"error": f"kv_{kind} timed out waiting for the "
+                                  "engine thread (serving loop running?)"}
+        res = work["result"]
+        if "error" in res:
+            return 400, res
+        return 200, res
+
+    def _process_handoff(self, work: dict) -> None:
+        """Engine-thread half of the /kv_offer and /kv_adopt handlers."""
+        try:
+            work["result"] = self._handoff_work(work["kind"],
+                                                work["payload"])
+        except Exception as exc:  # noqa: BLE001 - handler needs an answer
+            work["result"] = {"error": repr(exc)}
+        finally:
+            work["done"].set()
+
+    def _handoff_work(self, kind: str, payload: dict) -> dict:
+        chunks = [tuple(int(t) for t in c)
+                  for c in (payload.get("chunks") or [])]
+        if self.prefix_cache is None:
+            # no trie to adopt into: claim everything is held so the
+            # sender ships nothing; decode-side admission re-prefills
+            return {"need": []} if kind == "offer" else {"adopted": 0}
+        if any(len(c) != self.pool.page for c in chunks):
+            return {"error": "handoff chunks must be exactly "
+                             f"page_tokens={self.pool.page} tokens long"}
+        if kind == "offer":
+            flat = np.asarray([t for c in chunks for t in c], np.int32)
+            m = len(self.prefix_cache.match_nodes(flat))
+            return {"need": list(range(m, len(chunks)))}
+        from deepspeed_tpu.serving import handoff as hoff
+
+        want = {k for k, v in self._cache.items() if v.ndim == 5}
+        self._goodput.push("handoff")
+        try:
+            payloads = {}
+            for key, enc in (payload.get("pages") or {}).items():
+                planes = hoff.decode_page(enc)
+                if set(planes) != want:
+                    return {"error": "KV plane-layout mismatch between "
+                                     "roles (quantize_kv_cache and the "
+                                     "model config must match fleet-wide)"}
+                payloads[int(key)] = {
+                    k: np.ascontiguousarray(
+                        np.asarray(v).astype(self._cache[k].dtype))
+                    for k, v in planes.items()}
+
+            def alloc():
+                pid = self.pool.alloc_page()
+                while pid is None:
+                    if not self.prefix_cache.evict_lru():
+                        return None
+                    pid = self.pool.alloc_page()
+                return pid
+
+            adopted = self.prefix_cache.adopt_chunks(
+                chunks, payloads, alloc, self._write_page)
+            if adopted:
+                self._m_adopted_pages.inc(adopted)
+                self._m_pages_used.set(self.pool.pages_used)
+                self._m_pages_free.set(self.pool.pages_free)
+            return {"adopted": adopted}
+        finally:
+            self._goodput.pop()
 
     # ------------------------------------------------------------------
     # /profilez: on-demand device-true capture over scheduler iterations
@@ -1241,6 +1623,16 @@ class ServingEngine:
             # still device-resident, but it exists and later work is
             # ordered behind it
             self._m_ttft.record(req.t_first_token - req.t_submit)
+        if req.prefill_only:
+            # prefill-role finish (disaggregated serving): the prompt KV
+            # is resident — capture the full prompt pages for the
+            # prefill->decode handoff and finish WITHOUT decoding.  The
+            # decode replica owns sampling end to end (even token 1 is
+            # produced there, from byte-identical KV), so the response
+            # cannot depend on which role computed the prefix.
+            self._capture_handoff(req)
+            self._release(req, "prefill_done")
+            return
         # prefix resident + first token dispatched: the request's decode
         # phase begins here (re-entered after a preempt-resume re-prefill)
         self._tracer.decode_start(req.request_id, tpf)
@@ -1254,9 +1646,12 @@ class ServingEngine:
         req_bound = req.prompt_len + req.max_new_tokens - 1
         limit = min(req_bound, self.max_out - 1)
         req.limit_reason = "length" if limit == req_bound else "cache_budget"
-        if (req.eos_token_id >= 0
+        if (req.eos_token_id >= 0 or req.stream
                 or len(req.output_tokens) + 1 >= req.max_new_tokens
                 or limit <= S):
+            # streaming requests also take the sync: the first token IS
+            # the first chunk on the wire — deferring it would hold TTFT
+            # hostage to the first decode block's drain
             first = int(tok_dev)         # the once-per-request EOS sync
             req.output_tokens.append(first)
             if req.eos_token_id >= 0 and first == req.eos_token_id:
@@ -1412,9 +1807,13 @@ class ServingEngine:
             self._m_decode_toks.inc(n)
             self._goodput.add_tokens(n)
             refs += 1
-            if req.eos_token_id < 0:
+            if req.eos_token_id < 0 and not req.stream:
                 req.pending_blocks.append((idx, n))
             else:
+                # EOS rows need the drain for slot turnover; STREAMING
+                # rows ride the same lag-1 drain so their tokens land in
+                # output_tokens incrementally — the HTTP stream generator
+                # tails the list and ships each block as it drains
                 drainers.append(req)
             if self._pos[b] >= self._limit[b]:
                 # stop scheduling the row; EOS rows RELEASE at their drain
@@ -1430,7 +1829,8 @@ class ServingEngine:
             while len(self._outstanding) > self._drain_lag:
                 self._drain_one()
         for req in running:              # finish AFTER refs registered
-            if (req.eos_token_id < 0 and not self._active[req.slot]
+            if (req.eos_token_id < 0 and not req.stream
+                    and not self._active[req.slot]
                     and req.state == RUNNING):
                 self._materialize(req)
                 self._release(req, req.limit_reason)

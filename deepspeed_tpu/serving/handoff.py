@@ -1,0 +1,129 @@
+"""KV-page handoff codec for disaggregated prefill/decode serving.
+
+A prefill replica finishes chunked prefill with the request's KV sitting
+in its own paged pool; the decode replica needs those pages before it can
+emit token 1 without re-running prefill.  This module is the WIRE FORMAT
+of that transfer: page payloads (the ``{plane_name: [L, H_kv, page,
+D]}`` dicts ``ServingEngine._fetch_page_host`` reads and the host tier
+stores) serialized into JSON-able dicts, int8 over the wire via the
+blockwise codec from ``comm/quant.py``.
+
+Three plane encodings, chosen per plane:
+
+- a plane that is ALREADY int8 (``quantize_kv_cache=True`` pools store
+  k/v as int8 codes + fp32 scale planes) ships verbatim — the handoff is
+  LOSSLESS, so decode-side outputs are bit-identical to a monolithic
+  replica;
+- a wide (bf16/fp32) plane under ``wire="int8"`` is blockwise-quantized
+  (<= 1/254 relative error per element — the same budget every other
+  int8 relay in the repo carries);
+- ``wire="raw"`` ships wide planes byte-exact when the operator wants
+  bit-identity on an unquantized pool and can afford the bytes.
+
+The manifest that decides WHICH pages travel is the prefix-cache trie
+key set: the offer lists page-sized token chunks, the decode side
+answers with the indices it does not already hold (shared prefixes
+transfer once, fleet-wide).  Every byte count the bench/metrics report
+is computed here so sender and receiver agree: ``wire_nbytes`` is what
+crossed the socket (pre-base64), ``dense_twin_nbytes`` what the same
+page would have cost shipped dense at the engine compute dtype.
+
+Imports ``comm.quant`` (which imports jax) — replica-side only; the
+router relays handoff payloads as opaque JSON and must stay jax-free.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.comm.quant import (DEFAULT_BLOCK, decode_blockwise_np,
+                                      encode_blockwise_np)
+
+__all__ = ["encode_page", "decode_page", "wire_nbytes",
+           "dense_twin_nbytes", "page_chunks"]
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode("ascii")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode("ascii"))
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registered by jax; covers bfloat16 etc.
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_page(payload: Dict[str, np.ndarray], wire: str = "int8",
+                block: int = DEFAULT_BLOCK) -> dict:
+    """One page payload -> JSON-able dict.  int8 planes (quantized pool
+    codes + their fp32 scale planes ride as raw — scales are 1/page_tokens
+    of the code bytes) always ship verbatim; wide planes follow ``wire``."""
+    planes = {}
+    for name, arr in payload.items():
+        a = np.ascontiguousarray(np.asarray(arr))
+        if a.dtype == np.int8 or wire == "raw" or name.endswith("_scale"):
+            planes[name] = {"codec": "raw", "b": _b64(a.tobytes()),
+                            "dtype": str(a.dtype),
+                            "shape": [int(s) for s in a.shape],
+                            "nbytes": int(a.nbytes)}
+        else:
+            enc = encode_blockwise_np(a, block)
+            planes[name] = {"codec": "q8", "q": _b64(enc["q"]),
+                            "scale": _b64(enc["scale"]),
+                            "shape": [int(s) for s in enc["shape"]],
+                            "block": int(enc["block"]),
+                            "dtype": str(a.dtype),
+                            "nbytes": len(enc["q"]) + len(enc["scale"])}
+    return {"planes": planes, "wire": wire}
+
+
+def decode_page(enc: dict) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`encode_page` -> numpy payload dict.  q8 planes
+    come back fp32 in the original shape; the engine casts each plane to
+    its pool storage dtype at write time."""
+    out: Dict[str, np.ndarray] = {}
+    for name, plane in enc["planes"].items():
+        shape = tuple(plane["shape"])
+        if plane["codec"] == "raw":
+            out[name] = np.frombuffer(
+                _unb64(plane["b"]), _np_dtype(plane["dtype"])).reshape(shape)
+        else:
+            out[name] = decode_blockwise_np(
+                {"q": _unb64(plane["q"]), "scale": _unb64(plane["scale"]),
+                 "shape": shape, "block": plane["block"]})
+    return out
+
+
+def wire_nbytes(enc: dict) -> int:
+    """Payload bytes that crossed the socket (pre-base64 framing)."""
+    return sum(int(p["nbytes"]) for p in enc["planes"].values())
+
+
+def dense_twin_nbytes(payload: Dict[str, np.ndarray],
+                      dense_itemsize: int) -> int:
+    """What this page would cost shipped dense at the engine compute
+    dtype: every k/v element at ``dense_itemsize`` bytes.  Scale planes
+    have no dense twin (a dense cache does not store them)."""
+    total = 0
+    for name, arr in payload.items():
+        if name.endswith("_scale"):
+            continue
+        total += int(np.asarray(arr).size) * int(dense_itemsize)
+    return total
+
+
+def page_chunks(tokens: Sequence[int], page: int) -> List[List[int]]:
+    """The prompt's full page-sized token chunks — the handoff manifest
+    (exactly the prefix-cache trie's edge labels for this prompt)."""
+    toks = [int(t) for t in tokens]
+    return [toks[i * page:(i + 1) * page] for i in range(len(toks) // page)]

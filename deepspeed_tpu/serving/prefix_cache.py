@@ -245,6 +245,61 @@ class PrefixCache:
         return added
 
     # ------------------------------------------------------------------
+    def adopt_chunks(self, chunks: List[Tuple[int, ...]],
+                     payloads: Dict[int, dict],
+                     alloc_page, write_page) -> int:
+        """Adopt a HANDED-OFF prefix (disaggregated serving): ``chunks``
+        is the sender's manifest (page-sized token chunks from the
+        root), ``payloads`` maps chunk index -> page payload for the
+        chunks the sender shipped (it skips ones we reported as already
+        held).  Chunks already cached are touched in place, either tier;
+        a missing chunk with a payload gets a fresh page from
+        ``alloc_page`` (the engine's pressure-aware allocator — it may
+        evict through THIS cache mid-walk, which is safe: the walk
+        re-reads ``children`` each step and eviction never unpins a
+        just-pinned node), written via ``write_page``, and pinned into
+        the trie exactly like :meth:`insert`.  The walk stops at the
+        first chunk it can neither find nor fill (missing payload, pool
+        exhausted) — everything past it would be unmatchable anyway.
+        Returns pages newly adopted."""
+        children = self._children
+        parent: Optional[_Node] = None
+        tick = next(self._tick)
+        adopted = 0
+        for i, chunk in enumerate(chunks):
+            chunk = tuple(int(t) for t in chunk)
+            node = children.get(chunk)
+            if node is not None and node.page == -1:
+                if (self.host_store is None
+                        or not self.host_store.touch(node.host_key)):
+                    # host entry aged out: path is dead — prune and fall
+                    # through to re-homing it from the payload
+                    self._drop_subtree(node)
+                    node = None
+            if node is None:
+                payload = payloads.get(i)
+                if payload is None:
+                    break
+                page = alloc_page()
+                if page is None:
+                    break
+                write_page(page, payload)
+                node = _Node(chunk, int(page), parent)
+                children[chunk] = node
+                self.pool.pin(node.page)
+                self._lru_append(node)
+                self._nodes += 1
+                adopted += 1
+            elif node.page >= 0:
+                self._lru_touch(node)
+            node.tick = tick
+            parent = node
+            children = node.children
+        if adopted:
+            self._m_pages.set(self.pool.pages_cached)
+        return adopted
+
+    # ------------------------------------------------------------------
     def _drop_host_entry(self, node: _Node) -> None:
         if node.host_key is not None:
             self._host_nodes.pop(node.host_key, None)

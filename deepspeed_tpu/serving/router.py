@@ -47,6 +47,25 @@ is that something, built from signals the replicas already export:
   in-flight generation instead of producing a second one.  This is what
   makes timeouts retry-elsewhere-safe (previously they had to surface
   as 504 precisely because a retry could double-generate).
+- **role-split (disaggregated) fleets** — a replica spec may carry a
+  role (``name@prefill=url`` / ``name@decode=url``; default ``both``).
+  When the fleet has dedicated prefill replicas, ``dispatch`` runs a
+  TWO-PHASE request: phase 1 posts ``{"phase": "prefill"}`` to a
+  prefill replica, which runs admission + chunked prefill and ships the
+  computed KV pages to the chosen decode replica (``handoff_to``, int8
+  on the wire — docs/RESILIENCE.md "Disaggregated serving"); phase 2
+  dispatches the full generate to the decode pool, preferring the
+  replica the pages landed on.  The phase NEVER fails the request: a
+  sick prefill pool degrades to monolithic serving (the decode replica
+  recomputes the prefix itself).  Session affinity and breaker state
+  are role-scoped — a drained prefill replica cannot absorb decode
+  pins.
+- **token streaming with resume-from-token-N** — ``{"stream": true}``
+  payloads relay the replica's NDJSON chunk stream through the router
+  (TTFT becomes user-visible).  A replica that dies MID-STREAM is
+  retried on a survivor with ``resume_from=<tokens already relayed>``
+  and the same idempotency key, so the client sees one contiguous
+  token stream with no duplicated and no dropped tokens.
 
 The router dispatches ``POST /generate`` (the endpoint
 ``init_serving(metrics_port=...)`` attaches to the replica's metrics
@@ -241,8 +260,14 @@ class Replica:
                       "_cooldown": "lock:_lock",
                       "_probe_inflight": "lock:_lock"}
 
-    def __init__(self, name: str, base_url: str):
+    ROLES = ("both", "prefill", "decode")
+
+    def __init__(self, name: str, base_url: str, role: str = "both"):
         self.name = name
+        if role not in self.ROLES:
+            raise ValueError(f"unknown replica role {role!r} "
+                             f"(one of {self.ROLES})")
+        self.role = role
         self.base = base_url.rstrip("/")
         if not self.base.startswith("http"):
             self.base = "http://" + self.base
@@ -322,7 +347,8 @@ class Replica:
 
     def snapshot(self) -> Dict[str, object]:
         now = time.monotonic()
-        return {"name": self.name, "base": self.base, "ready": self.ready,
+        return {"name": self.name, "base": self.base, "role": self.role,
+                "ready": self.ready,
                 "reason": self.reason, "queue_depth": self.queue_depth,
                 "active_slots": self.active_slots,
                 "kv_busy": round(self.kv_busy, 4),
@@ -359,11 +385,18 @@ class Router:
         for i, spec in enumerate(replicas):
             name, sep, rest = spec.partition("=")
             if sep and not name.startswith("http") and "/" not in name:
-                self.replicas.append(Replica(name, rest))
+                # "name=url" or role-split "name@prefill=url"
+                name, _, role = name.partition("@")
+                self.replicas.append(Replica(name, rest,
+                                             role=role or "both"))
             else:
                 self.replicas.append(Replica(f"r{i}", spec))
         if not self.replicas:
             raise ValueError("router needs at least one replica URL")
+        # a fleet with ANY dedicated role dispatches role-aware; an
+        # all-"both" fleet keeps the legacy single-phase path bit-for-bit
+        self._has_roles = any(r.role != "both" for r in self.replicas)
+        self._has_prefill = any(r.role == "prefill" for r in self.replicas)
         self._by_name = {r.name: r for r in self.replicas}
         if len(self._by_name) != len(self.replicas):
             raise ValueError("duplicate replica names")
@@ -440,7 +473,7 @@ class Router:
                 "by kind",
                 labels={"kind": kind})
             for kind in ("pick", "attempt", "retry", "breaker_skip",
-                         "shed", "idem_join")}
+                         "shed", "idem_join", "handoff", "resume")}
         self._m_hop_seconds = self.registry.histogram(
             "ds_router_hop_seconds",
             "wall seconds per dispatch attempt (the POST to a replica, "
@@ -517,39 +550,69 @@ class Router:
     def ready_replicas(self) -> List[Replica]:
         return [r for r in self.replicas if r.ready]
 
+    @staticmethod
+    def _role_ok(rep: Replica, role: Optional[str]) -> bool:
+        """Role gate for dispatch targets.  Decode work may land on a
+        dedicated decode replica or a monolithic "both"; prefill-phase
+        work ONLY on a dedicated prefill replica (a "both" replica
+        prefills inline during its own decode dispatch — phase-splitting
+        to it would add a handoff without saving any work)."""
+        if role is None:
+            return True
+        if role == "decode":
+            return rep.role in ("decode", "both")
+        return rep.role == role
+
+    @staticmethod
+    def _akey(role: Optional[str], session: str):
+        """Affinity-map key: role-SCOPED in role-split fleets, so a
+        session's prefill pin and decode pin live independently and a
+        drained prefill replica can never absorb (or shadow) the
+        session's decode pin.  Legacy role-less dispatch keeps the bare
+        session string."""
+        return session if role is None else (role, session)
+
     def pick(self, session: Optional[str] = None,
-             exclude: Tuple[str, ...] = ()) -> Optional[Replica]:
+             exclude: Tuple[str, ...] = (),
+             role: Optional[str] = None) -> Optional[Replica]:
         """Session-affine when possible (prefix-cache locality), else the
         lowest-score ready replica (name as the deterministic final
-        tie-break).  Breaker-open replicas are skipped; when only
+        tie-break), restricted to ``role``-compatible replicas (see
+        :meth:`_role_ok`).  Breaker-open replicas are skipped; when only
         half-open replicas remain, the best-scored one admits a single
         probe.  A session pinned to a replica that LEFT membership
         (crash — a clean drain pops the pin at dispatch) falls back to
         least-loaded immediately AND drops the pin, so the conversation
         re-pins to the fallback replica — its prefix pages warm THERE,
         and the session must not bounce back to the cold original when
-        it rejoins inside the affinity TTL."""
+        it rejoins inside the affinity TTL.  A pin whose replica no
+        longer passes the role gate (fleet re-rolled) is dropped the
+        same way."""
         now = time.monotonic()
         ready = [r for r in self.replicas
-                 if r.ready and r.name not in exclude]
+                 if r.ready and r.name not in exclude
+                 and self._role_ok(r, role)]
         if session is not None:
+            akey = self._akey(role, session)
             with self._lock:
-                ent = self._affinity.get(session)
+                ent = self._affinity.get(akey)
             if ent is not None and now - ent[1] < self.affinity_ttl:
                 rep = self._by_name.get(ent[0])
                 usable = (rep is not None and rep.ready
-                          and rep.breaker_state(now) == "closed")
+                          and rep.breaker_state(now) == "closed"
+                          and self._role_ok(rep, role))
                 if usable and rep.name not in exclude:
                     return rep
                 if not usable:
-                    # pinned replica crashed / tripped its breaker: unpin
-                    # so the dispatch below re-pins to where it actually
-                    # lands.  A pin that is merely EXCLUDED this round
-                    # (e.g. it answered one transient 429) is kept — the
-                    # session returns to its warm prefix pages next time
+                    # pinned replica crashed / tripped its breaker / lost
+                    # its role: unpin so the dispatch below re-pins to
+                    # where it actually lands.  A pin that is merely
+                    # EXCLUDED this round (e.g. it answered one transient
+                    # 429) is kept — the session returns to its warm
+                    # prefix pages next time
                     with self._lock:
-                        if self._affinity.get(session) is ent:
-                            del self._affinity[session]
+                        if self._affinity.get(akey) is ent:
+                            del self._affinity[akey]
         # least-loaded over closed replicas AND half-open probes: a
         # cooled-down replica re-enters the ordering by score (it has no
         # inflight, so it naturally reaches the front) and admits ONE
@@ -597,6 +660,63 @@ class Router:
             except Exception:
                 return exc.code, {"error": f"replica returned {exc.code}"}
 
+    def _post_stream(self, rep: Replica, payload: dict):
+        """:meth:`_post` for streaming dispatches: returns ``(200,
+        live-response)`` so the relay can read NDJSON events
+        incrementally, or ``(code, body-dict)`` for any non-200 answer
+        (which the replica sends as plain JSON — streaming only starts
+        once the request is admitted)."""
+        import urllib.error
+        import urllib.request
+
+        tp = payload.get("traceparent")
+        payload = {k: v for k, v in payload.items() if k != "traceparent"}
+        headers = {"Content-Type": "application/json"}
+        if isinstance(tp, str) and tp:
+            headers["traceparent"] = tp
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            rep.base + "/generate", data=body, headers=headers)
+        deadline = self.request_timeout
+        try:
+            deadline = max(deadline, float(payload.get("timeout")) + 30.0)
+        except (TypeError, ValueError):
+            pass
+        try:
+            resp = urllib.request.urlopen(req, timeout=deadline)
+        except urllib.error.HTTPError as exc:
+            try:
+                return exc.code, json.load(exc)
+            except Exception:
+                return exc.code, {"error": f"replica returned {exc.code}"}
+        return resp.status, resp
+
+    def _hop(self, hops: List[dict], kind: str,
+             t0: Optional[float] = None, t1: Optional[float] = None,
+             **args) -> None:
+        """Append one trace-hop record (the shape :class:`_HopLog`
+        snapshots) to ``hops``."""
+        h: Dict[str, object] = {
+            "kind": kind,
+            "t0": t0 if t0 is not None else time.perf_counter()}
+        if t1 is not None:
+            h["t1"] = t1
+        if args:
+            h["args"] = args
+        hops.append(h)
+
+    def _file_hops(self, trace: str, t0: float, code: int,
+                   hops: List[dict]) -> None:
+        """Bump the per-kind hop counters + attempt-latency histogram and
+        file the finished dispatch record in the /requestz ring."""
+        for h in hops:
+            m = self._m_hops.get(h["kind"])
+            if m is not None:
+                m.inc()
+            if h["kind"] == "attempt" and "t1" in h:
+                self._m_hop_seconds.record(h["t1"] - h["t0"])
+        self.hops.record(trace, t0, time.perf_counter(), code, hops)
+
     def _take_retry_token(self) -> bool:
         """One retry's withdrawal from the budget bucket; False = the
         bucket is dry and the retry must be suppressed (a fleet where
@@ -609,38 +729,140 @@ class Router:
         self._m_budget_exhausted.inc()
         return False
 
-    def dispatch(self, payload: dict) -> Tuple[int, dict]:
-        """Route one ``/generate`` payload: ensure a trace context (the
-        caller's ``traceparent`` or one minted here), run the retry loop
-        in :meth:`_dispatch` recording a hop span per decision point,
-        then file the finished record in :attr:`hops` (the router's
-        ``/requestz`` ring).  200 bodies additionally carry the 32-hex
-        trace id under ``"trace"``."""
+    def _prepare(self, payload: dict) -> Tuple[dict, str]:
+        """Shared dispatch preamble: copy the payload, ensure a trace
+        context (the caller's ``traceparent`` or one minted here) and an
+        ``idempotency_key`` (minted BEFORE the prefill phase so phase 1
+        and phase 2 derive from one logical key)."""
         payload = dict(payload)
         tp = payload.get("traceparent")
         if not (isinstance(tp, str) and tp):
             tp = _mint_traceparent()
             payload["traceparent"] = tp
-        trace = _trace_id(tp)
+        if not payload.get("idempotency_key"):
+            payload["idempotency_key"] = \
+                f"{self._idem_prefix}-{next(self._idem_seq)}"
+        return payload, _trace_id(tp)
+
+    def _route_roles(self, payload: dict,
+                     hops: List[dict]) -> Tuple[Optional[str],
+                                                Optional[Replica]]:
+        """Pre-dispatch role decision: ``(role, preferred replica)``.
+        Legacy all-``both`` fleets keep ``role=None`` (no behavior
+        change).  A payload that IS a prefill-phase request routes
+        strictly to prefill replicas; everything else runs the prefill
+        phase (when the fleet has dedicated prefill replicas) and then
+        dispatches to the decode pool, preferring the replica the KV
+        pages were shipped to."""
+        if not self._has_roles:
+            return None, None
+        if payload.get("phase") == "prefill":
+            return "prefill", None
+        prefer = (self._prefill_phase(payload, hops)
+                  if self._has_prefill else None)
+        return "decode", prefer
+
+    def dispatch(self, payload: dict) -> Tuple[int, dict]:
+        """Route one ``/generate`` payload: :meth:`_prepare` the trace +
+        idempotency context, run the disaggregated prefill phase when the
+        fleet is role-split (:meth:`_route_roles`), then the retry loop
+        in :meth:`_dispatch` recording a hop span per decision point, and
+        file the finished record in :attr:`hops` (the router's
+        ``/requestz`` ring).  200 bodies additionally carry the 32-hex
+        trace id under ``"trace"``."""
+        payload, trace = self._prepare(payload)
         hops: List[dict] = []
         t0 = time.perf_counter()
-        code, body = self._dispatch(payload, hops)
-        for h in hops:
-            m = self._m_hops.get(h["kind"])
-            if m is not None:
-                m.inc()
-            if h["kind"] == "attempt" and "t1" in h:
-                self._m_hop_seconds.record(h["t1"] - h["t0"])
-        self.hops.record(trace, t0, time.perf_counter(), code, hops)
+        role, prefer = self._route_roles(payload, hops)
+        code, body = self._dispatch(payload, hops, role=role,
+                                    prefer=prefer)
+        self._file_hops(trace, t0, code, hops)
         if code == 200 and isinstance(body, dict):
             body.setdefault("trace", trace)
         return code, body
 
-    def _dispatch(self, payload: dict,
-                  hops: List[dict]) -> Tuple[int, dict]:
+    def _prefill_phase(self, payload: dict,
+                       hops: List[dict]) -> Optional[Replica]:
+        """Disaggregated phase 1: run admission + chunked prefill on a
+        dedicated prefill replica, which ships the matched/computed KV
+        pages to the chosen decode replica (``handoff_to``) before its
+        ``prefill_done`` answer lands here.  Returns the decode replica
+        the pages landed on — the preferred phase-2 target — or None
+        when the phase was skipped (no ready prefill/decode replica).
+        The phase NEVER fails the request: any phase error degrades to
+        monolithic serving (the decode replica recomputes the prefix
+        itself), while the breaker still learns about the sick prefill
+        replica."""
+        session = payload.get("session")
+        dec = self.pick(session=session, role="decode")
+        pre = self.pick(session=session, role="prefill")
+        if dec is not None:
+            # the phase never POSTs to dec itself — hand back a half-open
+            # probe reservation pick() may have made; phase 2 re-probes
+            dec.release_probe()
+        if dec is None or pre is None:
+            if pre is not None:
+                pre.release_probe()   # picked as a probe but never POSTed
+            return dec
+        pf = {k: v for k, v in payload.items()
+              if k not in ("stream", "resume_from", "phase", "handoff_to")}
+        pf["phase"] = "prefill"
+        pf["handoff_to"] = dec.base
+        pf["idempotency_key"] = f"{payload['idempotency_key']}-pf"
+        with self._lock:
+            pre.inflight += 1
+        t0 = time.perf_counter()
+        try:
+            try:
+                code, body = self._post(pre, pf)
+            except OSError as exc:
+                code, body = -1, {"error": f"unreachable: {exc}"}
+        finally:
+            with self._lock:
+                pre.inflight -= 1
+        args: Dict[str, object] = {"prefill": pre.name, "decode": dec.name,
+                                   "status": code}
+        ship = body.get("handoff") if isinstance(body, dict) else None
+        if isinstance(ship, dict):
+            for k in ("pages_shipped", "wire_bytes", "error"):
+                if ship.get(k) is not None:
+                    args[k] = ship[k]
+        self._hop(hops, "handoff", t0=t0, t1=time.perf_counter(), **args)
+        now = time.monotonic()
+        if code == 200:
+            pre.note_success()
+            self._m_breaker_open[pre.name].set(0)
+            self._m_dispatch[pre.name].inc()
+            if session is not None:
+                with self._lock:
+                    self._affinity[self._akey("prefill", session)] = \
+                        (pre.name, now)
+        elif code in (400, 429, 504):
+            # inconclusive for the breaker (bad payload / shed / deadline)
+            pre.release_probe()
+        else:
+            if pre.note_failure(now, self.breaker_threshold,
+                                self.breaker_cooldown,
+                                self.breaker_cooldown_max):
+                self._m_breaker_trips.inc()
+            self._m_breaker_open[pre.name].set(
+                0 if pre.breaker_state(now) == "closed" else 1)
+            if code in (-1, 503):
+                pre.ready = False
+                pre.reason = ((body or {}).get("error")
+                              or f"prefill -> {code}")
+        return dec
+
+    def _dispatch(self, payload: dict, hops: List[dict],
+                  role: Optional[str] = None,
+                  prefer: Optional[Replica] = None) -> Tuple[int, dict]:
         """The retry loop behind :meth:`dispatch`: pick → POST → retry
         elsewhere on failure, appending one hop dict per decision point
-        to ``hops``.  Returns ``(status, body)``; 200 bodies carry the
+        to ``hops``.  ``role`` restricts targets to role-compatible
+        replicas; ``prefer`` (the replica the prefill phase shipped KV
+        pages to) is tried FIRST when it is ready with a closed breaker
+        — a miss just falls back to the normal pick, the pages were an
+        optimization.  Returns ``(status, body)``; 200 bodies carry the
         serving replica's name under ``"replica"``.
 
         Every dispatch carries an ``idempotency_key`` (the caller's, or
@@ -666,18 +888,12 @@ class Router:
         Retries draw from the budget bucket; an empty bucket fails the
         request with what the last replica said instead of amplifying."""
         session = payload.get("session")
+        akey = self._akey(role, session) if session is not None else None
         payload = dict(payload)
 
         def hop(kind: str, t0: Optional[float] = None,
                 t1: Optional[float] = None, **args) -> None:
-            h: Dict[str, object] = {
-                "kind": kind,
-                "t0": t0 if t0 is not None else time.perf_counter()}
-            if t1 is not None:
-                h["t1"] = t1
-            if args:
-                h["args"] = args
-            hops.append(h)
+            self._hop(hops, kind, t0=t0, t1=t1, **args)
 
         if not payload.get("idempotency_key"):
             payload["idempotency_key"] = \
@@ -695,12 +911,26 @@ class Router:
         posts = 0
         for attempt in range(self.dispatch_rounds):
             t_pick = time.perf_counter()
-            rep = self.pick(session=session, exclude=tuple(tried))
+            rep = None
+            if prefer is not None:
+                # the prefill phase already shipped this request's KV
+                # pages to `prefer` — land the decode there while it is
+                # healthy (first attempt only; a dead/tripped prefer
+                # falls back to the normal pick and the decode replica
+                # recomputes the prefix)
+                if (prefer.ready and prefer.name not in tried
+                        and prefer.breaker_state(time.monotonic())
+                        == "closed"):
+                    rep = prefer
+                prefer = None
+            if rep is None:
+                rep = self.pick(session=session, exclude=tuple(tried),
+                                role=role)
             if rep is None and tried:
                 # every ready replica already refused this request this
                 # round; start a fresh round over re-polled membership
                 tried.clear()
-                rep = self.pick(session=session)
+                rep = self.pick(session=session, role=role)
             hop("pick", t0=t_pick, t1=time.perf_counter(),
                 attempt=attempt + 1,
                 replica=rep.name if rep is not None else None)
@@ -759,7 +989,7 @@ class Router:
                 self._m_dispatch[rep.name].inc()
                 if session is not None:
                     with self._lock:
-                        self._affinity[session] = (rep.name, now)
+                        self._affinity[akey] = (rep.name, now)
                     if len(self._affinity) > self.max_sessions:
                         self._expire_affinity()
                 body["replica"] = rep.name
@@ -808,7 +1038,7 @@ class Router:
                 rep.reason = body.get("error") or f"generate -> {code}"
             if session is not None:
                 with self._lock:
-                    self._affinity.pop(session, None)
+                    self._affinity.pop(akey, None)
             self._m_retries.inc()
             hop("retry", replica=rep.name, status=code)
             tried.add(rep.name)
@@ -829,6 +1059,227 @@ class Router:
         return 503, {"error": "no replica accepted the request after "
                               f"{self.dispatch_rounds} rounds",
                      "last": last_err}
+
+    # -- streaming dispatch --------------------------------------------
+    def dispatch_stream(self, payload: dict):
+        """Route one STREAMING ``/generate`` payload.  Returns ``(200,
+        iterator)`` where the iterator yields the replica's NDJSON
+        events (token chunks, then one terminal event) — or ``(code,
+        dict)`` when no stream could be established, same shapes as
+        :meth:`dispatch` errors.
+
+        A replica that dies MID-STREAM (socket death, or an in-stream
+        error event marked ``requeued``) is retried on a survivor with
+        ``resume_from=<tokens already relayed to the client>`` and the
+        SAME idempotency key: a live original joins its in-flight
+        generation (no double-generation), a fresh replica regenerates
+        deterministically and streams only the unsent suffix — either
+        way the client sees one contiguous token stream."""
+        payload, trace = self._prepare(payload)
+        payload["stream"] = True
+        hops: List[dict] = []
+        t0 = time.perf_counter()
+        role, prefer = self._route_roles(payload, hops)
+        sent0 = 0
+        try:
+            sent0 = max(0, int(payload.get("resume_from") or 0))
+        except (TypeError, ValueError):
+            pass
+        code, rep, resp, body = self._acquire_stream(
+            payload, hops, role, prefer, ())
+        if code != 200:
+            self._file_hops(trace, t0, code, hops)
+            return code, body
+        return 200, self._relay_stream(rep, resp, payload, hops, trace,
+                                       t0, role, sent0)
+
+    def _acquire_stream(self, payload: dict, hops: List[dict],
+                        role: Optional[str], prefer: Optional[Replica],
+                        exclude: Tuple[str, ...]):
+        """Establish ONE live streaming connection: the pick/retry loop
+        of :meth:`_dispatch`, slimmed to the streaming cases.  Returns
+        ``(200, replica, live-response, None)`` or ``(code, None, None,
+        error-body)``."""
+        session = payload.get("session")
+        akey = self._akey(role, session) if session is not None else None
+        tried = set(exclude)
+        last_err: Optional[dict] = None
+        posts = 0
+        for attempt in range(self.dispatch_rounds):
+            t_pick = time.perf_counter()
+            rep = None
+            if prefer is not None:
+                if (prefer.ready and prefer.name not in tried
+                        and prefer.breaker_state(time.monotonic())
+                        == "closed"):
+                    rep = prefer
+                prefer = None
+            if rep is None:
+                rep = self.pick(session=session, exclude=tuple(tried),
+                                role=role)
+            self._hop(hops, "pick", t0=t_pick, t1=time.perf_counter(),
+                      attempt=attempt + 1,
+                      replica=rep.name if rep is not None else None)
+            if rep is None:
+                self.refresh()
+                time.sleep(self.retry_backoff * (attempt + 1))
+                continue
+            if posts >= 1 and not self._take_retry_token():
+                rep.release_probe()
+                return 503, None, None, {
+                    "error": "retry budget exhausted (fleet-wide "
+                             "failures; not amplifying)",
+                    "last": last_err}
+            posts += 1
+            if posts >= 2:
+                self._hop(hops, "idem_join", replica=rep.name,
+                          key=payload["idempotency_key"])
+            with self._lock:
+                rep.inflight += 1
+            t_att = time.perf_counter()
+            try:
+                try:
+                    code, out = self._post_stream(rep, payload)
+                except OSError as exc:
+                    code, out = -1, {"error": f"unreachable: {exc}"}
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            self._hop(hops, "attempt", t0=t_att, t1=time.perf_counter(),
+                      replica=rep.name, n=posts, status=code)
+            now = time.monotonic()
+            if code == 200:
+                rep.note_success()
+                self._m_breaker_open[rep.name].set(0)
+                self._m_dispatch[rep.name].inc()
+                if session is not None:
+                    with self._lock:
+                        self._affinity[akey] = (rep.name, now)
+                    if len(self._affinity) > self.max_sessions:
+                        self._expire_affinity()
+                return 200, rep, out, None
+            if code in (400, 504):
+                rep.release_probe()
+                if isinstance(out, dict):
+                    out["replica"] = rep.name
+                return code, None, None, out
+            if code == 429:
+                rep.release_probe()
+                self._m_shed_429.inc()
+                self._hop(hops, "shed", replica=rep.name)
+                tried.add(rep.name)
+                last_err = out
+                continue
+            if rep.note_failure(now, self.breaker_threshold,
+                                self.breaker_cooldown,
+                                self.breaker_cooldown_max):
+                self._m_breaker_trips.inc()
+            self._m_breaker_open[rep.name].set(
+                0 if rep.breaker_state(now) == "closed" else 1)
+            if code in (-1, 503):
+                rep.ready = False
+                rep.reason = ((out if isinstance(out, dict) else {})
+                              .get("error") or f"generate -> {code}")
+            if session is not None:
+                with self._lock:
+                    self._affinity.pop(akey, None)
+            self._m_retries.inc()
+            self._hop(hops, "retry", replica=rep.name, status=code)
+            tried.add(rep.name)
+            last_err = out if isinstance(out, dict) else None
+        return 503, None, None, {
+            "error": "no replica accepted the stream after "
+                     f"{self.dispatch_rounds} rounds",
+            "last": last_err}
+
+    def _relay_stream(self, rep: Replica, resp, payload: dict,
+                      hops: List[dict], trace: str, t0: float,
+                      role: Optional[str], sent: int):
+        """The relay generator behind :meth:`dispatch_stream`: forward
+        the replica's NDJSON events, counting tokens relayed; when the
+        stream dies mid-generation, re-acquire on a survivor with
+        ``resume_from=sent`` (hop kind ``resume``) and keep going.  The
+        finished hop record files from the ``finally`` — a client that
+        hangs up mid-stream still lands a /requestz record."""
+        status = 200
+        try:
+            while True:
+                died = False
+                while True:
+                    try:
+                        line = resp.readline()
+                    except OSError:
+                        died = True
+                        break
+                    if not line:
+                        died = True       # EOF before the terminal event
+                        break
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        died = True
+                        break
+                    if not isinstance(ev, dict):
+                        continue
+                    if "tokens" in ev:
+                        sent += len(ev["tokens"])
+                        yield ev
+                        continue
+                    if ev.get("done"):
+                        ev.setdefault("trace", trace)
+                        ev.setdefault("replica", rep.name)
+                        yield ev
+                        return
+                    # in-stream error event from the replica
+                    st = 503
+                    try:
+                        st = int(ev.get("status") or 503)
+                    except (TypeError, ValueError):
+                        pass
+                    if st == 504 or not ev.get("requeued"):
+                        # authoritative (deadline) or non-retryable
+                        status = st
+                        ev.setdefault("replica", rep.name)
+                        yield ev
+                        return
+                    died = True           # requeued: resume elsewhere
+                    break
+                if not died:
+                    return
+                try:
+                    resp.close()
+                except OSError:
+                    pass
+                now = time.monotonic()
+                if rep.note_failure(now, self.breaker_threshold,
+                                    self.breaker_cooldown,
+                                    self.breaker_cooldown_max):
+                    self._m_breaker_trips.inc()
+                self._m_breaker_open[rep.name].set(
+                    0 if rep.breaker_state(now) == "closed" else 1)
+                rep.ready = False
+                rep.reason = "stream died mid-generation"
+                self._m_retries.inc()
+                self._hop(hops, "resume", replica=rep.name,
+                          resume_from=sent)
+                retry = dict(payload)
+                retry["resume_from"] = sent
+                code, rep2, resp2, body = self._acquire_stream(
+                    retry, hops, role, None, (rep.name,))
+                if code != 200:
+                    status = code
+                    err = {"error": (body or {}).get(
+                        "error", "stream resume failed"),
+                        "status": code, "n": sent}
+                    yield err
+                    return
+                rep, resp = rep2, resp2
+        finally:
+            try:
+                resp.close()
+            except OSError:
+                pass
+            self._file_hops(trace, t0, status, hops)
 
     def _expire_affinity(self) -> None:
         """Enforce the session-map bound: drop TTL-expired entries, then
@@ -877,6 +1328,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _stream(self, code: int, events) -> None:
+        """Relay an event iterator as chunked NDJSON (the same wire
+        shape a replica's streaming /generate answers with, so clients
+        need one parser whether they talk to a replica or the
+        router)."""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for event in events:
+                data = json.dumps(event, sort_keys=True).encode() + b"\n"
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass                 # client hung up mid-stream
+        finally:
+            close = getattr(events, "close", None)
+            if close is not None:
+                close()          # generator finally files the hop record
+
     def do_POST(self):  # noqa: N802 - http.server API
         path, _, _ = self.path.partition("?")
         if path not in ("/generate", "/generate/"):
@@ -889,6 +1362,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 raise ValueError("body must be a JSON object")
         except (ValueError, json.JSONDecodeError) as exc:
             self._send(400, {"error": f"bad JSON body: {exc}"})
+            return
+        if payload.get("stream"):
+            code, out = self.router.dispatch_stream(payload)
+            if isinstance(out, dict):
+                self._send(code, out)
+            else:
+                self._stream(code, out)
             return
         code, body = self.router.dispatch(payload)
         self._send(code, body)

@@ -96,6 +96,19 @@ class Request:
     # replica's tracer timeline and flight-recorder serve events to the
     # router's hop spans across the process boundary
     trace_id: str = ""
+    # streaming front (disaggregated serving): a streaming request joins
+    # the lag-1 drain path even without an EOS id so its tokens land in
+    # ``output_tokens`` incrementally — the HTTP generator tails the list
+    # and ships chunks as they appear (TTFT = first chunk on the wire)
+    stream: bool = False
+    # prefill-role request: finish at prefill completion (reason
+    # "prefill_done") instead of decoding; the engine captures the
+    # prompt's KV pages into ``handoff`` for the prefill->decode transfer
+    prefill_only: bool = False
+    # captured handoff: [(chunk_token_list, page_payload_dict), ...] for
+    # every full prompt page, read device->host on the engine thread at
+    # release time (set only for prefill_only requests)
+    handoff: Optional[List] = None
 
     @property
     def prompt_len(self) -> int:
@@ -180,7 +193,7 @@ class IterationScheduler:
                            "finished requests by reason",
                            labels={"reason": r})
             for r in ("eos", "length", "cache_budget", "cancelled",
-                      "deadline", "unknown")}
+                      "deadline", "prefill_done", "unknown")}
         self._m_shed = reg.counter(
             "ds_serve_shed_total",
             "submits refused by the bounded admission queue (429)")

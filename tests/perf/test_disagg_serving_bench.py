@@ -1,0 +1,42 @@
+"""Disaggregated-serving benchmark rung (slow): the shared-prefix trace
+through the router over a monolithic and a role-split fleet, plain and
+streaming (``bench.bench_disagg_serving``).  Marked ``slow`` — outside
+tier-1; the fast tier-1 coverage is tests/unit/test_disagg_serving.py.
+On the CPU mesh this validates the grid mechanics and the
+token-identity / wire-compression / TTFT-before-completion acceptance
+bits; the goodput-ratio number is a TPU row."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_disagg_serving_bench_scenario(capsys):
+    from bench import bench_disagg_serving
+
+    out = bench_disagg_serving(num_requests=8, num_slots=4, tiny=True)
+    # the acceptance bits: greedy outputs identical across the whole
+    # role-split x streaming grid, int8 wire strictly under the dense
+    # twin, and the first streamed chunk landing before completion
+    assert out["outputs_token_identical"] is True
+    assert 0 < out["handoff_wire_bytes"] < out["handoff_dense_bytes"]
+    assert out["handoff_compression"] > 1.0
+    assert 0 < out["ttft_stream_over_total"] < 1.0
+    for side in ("mono", "disagg"):
+        for variant in ("plain", "stream"):
+            cell = out[side][variant]
+            assert cell["answered"] == 8, (side, variant, cell)
+            assert cell["token_identical"] is True
+            assert cell["goodput_tok_s"] > 0
+    # only the role-split fleet ships pages
+    assert out["disagg"]["plain"]["handoff_pages_shipped"] > 0
+    assert "handoff_pages_shipped" not in out["mono"]["plain"]
+    with capsys.disabled():
+        print(f"\ndisagg serving bench (tiny/CPU): goodput ratio "
+              f"{out['disagg_goodput_ratio']}x, stream TTFT/total "
+              f"{out['ttft_stream_over_total']}, handoff compression "
+              f"{out['handoff_compression']}x "
+              f"({out['handoff_wire_bytes']}B wire / "
+              f"{out['handoff_dense_bytes']}B dense)")

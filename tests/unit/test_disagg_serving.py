@@ -1,0 +1,305 @@
+"""Disaggregated prefill/decode serving (ISSUE 19) — THE tier-1
+acceptance e2e plus the handoff/streaming pieces it is built from:
+
+- wire codec: int8 blockwise page encoding round-trips, int8-cache
+  planes ship verbatim (lossless), wire bytes < the dense twin;
+- role-split fleet e2e: a 2-prefill + 2-decode fleet answers a
+  shared-prefix trace through the router, every response token-identical
+  to single-engine ``generate()``, KV pages moving int8 over
+  ``/kv_offer`` + ``/kv_adopt``, zero leaked pages on both roles' pools
+  after drain;
+- token streaming: chunked ndjson events through replica front and
+  router front, first chunk strictly before completion (TTFT < total),
+  resume-from-token-N replays only the unsent suffix.
+
+The mid-stream replica-kill chaos path lives in
+tests/unit/test_serving_chaos.py (it rides ``make chaos`` too).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_mesh, set_global_mesh
+from deepspeed_tpu.models import causal_lm
+from deepspeed_tpu.monitor.metrics import MetricsRegistry
+from deepspeed_tpu.serving import Router, RouterServer
+from deepspeed_tpu.serving import handoff as hoff
+
+
+# ---------------------------------------------------------------------------
+# wire codec units (no model)
+# ---------------------------------------------------------------------------
+
+def test_handoff_page_codec_roundtrip_and_compression():
+    """fp32/bf16 planes ride int8 blockwise (decode ~= original, wire <
+    dense); int8 planes and *_scale planes ship RAW (byte-identical —
+    the lossless path token identity rests on)."""
+    rng = np.random.default_rng(0)
+    payload = {
+        "k": rng.standard_normal((2, 16, 4, 8)).astype(np.float32),
+        "v": rng.standard_normal((2, 16, 4, 8)).astype(np.float32),
+    }
+    enc = hoff.encode_page(payload, wire="int8")
+    dec = hoff.decode_page(enc)
+    assert set(dec) == {"k", "v"}
+    for name in ("k", "v"):
+        a, b = payload[name], dec[name]
+        assert b.shape == a.shape and b.dtype == a.dtype
+        assert float(np.max(np.abs(a - b))) <= (
+            np.max(np.abs(a)) / 127.0 + 1e-6)
+    wire = hoff.wire_nbytes(enc)
+    dense = hoff.dense_twin_nbytes(payload, 4)
+    assert wire < dense, (wire, dense)
+
+    qpayload = {
+        "k": rng.integers(-127, 127, (2, 16, 4, 8)).astype(np.int8),
+        "k_scale": rng.random((2, 16, 4, 1)).astype(np.float32),
+    }
+    enc = hoff.encode_page(qpayload, wire="int8")
+    dec = hoff.decode_page(enc)
+    np.testing.assert_array_equal(dec["k"], qpayload["k"])
+    np.testing.assert_array_equal(dec["k_scale"], qpayload["k_scale"])
+
+
+def test_handoff_raw_wire_is_lossless_for_any_dtype():
+    rng = np.random.default_rng(1)
+    payload = {"k": rng.standard_normal((1, 8, 2, 4)).astype(np.float32)}
+    dec = hoff.decode_page(hoff.encode_page(payload, wire="raw"))
+    np.testing.assert_array_equal(dec["k"], payload["k"])
+
+
+def test_page_chunks_partitions_only_full_pages():
+    toks = list(range(37))
+    chunks = hoff.page_chunks(toks, 16)
+    assert [len(c) for c in chunks] == [16, 16]
+    assert list(chunks[0]) == list(range(16))
+
+
+# ---------------------------------------------------------------------------
+# the role-split fleet (module fixture: built once, several tests)
+# ---------------------------------------------------------------------------
+
+N_REQ = 10
+SYS_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def disagg_fleet(devices):
+    """(ref, replicas{role: [engines]}, router, front, prompts, news,
+    want): 2 prefill + 2 decode replicas behind the router front, a
+    quantized KV cache on every engine (int8 cache planes -> the int8
+    handoff is lossless -> outputs must be token-identical to the
+    single-engine reference)."""
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    rng = jax.random.PRNGKey(7)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))
+    cfg = {"dtype": "float32", "max_out_tokens": 96, "kv_page_tokens": 16,
+           "quantize_kv_cache": True, "max_queue_depth": N_REQ + 2}
+    np_rng = np.random.default_rng(3)
+    shared = np_rng.integers(0, 256, size=SYS_LEN).astype(np.int32)
+    prompts, news = [], []
+    for i in range(N_REQ):
+        tail = np_rng.integers(0, 256, size=int(
+            np_rng.integers(3, 9))).astype(np.int32)
+        if i % 4 != 3:                     # 3/4 share the system prompt
+            prompts.append(np.concatenate([shared, tail]))
+        else:
+            prompts.append(np_rng.integers(
+                0, 256, size=SYS_LEN // 2).astype(np.int32))
+        news.append(int(np_rng.integers(8, 25)))
+    ref = deepspeed_tpu.init_inference(model, config=dict(cfg))
+    ref.set_params(params)
+    want = [[int(t) for t in np.asarray(ref.generate(
+                p[None], max_new_tokens=n, do_sample=False))[0, len(p):]]
+            for p, n in zip(prompts, news)]
+    replicas = {"prefill": [], "decode": []}
+    for role in ("prefill", "prefill", "decode", "decode"):
+        s = deepspeed_tpu.init_serving(
+            model, config=dict(cfg), num_slots=2, prefill_chunk=16,
+            decode_block_tokens=4, role=role, metrics_port=0,
+            registry=MetricsRegistry().enable(), private_health=True,
+            serve_loop=True)
+        s.set_params(params)
+        replicas[role].append(s)
+    router = Router(
+        [f"{r}{i}@{r}={s.metrics_server.url}"
+         for r in ("prefill", "decode")
+         for i, s in enumerate(replicas[r])],
+        registry=MetricsRegistry().enable(), dispatch_rounds=8,
+        retry_backoff=0.02, poll_interval=0.05, poll_timeout=1.0,
+        request_timeout=120.0)
+    router.refresh()
+    front = RouterServer(router).start()
+    yield ref, replicas, router, front, prompts, news, want
+    front.stop()
+    router.stop()
+    for pool in replicas.values():
+        for s in pool:
+            s.close()
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+def _stream(url, payload, timeout=120):
+    """POST a streaming /generate; returns (tokens, first_chunk_s,
+    total_s, final_event)."""
+    t0 = time.perf_counter()
+    req = urllib.request.Request(
+        url + "/generate",
+        data=json.dumps(dict(payload, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    toks, first, final = [], None, None
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for line in resp:
+            ev = json.loads(line)
+            if ev.get("tokens"):
+                if first is None:
+                    first = time.perf_counter() - t0
+                toks.extend(ev["tokens"])
+            if ev.get("done") or ev.get("error"):
+                final = ev
+                break
+    return toks, first, time.perf_counter() - t0, final
+
+
+def test_disagg_fleet_e2e_token_identical_and_no_leaks(disagg_fleet):
+    """THE acceptance e2e: the shared-prefix trace through the router —
+    every request answered 200, token-identical to ``generate()``; the
+    prefill phase really ran (handoff hops + int8 wire bytes < the dense
+    twin); after drain both roles' pools and prefix caches hold zero
+    leaked pages."""
+    _ref, replicas, router, front, prompts, news, want = disagg_fleet
+    results = [None] * N_REQ
+
+    def client(i):
+        payload = {"prompt": prompts[i].tolist(), "max_new_tokens": news[i],
+                   "session": f"sess-{i % 3}", "timeout": 90}
+        for _ in range(6):
+            try:
+                results[i] = _post(front.url, payload)
+                if results[i][0] != 503:
+                    return
+            except urllib.error.HTTPError as exc:
+                results[i] = (exc.code, {})
+                if exc.code not in (429, 503):
+                    return
+            time.sleep(0.3)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_REQ)]
+    for t in threads:
+        t.start()
+        time.sleep(0.02)
+    for t in threads:
+        t.join(timeout=180)
+    for i, r in enumerate(results):
+        assert r is not None and r[0] == 200, (i, r)
+        assert r[1]["tokens"] == want[i], f"request {i} diverged"
+    # the prefill pool did the prompt work and shipped pages int8
+    shipped = wire = dense = 0
+    for s in replicas["prefill"]:
+        snap = s._registry.snapshot()
+        shipped += int(snap.get("ds_serve_kv_handoff_pages_total", 0) or 0)
+        fam = snap.get("ds_serve_kv_handoff_bytes_total") or {}
+        wire += int(fam.get('{dtype="int8"}', 0) or 0)
+        dense += int(fam.get('{dtype="dense"}', 0) or 0)
+    assert shipped > 0, "no KV pages were handed off"
+    assert 0 < wire < dense, (wire, dense)
+    adopted = sum(int(s._registry.snapshot().get(
+        "ds_serve_kv_adopted_pages_total", 0) or 0)
+        for s in replicas["decode"])
+    assert adopted > 0, "decode pool never adopted a handoff"
+    assert router.registry.get(
+        "ds_router_hops_total", labels={"kind": "handoff"}).value > 0
+    # zero leaked pages on BOTH roles' pools after drain
+    for pool in replicas.values():
+        for s in pool:
+            s.drain(timeout=60)
+            assert s.scheduler.num_occupied == 0
+            s.pool.check_no_leak()
+            if s.prefix_cache is not None:
+                s.prefix_cache.check_no_leak()
+            s.resume_admission()
+
+
+def test_disagg_streaming_ttft_before_completion(disagg_fleet):
+    """Streaming through the ROUTER front on the role-split fleet: the
+    token stream is identical to ``generate()`` and the first chunk
+    lands strictly before the stream completes (TTFT < total latency —
+    the user-visible point of streaming)."""
+    _ref, _replicas, _router, front, prompts, news, want = disagg_fleet
+    i = int(np.argmax(news))               # the longest generation
+    toks, first, total, final = _stream(
+        front.url, {"prompt": prompts[i].tolist(),
+                    "max_new_tokens": news[i], "timeout": 90})
+    assert final and final.get("done"), final
+    assert toks == want[i]
+    assert first is not None and first < total, (first, total)
+    # more than one chunk actually arrived before the end (the stream
+    # streamed, it didn't buffer-then-flush)
+    assert final["n"] == len(toks)
+
+
+def test_replica_stream_resume_from_token_n(disagg_fleet):
+    """Resume-from-token-N at the replica: a second streaming dispatch
+    carrying the same idempotency key and ``resume_from=k`` receives
+    ONLY the unsent suffix (idempotent join — no second generation), so
+    a router retry after a mid-stream socket death never replays sent
+    tokens.  The decode replica serves both (its role accepts full
+    generates)."""
+    _ref, replicas, _router, front, prompts, news, want = disagg_fleet
+    serve = replicas["decode"][0]
+    url = serve.metrics_server.url
+    reg = serve._registry
+    base_sub = reg.get("ds_serve_submitted_total").value
+    i = int(np.argmax(news))
+    k = news[i] // 2
+    payload = {"prompt": prompts[i].tolist(), "max_new_tokens": news[i],
+               "idempotency_key": "stream-resume-pin", "timeout": 90}
+    toks, _f, _t, final = _stream(url, payload)
+    assert final.get("done") and toks == want[i]
+    # replay with resume_from=k: only the suffix arrives, no new submit
+    toks2, _f, _t, final2 = _stream(url, dict(payload, resume_from=k))
+    assert final2.get("done")
+    assert toks2 == want[i][k:]
+    assert reg.get("ds_serve_submitted_total").value == base_sub + 1
+    assert reg.get("ds_serve_idem_hits_total").value >= 1
+    assert reg.get("ds_serve_stream_resumes_total").value >= 1
+
+
+def test_monolithic_fallback_when_decode_pool_exhausted(disagg_fleet):
+    """Degraded mode: with every prefill replica out of membership the
+    router skips the prefill phase and the decode pool answers
+    monolithically — same tokens, no 5xx."""
+    _ref, replicas, router, front, prompts, news, want = disagg_fleet
+    for rep in router.replicas:
+        if rep.role == "prefill":
+            rep.ready = False
+    try:
+        code, body = _post(front.url, {"prompt": prompts[0].tolist(),
+                                       "max_new_tokens": news[0],
+                                       "timeout": 90})
+        assert code == 200 and body["tokens"] == want[0]
+    finally:
+        router.refresh()
+        assert sum(r.ready for r in router.replicas) == 4

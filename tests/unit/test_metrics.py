@@ -376,6 +376,22 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
                       "restarts_observed": 1,
                       "answered_exactly_once": True,
                       "outputs_token_identical": True},
+                  "disagg_serving": {
+                      "handoff_compression": 1.94,
+                      "handoff_wire_bytes": 54272,
+                      "handoff_dense_bytes": 105472,
+                      "disagg_goodput_ratio": 1.07,
+                      "ttft_stream_over_total": 0.31,
+                      "outputs_token_identical": True,
+                      "mono": {"plain": {"goodput_tok_s": 90.0},
+                               "stream": {"goodput_tok_s": 91.0}},
+                      "disagg": {
+                          "plain": {"goodput_tok_s": 95.0,
+                                    "ttft_p50_s": 0.021,
+                                    "device_profile": {"huge": "z" * 500}},
+                          "stream": {"goodput_tok_s": 96.0,
+                                     "ttft_p50_s": 0.012,
+                                     "client_p50_s": 0.04}}},
                   "elastic_resume": {
                       "status": "ok", "world_save": 4, "worlds": [2, 8],
                       "resume_latency_s_max": 0.68,
@@ -441,6 +457,15 @@ def test_bench_summary_new_rungs_roundtrip_and_strip_bulk():
     assert fc["restarts_observed"] == 1 and fc["shed_429"] == 2
     assert fc["answered_exactly_once"] is True
     assert fc["outputs_token_identical"] is True
+    # the ISSUE 19 disaggregated-serving acceptance row rides BENCH_JSON:
+    # role-split goodput ratio, user-visible streaming TTFT, int8 KV
+    # handoff compression vs the dense twin, grid-wide token identity
+    dg = parsed["disagg_serving"]
+    assert dg["disagg_goodput_ratio"] == 1.07
+    assert dg["ttft_stream_p50_s"] == 0.012
+    assert dg["ttft_stream_over_total"] == 0.31
+    assert dg["handoff_compression"] == 1.94
+    assert dg["outputs_token_identical"] is True
     # the ISSUE 14 elastic-resume acceptance row rides BENCH_JSON
     er = parsed["elastic_resume"]
     assert er["resume_latency_s"] == 0.68

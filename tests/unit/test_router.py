@@ -521,3 +521,108 @@ def test_router_requestz_endpoint_snapshot_and_perfetto():
         if front is not None:
             front.stop()
         fake.stop()
+
+
+# ---------------------------------------------------------------------------
+# role-split fleets (ISSUE 19): spec parsing, role-scoped picks and
+# affinity, the two-phase prefill->decode dispatch with a handoff hop
+# ---------------------------------------------------------------------------
+
+def test_role_spec_parsing_and_role_scoped_pick():
+    """``name@role=url`` specs land roles on the replicas; ``pick``
+    filters by role — ``prefill`` picks are STRICT (a generalist never
+    absorbs prefill-phase work), ``decode`` picks accept ``both`` (a
+    generalist can always finish a generation), role=None fleets see
+    everyone."""
+    router_tool = _tool("router")
+    p, d, b = (router_tool._FakeReplica(n) for n in "pdb")
+    try:
+        router = Router([f"p@prefill={p.url}", f"d@decode={d.url}",
+                         f"b={b.url}"],
+                        registry=MetricsRegistry().enable())
+        router.refresh()
+        assert [r.role for r in router.replicas] == \
+            ["prefill", "decode", "both"]
+        assert router._has_roles and router._has_prefill
+        assert router.replicas[0].snapshot()["role"] == "prefill"
+        # strict prefill: only the prefill replica qualifies
+        for _ in range(4):
+            assert router.pick(role="prefill").name == "p"
+        # decode accepts decode + both
+        assert {router.pick(role="decode").name for _ in range(8)} <= \
+            {"d", "b"}
+        # bad role in the spec is a loud constructor error
+        with pytest.raises(ValueError):
+            Router([f"x@Frobnicate={p.url}"])
+    finally:
+        for f in (p, d, b):
+            f.stop()
+
+
+def test_role_scoped_affinity_wrong_role_pin_dropped():
+    """Affinity keys are (role, session) in role-split fleets, so one
+    session holds one pin PER ROLE — and a pin that somehow points at a
+    wrong-role replica (the drained-prefill-absorbs-decode-pins bug
+    class) is dropped at pick instead of honored."""
+    router_tool = _tool("router")
+    p, d = router_tool._FakeReplica("p"), router_tool._FakeReplica("d")
+    try:
+        router = Router([f"p@prefill={p.url}", f"d@decode={d.url}"],
+                        registry=MetricsRegistry().enable(),
+                        affinity_ttl=3600.0, retry_backoff=0.01)
+        router.refresh()
+        code, body = router.dispatch({"prompt": [1, 2], "max_new_tokens": 2,
+                                      "session": "conv"})
+        assert code == 200 and body["replica"] == "d"
+        # tuple keys, one pin per role; NO bare-string key in role fleets
+        assert router._affinity[("decode", "conv")][0] == "d"
+        assert router._affinity[("prefill", "conv")][0] == "p"
+        assert "conv" not in router._affinity
+        # poison the decode pin with the prefill replica: the role check
+        # at pick drops it and repins to a decode-capable replica
+        import time as _time
+
+        router._affinity[("decode", "conv")] = ("p", _time.monotonic())
+        picked = router.pick(session="conv", role="decode")
+        assert picked is not None and picked.name == "d"
+        assert router._affinity.get(("decode", "conv"), (None,))[0] != "p"
+    finally:
+        p.stop()
+        d.stop()
+
+
+def test_role_split_dispatch_runs_prefill_phase_then_decode():
+    """A role-split dispatch is two-phase: the prefill replica gets the
+    ``{"phase": "prefill"}`` twin (logged as a ``handoff`` hop), the
+    decode replica answers the request itself; a prefill-pool outage
+    DEGRADES to monolithic (decode-only) instead of failing."""
+    router_tool = _tool("router")
+    p, d = router_tool._FakeReplica("p"), router_tool._FakeReplica("d")
+    try:
+        router = Router([f"p@prefill={p.url}", f"d@decode={d.url}"],
+                        registry=MetricsRegistry().enable(),
+                        dispatch_rounds=3, retry_backoff=0.01)
+        router.refresh()
+        code, body = router.dispatch({"prompt": [5, 6, 7],
+                                      "max_new_tokens": 2,
+                                      "session": "s1"})
+        assert code == 200 and body["replica"] == "d"
+        assert len(p.served) == 1 and len(d.served) == 1
+        assert router.registry.get("ds_router_hops_total",
+                                   labels={"kind": "handoff"}).value == 1
+        # the hop log carries the phase: a handoff hop names both sides
+        last = router.hops.snapshot()["dispatches"][-1]
+        kinds = [h["kind"] for h in last["hops"]]
+        assert "handoff" in kinds
+        hop = next(h for h in last["hops"] if h["kind"] == "handoff")
+        assert hop["args"]["prefill"] == "p"
+        assert hop["args"]["decode"] == "d"
+        # prefill pool dies -> dispatch still answers (decode-only)
+        p.ready = False
+        router.refresh()
+        code, body = router.dispatch({"prompt": [8, 9],
+                                      "max_new_tokens": 2})
+        assert code == 200 and body["replica"] == "d"
+    finally:
+        p.stop()
+        d.stop()

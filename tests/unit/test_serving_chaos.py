@@ -736,3 +736,133 @@ def test_drain_racing_concurrent_kill(fleet, rng):
     serve0.resume_admission()
     _quiesce(serve0)
     _reset_fleet(replicas, proxy, router)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated streaming chaos (ISSUE 19): a decode replica dies
+# mid-stream, the router resumes from token N on a survivor.  Marked
+# slow — rides `make chaos` (the tier-1 resume/identity coverage is
+# tests/unit/test_disagg_serving.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_decode_replica_killed_mid_stream_resumes_on_survivor(devices):
+    """Kill the decode replica serving a token stream mid-generation:
+    the router's relay re-dispatches with ``resume_from=N`` onto the
+    surviving decode replica and splices the suffix — the client reads
+    ONE contiguous stream, token-identical to ``generate()``, with no
+    token sent twice and exactly one regeneration (no double-answer)."""
+    mesh = build_mesh(fsdp=8, devices=devices)
+    set_global_mesh(mesh)
+    model = causal_lm("llama-tiny", mesh=mesh, num_layers=2, hidden_size=64,
+                      intermediate_size=128, num_heads=4, num_kv_heads=2,
+                      vocab_size=256, remat=False)
+    params = model.init(jax.random.PRNGKey(11), jnp.zeros((1, 8), jnp.int32))
+    cfg = {"dtype": "float32", "max_out_tokens": 128, "kv_page_tokens": 16,
+           "quantize_kv_cache": True, "max_queue_depth": 4}
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(12), (21,), 0, 256),
+        dtype=np.int32)
+    max_new = 64
+    ref = deepspeed_tpu.init_inference(model, config=dict(cfg))
+    ref.set_params(params)
+    want = [int(t) for t in np.asarray(ref.generate(
+        prompt[None], max_new_tokens=max_new,
+        do_sample=False))[0, len(prompt):]]
+    roles = ("prefill", "decode", "decode")
+    replicas = []
+    router = front = None
+    try:
+        for role in roles:
+            s = deepspeed_tpu.init_serving(
+                model, config=dict(cfg), num_slots=2, prefill_chunk=16,
+                decode_block_tokens=2, role=role, metrics_port=0,
+                registry=MetricsRegistry().enable(), private_health=True,
+                serve_loop=True)
+            s.set_params(params)
+            replicas.append(s)
+        router = Router(
+            [f"{r}{i}@{r}={s.metrics_server.url}"
+             for i, (r, s) in enumerate(zip(roles, replicas))],
+            registry=MetricsRegistry().enable(), dispatch_rounds=6,
+            retry_backoff=0.02, poll_interval=0.05, poll_timeout=1.0,
+            request_timeout=120.0)
+        router.refresh()
+        front = RouterServer(router).start()
+        decodes = replicas[1:]
+        got, events = [], []
+        first_chunk = threading.Event()
+        stream_done = threading.Event()
+
+        def client():
+            req = urllib.request.Request(
+                front.url + "/generate",
+                data=json.dumps({"prompt": prompt.tolist(),
+                                 "max_new_tokens": max_new,
+                                 "stream": True, "timeout": 90}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    for line in resp:
+                        ev = json.loads(line)
+                        events.append(ev)
+                        if ev.get("tokens"):
+                            got.extend(ev["tokens"])
+                            first_chunk.set()
+                        if ev.get("done") or ev.get("error"):
+                            break
+            finally:
+                first_chunk.set()
+                stream_done.set()
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert first_chunk.wait(timeout=120), "stream never produced"
+        # find the decode replica streaming this request and kill its
+        # serving loop at the next step boundary (mid-generation)
+        victim = next(s for s in decodes if s.scheduler.num_occupied)
+        survivor = next(s for s in decodes if s is not victim)
+        assert len(got) < max_new, "generation finished before the kill"
+        with crash_on_call(victim, "step", n=1):
+            t.join(timeout=120)
+        assert stream_done.is_set()
+        final = events[-1]
+        assert final.get("done") is True, f"stream ended badly: {final}"
+        # contiguous, token-identical, nothing sent twice
+        assert got == want
+        assert final["n"] == len(want)
+        # cumulative n across token events is strictly increasing with
+        # no overlap — the resumed suffix started exactly at N
+        ns = [ev["n"] for ev in events if ev.get("tokens")]
+        assert ns == sorted(set(ns))
+        # the resume really crossed replicas: the survivor saw a
+        # resume_from > 0 dispatch, the router logged the resume hop and
+        # a retry, and exactly TWO generations ran fleet-wide (the
+        # killed original + the survivor's regeneration — no fan-out)
+        assert survivor._registry.get(
+            "ds_serve_stream_resumes_total").value >= 1
+        # the hop record files in the relay's finally on the front's
+        # handler thread — give it a beat after the client hangs up
+        deadline = time.monotonic() + 10
+        while router.registry.get(
+                "ds_router_hops_total",
+                labels={"kind": "resume"}).value < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert router.registry.get(
+            "ds_router_hops_total", labels={"kind": "resume"}).value >= 1
+        assert router.registry.get("ds_router_retries_total").value >= 1
+        subs = sum(s._registry.get("ds_serve_submitted_total").value
+                   for s in decodes)
+        assert subs == 2, subs
+        # the victim died for real (loop crashed, replica not ready)
+        assert victim._loop_crashed
+    finally:
+        if front is not None:
+            front.stop()
+        if router is not None:
+            router.stop()
+        for s in replicas:
+            s.close()

@@ -246,6 +246,31 @@ def serving_kv_summary(metrics: Dict[str, object]) -> str:
     if demote or promote or host:
         lines.append(f"kv host tier: {host} pages resident, "
                      f"{demote} demoted, {promote} promoted")
+    ttft = metrics.get("ds_serve_ttft_seconds") or {}
+    if isinstance(ttft, dict) and ttft.get("count"):
+        lines.append(f"ttft: p50 {ttft['p50']:.4g}s  "
+                     f"p99 {ttft['p99']:.4g}s  "
+                     f"({int(ttft['count'])} requests)")
+    # disaggregated-serving KV handoff (docs/RESILIENCE.md
+    # "Disaggregated serving"): wire bytes by dtype vs the dense twin
+    hand = metrics.get("ds_serve_kv_handoff_bytes_total") or {}
+    if isinstance(hand, dict) and hand:
+        dense = float(hand.get('{dtype="dense"}', 0) or 0)
+        wire = sum(float(v or 0) for k, v in hand.items()
+                   if k != '{dtype="dense"}')
+        shipped = int(metrics.get("ds_serve_kv_handoff_pages_total", 0)
+                      or 0)
+        adopted = int(metrics.get("ds_serve_kv_adopted_pages_total", 0)
+                      or 0)
+        line = (f"kv handoff: {shipped} pages shipped / {adopted} "
+                f"adopted, {human_bytes(wire)} on the wire")
+        if dense:
+            line += (f" ({human_bytes(dense)} dense twin, "
+                     f"{100 * wire / dense:.0f}%)")
+        lines.append(line)
+    resumes = int(metrics.get("ds_serve_stream_resumes_total", 0) or 0)
+    if resumes:
+        lines.append(f"stream resumes: {resumes}")
     return "\n".join(lines)
 
 

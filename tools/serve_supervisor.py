@@ -32,6 +32,17 @@ command template; the child also sees ``DS_REPLICA_INDEX`` /
   ``--scale-down-queue``.  Scale-in is a graceful SIGTERM: the replica
   drains (zero-drop — the router re-dispatches its queued work) and
   exits on its own; only past the grace window is it killed.
+- **role-split fleets** — ``--prefill-replicas N --decode-replicas M``
+  runs the disaggregated-serving topology (docs/RESILIENCE.md
+  "Disaggregated serving") as two independently-scaled pools: each
+  replica's command template may use ``{role}`` (also exported as
+  ``DS_REPLICA_ROLE``) to start as a prefill or a decode replica, and
+  the scale loop evaluates each pool over its OWN members — a prefill
+  pool's pressure shows up as admission-queue depth, a decode pool's as
+  KV-pool occupancy, and each pool has its own sustain windows and
+  ``--max-prefill-replicas`` / ``--max-decode-replicas`` bounds.  With
+  both role counts at 0 (the default) the supervisor runs the legacy
+  single ``both`` pool, bit-for-bit.
 - **graceful shutdown** — SIGTERM to the supervisor forwards SIGTERM to
   every replica (drain → exit), waits out the grace window, SIGKILLs
   stragglers, and exits without restarting anything.
@@ -145,6 +156,23 @@ class _Sustain:
         return now - self.since >= self.sustain_s
 
 
+class _Pool:
+    """One role's scaling state: its replica target, bounds, and the
+    sustain windows its scale decisions flap-guard through.  A legacy
+    fleet is one ``both`` pool; a role-split fleet runs a ``prefill``
+    and a ``decode`` pool side by side, each scaled over its own
+    members' signals."""
+
+    def __init__(self, role: str, target: int, lo: int, hi: int,
+                 sustain_s: float):
+        self.role = role
+        self.target = int(target)
+        self.min = int(lo)
+        self.max = int(hi)
+        self.up = _Sustain(sustain_s)
+        self.down = _Sustain(sustain_s)
+
+
 class ReplicaHandle:
     """One supervised replica slot: its process, its restart ladder, and
     the supervisor's last view of its health/load."""
@@ -159,10 +187,11 @@ class ReplicaHandle:
     #                          ladder on a replacement slot forever)
 
     def __init__(self, index: int, port: int, cmd: List[str],
-                 policy: RestartPolicy):
+                 policy: RestartPolicy, role: str = "both"):
         self.index = index
         self.port = port
         self.cmd = cmd
+        self.role = role
         self.policy = policy
         self.proc: Optional[subprocess.Popen] = None
         self.state = ReplicaHandle.BACKOFF
@@ -184,6 +213,7 @@ class ReplicaHandle:
 
     def snapshot(self) -> Dict[str, object]:
         return {"index": self.index, "port": self.port, "state": self.state,
+                "role": self.role,
                 "ready": self.ready, "pid":
                     (self.proc.pid if self.proc is not None else None),
                 "restarts": self.policy.restarts,
@@ -207,6 +237,11 @@ class ServeSupervisor:
                  max_replicas: Optional[int] = None,
                  scale_up_queue: float = 0.0, scale_down_queue: float = 0.0,
                  kv_high: float = 0.92, scale_sustain_s: float = 10.0,
+                 prefill_replicas: int = 0, decode_replicas: int = 0,
+                 min_prefill_replicas: Optional[int] = None,
+                 max_prefill_replicas: Optional[int] = None,
+                 min_decode_replicas: Optional[int] = None,
+                 max_decode_replicas: Optional[int] = None,
                  env: Optional[Dict[str, str]] = None,
                  sleep=time.sleep, status_file: Optional[str] = None,
                  runledger: Optional[str] = None,
@@ -223,7 +258,6 @@ class ServeSupervisor:
         self.poll_timeout = float(poll_timeout)
         self.wedge_timeout = float(wedge_timeout)
         self.grace_s = float(grace_s)
-        self.target = int(replicas)
         self.min_replicas = int(min_replicas if min_replicas is not None
                                 else replicas)
         self.max_replicas = int(max_replicas if max_replicas is not None
@@ -231,8 +265,30 @@ class ServeSupervisor:
         self.scale_up_queue = float(scale_up_queue)
         self.scale_down_queue = float(scale_down_queue)
         self.kv_high = float(kv_high)
-        self._up = _Sustain(scale_sustain_s)
-        self._down = _Sustain(scale_sustain_s)
+        pre, dec = int(prefill_replicas or 0), int(decode_replicas or 0)
+        self.role_split = pre > 0 or dec > 0
+        if self.role_split:
+            if pre <= 0 or dec <= 0:
+                raise ValueError("role-split fleets need BOTH "
+                                 "prefill_replicas and decode_replicas > 0")
+            self.pools = {
+                "prefill": _Pool(
+                    "prefill", pre,
+                    min_prefill_replicas if min_prefill_replicas
+                    is not None else pre,
+                    max_prefill_replicas if max_prefill_replicas
+                    is not None else pre, scale_sustain_s),
+                "decode": _Pool(
+                    "decode", dec,
+                    min_decode_replicas if min_decode_replicas
+                    is not None else dec,
+                    max_decode_replicas if max_decode_replicas
+                    is not None else dec, scale_sustain_s)}
+        else:
+            self.pools = {"both": _Pool("both", int(replicas),
+                                        self.min_replicas,
+                                        self.max_replicas,
+                                        scale_sustain_s)}
         self.base_env = dict(env if env is not None else os.environ)
         self.sleep = sleep
         self.status_file = status_file
@@ -250,24 +306,31 @@ class ServeSupervisor:
         self.scale_ins = 0
         self._next_index = 0
         self._terminating = False
-        for _ in range(self.target):
-            self._new_handle()
+        for pool in self.pools.values():
+            for _ in range(pool.target):
+                self._new_handle(pool.role)
+
+    @property
+    def target(self) -> int:
+        """Total wanted replicas across every role pool."""
+        return sum(p.target for p in self.pools.values())
 
     # -- lifecycle ------------------------------------------------------
     def _log(self, msg: str) -> None:
         print(f"[serve_supervisor] {msg}", file=sys.stderr, flush=True)
 
-    def _new_handle(self) -> ReplicaHandle:
+    def _new_handle(self, role: str = "both") -> ReplicaHandle:
         idx = self._next_index
         self._next_index += 1
         port = self.base_port + idx
         cmd = [a.replace("{port}", str(port)).replace("{index}", str(idx))
+               .replace("{role}", role)
                for a in self.cmd_template]
         policy = RestartPolicy(max_restarts=self.max_restarts,
                                backoff_base=self.backoff_base,
                                backoff_max=self.backoff_max,
                                healthy_reset_s=self.healthy_reset_s)
-        h = ReplicaHandle(idx, port, cmd, policy)
+        h = ReplicaHandle(idx, port, cmd, policy, role=role)
         self.replicas.append(h)
         return h
 
@@ -289,6 +352,7 @@ class ServeSupervisor:
         env = dict(self.base_env)
         env["DS_REPLICA_INDEX"] = str(h.index)
         env["DS_REPLICA_PORT"] = str(h.port)
+        env["DS_REPLICA_ROLE"] = h.role
         env["DS_SUPERVISOR_RESTART"] = str(h.policy.restarts)
         if self.runledger:
             env["DSTPU_RUNLEDGER"] = self.runledger
@@ -431,13 +495,27 @@ class ServeSupervisor:
                                 exit_code=137, backoff_s=decision.delay)
 
     def _scale(self, now: float) -> None:
-        if self.max_replicas <= self.min_replicas or self._terminating:
+        if self._terminating:
+            return
+        for pool in self.pools.values():
+            self._scale_pool(pool, now)
+
+    def _scale_pool(self, pool: _Pool, now: float) -> None:
+        """One pool's scale decision over its OWN members' signals.  The
+        same watermarks apply to every pool, but the signals separate by
+        role naturally: a prefill pool's pressure shows up as
+        admission-queue depth (it runs admission + chunked prefill), a
+        decode pool's as KV-pool occupancy (it holds every active
+        generation's pages) — so a shared-prefix burst scales the
+        prefill pool while long generations scale the decode pool."""
+        if pool.max <= pool.min:
             return
         ready = [h for h in self.replicas if h.ready
-                 and h.state == ReplicaHandle.RUNNING]
+                 and h.state == ReplicaHandle.RUNNING
+                 and h.role == pool.role]
         if not ready:
-            self._up.update(False, now)
-            self._down.update(False, now)
+            pool.up.update(False, now)
+            pool.down.update(False, now)
             return
         mean_q = sum(h.queue_depth for h in ready) / len(ready)
         max_kv = max(h.kv_busy for h in ready)
@@ -449,18 +527,20 @@ class ServeSupervisor:
         want_down = (self.scale_down_queue > 0
                      and mean_q <= self.scale_down_queue
                      and max_kv < self.kv_high)
-        if self._up.update(want_up, now) and self.target < self.max_replicas:
-            self.target += 1
+        label = f" [{pool.role}]" if self.role_split else ""
+        if pool.up.update(want_up, now) and pool.target < pool.max:
+            pool.target += 1
             self.scale_outs += 1
-            self._up.since = None        # re-sustain before the next step
-            self._log(f"scale OUT -> {self.target} (mean queue {mean_q:.1f},"
-                      f" kv {max_kv:.2f})")
-        elif self._down.update(want_down, now) \
-                and self.target > self.min_replicas:
-            self.target -= 1
+            pool.up.since = None         # re-sustain before the next step
+            self._log(f"scale OUT{label} -> {pool.target} (mean queue "
+                      f"{mean_q:.1f}, kv {max_kv:.2f})")
+        elif pool.down.update(want_down, now) \
+                and pool.target > pool.min:
+            pool.target -= 1
             self.scale_ins += 1
-            self._down.since = None
-            self._log(f"scale IN -> {self.target} (mean queue {mean_q:.1f})")
+            pool.down.since = None
+            self._log(f"scale IN{label} -> {pool.target} "
+                      f"(mean queue {mean_q:.1f})")
 
     def _reconcile(self, now: float) -> None:
         # drop slots that drained out on purpose (scale-in complete);
@@ -468,31 +548,35 @@ class ServeSupervisor:
         # runs visibly degraded instead of crash-looping replacements
         self.replicas = [h for h in self.replicas
                          if h.state != ReplicaHandle.RETIRED]
-        live = [h for h in self.replicas
-                if h.state in (ReplicaHandle.RUNNING, ReplicaHandle.BACKOFF)]
-        occupying = live + [h for h in self.replicas
-                            if h.state == ReplicaHandle.FAILED]
         if not self._terminating:
-            while len(occupying) < self.target:
-                h = self._new_handle()
-                live.append(h)
-                occupying.append(h)
-            # scale-in: SIGTERM the youngest slot — drain is zero-drop
-            # (the router re-dispatches its queued work) and the replica
-            # exits on its own; stragglers are killed past the grace
-            surplus = len(occupying) - self.target
-            for h in sorted(live, key=lambda x: -x.index)[:max(0, surplus)]:
-                if h.state == ReplicaHandle.RUNNING and h.alive():
-                    self._log(f"replica {h.index}: scale-in SIGTERM "
-                              f"(drain -> exit)")
-                    try:
-                        h.proc.send_signal(signal.SIGTERM)
-                    except ProcessLookupError:
-                        pass
-                    h.state = ReplicaHandle.DRAINING
-                    h.drain_deadline = now + self.grace_s
-                elif h.state == ReplicaHandle.BACKOFF:
-                    self.replicas.remove(h)   # never spawned/waiting: drop
+            for pool in self.pools.values():
+                members = [h for h in self.replicas if h.role == pool.role]
+                live = [h for h in members if h.state in
+                        (ReplicaHandle.RUNNING, ReplicaHandle.BACKOFF)]
+                occupying = live + [h for h in members
+                                    if h.state == ReplicaHandle.FAILED]
+                while len(occupying) < pool.target:
+                    h = self._new_handle(pool.role)
+                    live.append(h)
+                    occupying.append(h)
+                # scale-in: SIGTERM the youngest slot — drain is
+                # zero-drop (the router re-dispatches its queued work)
+                # and the replica exits on its own; stragglers are
+                # killed past the grace
+                surplus = len(occupying) - pool.target
+                for h in sorted(live,
+                                key=lambda x: -x.index)[:max(0, surplus)]:
+                    if h.state == ReplicaHandle.RUNNING and h.alive():
+                        self._log(f"replica {h.index} ({h.role}): scale-in "
+                                  f"SIGTERM (drain -> exit)")
+                        try:
+                            h.proc.send_signal(signal.SIGTERM)
+                        except ProcessLookupError:
+                            pass
+                        h.state = ReplicaHandle.DRAINING
+                        h.drain_deadline = now + self.grace_s
+                    elif h.state == ReplicaHandle.BACKOFF:
+                        self.replicas.remove(h)  # never spawned: drop
         for h in self.replicas:
             if h.state == ReplicaHandle.DRAINING and h.alive() \
                     and now > h.drain_deadline:
@@ -549,6 +633,8 @@ class ServeSupervisor:
 
     def snapshot(self) -> Dict[str, object]:
         return {"target": self.target,
+                "targets": {p.role: p.target for p in self.pools.values()},
+                "role_split": self.role_split,
                 "total_restarts": self.total_restarts,
                 "scale_outs": self.scale_outs, "scale_ins": self.scale_ins,
                 "replicas": [h.snapshot() for h in self.replicas]}
@@ -569,14 +655,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 port, beh_path, marker = int(sys.argv[1]), sys.argv[2], sys.argv[3]
 index = int(os.environ.get("DS_REPLICA_INDEX", "-1"))
+role = os.environ.get("DS_REPLICA_ROLE", "both")
 state = {"draining": False}
 
 def beh():
     try:
         with open(beh_path) as fh:
-            return json.load(fh)
+            b = json.load(fh)
     except Exception:
         return {}
+    # per-role overlay: {"roles": {"decode": {"kv_used": 9}}} pressures
+    # one pool without touching the other (role-split selftest)
+    b.update(b.get("roles", {}).get(role, {}))
+    return b
 
 class H(BaseHTTPRequestHandler):
     def do_GET(self):
@@ -748,9 +839,63 @@ def selftest() -> int:
             for h in sup.replicas:
                 if h.alive():
                     h.proc.kill()
+    _selftest_role_split()
     print("serve_supervisor selftest: OK (restart-on-kill, wedge "
-          "detection, scale-out/in, graceful shutdown)")
+          "detection, scale-out/in, role-split pools, graceful shutdown)")
     return 0
+
+
+def _selftest_role_split() -> None:
+    """Role-split pools: 1 prefill + 1 decode come up with their roles in
+    the environment and the status file, and sustained KV pressure on
+    the DECODE pool alone scales only the decode pool out."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        beh_path = os.path.join(td, "behavior.json")
+        marker = os.path.join(td, "drained.txt")
+        with open(beh_path, "w") as fh:
+            json.dump({}, fh)
+        base = _free_port_block(3)
+        status_path = os.path.join(td, "status.json")
+        sup = ServeSupervisor(
+            [sys.executable, "-c", _FAKE_REPLICA_PROG, "{port}", beh_path,
+             marker],
+            base_port=base, max_restarts=4, backoff_base=0.05,
+            backoff_max=0.2, healthy_reset_s=None, poll_interval=0.05,
+            poll_timeout=0.5, wedge_timeout=5.0, grace_s=5.0,
+            prefill_replicas=1, decode_replicas=1,
+            max_decode_replicas=2, kv_high=0.8, scale_sustain_s=0.2,
+            status_file=status_path)
+        assert sup.target == 2 and sup.role_split
+        thread = threading.Thread(target=sup.run, daemon=True)
+        thread.start()
+        try:
+            _wait(lambda: sum(h.ready for h in sup.replicas) == 2, 15,
+                  "role-split fleet ready")
+            roles = sorted(h.role for h in sup.replicas)
+            assert roles == ["decode", "prefill"], roles
+            st = json.load(open(status_path))
+            assert st["role_split"] is True
+            assert st["targets"] == {"prefill": 1, "decode": 1}
+            assert sorted(r["role"] for r in st["replicas"]) == roles
+            # decode-only KV pressure -> ONLY the decode pool scales out
+            with open(beh_path, "w") as fh:
+                json.dump({"roles": {"decode":
+                                     {"kv_used": 9, "kv_free": 1}}}, fh)
+            _wait(lambda: sup.pools["decode"].target == 2
+                  and sum(h.ready for h in sup.replicas
+                          if h.role == "decode") == 2, 20,
+                  "decode pool scale-out")
+            assert sup.pools["prefill"].target == 1
+            assert sum(1 for h in sup.replicas
+                       if h.role == "prefill") == 1
+        finally:
+            sup.request_stop()
+            thread.join(timeout=20)
+            for h in sup.replicas:
+                if h.alive():
+                    h.proc.kill()
 
 
 # ---------------------------------------------------------------------------
@@ -780,6 +925,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--grace", type=float, default=SIGTERM_GRACE_S)
     parser.add_argument("--min-replicas", type=int, default=None)
     parser.add_argument("--max-replicas", type=int, default=None)
+    parser.add_argument("--prefill-replicas", type=int, default=0,
+                        help="run a role-split (disaggregated) fleet with "
+                             "this many prefill replicas ({role} / "
+                             "DS_REPLICA_ROLE tells each child its role; "
+                             "requires --decode-replicas too)")
+    parser.add_argument("--decode-replicas", type=int, default=0,
+                        help="decode replicas of a role-split fleet")
+    parser.add_argument("--min-prefill-replicas", type=int, default=None)
+    parser.add_argument("--max-prefill-replicas", type=int, default=None)
+    parser.add_argument("--min-decode-replicas", type=int, default=None)
+    parser.add_argument("--max-decode-replicas", type=int, default=None)
     parser.add_argument("--scale-up-queue", type=float, default=0.0,
                         help="mean fleet queue depth that scales OUT when "
                              "sustained (0 disables queue-driven scaling)")
@@ -822,7 +978,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         grace_s=args.grace, min_replicas=args.min_replicas,
         max_replicas=args.max_replicas, scale_up_queue=args.scale_up_queue,
         scale_down_queue=args.scale_down_queue, kv_high=args.kv_high,
-        scale_sustain_s=args.scale_sustain, status_file=args.status_file,
+        scale_sustain_s=args.scale_sustain,
+        prefill_replicas=args.prefill_replicas,
+        decode_replicas=args.decode_replicas,
+        min_prefill_replicas=args.min_prefill_replicas,
+        max_prefill_replicas=args.max_prefill_replicas,
+        min_decode_replicas=args.min_decode_replicas,
+        max_decode_replicas=args.max_decode_replicas,
+        status_file=args.status_file,
         runledger=args.runledger, run_id=args.run_id)
     return sup.run()
 
