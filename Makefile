@@ -1,7 +1,7 @@
 # Single-command entries the builder's verify recipe runs before the
 # suite (see ROADMAP.md for the canonical tier-1 line).
 
-.PHONY: lint lint-json tier1 chaos perf-diff
+.PHONY: lint lint-json tier1 chaos perf-diff profile-report
 
 # dslint: AST-level invariant checker (docs/LINT.md) — no jax needed
 lint:
@@ -15,6 +15,12 @@ lint-json:
 # beyond tolerance; no jax needed)
 perf-diff:
 	python tools/perf_ledger.py --check --all
+
+# newest continuous-profiler window + window-over-window regression
+# verdict from the on-disk history ring (docs/OBSERVABILITY.md
+# "Continuous profiling"; no jax needed — the ring is plain JSON)
+profile-report:
+	python tools/trace_report.py --history profile_history
 
 # lint first (seconds), then the tier-1 suite (minutes)
 tier1: lint

@@ -2378,6 +2378,86 @@ def _run_1b4_subprocess() -> dict:
             "ladder_attempts": attempts}
 
 
+def bench_continuous_profiler() -> dict:
+    """Continuous-profiler rung (ISSUE 20): arm the always-on profiler on
+    a tiny training loop at a forced cadence (capture every 2 steps,
+    1-step windows, duty cap lifted) and report what the SCHEDULED path
+    produced with no operator ``/profilez`` in the loop: the history-ring
+    window count, the latest window's per-scope per-step device-seconds,
+    whether the phase lanes stay under the per-step wall, and the
+    window-over-window differ verdict.  The scheduler, ring, and differ
+    are host-side mechanisms, so the CPU smoke row is meaningful; on the
+    TPU runner the same rung exercises real device captures."""
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.profiling.continuous import HistoryRing, diff_windows
+
+    hist = tempfile.mkdtemp(prefix="dstpu_bench_cprof_")
+    t_start = time.perf_counter()
+    try:
+        mesh = build_mesh(devices=jax.devices()[:1])
+        set_global_mesh(mesh)
+        model = causal_lm("gpt2-small", mesh=mesh, num_layers=2,
+                          hidden_size=128, intermediate_size=512,
+                          num_heads=4, vocab_size=2048)
+        ds_config = {
+            "train_batch_size": 2,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10**9,
+            "continuous_profiler": {
+                "enabled": True, "every_steps": 2, "every_seconds": 3600.0,
+                "capture_steps": 1, "max_duty_cycle": 1.0,
+                "history_dir": hist, "max_windows": 8},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=ds_config, mesh=mesh)
+        rng = jax.random.PRNGKey(7)
+        tokens = jax.random.randint(rng, (1, 2, 128), 0, 2048)
+        batch = (tokens, tokens)
+        ring = HistoryRing(hist)
+        n = 0
+        while n < 16 and len(ring.paths()) < 2:
+            engine.train_step(batch)
+            n += 1
+        sync(engine.state.params)
+        if engine._cprof is not None:
+            engine._cprof.close()      # abandon any in-flight window
+        wins = ring.latest(4)
+        if len(wins) < 2:
+            return {"status": f"failed: {len(wins)} windows after {n} steps"}
+        prev, cur = wins[-2], wins[-1]
+        phase_s = sum(cur["scopes"].get(k, 0.0) for k in
+                      ("fwd_bwd", "optimizer", "comm", "other", "gap"))
+        per_step_wall = cur["window_s"] / max(1, cur["steps"])
+        return {
+            "status": "ok",
+            "windows": len(ring.paths()),
+            "train_steps": n,
+            "wall_s": round(time.perf_counter() - t_start, 3),
+            "latest": {
+                "seq": cur["seq"], "steps": cur["steps"],
+                "window_ms": round(1e3 * cur["window_s"], 2),
+                "busy_ratio": round(cur["busy_ratio"], 4),
+                "coverage_ratio": round(cur["coverage_ratio"], 4),
+                "overhead_ratio": round(cur["overhead_ratio"], 4),
+                "degraded": cur["degraded"],
+                "top_scopes_ms": {
+                    k: round(1e3 * v, 3) for k, v in
+                    sorted(cur["scopes"].items(), key=lambda kv: -kv[1])[:4]},
+            },
+            # the five phase lanes partition the per-step wall exactly;
+            # float slack only (acceptance: scope sums <= window wall)
+            "phases_within_wall": bool(phase_s <= per_step_wall * 1.001),
+            "regressions_vs_prev": [r["scope"] for r in
+                                    diff_windows(prev, cur)],
+        }
+    finally:
+        shutil.rmtree(hist, ignore_errors=True)
+
+
 def main():
     if os.environ.get("DSTPU_BENCH_EMIT_ONLY"):
         # subprocess pin for the stdout contract (tests/unit/
@@ -2469,6 +2549,18 @@ def main():
         except Exception as exc:
             rung_elastic = {"status": f"failed: {type(exc).__name__}",
                             "error": str(exc)[:200]}
+
+    # continuous-profiler rung (ISSUE 20): the scheduled-capture path end
+    # to end — >=2 history windows, per-scope device-seconds under the
+    # window wall, differ verdict — with no operator /profilez in the
+    # loop; host-side mechanism, so CPU-meaningful
+    rung_cprof = None
+    if os.environ.get("DSTPU_BENCH_SKIP_CPROF") != "1":
+        try:
+            rung_cprof = bench_continuous_profiler()
+        except Exception as exc:
+            rung_cprof = {"status": f"failed: {type(exc).__name__}",
+                          "error": str(exc)[:200]}
 
     mesh = build_mesh(devices=jax.devices()[:1])
     set_global_mesh(mesh)
@@ -2680,6 +2772,7 @@ def main():
                    # live tflops/mfu gauges, peak HBM, top collectives
                    **({"metrics": train_metrics} if train_metrics else {}),
                    **({"goodput": rung_goodput} if rung_goodput else {}),
+                   **({"cprof": rung_cprof} if rung_cprof else {}),
                    **({"llama_1b4": rung_1b4} if rung_1b4 else {}),
                    **({"overlap_1b4": rung_overlap} if rung_overlap
                       else {}),

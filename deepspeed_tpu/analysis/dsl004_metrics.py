@@ -288,6 +288,31 @@ ok = reg.counter("ds_serve_requests_total", "documented name")
 dyn = reg.counter(name_variable)          # dynamic: runtime guard owns it
 '''
 
+# the ds_prof_* continuous-profiler family (docs/OBSERVABILITY.md
+# "Continuous profiling"): the documented-name check must cover it like
+# any other ds_ family — including the labeled {scope=} rows, whose docs
+# tokens carry a label block the normalizer strips
+SELFTEST_PROF_DOCS = '''\
+# Observability
+| `ds_prof_windows_total` | counter | completed windows |
+| `ds_prof_scope_device_seconds{scope=}` | gauge | per-scope seconds |
+'''
+
+SELFTEST_BAD_PROF = '''\
+from deepspeed_tpu.monitor.metrics import get_registry
+
+reg = get_registry()
+bad = reg.counter("ds_prof_bogus_total", "undocumented ds_prof name")
+'''
+
+SELFTEST_GOOD_PROF = '''\
+from deepspeed_tpu.monitor.metrics import get_registry
+
+reg = get_registry()
+ok = reg.counter("ds_prof_windows_total", "documented")
+lab = reg.gauge("ds_prof_scope_device_seconds", labels={"scope": "comm"})
+'''
+
 SELFTEST_BAD_BENCH = '''\
 import json
 

@@ -80,6 +80,21 @@ def run_selftest(verbose: bool = False) -> List[str]:
         check(bool(hits), "DSL004 flags a summary block outside the "
                           "cap victim list")
 
+        # DSL004 documented-name check over the ds_prof_* continuous-
+        # profiler family: a fixture docs file documents two names (one
+        # labeled); an undocumented ds_prof_ literal must be flagged, the
+        # documented pair (labels stripped by the normalizer) must pass
+        sub = os.path.join(td, "dsl004_prof")
+        _write_tree(sub, {"docs/OBSERVABILITY.md":
+                          dsl004_metrics.SELFTEST_PROF_DOCS})
+        hits = [f for f in _lint_source(dsl004_metrics.SELFTEST_BAD_PROF,
+                                        sub) if f.rule == "DSL004"]
+        check(bool(hits), "DSL004 flags an undocumented ds_prof_* name")
+        clean = [f for f in _lint_source(dsl004_metrics.SELFTEST_GOOD_PROF,
+                                         sub) if f.rule == "DSL004"]
+        check(not clean, "DSL004 accepts documented ds_prof_* names "
+                         f"(got {[f.render() for f in clean]})")
+
         # DSL003 import-graph closure (project trees)
         for name, tree, expect in (
                 ("bad", dsl003_jaxfree.SELFTEST_BAD_TREE, True),

@@ -133,6 +133,22 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(tracer.snapshot(limit),
                                   sort_keys=True).encode()
             ctype = "application/json"
+        elif path in ("/profilez/history", "/profilez/history/"):
+            # latest continuous-profiler windows of every live engine in
+            # this process (docs/OBSERVABILITY.md "Continuous profiling").
+            # Serves {"engines": [], "windows": []} when no profiler is
+            # armed — a cheap fleet scrape, never a capture trigger.
+            from deepspeed_tpu.profiling.continuous import history_snapshot
+
+            qs = parse_qs(query)
+            try:
+                limit = int(qs.get("n", ["8"])[0])
+            except ValueError:
+                self.send_error(400, "n must be an integer")
+                return
+            body = json.dumps(history_snapshot(limit),
+                              sort_keys=True).encode()
+            ctype = "application/json"
         elif path in ("/profilez", "/profilez/"):
             code, payload = self._profilez(parse_qs(query))
             body = json.dumps(payload, sort_keys=True).encode()
@@ -174,7 +190,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/":
             body = json.dumps({"endpoints": ["/goodputz", "/healthz",
                                              "/metrics", "/statz",
-                                             "/profilez", "/requestz",
+                                             "/profilez",
+                                             "/profilez/history",
+                                             "/requestz",
                                              "/generate", "/kv_offer",
                                              "/kv_adopt"]}
                               ).encode()

@@ -425,6 +425,37 @@ class SloConfig(DeepSpeedConfigModel):
                  ("shed_ratio", self.shed_ratio)) if v is not None}
 
 
+class ContinuousProfilerConfig(DeepSpeedConfigModel):
+    """``continuous_profiler`` section (TPU extension; docs/OBSERVABILITY.md
+    "Continuous profiling"): always-on, low-duty-cycle device-trace
+    captures.  Every ``every_steps`` steps or ``every_seconds`` seconds —
+    whichever comes first — the engine opens a short
+    ``capture_steps``-step trace window, decomposes it into per-scope
+    device-seconds (``ds_prof_scope_device_seconds{scope=}`` plus the
+    ``ds_comm_<op>_device_seconds`` feed ``/profilez`` would have
+    produced), persists the summary to the bounded ``history_dir`` ring,
+    and diffs it against the previous window (flight event
+    ``prof_regression`` + ``ds_prof_regressions_total{scope=}`` when a
+    scope drifts past ``regression_tolerance``).  ``max_duty_cycle``
+    caps cumulative capture+decompose wall time as a fraction of run
+    wall clock (default ≤1%); a window that would bust the budget is
+    deferred, counted in ``ds_prof_window_overhead_ratio``'s headroom.
+    Default OFF: disabled costs one ``is not None`` branch per step
+    boundary and never changes compiled programs (the named scopes are
+    unconditional)."""
+
+    enabled: bool = False
+    every_steps: int = 200
+    every_seconds: float = 120.0
+    capture_steps: int = 2
+    max_duty_cycle: float = 0.01
+    history_dir: str = "profile_history"
+    max_windows: int = 64
+    max_bytes: int = 4 << 20
+    regression_tolerance: float = 0.25
+    min_scope_seconds: float = 5e-5
+
+
 class AnomalyConfig(DeepSpeedConfigModel):
     """``anomaly_detection`` section (TPU extension; docs/RESILIENCE.md
     "Elastic training"): bf16/fp32 step-anomaly containment — the fp16
@@ -665,6 +696,8 @@ class DeepSpeedConfig:
         self.goodput = GoodputConfig(**d.get("goodput", {}))
         self.slo = SloConfig(**d.get("slo", {}))
         self.watchdog = WatchdogConfig(**d.get("watchdog", {}))
+        self.continuous_profiler = ContinuousProfilerConfig(
+            **d.get("continuous_profiler", {}))
         self.anomaly_detection = AnomalyConfig(**d.get("anomaly_detection", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
         self.elasticity = ElasticityConfig(**d.get("elasticity", {}))
@@ -774,6 +807,18 @@ class DeepSpeedConfig:
             raise ValueError("train_batch_size must be positive")
         if self.gradient_clipping < 0:
             raise ValueError("gradient_clipping must be >= 0")
+        cp = self.continuous_profiler
+        if cp.enabled:
+            if not 0.0 < cp.max_duty_cycle <= 1.0:
+                raise ValueError("continuous_profiler.max_duty_cycle must "
+                                 "be in (0, 1]")
+            if cp.every_steps < 1 or cp.every_seconds <= 0.0:
+                raise ValueError("continuous_profiler cadence must be "
+                                 "positive (every_steps >= 1, "
+                                 "every_seconds > 0)")
+            if cp.capture_steps < 1:
+                raise ValueError("continuous_profiler.capture_steps must "
+                                 "be >= 1")
 
     def print_config(self) -> None:
         logger.info("DeepSpeedConfig:")

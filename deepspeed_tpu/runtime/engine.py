@@ -787,6 +787,40 @@ class DeepSpeedEngine:
                 "ds_trace")
             self._trace = TraceCapture(trace_dir, start_step=ptc.start_step,
                                        num_steps=ptc.num_steps)
+        # -- always-on continuous profiler (docs/OBSERVABILITY.md
+        # "Continuous profiling"): scheduled low-duty-cycle device
+        # captures feeding ds_comm_<op>_device_seconds + ds_prof_* with
+        # no operator /profilez.  Disabled = a None slot and one branch
+        # per boundary tick (the PR 3 contract); enabling it implies the
+        # registry switch — an attribution feed nobody records is dead
+        # weight.
+        self._cprof = None
+        cpc = self.config.continuous_profiler
+        if cpc.enabled:
+            from deepspeed_tpu.profiling.continuous import ContinuousProfiler
+            from deepspeed_tpu.profiling.continuous import ensure_registered
+
+            get_registry().enable()
+            ensure_registered(get_registry())
+            self._cprof = ContinuousProfiler(
+                engine="train",
+                every_steps=cpc.every_steps,
+                every_seconds=cpc.every_seconds,
+                capture_steps=cpc.capture_steps,
+                max_duty_cycle=cpc.max_duty_cycle,
+                history_dir=cpc.history_dir,
+                max_windows=cpc.max_windows,
+                max_bytes=cpc.max_bytes,
+                regression_tolerance=cpc.regression_tolerance,
+                min_scope_seconds=cpc.min_scope_seconds,
+                bytes_per_op_fn=self._profile_bytes_per_op,
+                flight=self._flight)
+            log_dist(
+                f"continuous profiler armed: {cpc.capture_steps}-step "
+                f"window every {cpc.every_steps} steps or "
+                f"{cpc.every_seconds:g}s (duty cycle <= "
+                f"{100 * cpc.max_duty_cycle:g}%) -> {cpc.history_dir}",
+                ranks=[0])
         self.tput_timer = ThroughputTimer(batch_size=self.config.train_batch_size)
         self.training_dataloader = None
         if training_data is not None:
@@ -2574,6 +2608,11 @@ class DeepSpeedEngine:
                            "capturing (or still ahead); retry after it "
                            "closes")
             return
+        if self._cprof is not None and self._cprof.active:
+            # the operator wins the single global profiler session: the
+            # abandoned continuous window simply reschedules at its next
+            # cadence tick
+            self._cprof.close()
         import tempfile
 
         trace_dir = req.trace_dir or tempfile.mkdtemp(prefix="ds_profilez_")
@@ -2625,6 +2664,30 @@ class DeepSpeedEngine:
                 # capture could spuriously trip the watchdog
                 self._wd_last_t = time.perf_counter()
 
+    def _cprof_tick(self) -> None:
+        """Boundary hook of the continuous profiler: close a finished
+        window (the decompose + history commit run inline here, between
+        steps), else open the next one when due — never while another
+        holder (profile_trace, a pending/claimed /profilez request, a
+        watchdog capture) owns or is about to claim jax's single global
+        profiler session.  One attribute load + one branch when off."""
+        cp = self._cprof
+        if cp is None:
+            return
+        if cp.active:
+            if cp.after_step(self._host_steps) is not None \
+                    and self._watchdog is not None:
+                # the decompose ran inside this boundary interval; exclude
+                # it from the next step-time sample (the
+                # _finish_aux_trace idiom)
+                self._wd_last_t = time.perf_counter()
+            return
+        if (self._aux_trace is not None
+                or self._pz_broker.pending is not None
+                or (self._trace is not None and not self._trace.done)):
+            return
+        cp.maybe_begin(self._host_steps + 1)
+
     def _watchdog_tick(self) -> None:
         """Feed the boundary-to-boundary wall time to the watchdog; on a
         trip, dump the flight recorder and arm the one-shot capture.  The
@@ -2651,6 +2714,10 @@ class DeepSpeedEngine:
         wdc = self.config.watchdog
         if (wdc.trace and perfetto_supported() and self._aux_trace is None
                 and (self._trace is None or self._trace.done)):
+            if self._cprof is not None and self._cprof.active:
+                # a trip capture diagnoses an anomaly NOW; the abandoned
+                # continuous window reschedules at its next cadence tick
+                self._cprof.close()
             import tempfile
 
             trace_dir = (wdc.output_path
@@ -3109,6 +3176,7 @@ class DeepSpeedEngine:
         self._watchdog_tick()
         self._anomaly_tick()
         self._aux_trace_tick()
+        self._cprof_tick()
         self._preemption_tick()
 
     def _maybe_emit_flops_profile(self) -> None:
@@ -3421,6 +3489,7 @@ class DeepSpeedEngine:
         self._watchdog_tick()
         self._anomaly_tick()
         self._aux_trace_tick()
+        self._cprof_tick()
         self._preemption_tick()
         return loss
 

@@ -301,6 +301,37 @@ class ServingEngine:
         # events — both disabled-by-default one-branch no-ops
         self._tracer = get_request_tracer()
         self._flight = get_flight_recorder()
+        # continuous profiler (docs/OBSERVABILITY.md "Continuous
+        # profiling"): scheduled low-duty-cycle capture windows over
+        # scheduler iterations, sharing the /profilez decompose + registry
+        # paths.  Default OFF — self._cprof stays None and the steady-state
+        # cost is one attribute load + branch per iteration (PR 3 contract).
+        self._cprof = None
+        cpc = dict(getattr(self._config, "continuous_profiler", None) or {})
+        if cpc.get("enabled"):
+            from deepspeed_tpu.profiling.continuous import (
+                ContinuousProfiler, ensure_registered)
+
+            get_registry().enable()
+            ensure_registered(get_registry())
+            self._cprof = ContinuousProfiler(
+                engine="serving",
+                every_steps=int(cpc.get("every_steps", 200)),
+                every_seconds=float(cpc.get("every_seconds", 120.0)),
+                capture_steps=int(cpc.get("capture_steps", 2)),
+                max_duty_cycle=float(cpc.get("max_duty_cycle", 0.01)),
+                history_dir=cpc.get("history_dir", "profile_history"),
+                max_windows=int(cpc.get("max_windows", 64)),
+                max_bytes=int(cpc.get("max_bytes", 4 << 20)),
+                regression_tolerance=float(
+                    cpc.get("regression_tolerance", 0.25)),
+                min_scope_seconds=float(
+                    cpc.get("min_scope_seconds", 5e-5)),
+                flight=self._flight)
+            log_dist("continuous profiler armed (serving): every "
+                     f"{self._cprof.every_steps} steps / "
+                     f"{self._cprof.every_seconds}s, duty cycle <= "
+                     f"{self._cprof.max_duty_cycle:.2%}", ranks=[0])
         # run-level goodput ledger (docs/OBSERVABILITY.md "Goodput
         # ledger"): serving shares the same process-global run clock.
         # Enabled by the DSTPU_RUNLEDGER env (serve_supervisor's channel)
@@ -559,6 +590,7 @@ class ServingEngine:
         finished = self.scheduler.finished[done_before:]
         self._m_step_finished.set(len(finished))
         self._profilez_end()
+        self._cprof_tick()
         return finished
 
     def run(self) -> List[Request]:
@@ -1284,6 +1316,11 @@ class ServingEngine:
     def _profilez_begin(self) -> None:
         if self._pz is not None or self._pz_broker.pending is None:
             return
+        if self._cprof is not None and self._cprof.active:
+            # the operator wins the single global jax profiler session:
+            # the abandoned continuous window simply reschedules at its
+            # next cadence tick
+            self._cprof.close()
         req = self._pz_broker.claim()
         if req is None:
             return
@@ -1322,6 +1359,24 @@ class ServingEngine:
                 req, error=f"trace post-processing failed: {exc}")
             return
         self._pz_broker.resolve(req, summary=summary)
+
+    def _cprof_tick(self) -> None:
+        """End-of-iteration hook of the continuous profiler: close a
+        finished window (decompose + history commit run inline here,
+        between scheduler iterations), else open the next one when due —
+        a window opened now covers the NEXT iteration's dispatches.
+        Never opens while an operator /profilez request is pending or
+        claimed (jax has one global profiler session; the operator wins).
+        One attribute load + one branch when off."""
+        cp = self._cprof
+        if cp is None:
+            return
+        if cp.active:
+            cp.after_step(self.steps)
+            return
+        if self._pz is not None or self._pz_broker.pending is not None:
+            return
+        cp.maybe_begin(self.steps + 1)
 
     # ------------------------------------------------------------------
     # prefix caching (serving/prefix_cache.py)
@@ -2017,6 +2072,8 @@ class ServingEngine:
         dropped engine's server is also stopped by a GC finalizer, so
         ``close()`` is for deterministic shutdown, not a leak guard."""
         self.stop_loop()
+        if self._cprof is not None:
+            self._cprof.close()
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

@@ -684,6 +684,16 @@ def test_namespace_guard_all_metrics_documented(devices):
     from deepspeed_tpu.profiling import device_trace
 
     device_trace.ensure_registered(get_registry())
+    # ISSUE 20 families: the continuous-profiler ds_prof_* window gauges
+    # and counters (the labeled scope/regression series register at first
+    # use with labels, exercised by tests/unit/test_continuous_profiler)
+    from deepspeed_tpu.profiling import continuous
+
+    continuous.ensure_registered(get_registry())
+    get_registry().gauge("ds_prof_scope_device_seconds",
+                         labels={"scope": "fwd_bwd"}).set(0.0)
+    get_registry().counter("ds_prof_regressions_total",
+                           labels={"scope": "comm"})
     # ISSUE 7 families: the per-request phase-attribution histograms
     # (registered at tracer construction) and the training-numerics
     # step gauges (registered lazily at the optimizer boundary, so the

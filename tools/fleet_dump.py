@@ -13,6 +13,7 @@ per-replica SKEW (a hot replica reads directly off the skew column):
     python tools/fleet_dump.py --supervisor-status=sup.json url...
     python tools/fleet_dump.py --supervisor-status=sup.json  # status alone
     python tools/fleet_dump.py --trace router=u0 ra=u1 rb=u2 --out=m.json
+    python tools/fleet_dump.py --profiles ra=u1 rb=u2      # straggler view
     python tools/fleet_dump.py --selftest                  # parser self-check
 
 ``--trace`` switches to DISTRIBUTED-TRACE merge (docs/OBSERVABILITY.md
@@ -32,6 +33,15 @@ timestamps share that process's trace-session domain.  Every scrape and
 status output also carries a ``scraped_at`` ``{wall, mono}`` pair so a
 metrics view, a supervisor status, and a trace can be correlated in
 time; the rendered views show the resulting skew.
+
+``--profiles`` merges N replicas' CONTINUOUS-PROFILER histories
+(docs/OBSERVABILITY.md "Continuous profiling"): every source is scraped
+at ``/profilez/history`` (a non-URL source is a saved snapshot, a single
+window file, or a ``profile_history/`` ring directory), each window is
+placed on the FIRST source's unix clock via its ``clock`` anchors (the
+same anchor-shift contract as ``--trace``), and the view shows each
+replica's latest window plus the per-replica DEVICE-BUSY SKEW — a
+replica whose device-busy ratio trails the fleet is the straggler.
 
 ``--supervisor-status=<file>`` renders a supervisor's ``--status-file``
 JSON (either ``train_supervisor`` or ``serve_supervisor`` schema:
@@ -76,7 +86,8 @@ from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from metrics_dump import base_url, is_url, render_table  # noqa: E402
+from metrics_dump import (base_url, is_url,  # noqa: E402
+                          load_profile_history, render_table)
 
 
 def _load_metrics():
@@ -343,6 +354,120 @@ def merge_traces(docs: Dict[str, Dict[str, object]],
 
 
 # ---------------------------------------------------------------------------
+# continuous-profiler history merge (--profiles): N /profilez/history
+# snapshots onto the first source's unix clock + device-busy skew
+# ---------------------------------------------------------------------------
+
+
+def merge_profiles(histories: Dict[str, Dict[str, object]]
+                   ) -> Dict[str, object]:
+    """Merge ``{replica: /profilez/history snapshot}`` onto ONE clock.
+
+    Each window record carries its capture's ``clock`` anchors
+    (``window_unix_lo``/``window_unix_hi`` — wall time of the window's
+    span, the ``set_trace_clock_anchor()`` contract), so placement on the
+    first source's clock is the same pure shift as ``--trace``:
+    ``offset_s = window_unix_lo - ref_lo``.  The straggler signal is the
+    spread of the LATEST windows' device-busy ratios: a replica whose
+    device sits idle while its peers are busy reads directly off the
+    skew."""
+    if not histories:
+        raise ValueError("--profiles needs at least one source")
+    timeline: List[Dict[str, object]] = []
+    latest: Dict[str, Dict[str, object]] = {}
+    for name, snap in histories.items():
+        for w in snap.get("windows") or []:
+            rec = dict(w)
+            rec["replica"] = name
+            timeline.append(rec)
+            cur = latest.get(name)
+            if cur is None or (rec.get("seq") or 0) >= (cur.get("seq") or 0):
+                latest[name] = rec
+    ref = next(iter(histories))
+    ref_lo = None
+    for w in timeline:
+        if w["replica"] == ref:
+            lo = (w.get("clock") or {}).get("window_unix_lo")
+            if lo and (ref_lo is None or lo < ref_lo):
+                ref_lo = float(lo)
+    for w in timeline:
+        lo = (w.get("clock") or {}).get("window_unix_lo")
+        w["offset_s"] = (round(float(lo) - ref_lo, 6)
+                         if lo and ref_lo is not None else None)
+    timeline.sort(key=lambda w: (w.get("offset_s")
+                                 if w.get("offset_s") is not None else 0.0,
+                                 str(w["replica"])))
+    out: Dict[str, object] = {"reference": ref,
+                              "reference_unix_lo": ref_lo,
+                              "replicas": sorted(histories),
+                              "scraped_at": _stamp_now(),
+                              "windows": timeline,
+                              "latest": latest}
+    busy = [float(w.get("busy_ratio") or 0.0) for w in latest.values()]
+    if busy:
+        out["device_busy"] = _spread(busy)
+    return out
+
+
+def render_profiles(merged: Dict[str, object]) -> str:
+    latest = merged.get("latest") or {}
+    if not latest:
+        return ("(no continuous-profiler windows on any replica — is "
+                "continuous_profiler.enabled set?)")
+    rows = []
+    for name in sorted(latest):
+        w = latest[name]
+        off = w.get("offset_s")
+        rows.append([
+            name, str(w.get("engine", "")), str(w.get("seq", "")),
+            str(w.get("step", "")),
+            f"{float(w.get('window_s') or 0.0) * 1e3:.3f}",
+            f"{100 * float(w.get('busy_ratio') or 0.0):.2f}%",
+            f"{100 * float(w.get('coverage_ratio') or 0.0):.2f}%",
+            f"{100 * float(w.get('overhead_ratio') or 0.0):.2f}%",
+            _fmt(off) if off is not None else ""])
+    lines = [f"profiles: {len(merged.get('windows') or [])} window(s) "
+             f"from {len(latest)} replica(s), clock reference "
+             f"{merged.get('reference')}"]
+    lines += render_table(["replica", "engine", "seq", "step", "wall_ms",
+                           "busy", "coverage", "overhead", "offset_s"],
+                          rows)
+    busy = merged.get("device_busy")
+    if isinstance(busy, dict):
+        lines.append(f"device busy: min {100 * busy['min']:.2f}%  "
+                     f"max {100 * busy['max']:.2f}%  "
+                     f"mean {100 * busy['mean']:.2f}%  "
+                     f"skew {busy['skew']:.4g}"
+                     + ("  <- straggler signal" if busy["skew"] > 0.2
+                        else ""))
+    return "\n".join(lines)
+
+
+def profiles_main(args: List[str], flags: set) -> int:
+    """``--profiles``: scrape/load every source's continuous-profiler
+    history and render the merged straggler view (``--json`` for the
+    machine-readable merge)."""
+    histories: Dict[str, Dict[str, object]] = {}
+    for i, src in enumerate(args):
+        name, sep, rest = src.partition("=")
+        if sep and not name.startswith("http") and "/" not in name:
+            src = rest
+        else:
+            name = f"r{i}"
+        histories[name] = load_profile_history(src)
+    try:
+        merged = merge_profiles(histories)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if "--json" in flags:
+        print(json.dumps(merged, sort_keys=True, default=str))
+    else:
+        print(render_profiles(merged))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
 
@@ -539,6 +664,28 @@ def selftest() -> int:
         pass
     else:
         raise AssertionError("unknown --capture source must be rejected")
+    # continuous-profiler history merge: two replicas whose windows start
+    # 3s apart on the wall clock; the slow replica's low busy ratio must
+    # surface as device-busy skew and its window land at offset_s=3
+    def _pwin(seq, lo, busy):
+        return {"seq": seq, "engine": "serving", "step": 10 * seq,
+                "steps": 2, "window_s": 0.1, "busy_ratio": busy,
+                "coverage_ratio": 0.01, "overhead_ratio": 0.005,
+                "scopes": {"comm": 0.01},
+                "clock": {"anchor_unix": lo, "window_unix_lo": lo,
+                          "window_unix_hi": lo + 0.1}}
+    hist = {"ra": {"engines": ["serving"],
+                   "windows": [_pwin(1, 500.0, 0.9), _pwin(2, 600.0, 0.8)]},
+            "rb": {"engines": ["serving"],
+                   "windows": [_pwin(1, 503.0, 0.2)]}}
+    pm = merge_profiles(hist)
+    assert pm["reference"] == "ra" and pm["reference_unix_lo"] == 500.0
+    assert pm["latest"]["ra"]["seq"] == 2
+    offs = {(w["replica"], w["seq"]): w["offset_s"] for w in pm["windows"]}
+    assert offs[("rb", 1)] == 3.0 and offs[("ra", 1)] == 0.0
+    assert abs(pm["device_busy"]["skew"] - (0.8 - 0.2) / 0.5) < 1e-9
+    out = render_profiles(pm)
+    assert "straggler signal" in out and "rb" in out, out
     print("fleet_dump selftest: OK")
     return 0
 
@@ -604,6 +751,8 @@ def main(argv: List[str]) -> int:
         return selftest()
     if "--trace" in flags:
         return trace_main(args, flags)
+    if "--profiles" in flags:
+        return profiles_main(args, flags)
     # --supervisor-status=<file>: supervisor truth (ladder counters,
     # replica/child states) rendered next to the scrape — readable alone
     # too (a down fleet has no /statz to scrape, but the file survives)

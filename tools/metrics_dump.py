@@ -27,7 +27,12 @@ prints the slowest-exemplar table from the same host's ``/requestz``
 endpoint (or a saved ``/requestz`` snapshot file passed as the source):
 per request id, latency, the queue/prefill/decode/preempted-wait phase
 breakdown, preemption count and finish reason, plus the tail-attribution
-line — the "which requests were slow and why" view.  ``ds_mem_*``
+line — the "which requests were slow and why" view.  ``--profile``
+renders the latest continuous-profiler window (top scopes by per-step
+device-seconds, run coverage %, capture overhead %) from the host's
+``/profilez/history`` endpoint, a saved history snapshot, or a
+``profile_history/`` ring directory (docs/OBSERVABILITY.md "Continuous
+profiling").  ``ds_mem_*``
 byte gauges render humanized (GiB/MiB) in the value column;
 ``ds_train_mfu`` and ``*_ratio`` histogram columns render as percentages.
 
@@ -55,7 +60,8 @@ def base_url(src: str) -> str:
     known (fleet_dump imports it too)."""
     url = src if src.startswith("http") else f"http://{src}"
     url = url.split("?", 1)[0].split("#", 1)[0].rstrip("/")
-    for suffix in ("/metrics", "/statz", "/requestz", "/profilez"):
+    for suffix in ("/metrics", "/statz", "/requestz", "/profilez/history",
+                   "/profilez"):
         if url.endswith(suffix):
             url = url[: -len(suffix)]
     return url
@@ -287,6 +293,86 @@ def load_requestz(src: str) -> Dict[str, object]:
         return json.load(fh)
 
 
+def load_profile_history(src: str) -> Dict[str, object]:
+    """The ``/profilez/history`` snapshot from a live endpoint, a saved
+    snapshot JSON, a single window file, or a ``profile_history/`` ring
+    directory (read directly — the on-disk window files ARE the scrape
+    payload, one JSON per window)."""
+    if is_url(src):
+        import urllib.request
+
+        with urllib.request.urlopen(base_url(src) + "/profilez/history",
+                                    timeout=5) as resp:
+            return json.load(resp)
+    if os.path.isdir(src):
+        windows = []
+        for fn in sorted(os.listdir(src)):
+            if fn.startswith("ds_prof_window_") and fn.endswith(".json"):
+                try:
+                    with open(os.path.join(src, fn)) as fh:
+                        windows.append(json.load(fh))
+                except (OSError, ValueError):
+                    pass         # pruned underneath us, or torn by a crash
+        engines = sorted({w.get("engine") for w in windows
+                          if w.get("engine")})
+        return {"engines": engines, "windows": windows}
+    with open(src) as fh:
+        data = json.load(fh)
+    if "windows" in data:
+        return data
+    return {"engines": [data.get("engine")] if data.get("engine") else [],
+            "windows": [data]}          # a single saved window file
+
+
+def profile_rows(window: Dict[str, object]) -> List[List[str]]:
+    """Top-scope rows [scope, per_step_ms, share] for one window record,
+    sorted by per-step device-seconds descending."""
+    scopes = sorted((window.get("scopes") or {}).items(),
+                    key=lambda kv: -kv[1])
+    steps = window.get("steps") or 1
+    wall = float(window.get("window_s") or 0.0) / max(1, steps)
+    rows = []
+    for name, sec in scopes:
+        if sec <= 0.0:
+            continue
+        share = f"{100.0 * sec / wall:.1f}%" if wall else ""
+        rows.append([name, f"{sec * 1e3:.4f}", share])
+    return rows
+
+
+def render_profile(snap: Dict[str, object]) -> str:
+    """Latest-window view of a ``/profilez/history`` snapshot: one block
+    per engine kind (a process can run both a training and a serving
+    profiler), each with the coverage/overhead line and the top-scope
+    table."""
+    windows = snap.get("windows") or []
+    if not windows:
+        return ("(no continuous-profiler windows — is the profiler "
+                "enabled? config continuous_profiler.enabled)")
+    latest: Dict[str, Dict[str, object]] = {}
+    for w in windows:                    # windows arrive oldest-first
+        latest[str(w.get("engine"))] = w
+    blocks = []
+    for engine in sorted(latest):
+        w = latest[engine]
+        head = (f"engine={engine} window #{w.get('seq', '?')} "
+                f"step={w.get('step')}: {w.get('steps')} step(s), "
+                f"{float(w.get('window_s') or 0.0) * 1e3:.3f}ms wall, "
+                f"device busy {100 * float(w.get('busy_ratio') or 0):.2f}%")
+        lines = [head]
+        if w.get("degraded"):
+            lines.append("NOTE: degraded (host-range attribution only)")
+        lines.append(
+            f"run coverage {100 * float(w.get('coverage_ratio') or 0):.2f}%"
+            f", capture overhead "
+            f"{100 * float(w.get('overhead_ratio') or 0):.2f}%")
+        rows = profile_rows(w)
+        if rows:
+            lines += render_table(["scope", "per_step_ms", "share"], rows)
+        blocks.append("\n".join(lines))
+    return "\n\n".join(blocks)
+
+
 def requests_rows(snap: Dict[str, object]) -> List[List[str]]:
     """Slowest-exemplar rows [id, latency, queue, prefill, decode,
     preempted_wait, toks, preempts, reason] from a ``/requestz``
@@ -393,6 +479,11 @@ def main(argv: List[str]) -> int:
         # the source here is the /requestz surface (a URL is normalized to
         # it; a file is a saved /requestz snapshot), not a /statz snapshot
         print(render_requests(load_requestz(args[0])))
+        return 0
+    if "--profile" in flags:
+        # likewise the /profilez/history surface: a URL normalizes to it,
+        # a directory is the on-disk profile_history/ ring itself
+        print(render_profile(load_profile_history(args[0])))
         return 0
     metrics = load_snapshot(args[0])
     if not metrics:

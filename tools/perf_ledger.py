@@ -14,7 +14,16 @@ JSON.  This turns the archive into a gate:
     python tools/perf_ledger.py --dir=/path        # ledgers elsewhere
     python tools/perf_ledger.py --tolerance=0.15   # global tolerance
     python tools/perf_ledger.py --tolerance=tokens_per_sec=0.05
+    python tools/perf_ledger.py --profile-history=profile_history --check
     python tools/perf_ledger.py --selftest         # fixture must fail
+
+``--profile-history=<dir>`` gates a CONTINUOUS-PROFILER ring instead of
+the bench trajectory (docs/OBSERVABILITY.md "Continuous profiling"): the
+newest two ``ds_prof_window_*.json`` window records are compared with
+the profiler's own window differ — per-scope per-step device-seconds,
+the same substring-matched ``--tolerance`` rules, lower-is-better — so
+the on-disk history the live engine writes and the offline gate share
+ONE tolerance contract.  ``--check`` exits 1 when any scope regressed.
 
 What is parsed (keyed by the bench summary's block names — the same
 tuple DSL004 pins as the ``summary_lines`` victim order):
@@ -352,6 +361,56 @@ def selftest() -> int:
 
 
 # ---------------------------------------------------------------------------
+# --profile-history: gate a continuous-profiler ring with the profiler's
+# own window differ (shared tolerance semantics)
+# ---------------------------------------------------------------------------
+
+
+def _load_continuous():
+    """The continuous-profiler offline half, via trace_report's no-jax
+    stub loader — ONE copy of the path-loading idiom in the toolchain."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    return trace_report.continuous
+
+
+def profile_history_main(directory: str, flags: List[str],
+                         default_tol: Optional[float],
+                         tolerances: List[Tuple[str, float]]) -> int:
+    continuous = _load_continuous()
+    windows = continuous.HistoryRing(directory).latest(2)
+    if len(windows) < 2:
+        print(f"need >= 2 windows under {directory}, have {len(windows)}",
+              file=sys.stderr)
+        return 2
+    prev, cur = windows[-2], windows[-1]
+    regs = continuous.diff_windows(
+        prev, cur,
+        default_tol=(default_tol if default_tol is not None
+                     else continuous.DEFAULT_TOLERANCE),
+        tolerances=tolerances)
+    if "--json" in flags:
+        print(json.dumps({"prev_seq": prev.get("seq"),
+                          "cur_seq": cur.get("seq"),
+                          "regressions": regs}, sort_keys=True))
+    else:
+        print(f"profile history {directory}: window "
+              f"#{prev.get('seq', '?')} -> #{cur.get('seq', '?')}, "
+              f"{len(regs)} scope regression(s)")
+        for r in regs:
+            print(f"REGRESSION scope {r['scope']}: "
+                  f"{r['prev_s']:g}s -> {r['cur_s']:g}s per step, "
+                  f"{100 * r['rel']:+.1f}% vs lower-is-better tolerance "
+                  f"{100 * r['tol']:.0f}%")
+    if "--check" in flags and regs:
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv: List[str]) -> int:
@@ -363,11 +422,14 @@ def main(argv: List[str]) -> int:
     if "--selftest" in flags:
         return selftest()
     ledger_dir = _REPO
-    default_tol = 0.10
+    default_tol: Optional[float] = None    # mode default when unset
     tolerances: List[Tuple[str, float]] = []
+    profile_dir: Optional[str] = None
     for f in flags:
         if f.startswith("--dir="):
             ledger_dir = f.split("=", 1)[1]
+        elif f.startswith("--profile-history="):
+            profile_dir = f.split("=", 1)[1]
         elif f.startswith("--tolerance="):
             spec = f.split("=", 1)[1]
             name, sep, val = spec.rpartition("=")
@@ -379,6 +441,11 @@ def main(argv: List[str]) -> int:
             except ValueError:
                 print(f"bad tolerance: {spec}", file=sys.stderr)
                 return 2
+    if profile_dir is not None:
+        return profile_history_main(profile_dir, flags, default_tol,
+                                    tolerances)
+    if default_tol is None:
+        default_tol = 0.10
     traj = load_trajectory(ledger_dir)
     if not traj["runs"]:
         print(f"no BENCH_*/MULTICHIP_* ledgers under {ledger_dir}",

@@ -8,6 +8,7 @@ The same analysis ``/profilez`` runs on a live engine
     python tools/trace_report.py /tmp/ds_trace --steps 2  # per-step columns
     python tools/trace_report.py /tmp/ds_trace --json     # machine-readable
     python tools/trace_report.py --timeline export.json   # span-lane render
+    python tools/trace_report.py --history profile_history  # continuous ring
 
 ``--timeline`` renders a TRACE-EVENT EXPORT instead of a device trace:
 anything emitted through the repo's shared perfetto envelope — a
@@ -86,6 +87,33 @@ def _load_device_trace():
 
 
 device_trace = _load_device_trace()
+
+
+def _load_continuous():
+    """The continuous-profiler offline half (history ring + window
+    differ + render), same no-jax contract: reuse the live module when
+    the package is imported, else load ``continuous.py`` by file path
+    under the ``_dst`` stubs (its relative ``from .device_trace import``
+    resolves against the module loaded above)."""
+    if "deepspeed_tpu" in sys.modules:
+        from deepspeed_tpu.profiling import continuous
+
+        return continuous
+    mod = sys.modules.get("_dst.profiling.continuous")
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(_REPO, "deepspeed_tpu", "profiling", "continuous.py")
+    spec = importlib.util.spec_from_file_location(
+        "_dst.profiling.continuous", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_dst.profiling.continuous"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+continuous = _load_continuous()
 
 
 def _table(header: List[str], rows: List[List[str]]) -> str:
@@ -341,8 +369,59 @@ def _selftest_in(d: str) -> int:
     assert "ds_train_steps:steps" in tt and "step 1" in tt \
         and "anomaly_skip" in tt, tt
     print(tt)
+    # --history: a two-window ring with a seeded comm regression must
+    # name the scope; a clean twin must stay quiet (the golden-fixture
+    # contract the live differ shares)
+    hist = os.path.join(d, "profile_history")
+    base = {"engine": "train", "step": 10, "steps": 2, "window_s": 0.2,
+            "device_busy_s": 0.18, "busy_ratio": 0.9,
+            "coverage_ratio": 0.01, "overhead_ratio": 0.004,
+            "scopes": {"fwd_bwd": 0.06, "optimizer": 0.01,
+                       "comm": 0.02, "other": 0.005, "gap": 0.005}}
+    ring = continuous.HistoryRing(hist)
+    ring.append(json.loads(json.dumps(base)))
+    slow = json.loads(json.dumps(base))
+    slow["step"] = 20
+    slow["scopes"]["comm"] = 0.04          # +100% > 25% tolerance
+    ring.append(slow)
+    ht = render_history(hist)
+    assert "REGRESSIONS" in ht and "comm:" in ht, ht
+    clean = os.path.join(d, "profile_history_clean")
+    cring = continuous.HistoryRing(clean)
+    cring.append(json.loads(json.dumps(base)))
+    cring.append(json.loads(json.dumps(base)))
+    assert "no regressions" in render_history(clean)
+    print(ht)
     print("trace_report selftest: OK")
     return 0
+
+
+def render_history(directory: str, n: int = 2) -> str:
+    """The newest continuous-profiler windows from a ``profile_history/``
+    ring directory (docs/OBSERVABILITY.md "Continuous profiling"): the
+    latest window rendered in full, plus the window-over-window differ
+    verdict against its predecessor — the same differ that fires the
+    ``prof_regression`` flight event on the live engine."""
+    windows = continuous.HistoryRing(directory).latest(max(2, n))
+    if not windows:
+        return (f"(no ds_prof_window_*.json in {directory} — is the "
+                "continuous profiler enabled?)")
+    out = [continuous.render_window(windows[-1])]
+    if len(windows) >= 2:
+        regs = continuous.diff_windows(windows[-2], windows[-1])
+        if regs:
+            out.append("")
+            out.append("REGRESSIONS vs window "
+                       f"#{windows[-2].get('seq', '?')}:")
+            for r in regs:
+                out.append(f"  {r['scope']}: {r['prev_s'] * 1e3:.4f}ms -> "
+                           f"{r['cur_s'] * 1e3:.4f}ms per step "
+                           f"(+{100 * r['rel']:.1f}%, tol "
+                           f"{100 * r['tol']:.0f}%)")
+        else:
+            out.append(f"no regressions vs window "
+                       f"#{windows[-2].get('seq', '?')}")
+    return "\n".join(out)
 
 
 def main(argv: List[str]) -> int:
@@ -361,9 +440,24 @@ def main(argv: List[str]) -> int:
                          "(/requestz perfetto, step timeline, or a "
                          "fleet_dump --trace merge) instead of a device "
                          "trace dir")
+    ap.add_argument("--history", action="store_true",
+                    help="render the argument as a continuous-profiler "
+                         "profile_history/ ring directory: newest window "
+                         "+ window-over-window regression verdict")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of tables")
     ns = ap.parse_args(argv[1:])
+    if ns.history:
+        if ns.json:
+            windows = continuous.HistoryRing(ns.trace).latest(2)
+            print(json.dumps(
+                {"windows": windows,
+                 "regressions": (continuous.diff_windows(*windows[-2:])
+                                 if len(windows) >= 2 else [])},
+                sort_keys=True))
+        else:
+            print(render_history(ns.trace))
+        return 0
     if ns.timeline:
         try:
             doc = load_timeline(ns.trace)
